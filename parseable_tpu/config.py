@@ -1,0 +1,263 @@
+"""Options / CLI configuration.
+
+Re-creation of the reference CLI surface (reference: src/cli.rs:70-641,
+src/option.rs) as a dataclass populated from `P_*` environment variables and
+argparse flags.  Env-var names are kept identical to the reference so existing
+deployments can switch over without config changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import uuid
+from dataclasses import dataclass, field, fields
+from enum import Enum
+from pathlib import Path
+
+
+class Mode(str, Enum):
+    """Server modes (reference: src/option.rs Mode enum, main.rs:54-70)."""
+
+    ALL = "all"
+    INGEST = "ingest"
+    QUERY = "query"
+    # index/prism are enterprise-only in the reference; accepted but mapped
+    INDEX = "index"
+    PRISM = "prism"
+
+    def to_str(self) -> str:
+        return {
+            Mode.ALL: "All",
+            Mode.INGEST: "Ingest",
+            Mode.QUERY: "Query",
+            Mode.INDEX: "Index",
+            Mode.PRISM: "Prism",
+        }[self]
+
+
+class Compression(str, Enum):
+    """Parquet compression (reference: src/cli.rs:456-463; default lz4_raw)."""
+
+    UNCOMPRESSED = "uncompressed"
+    SNAPPY = "snappy"
+    GZIP = "gzip"
+    LZO = "lzo"
+    BROTLI = "brotli"
+    LZ4 = "lz4"
+    LZ4_RAW = "lz4_raw"
+    ZSTD = "zstd"
+
+    def to_parquet(self) -> str:
+        """Map to a pyarrow parquet codec name."""
+        return {
+            Compression.UNCOMPRESSED: "none",
+            Compression.SNAPPY: "snappy",
+            Compression.GZIP: "gzip",
+            Compression.LZO: "snappy",  # lzo unsupported by pyarrow; nearest
+            Compression.BROTLI: "brotli",
+            Compression.LZ4: "lz4",
+            Compression.LZ4_RAW: "lz4_raw",
+            Compression.ZSTD: "zstd",
+        }[self]
+
+
+def _env(name: str, default: str | None = None) -> str | None:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = _env(name)
+    return int(v) if v is not None else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = _env(name)
+    return float(v) if v is not None else default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = _env(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Options:
+    """All server options. Defaults mirror the reference (src/cli.rs:135-641)."""
+
+    # --- identity / addresses -------------------------------------------------
+    address: str = field(default_factory=lambda: _env("P_ADDR", "0.0.0.0:8000"))
+    ingestor_endpoint: str = field(default_factory=lambda: _env("P_INGESTOR_ENDPOINT", ""))
+    querier_endpoint: str = field(default_factory=lambda: _env("P_QUERIER_ENDPOINT", ""))
+    flight_port: int = field(default_factory=lambda: _env_int("P_FLIGHT_PORT", 8002))
+    grpc_port: int = field(default_factory=lambda: _env_int("P_GRPC_PORT", 8001))
+    mode: Mode = field(default_factory=lambda: Mode(_env("P_MODE", "all").lower()))
+
+    # --- auth -----------------------------------------------------------------
+    username: str = field(default_factory=lambda: _env("P_USERNAME", "admin"))
+    password: str = field(default_factory=lambda: _env("P_PASSWORD", "admin"))
+
+    # --- staging --------------------------------------------------------------
+    local_staging_path: Path = field(
+        default_factory=lambda: Path(_env("P_STAGING_DIR", "./staging"))
+    )
+    # rows buffered in the arrow writer before a disk write
+    # (reference: parseable/streams.rs:77-121 DISK_WRITE_BATCH_ROWS)
+    disk_write_batch_rows: int = field(
+        default_factory=lambda: _env_int("P_DISK_WRITE_BATCH_ROWS", 10_000)
+    )
+    max_arrow_files_per_parquet: int = field(
+        default_factory=lambda: _env_int("P_MAX_ARROW_FILES_PER_PARQUET", 20)
+    )
+    enable_memory_staging: bool = field(
+        default_factory=lambda: _env_bool("P_ENABLE_MEMORY_STAGING", False)
+    )
+
+    # --- parquet --------------------------------------------------------------
+    # (reference: src/cli.rs:440-463)
+    row_group_size: int = field(default_factory=lambda: _env_int("P_PARQUET_ROW_GROUP_SIZE", 262_144))
+    parquet_compression: Compression = field(
+        default_factory=lambda: Compression(_env("P_PARQUET_COMPRESSION_ALGO", "lz4_raw"))
+    )
+
+    # --- query ----------------------------------------------------------------
+    # (reference: src/cli.rs:210-228,448-454; src/query/mod.rs:216-226)
+    execution_batch_size: int = field(
+        default_factory=lambda: _env_int("P_EXECUTION_BATCH_SIZE", 20_000)
+    )
+    query_timeout_secs: int = field(default_factory=lambda: _env_int("P_QUERY_TIMEOUT", 300))
+    query_memory_limit_bytes: int | None = field(
+        default_factory=lambda: (
+            int(v) if (v := _env("P_QUERY_MEMORY_LIMIT")) is not None else None
+        )
+    )
+    # "tpu" ships pruned row blocks to device kernels; "cpu" uses the
+    # pyarrow-compute fallback engine (the measured baseline).
+    query_engine: str = field(default_factory=lambda: _env("P_QUERY_ENGINE", "tpu"))
+
+    # --- ingest ---------------------------------------------------------------
+    # (reference: src/cli.rs:576-583 max payload; event flatten depth)
+    max_event_payload_bytes: int = field(
+        default_factory=lambda: _env_int("P_MAX_EVENT_PAYLOAD_SIZE", 10 * 1024 * 1024)
+    )
+    event_flatten_level: int = field(default_factory=lambda: _env_int("P_MAX_FLATTEN_LEVEL", 10))
+    # max age (hours) of an event's time-partition value relative to the first
+    # seen timestamp (reference: utils/json/flatten.rs validate_time_partition)
+    event_max_chunk_age: int = field(default_factory=lambda: _env_int("P_EVENT_MAX_CHUNK_AGE", 24))
+    dataset_fields_allowed_limit: int = field(
+        default_factory=lambda: _env_int("P_DATASET_FIELD_COUNT_LIMIT", 250)
+    )
+
+    # --- hot tier -------------------------------------------------------------
+    # (reference: src/cli.rs:350-375)
+    hot_tier_storage_path: Path | None = field(
+        default_factory=lambda: (Path(v) if (v := _env("P_HOT_TIER_DIR")) else None)
+    )
+    hot_tier_download_chunk_bytes: int = field(
+        default_factory=lambda: _env_int("P_HOT_TIER_CHUNK_SIZE", 8 * 1024 * 1024)
+    )
+    hot_tier_download_concurrency: int = field(
+        default_factory=lambda: _env_int("P_HOT_TIER_CONCURRENCY", 16)
+    )
+
+    # --- object storage upload ------------------------------------------------
+    multipart_threshold_bytes: int = field(
+        default_factory=lambda: _env_int("P_MULTIPART_THRESHOLD", 25 * 1024 * 1024)
+    )
+    upload_concurrency: int = field(default_factory=lambda: _env_int("P_UPLOAD_CONCURRENCY", 8))
+
+    # --- sync intervals (overridable for tests) -------------------------------
+    local_sync_interval_secs: int = field(default_factory=lambda: _env_int("P_LOCAL_SYNC_INTERVAL", 60))
+    upload_interval_secs: int = field(default_factory=lambda: _env_int("P_STORAGE_UPLOAD_INTERVAL", 30))
+
+    # --- TPU / mesh -----------------------------------------------------------
+    # Logical mesh axes for the query reduce tree ("data" shards row blocks).
+    mesh_shape: str = field(default_factory=lambda: _env("P_TPU_MESH", ""))
+    # pad row blocks to this many rows before shipping to device (static shapes)
+    device_block_rows: int = field(default_factory=lambda: _env_int("P_TPU_BLOCK_ROWS", 1 << 20))
+
+    # --- misc -----------------------------------------------------------------
+    check_update: bool = field(default_factory=lambda: _env_bool("P_CHECK_UPDATE", True))
+    send_analytics: bool = field(default_factory=lambda: _env_bool("P_SEND_ANONYMOUS_USAGE_DATA", False))
+    cpu_threshold_pct: float = field(default_factory=lambda: _env_float("P_CPU_THRESHOLD", 90.0))
+    memory_threshold_pct: float = field(default_factory=lambda: _env_float("P_MEMORY_THRESHOLD", 90.0))
+    openai_api_key: str | None = field(default_factory=lambda: _env("P_OPENAI_API_KEY"))
+
+    def staging_dir(self) -> Path:
+        self.local_staging_path.mkdir(parents=True, exist_ok=True)
+        return self.local_staging_path
+
+
+@dataclass
+class StorageOptions:
+    """Which storage backend to use + its parameters.
+
+    Reference models this as the clap subcommand
+    (`parseable {local-store|s3-store|blob-store|gcs-store}`; src/cli.rs:76-132).
+    """
+
+    backend: str = "local-store"  # local-store | s3-store | gcs-store | blob-store
+    # local-store
+    root: Path = field(default_factory=lambda: Path(_env("P_FS_DIR", "./data")))
+    # s3/gcs/blob
+    bucket: str | None = field(default_factory=lambda: _env("P_S3_BUCKET") or _env("P_GCS_BUCKET"))
+    region: str | None = field(default_factory=lambda: _env("P_S3_REGION"))
+    endpoint_url: str | None = field(default_factory=lambda: _env("P_S3_URL"))
+    access_key: str | None = field(default_factory=lambda: _env("P_S3_ACCESS_KEY"))
+    secret_key: str | None = field(default_factory=lambda: _env("P_S3_SECRET_KEY"))
+
+
+def generate_node_id() -> str:
+    """ULID-like unique node id (reference uses ULID; modal/mod.rs:297-601)."""
+    return uuid.uuid4().hex
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parseable-tpu",
+        description="TPU-native observability data lake (parseable-compatible API)",
+    )
+    sub = p.add_subparsers(dest="backend")
+    for name in ("local-store", "s3-store", "gcs-store", "blob-store"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--fs-dir", default=None, help="root dir for local-store")
+        sp.add_argument("--bucket", default=None)
+    p.add_argument("--mode", default=None, choices=[m.value for m in Mode])
+    p.add_argument("--address", default=None)
+    p.add_argument("--staging-dir", default=None)
+    p.add_argument("--query-engine", default=None, choices=["tpu", "cpu"])
+    return p
+
+
+def parse_cli(argv: list[str] | None = None) -> tuple[Options, StorageOptions]:
+    args = build_parser().parse_args(argv)
+    opts = Options()
+    if args.mode:
+        opts.mode = Mode(args.mode)
+    if args.address:
+        opts.address = args.address
+    if args.staging_dir:
+        opts.local_staging_path = Path(args.staging_dir)
+    if args.query_engine:
+        opts.query_engine = args.query_engine
+    storage = StorageOptions()
+    if args.backend:
+        storage.backend = args.backend
+        if getattr(args, "fs_dir", None):
+            storage.root = Path(args.fs_dir)
+        if getattr(args, "bucket", None):
+            storage.bucket = args.bucket
+    return opts, storage
+
+
+def options_summary(opts: Options) -> dict:
+    out = {}
+    for f in fields(opts):
+        v = getattr(opts, f.name)
+        if f.name == "password":
+            v = "***"
+        out[f.name] = str(v)
+    return out

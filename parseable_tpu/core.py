@@ -1,0 +1,354 @@
+"""L3 — core service state ("PARSEABLE" in the reference).
+
+Glues options + storage + metastore + stream registry, and owns the
+staging->parquet->object-store->catalog pipeline:
+
+- stream CRUD & schema commit        (reference: parseable/mod.rs:450-1158)
+- `upload_files_from_staging`        (reference: object_storage.rs:1024-1139)
+- `update_snapshot`                  (reference: catalog/mod.rs:108-497)
+
+Distributed layout note: every ingestor writes its *own* `.stream.json`
+(`ingestor.<id>.stream.json`), and queriers merge all nodes' snapshots at
+scan time — object storage is the rendezvous, no direct coordination.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from datetime import UTC, datetime, timedelta
+from pathlib import Path
+
+import pyarrow as pa
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.catalog import (
+    Manifest,
+    ManifestItem,
+    create_from_parquet_file,
+    partition_path,
+)
+from parseable_tpu.config import Mode, Options, StorageOptions, generate_node_id
+from parseable_tpu.event.format import LogSource, SchemaVersion
+from parseable_tpu.metastore import MetastoreError, ObjectStoreMetastore
+from parseable_tpu.storage import ObjectStoreFormat, rfc3339_now
+from parseable_tpu.storage.object_storage import UploadPool, make_provider
+from parseable_tpu.streams import LogStreamMetadata, Stream, Streams
+from parseable_tpu.utils.arrowutil import merge_schemas
+from parseable_tpu.utils.metrics import EVENTS_STORAGE_SIZE_DATE, LIFETIME_EVENTS_STORAGE_SIZE, STORAGE_SIZE
+
+logger = logging.getLogger(__name__)
+
+# stream name rules (reference: src/validator.rs)
+_STREAM_NAME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9_-]*$")
+_INTERNAL_NAMES = {"pmeta", "pstats"}
+MAX_STREAM_NAME_LEN = 100
+
+
+class StreamError(Exception):
+    pass
+
+
+class StreamNotFound(StreamError):
+    pass
+
+
+def validate_stream_name(name: str, internal_ok: bool = False) -> None:
+    if not name or len(name) > MAX_STREAM_NAME_LEN:
+        raise StreamError(f"invalid stream name length: {name!r}")
+    if name.lower() in _INTERNAL_NAMES and not internal_ok:
+        raise StreamError(f"stream name {name!r} is reserved")
+    if not _STREAM_NAME_RE.match(name):
+        raise StreamError(
+            f"stream name {name!r} invalid: must start with a letter and use only "
+            "alphanumerics, '-' or '_'"
+        )
+
+
+class Parseable:
+    """The service god-object (reference: parseable/mod.rs:139-267)."""
+
+    def __init__(self, options: Options | None = None, storage_options: StorageOptions | None = None):
+        self.options = options or Options()
+        self.storage_options = storage_options or StorageOptions()
+        self.provider = make_provider(
+            self.storage_options.backend,
+            root=self.storage_options.root,
+            bucket=self.storage_options.bucket,
+            region=self.storage_options.region,
+            endpoint=self.storage_options.endpoint_url,
+        )
+        self.storage = self.provider.construct_client()
+        self.metastore = ObjectStoreMetastore(self.storage)
+        self.node_id = self._load_or_create_node_id()
+        ingestor_id = self.node_id if self.options.mode == Mode.INGEST else None
+        self.streams = Streams(self.options, ingestor_id)
+        self.uploader = UploadPool(self.storage, self.options.upload_concurrency)
+
+    # ------------------------------------------------------------------ node
+
+    def _load_or_create_node_id(self) -> str:
+        """Node identity persisted in staging, stable across restarts
+        (reference: modal/mod.rs:388-452)."""
+        path = self.options.staging_dir() / ".node.json"
+        if path.is_file():
+            import json
+
+            try:
+                return json.loads(path.read_text())["node_id"]
+            except (KeyError, ValueError):
+                pass
+        node_id = generate_node_id()
+        import json
+
+        path.write_text(json.dumps({"node_id": node_id, "created_at": rfc3339_now()}))
+        return node_id
+
+    def register_node(self, address: str) -> None:
+        node_type = {Mode.INGEST: "ingestor", Mode.QUERY: "querier"}.get(
+            self.options.mode, "all"
+        )
+        self.metastore.put_node(
+            {
+                "node_id": self.node_id,
+                "node_type": node_type,
+                "domain_name": f"http://{address}",
+                "mode": self.options.mode.to_str(),
+                "registered_at": rfc3339_now(),
+            }
+        )
+
+    # --------------------------------------------------------------- streams
+
+    @property
+    def _node_suffix(self) -> str | None:
+        """Ingestors write per-node stream jsons; all/query write the base."""
+        return self.node_id if self.options.mode == Mode.INGEST else None
+
+    def create_stream_if_not_exists(
+        self,
+        name: str,
+        stream_type: str = "UserDefined",
+        log_source: LogSource = LogSource.JSON,
+        time_partition: str | None = None,
+        custom_partition: str | None = None,
+        static_schema: pa.Schema | None = None,
+        telemetry_type: str = "logs",
+    ) -> Stream:
+        existing = self.streams.get(name)
+        if existing is not None:
+            return existing
+        validate_stream_name(name, internal_ok=stream_type == "Internal")
+        # check object store for an existing definition (distributed bootstrap)
+        meta = None
+        try:
+            fmts = self.metastore.get_all_stream_jsons(name)
+        except MetastoreError:
+            fmts = []
+        if fmts:
+            meta = self._metadata_from_format(fmts[0])
+            schema = self.metastore.get_schema(name)
+            if schema is not None:
+                meta.schema = {f.name: f for f in schema}
+        if meta is None:
+            meta = LogStreamMetadata(
+                time_partition=time_partition,
+                custom_partition=custom_partition,
+                stream_type=stream_type,
+                log_source=[log_source],
+                telemetry_type=telemetry_type,
+                created_at=rfc3339_now(),
+            )
+            if static_schema is not None:
+                meta.schema = {f.name: f for f in static_schema}
+                meta.static_schema_flag = True
+            fmt = ObjectStoreFormat(
+                created_at=meta.created_at,
+                time_partition=time_partition,
+                custom_partition=custom_partition,
+                static_schema_flag=meta.static_schema_flag,
+                stream_type=stream_type,
+                log_source=[{"log_source_format": log_source.value, "fields": []}],
+                telemetry_type=telemetry_type,
+            )
+            self.metastore.put_stream_json(name, fmt, self._node_suffix)
+            if static_schema is not None:
+                self.metastore.put_schema(name, static_schema)
+        return self.streams.get_or_create(name, meta)
+
+    @staticmethod
+    def _metadata_from_format(fmt: ObjectStoreFormat) -> LogStreamMetadata:
+        return LogStreamMetadata(
+            schema_version=SchemaVersion(fmt.schema_version)
+            if fmt.schema_version in ("v0", "v1")
+            else SchemaVersion.V1,
+            time_partition=fmt.time_partition,
+            time_partition_limit_days=int(fmt.time_partition_limit.rstrip("d"))
+            if fmt.time_partition_limit
+            else None,
+            custom_partition=fmt.custom_partition,
+            static_schema_flag=fmt.static_schema_flag,
+            stream_type=fmt.stream_type,
+            log_source=[
+                LogSource.from_str(e.get("log_source_format", "json")) for e in fmt.log_source
+            ],
+            telemetry_type=fmt.telemetry_type,
+            created_at=fmt.created_at,
+            first_event_at=fmt.first_event_at,
+            retention=fmt.retention,
+            hot_tier_enabled=fmt.hot_tier_enabled,
+            infer_timestamp=fmt.infer_timestamp,
+        )
+
+    def get_stream(self, name: str) -> Stream:
+        s = self.streams.get(name)
+        if s is None:
+            raise StreamNotFound(f"stream {name!r} not found")
+        return s
+
+    def load_streams_from_storage(self) -> list[str]:
+        """Query-mode bootstrap: instantiate every stream known to storage."""
+        names = self.metastore.list_streams()
+        for name in names:
+            if self.streams.contains(name):
+                continue
+            fmts = self.metastore.get_all_stream_jsons(name)
+            if not fmts:
+                continue
+            meta = self._metadata_from_format(fmts[0])
+            schema = self.metastore.get_schema(name)
+            if schema is not None:
+                meta.schema = {f.name: f for f in schema}
+            self.streams.get_or_create(name, meta)
+        return names
+
+    # ---------------------------------------------------------------- schema
+
+    def commit_schema(self, stream_name: str, new_schema: pa.Schema) -> None:
+        """Merge batch schema into the stream schema and persist
+        (reference: event/mod.rs:158, object_storage.rs:1368)."""
+        stream = self.get_stream(stream_name)
+        current = pa.schema(list(stream.metadata.schema.values())) if stream.metadata.schema else pa.schema([])
+        merged = merge_schemas([current, new_schema])
+        stream.metadata.schema = {f.name: f for f in merged}
+        self.metastore.put_schema(stream_name, merged)
+
+    # ----------------------------------------------------------------- sync
+
+    def local_sync(self, shutdown: bool = False) -> None:
+        """60 s tick: flush arrows + convert to parquet (sync.rs:244-313)."""
+        self.streams.flush_and_convert(shutdown)
+
+    def upload_files_from_staging(self, stream: Stream) -> list[str]:
+        """30 s tick per stream: upload parquet, update catalog, delete staged
+        (reference: object_storage.rs:1024-1139 + catalog update)."""
+        uploaded: list[str] = []
+        files = stream.parquet_files()
+        if not files:
+            return uploaded
+        futures = []
+        for f in files:
+            key = stream.stream_relative_path(f)
+            futures.append((f, key, self.uploader.submit(key, f)))
+        manifest_files = []
+        for f, key, fut in futures:
+            try:
+                fut.result()
+            except Exception:
+                logger.exception("upload failed for %s; will retry next cycle", f)
+                continue
+            entry = create_from_parquet_file(self.storage.absolute_url(key), f)
+            manifest_files.append(entry)
+            uploaded.append(key)
+            f.unlink(missing_ok=True)
+        if manifest_files:
+            self.update_snapshot(stream, manifest_files)
+        return uploaded
+
+    def sync_all_streams(self) -> None:
+        for name in self.streams.list_names():
+            try:
+                self.upload_files_from_staging(self.get_stream(name))
+            except Exception:
+                logger.exception("object store sync failed for %s", name)
+
+    # --------------------------------------------------------------- catalog
+
+    @staticmethod
+    def _file_time_bounds(entry) -> tuple[datetime, datetime]:
+        for col in entry.columns:
+            if col.name == DEFAULT_TIMESTAMP_KEY and col.stats is not None:
+                lo = datetime.fromtimestamp(col.stats.min / 1000, UTC)
+                hi = datetime.fromtimestamp(col.stats.max / 1000, UTC)
+                return lo, hi
+        now = datetime.now(UTC)
+        return now, now
+
+    def update_snapshot(self, stream: Stream, entries: list) -> None:
+        """Append manifest entries + refresh the stream snapshot
+        (reference: catalog/mod.rs:108-497)."""
+        try:
+            fmt = self.metastore.get_stream_json(stream.name, self._node_suffix)
+        except MetastoreError:
+            fmt = ObjectStoreFormat(created_at=stream.metadata.created_at or rfc3339_now())
+
+        for entry in entries:
+            lower, upper = self._file_time_bounds(entry)
+            day_lower = lower.replace(hour=0, minute=0, second=0, microsecond=0)
+            day_upper = day_lower + timedelta(days=1) - timedelta(milliseconds=1)
+            prefix = partition_path(stream.name, lower, lower)
+            manifest = self.metastore.get_manifest(prefix) or Manifest()
+            manifest.apply_change(entry)
+            self.metastore.put_manifest(prefix, manifest)
+
+            manifest_path_full = f"{prefix}/manifest.json"
+            item = next(
+                (m for m in fmt.snapshot.manifest_list if m.manifest_path == manifest_path_full),
+                None,
+            )
+            if item is None:
+                item = ManifestItem(
+                    manifest_path=manifest_path_full,
+                    time_lower_bound=day_lower,
+                    time_upper_bound=day_upper,
+                )
+                fmt.snapshot.manifest_list.append(item)
+            item.events_ingested += entry.num_rows
+            item.ingestion_size += entry.ingestion_size
+            item.storage_size += entry.file_size
+            fmt.stats.events += entry.num_rows
+            fmt.stats.storage += entry.file_size
+            fmt.stats.lifetime_events += entry.num_rows
+            fmt.stats.lifetime_storage += entry.file_size
+            date = lower.date().isoformat()
+            EVENTS_STORAGE_SIZE_DATE.labels("data", stream.name, "json", date).inc(entry.file_size)
+            LIFETIME_EVENTS_STORAGE_SIZE.labels("data", stream.name, "json").inc(entry.file_size)
+            STORAGE_SIZE.labels("data", stream.name, "json").inc(entry.file_size)
+
+        if fmt.first_event_at is None and stream.metadata.first_event_at:
+            fmt.first_event_at = stream.metadata.first_event_at
+        self.metastore.put_stream_json(stream.name, fmt, self._node_suffix)
+
+    # -------------------------------------------------------------- shutdown
+
+    def shutdown(self) -> None:
+        """Flush staging, convert, upload, then stop (sync.rs:71-86)."""
+        self.local_sync(shutdown=True)
+        self.sync_all_streams()
+        self.uploader.shutdown()
+
+
+# Global instance, set by the server entrypoint (reference: PARSEABLE Lazy).
+_GLOBAL: Parseable | None = None
+
+
+def get_parseable() -> Parseable:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = Parseable()
+    return _GLOBAL
+
+
+def set_parseable(p: Parseable) -> None:
+    global _GLOBAL
+    _GLOBAL = p

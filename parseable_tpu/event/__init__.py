@@ -1,0 +1,72 @@
+"""L4 — event model.
+
+Parity target (reference: src/event/mod.rs): `Event.process` computes the
+schema key, commits first-seen schemas, pushes into staging, bumps stats and
+fans out to livetail subscribers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import UTC, datetime
+
+import pyarrow as pa
+
+from parseable_tpu.event.format import LogSource, get_schema_key
+from parseable_tpu.streams import Stream
+from parseable_tpu.utils.metrics import (
+    EVENTS_INGESTED,
+    EVENTS_INGESTED_DATE,
+    EVENTS_INGESTED_SIZE,
+    EVENTS_INGESTED_SIZE_DATE,
+    LIFETIME_EVENTS_INGESTED,
+    LIFETIME_EVENTS_INGESTED_SIZE,
+)
+
+
+@dataclass
+class Event:
+    """One parsed ingest unit ready to enter staging."""
+
+    stream_name: str
+    rb: pa.RecordBatch
+    origin_format: str = "json"
+    origin_size: int = 0
+    is_first_event: bool = False
+    parsed_timestamp: datetime = field(default_factory=lambda: datetime.now(UTC))
+    time_partition: str | None = None
+    custom_partition_values: dict[str, str] = field(default_factory=dict)
+    stream_type: str = "UserDefined"
+    log_source: LogSource = LogSource.JSON
+
+    def get_schema_key(self) -> str:
+        """Key of this batch's schema shape + partition suffix
+        (reference: event/mod.rs:78-87,148)."""
+        key = get_schema_key(list(self.rb.schema.names))
+        ts = self.parsed_timestamp
+        suffix = f"{ts.date()}{ts.hour:02d}{ts.minute:02d}"
+        custom = "".join(f"{k}={v}" for k, v in sorted(self.custom_partition_values.items()))
+        return f"{key}{suffix}{custom}" if (self.time_partition or custom) else key
+
+    def process(self, stream: Stream, livetail=None, commit_schema=None) -> None:
+        """[HOT LOOP] push into staging + stats (reference: event/mod.rs:76-129)."""
+        schema_key = get_schema_key(list(self.rb.schema.names))
+        if self.is_first_event and commit_schema is not None:
+            commit_schema(self.stream_name, self.rb.schema)
+        ts = self.parsed_timestamp
+        if ts.tzinfo is not None:
+            ts = ts.astimezone(UTC).replace(tzinfo=None)
+        stream.push(schema_key, self.rb, ts, self.custom_partition_values)
+        n = self.rb.num_rows
+        labels = (self.stream_name, self.origin_format)
+        EVENTS_INGESTED.labels(*labels).inc(n)
+        EVENTS_INGESTED_SIZE.labels(*labels).inc(self.origin_size)
+        LIFETIME_EVENTS_INGESTED.labels(*labels).inc(n)
+        LIFETIME_EVENTS_INGESTED_SIZE.labels(*labels).inc(self.origin_size)
+        date = datetime.now(UTC).date().isoformat()
+        EVENTS_INGESTED_DATE.labels(*labels, date).inc(n)
+        EVENTS_INGESTED_SIZE_DATE.labels(*labels, date).inc(self.origin_size)
+        if stream.metadata.first_event_at is None:
+            stream.metadata.first_event_at = self.parsed_timestamp.isoformat()
+        if livetail is not None:
+            livetail(self.stream_name, self.rb)

@@ -1,0 +1,365 @@
+"""Event format: JSON -> Arrow schema inference, widening, conflict renaming.
+
+Parity targets (reference: src/event/format/mod.rs:148-620, json.rs:42-556):
+
+- infer an Arrow schema from flattened JSON records;
+- SchemaVersion.V1: every number infers as float64; string fields whose name
+  contains a time-ish part and whose value parses as a datetime infer as
+  timestamp(ms) (gated on `infer_timestamp`);
+- fields already present in the stream schema keep the stored type;
+- values incompatible with the stored type cause a *per-record* rename of the
+  offending field to `{name}_{type-suffix}` so ingest never fails on type
+  drift (detect_schema_conflicts / rename_per_record_type_mismatches).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field as dc_field
+from datetime import UTC, datetime
+from enum import Enum
+from typing import Any
+
+import pyarrow as pa
+
+from parseable_tpu.utils.timeutil import parse_rfc3339
+
+# Field-name fragments that suggest a timestamp value
+# (reference: event/format/mod.rs:46 TIME_FIELD_NAME_PARTS)
+TIME_FIELD_NAME_PARTS = (
+    "time",
+    "date",
+    "timestamp",
+    "created",
+    "received",
+    "ingested",
+    "collected",
+    "start",
+    "end",
+    "at",
+    "_ts",
+)
+
+
+class SchemaVersion(str, Enum):
+    V0 = "v0"
+    V1 = "v1"
+
+
+class LogSource(str, Enum):
+    """Where an event came from (reference: event/format/mod.rs:73-99)."""
+
+    JSON = "json"
+    OTEL_LOGS = "otel-logs"
+    OTEL_METRICS = "otel-metrics"
+    OTEL_TRACES = "otel-traces"
+    KINESIS = "kinesis"
+    PMETA = "pmeta"
+    CUSTOM = "custom"
+
+    @classmethod
+    def from_str(cls, s: str) -> "LogSource":
+        try:
+            return cls(s.lower())
+        except ValueError:
+            return cls.CUSTOM
+
+
+def normalize_field_name(name: str) -> str:
+    """Replace a leading '@' with '_' (reference: mod.rs:65)."""
+    return "_" + name[1:] if name.startswith("@") else name
+
+
+def datatype_suffix(t: pa.DataType) -> str:
+    """Short type tag used when renaming conflicting fields."""
+    if pa.types.is_null(t):
+        return "null"
+    if pa.types.is_boolean(t):
+        return "bool"
+    if pa.types.is_integer(t):
+        return str(t)  # int64 / uint64 / ...
+    if t == pa.float64():
+        return "float64"
+    if t == pa.float32():
+        return "float32"
+    if pa.types.is_timestamp(t):
+        return "ts"
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return "str"
+    if pa.types.is_list(t):
+        return "list"
+    return str(t)
+
+
+def _is_timestampy(name: str) -> bool:
+    lname = name.lower()
+    return any(part in lname for part in TIME_FIELD_NAME_PARTS)
+
+
+def _parses_as_datetime(s: str) -> bool:
+    try:
+        parse_rfc3339(s)
+        return True
+    except ValueError:
+        return False
+
+
+def infer_value_type(
+    name: str,
+    value: Any,
+    schema_version: SchemaVersion = SchemaVersion.V1,
+    infer_timestamp: bool = True,
+) -> pa.DataType:
+    """Arrow type for one JSON value under the given schema version."""
+    if value is None:
+        return pa.null()
+    if isinstance(value, bool):
+        return pa.bool_()
+    if isinstance(value, int):
+        return pa.float64() if schema_version == SchemaVersion.V1 else pa.int64()
+    if isinstance(value, float):
+        return pa.float64()
+    if isinstance(value, str):
+        if (
+            schema_version == SchemaVersion.V1
+            and infer_timestamp
+            and _is_timestampy(normalize_field_name(name))
+            and _parses_as_datetime(value)
+        ):
+            return pa.timestamp("ms")
+        return pa.string()
+    if isinstance(value, list):
+        elem = pa.null()
+        for v in value:
+            t = infer_value_type(name, v, schema_version, infer_timestamp)
+            elem = _merge_types(elem, t)
+        return pa.list_(elem)
+    if isinstance(value, dict):
+        # objects should have been flattened; store residue as JSON text
+        return pa.string()
+    return pa.string()
+
+
+def _merge_types(a: pa.DataType, b: pa.DataType) -> pa.DataType:
+    if a == b:
+        return a
+    if pa.types.is_null(a):
+        return b
+    if pa.types.is_null(b):
+        return a
+    if pa.types.is_integer(a) and pa.types.is_floating(b):
+        return b
+    if pa.types.is_floating(a) and pa.types.is_integer(b):
+        return a
+    if pa.types.is_timestamp(a) and pa.types.is_string(b):
+        return a
+    if pa.types.is_string(a) and pa.types.is_timestamp(b):
+        return b
+    return pa.string()
+
+
+def infer_json_schema(
+    records: list[dict[str, Any]],
+    schema_version: SchemaVersion = SchemaVersion.V1,
+    infer_timestamp: bool = True,
+) -> pa.Schema:
+    """Infer a sorted-by-name schema over all records."""
+    types: dict[str, pa.DataType] = {}
+    for rec in records:
+        for key, value in rec.items():
+            name = normalize_field_name(key)
+            t = infer_value_type(name, value, schema_version, infer_timestamp)
+            types[name] = _merge_types(types.get(name, pa.null()), t)
+    for name, t in types.items():
+        if pa.types.is_null(t):
+            types[name] = pa.string()
+    fields = [pa.field(name, t, nullable=True) for name, t in sorted(types.items())]
+    return pa.schema(fields)
+
+
+def update_field_type_in_schema(
+    inferred: pa.Schema,
+    existing: dict[str, pa.Field] | None,
+    time_partition: str | None = None,
+) -> pa.Schema:
+    """Apply stored-schema overrides to an inferred schema.
+
+    - fields stored as timestamp stay timestamps even when a record's value
+      inferred as string;
+    - a new time-partition column inferred as string becomes timestamp(ms).
+    """
+    fields: list[pa.Field] = []
+    existing = existing or {}
+    for f in inferred:
+        stored = existing.get(f.name)
+        if stored is not None and pa.types.is_timestamp(stored.type):
+            fields.append(pa.field(f.name, stored.type, nullable=True))
+        elif (
+            time_partition is not None
+            and f.name == time_partition
+            and f.name not in existing
+            and pa.types.is_string(f.type)
+        ):
+            fields.append(pa.field(f.name, pa.timestamp("ms"), nullable=True))
+        else:
+            fields.append(pa.field(f.name, f.type, nullable=True))
+    return pa.schema(fields)
+
+
+def value_compatible_with_type(value: Any, t: pa.DataType) -> bool:
+    """Can `value` be stored in a column of type `t` without corruption?
+
+    (reference: event/format/mod.rs:442-487)
+    """
+    if value is None:
+        return True
+    if pa.types.is_boolean(t):
+        return isinstance(value, bool)
+    if pa.types.is_integer(t):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if pa.types.is_floating(t):
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if pa.types.is_timestamp(t):
+        return isinstance(value, str) and _parses_as_datetime(value)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return isinstance(value, str)
+    if pa.types.is_list(t):
+        return isinstance(value, list)
+    return True
+
+
+def detect_schema_conflicts(
+    records: list[dict[str, Any]],
+    stored: dict[str, pa.Field],
+    schema_version: SchemaVersion = SchemaVersion.V1,
+) -> dict[str, str]:
+    """Map of field name -> renamed field name for records whose value type
+    conflicts with the stored column type."""
+    renames: dict[str, str] = {}
+    for rec in records:
+        for key, value in rec.items():
+            name = normalize_field_name(key)
+            f = stored.get(name)
+            if f is None or value is None:
+                continue
+            if not value_compatible_with_type(value, f.type):
+                vt = infer_value_type(name, value, schema_version)
+                renames[name] = f"{name}_{datatype_suffix(vt)}"
+    return renames
+
+
+def rename_per_record_type_mismatches(
+    records: list[dict[str, Any]],
+    stored: dict[str, pa.Field],
+    renames: dict[str, str],
+) -> list[dict[str, Any]]:
+    """Rename only the offending fields in only the offending records."""
+    if not renames:
+        return records
+    out = []
+    for rec in records:
+        new_rec = {}
+        for key, value in rec.items():
+            name = normalize_field_name(key)
+            target = renames.get(name)
+            if (
+                target is not None
+                and name in stored
+                and value is not None
+                and not value_compatible_with_type(value, stored[name].type)
+            ):
+                new_rec[target] = value
+            else:
+                new_rec[name] = value
+        out.append(new_rec)
+    return out
+
+
+def get_schema_key(fields: list[str]) -> str:
+    """Stable 64-bit hex key over sorted field names.
+
+    Reference uses xxh3 (event/mod.rs:148); any stable 64-bit hash works since
+    the key is only used to group staging files by schema shape.
+    """
+    h = hashlib.blake2b(digest_size=8)
+    for name in sorted(fields):
+        h.update(name.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+@dataclass
+class EventSchema:
+    """An inferred + reconciled schema plus the records ready to encode."""
+
+    schema: pa.Schema
+    records: list[dict[str, Any]]
+    is_first: bool = False
+    renames: dict[str, str] = dc_field(default_factory=dict)
+
+
+def prepare_event(
+    records: list[dict[str, Any]],
+    stored_schema: dict[str, pa.Field] | None,
+    schema_version: SchemaVersion = SchemaVersion.V1,
+    time_partition: str | None = None,
+    infer_timestamp: bool = True,
+) -> EventSchema:
+    """Full `to_data` pipeline: conflict renames -> inference -> overrides."""
+    stored = stored_schema or {}
+    renames = detect_schema_conflicts(records, stored, schema_version)
+    records = rename_per_record_type_mismatches(records, stored, renames)
+    inferred = infer_json_schema(records, schema_version, infer_timestamp)
+    merged_fields: list[pa.Field] = []
+    for f in inferred:
+        stored_f = stored.get(f.name)
+        if stored_f is not None:
+            merged_fields.append(pa.field(f.name, stored_f.type, nullable=True))
+        else:
+            merged_fields.append(f)
+    schema = update_field_type_in_schema(pa.schema(merged_fields), stored, time_partition)
+    is_first = not stored
+    return EventSchema(schema=schema, records=records, is_first=is_first, renames=renames)
+
+
+def _coerce(value: Any, t: pa.DataType) -> Any:
+    if value is None:
+        return None
+    if pa.types.is_timestamp(t):
+        if isinstance(value, str):
+            try:
+                return parse_rfc3339(value).replace(tzinfo=None)
+            except ValueError:
+                return None
+        if isinstance(value, (int, float)):
+            return datetime.fromtimestamp(value / 1000.0, UTC).replace(tzinfo=None)
+        if isinstance(value, datetime):
+            return value
+        return None
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        if isinstance(value, str):
+            return value
+        import json as _json
+
+        return _json.dumps(value, separators=(",", ":"), default=str)
+    if pa.types.is_floating(t):
+        return float(value) if isinstance(value, (int, float)) and not isinstance(value, bool) else None
+    if pa.types.is_integer(t):
+        return int(value) if isinstance(value, (int, float)) and not isinstance(value, bool) else None
+    if pa.types.is_boolean(t):
+        return value if isinstance(value, bool) else None
+    if pa.types.is_list(t):
+        if not isinstance(value, list):
+            return None
+        return [_coerce(v, t.value_type) for v in value]
+    return value
+
+
+def decode(records: list[dict[str, Any]], schema: pa.Schema) -> pa.RecordBatch:
+    """Columnar-encode records against `schema` (arrow-json Decoder parity)."""
+    cols = []
+    for f in schema:
+        cols.append(
+            pa.array([_coerce(rec.get(f.name), f.type) for rec in records], type=f.type)
+        )
+    return pa.RecordBatch.from_arrays(cols, schema=schema)

@@ -1,0 +1,305 @@
+"""L1 — Metastore: all system metadata as JSON on the object store.
+
+Parity target (reference: src/metastore/metastore_traits.rs:47-347 — ~60
+async methods; metastores/object_store_metastore.rs). Grouped here into
+generic typed CRUD over dot-prefixed directories, exactly mirroring the
+reference's layout:
+
+    <stream>/.stream/*.stream.json      stream metadata (per node)
+    <stream>/.stream/.schema            merged arrow schema
+    <prefix>/manifest.json              manifests
+    .alerts/<id>.json                   alerts
+    .targets/<id>.json                  notification targets
+    .users/<id>.json                    dashboards/filters owners
+    .parseable/<node>.json              node membership
+    .parseable.json                     deployment metadata
+"""
+
+from __future__ import annotations
+
+import json
+from abc import ABC, abstractmethod
+from typing import Any
+
+import pyarrow as pa
+
+from parseable_tpu.catalog import Manifest
+from parseable_tpu.storage import (
+    ALERTS_ROOT_DIRECTORY,
+    MANIFEST_FILE,
+    PARSEABLE_METADATA_FILE_NAME,
+    PARSEABLE_ROOT_DIRECTORY,
+    STREAM_ROOT_DIRECTORY,
+    TARGETS_ROOT_DIRECTORY,
+    USERS_ROOT_DIR,
+    ObjectStoreFormat,
+    schema_path,
+    stream_json_path,
+)
+from parseable_tpu.storage.object_storage import NoSuchKey, ObjectStorage
+
+
+class MetastoreError(Exception):
+    pass
+
+
+class Metastore(ABC):
+    """Metadata CRUD surface used by every layer above L1."""
+
+    # streams
+    @abstractmethod
+    def get_stream_json(self, stream: str, node_id: str | None = None) -> ObjectStoreFormat: ...
+
+    @abstractmethod
+    def get_all_stream_jsons(self, stream: str) -> list[ObjectStoreFormat]: ...
+
+    @abstractmethod
+    def put_stream_json(self, stream: str, fmt: ObjectStoreFormat, node_id: str | None = None) -> None: ...
+
+    @abstractmethod
+    def list_streams(self) -> list[str]: ...
+
+    @abstractmethod
+    def delete_stream(self, stream: str) -> None: ...
+
+    # schema
+    @abstractmethod
+    def get_schema(self, stream: str) -> pa.Schema | None: ...
+
+    @abstractmethod
+    def put_schema(self, stream: str, schema: pa.Schema) -> None: ...
+
+    # manifests
+    @abstractmethod
+    def get_manifest(self, prefix: str) -> Manifest | None: ...
+
+    @abstractmethod
+    def put_manifest(self, prefix: str, manifest: Manifest) -> None: ...
+
+    @abstractmethod
+    def delete_manifest(self, prefix: str) -> None: ...
+
+    # generic named-document collections (alerts, targets, dashboards, ...)
+    @abstractmethod
+    def get_document(self, collection: str, doc_id: str) -> dict | None: ...
+
+    @abstractmethod
+    def put_document(self, collection: str, doc_id: str, doc: dict) -> None: ...
+
+    @abstractmethod
+    def delete_document(self, collection: str, doc_id: str) -> None: ...
+
+    @abstractmethod
+    def list_documents(self, collection: str) -> list[dict]: ...
+
+    # deployment + nodes
+    @abstractmethod
+    def get_parseable_metadata(self) -> dict | None: ...
+
+    @abstractmethod
+    def put_parseable_metadata(self, doc: dict) -> None: ...
+
+    @abstractmethod
+    def list_nodes(self, node_type: str | None = None) -> list[dict]: ...
+
+    @abstractmethod
+    def put_node(self, node: dict) -> None: ...
+
+    @abstractmethod
+    def delete_node(self, node_id: str) -> None: ...
+
+
+def _schema_to_json(schema: pa.Schema) -> dict:
+    return {
+        "fields": [
+            {"name": f.name, "data_type": str(f.type), "nullable": f.nullable} for f in schema
+        ]
+    }
+
+
+_TYPE_PARSERS: dict[str, pa.DataType] = {}
+
+
+def _parse_type(s: str) -> pa.DataType:
+    if not _TYPE_PARSERS:
+        _TYPE_PARSERS.update(
+            {
+                "null": pa.null(),
+                "bool": pa.bool_(),
+                "int8": pa.int8(),
+                "int16": pa.int16(),
+                "int32": pa.int32(),
+                "int64": pa.int64(),
+                "uint8": pa.uint8(),
+                "uint16": pa.uint16(),
+                "uint32": pa.uint32(),
+                "uint64": pa.uint64(),
+                "float": pa.float32(),
+                "double": pa.float64(),
+                "float32": pa.float32(),
+                "float64": pa.float64(),
+                "string": pa.string(),
+                "large_string": pa.large_string(),
+                "binary": pa.binary(),
+                "timestamp[ms]": pa.timestamp("ms"),
+                "timestamp[us]": pa.timestamp("us"),
+                "timestamp[ns]": pa.timestamp("ns"),
+                "date32[day]": pa.date32(),
+            }
+        )
+    if s in _TYPE_PARSERS:
+        return _TYPE_PARSERS[s]
+    if s.startswith("list<") and s.endswith(">"):
+        inner = s[5:-1]
+        if ": " in inner:
+            inner = inner.split(": ", 1)[1]
+        return pa.list_(_parse_type(inner))
+    return pa.string()
+
+
+def _schema_from_json(obj: dict) -> pa.Schema:
+    return pa.schema(
+        [
+            pa.field(f["name"], _parse_type(f["data_type"]), f.get("nullable", True))
+            for f in obj.get("fields", [])
+        ]
+    )
+
+
+class ObjectStoreMetastore(Metastore):
+    """The only metastore implementation, like the reference's."""
+
+    def __init__(self, storage: ObjectStorage):
+        self.storage = storage
+
+    # -- low level ----------------------------------------------------------
+    def _get_json(self, key: str) -> dict | None:
+        try:
+            return json.loads(self.storage.get_object(key))
+        except NoSuchKey:
+            return None
+        except json.JSONDecodeError as e:
+            raise MetastoreError(f"corrupt metadata object {key}: {e}") from e
+
+    def _put_json(self, key: str, doc: Any) -> None:
+        self.storage.put_object(key, json.dumps(doc, default=str).encode())
+
+    # -- streams ------------------------------------------------------------
+    def get_stream_json(self, stream: str, node_id: str | None = None) -> ObjectStoreFormat:
+        obj = self._get_json(stream_json_path(stream, node_id))
+        if obj is None:
+            raise MetastoreError(f"stream {stream} not found")
+        return ObjectStoreFormat.from_json(obj)
+
+    def get_all_stream_jsons(self, stream: str) -> list[ObjectStoreFormat]:
+        """All nodes' stream jsons — queriers merge these at scan time
+        (reference: stream_schema_provider.rs:566-585)."""
+        prefix = f"{stream}/{STREAM_ROOT_DIRECTORY}"
+        out = []
+        for meta in self.storage.list_prefix(prefix):
+            if meta.key.endswith("stream.json"):
+                obj = self._get_json(meta.key)
+                if obj is not None:
+                    out.append(ObjectStoreFormat.from_json(obj))
+        return out
+
+    def put_stream_json(self, stream: str, fmt: ObjectStoreFormat, node_id: str | None = None) -> None:
+        self._put_json(stream_json_path(stream, node_id), fmt.to_json())
+
+    def list_streams(self) -> list[str]:
+        out = []
+        for d in self.storage.list_dirs(""):
+            if d.startswith("."):
+                continue
+            if self.storage.list_dirs(d) or any(True for _ in self.storage.list_prefix(d)):
+                out.append(d)
+        return sorted(out)
+
+    def delete_stream(self, stream: str) -> None:
+        self.storage.delete_prefix(stream)
+
+    # -- schema -------------------------------------------------------------
+    def get_schema(self, stream: str) -> pa.Schema | None:
+        obj = self._get_json(schema_path(stream))
+        return _schema_from_json(obj) if obj is not None else None
+
+    def put_schema(self, stream: str, schema: pa.Schema) -> None:
+        self._put_json(schema_path(stream), _schema_to_json(schema))
+
+    # -- manifests ----------------------------------------------------------
+    def get_manifest(self, prefix: str) -> Manifest | None:
+        obj = self._get_json(f"{prefix}/{MANIFEST_FILE}")
+        return Manifest.from_json(obj) if obj is not None else None
+
+    def put_manifest(self, prefix: str, manifest: Manifest) -> None:
+        self._put_json(f"{prefix}/{MANIFEST_FILE}", manifest.to_json())
+
+    def delete_manifest(self, prefix: str) -> None:
+        self.storage.delete_object(f"{prefix}/{MANIFEST_FILE}")
+
+    # -- named document collections ----------------------------------------
+    _COLLECTIONS = {
+        "alerts": ALERTS_ROOT_DIRECTORY,
+        "targets": TARGETS_ROOT_DIRECTORY,
+        "alert_state": ".alert-states",
+        "dashboards": f"{USERS_ROOT_DIR}/dashboards",
+        "filters": f"{USERS_ROOT_DIR}/filters",
+        "correlations": f"{USERS_ROOT_DIR}/correlations",
+        "apikeys": ".keystones",
+        "roles": f"{USERS_ROOT_DIR}/roles",
+        "users": f"{USERS_ROOT_DIR}/users",
+        "llmconfigs": ".llmconfigs",
+        "chats": ".chats",
+    }
+
+    def _collection_prefix(self, collection: str) -> str:
+        try:
+            return self._COLLECTIONS[collection]
+        except KeyError:
+            raise MetastoreError(f"unknown metastore collection {collection!r}") from None
+
+    def get_document(self, collection: str, doc_id: str) -> dict | None:
+        return self._get_json(f"{self._collection_prefix(collection)}/{doc_id}.json")
+
+    def put_document(self, collection: str, doc_id: str, doc: dict) -> None:
+        self._put_json(f"{self._collection_prefix(collection)}/{doc_id}.json", doc)
+
+    def delete_document(self, collection: str, doc_id: str) -> None:
+        self.storage.delete_object(f"{self._collection_prefix(collection)}/{doc_id}.json")
+
+    def list_documents(self, collection: str) -> list[dict]:
+        prefix = self._collection_prefix(collection)
+        docs = []
+        for meta in self.storage.list_prefix(prefix):
+            if meta.key.endswith(".json"):
+                obj = self._get_json(meta.key)
+                if obj is not None:
+                    docs.append(obj)
+        return docs
+
+    # -- deployment + nodes --------------------------------------------------
+    def get_parseable_metadata(self) -> dict | None:
+        return self._get_json(PARSEABLE_METADATA_FILE_NAME)
+
+    def put_parseable_metadata(self, doc: dict) -> None:
+        self._put_json(PARSEABLE_METADATA_FILE_NAME, doc)
+
+    def list_nodes(self, node_type: str | None = None) -> list[dict]:
+        out = []
+        for meta in self.storage.list_prefix(PARSEABLE_ROOT_DIRECTORY):
+            if meta.key.endswith(".json"):
+                obj = self._get_json(meta.key)
+                if obj is not None and (node_type is None or obj.get("node_type") == node_type):
+                    out.append(obj)
+        return out
+
+    def put_node(self, node: dict) -> None:
+        node_type = node.get("node_type", "ingestor")
+        self._put_json(
+            f"{PARSEABLE_ROOT_DIRECTORY}/{node_type}.{node['node_id']}.json", node
+        )
+
+    def delete_node(self, node_id: str) -> None:
+        for meta in self.storage.list_prefix(PARSEABLE_ROOT_DIRECTORY):
+            if node_id in meta.key:
+                self.storage.delete_object(meta.key)

@@ -1,0 +1,302 @@
+"""ObjectStorage interface + LocalFS implementation + upload orchestration.
+
+Parity targets (reference: src/storage/object_storage.rs:292-445 traits,
+:1024-1326 staging upload; src/storage/localfs.rs).
+
+The provider abstraction keeps the reference's split:
+- `ObjectStorageProvider` — constructs clients and names the backend;
+- `ObjectStorage`         — get/put/delete/list/upload primitives.
+
+GCS/S3 backends are declared but gated: this environment has no cloud SDKs or
+egress, so they raise `StorageUnavailable` unless their SDK import succeeds.
+LocalFS is fully functional and is what tests/benchmarks use (same as the
+reference's `local-store` mode).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import threading
+import time
+from abc import ABC, abstractmethod
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterator
+
+from parseable_tpu.utils.metrics import STORAGE_REQUEST_TIME
+
+
+class ObjectStorageError(Exception):
+    pass
+
+
+class NoSuchKey(ObjectStorageError):
+    pass
+
+
+class StorageUnavailable(ObjectStorageError):
+    pass
+
+
+@dataclass
+class ObjectMeta:
+    key: str
+    size: int
+    last_modified: float
+
+
+class ObjectStorage(ABC):
+    """Synchronous object-store primitives; concurrency via worker pools."""
+
+    name: str = "abstract"
+
+    # -- primitives ---------------------------------------------------------
+    @abstractmethod
+    def get_object(self, key: str) -> bytes: ...
+
+    @abstractmethod
+    def put_object(self, key: str, data: bytes) -> None: ...
+
+    @abstractmethod
+    def delete_object(self, key: str) -> None: ...
+
+    @abstractmethod
+    def head(self, key: str) -> ObjectMeta: ...
+
+    @abstractmethod
+    def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]: ...
+
+    @abstractmethod
+    def list_dirs(self, prefix: str) -> list[str]:
+        """Immediate child 'directories' under a prefix."""
+
+    @abstractmethod
+    def upload_file(self, key: str, path: Path) -> None:
+        """Upload a local file (multipart when large)."""
+
+    @abstractmethod
+    def download_file(self, key: str, path: Path) -> None: ...
+
+    @abstractmethod
+    def delete_prefix(self, prefix: str) -> None: ...
+
+    # -- helpers ------------------------------------------------------------
+    def exists(self, key: str) -> bool:
+        try:
+            self.head(key)
+            return True
+        except NoSuchKey:
+            return False
+
+    def get_objects(self, prefix: str, predicate: Callable[[str], bool] | None = None) -> list[tuple[str, bytes]]:
+        out = []
+        for meta in self.list_prefix(prefix):
+            if predicate is None or predicate(meta.key):
+                out.append((meta.key, self.get_object(meta.key)))
+        return out
+
+    def absolute_url(self, key: str) -> str:
+        return key
+
+
+class ObjectStorageProvider(ABC):
+    """Factory for a backend (reference: object_storage.rs:292-303)."""
+
+    @abstractmethod
+    def construct_client(self) -> ObjectStorage: ...
+
+    @abstractmethod
+    def get_endpoint(self) -> str: ...
+
+
+def _timed(backend: str, op: str):
+    """Record per-call latency into the Prometheus histogram
+    (reference: storage/metrics_layer.rs MetricLayer)."""
+    return STORAGE_REQUEST_TIME.labels(backend, op).time()
+
+
+class LocalFS(ObjectStorage):
+    """Filesystem-backed object store (reference: storage/localfs.rs)."""
+
+    name = "drive"
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _abs(self, key: str) -> Path:
+        p = (self.root / key).resolve()
+        if not str(p).startswith(str(self.root.resolve())):
+            raise ObjectStorageError(f"key escapes root: {key}")
+        return p
+
+    def get_object(self, key: str) -> bytes:
+        with _timed(self.name, "GET"):
+            p = self._abs(key)
+            if not p.is_file():
+                raise NoSuchKey(key)
+            return p.read_bytes()
+
+    def put_object(self, key: str, data: bytes) -> None:
+        with _timed(self.name, "PUT"):
+            p = self._abs(key)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            tmp = p.with_name(p.name + ".tmp")
+            tmp.write_bytes(data)
+            os.replace(tmp, p)
+
+    def delete_object(self, key: str) -> None:
+        with _timed(self.name, "DELETE"):
+            p = self._abs(key)
+            with contextlib.suppress(FileNotFoundError):
+                p.unlink()
+
+    def head(self, key: str) -> ObjectMeta:
+        with _timed(self.name, "HEAD"):
+            p = self._abs(key)
+            if not p.is_file():
+                raise NoSuchKey(key)
+            st = p.stat()
+            return ObjectMeta(key=key, size=st.st_size, last_modified=st.st_mtime)
+
+    def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
+        with _timed(self.name, "LIST"):
+            base = self._abs(prefix) if prefix else self.root
+            if not base.exists():
+                return
+            if base.is_file():
+                st = base.stat()
+                yield ObjectMeta(prefix, st.st_size, st.st_mtime)
+                return
+            pattern = "**/*" if recursive else "*"
+            for p in sorted(base.glob(pattern)):
+                if p.is_file() and not p.name.endswith(".tmp"):
+                    key = str(p.relative_to(self.root))
+                    st = p.stat()
+                    yield ObjectMeta(key, st.st_size, st.st_mtime)
+
+    def list_dirs(self, prefix: str) -> list[str]:
+        base = self._abs(prefix) if prefix else self.root
+        if not base.is_dir():
+            return []
+        return sorted(d.name for d in base.iterdir() if d.is_dir())
+
+    def upload_file(self, key: str, path: Path) -> None:
+        with _timed(self.name, "PUT"):
+            dest = self._abs(key)
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            tmp = dest.with_name(dest.name + ".tmp")
+            shutil.copyfile(path, tmp)
+            os.replace(tmp, dest)
+
+    def download_file(self, key: str, path: Path) -> None:
+        with _timed(self.name, "GET"):
+            src = self._abs(key)
+            if not src.is_file():
+                raise NoSuchKey(key)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_name(path.name + ".tmp")
+            shutil.copyfile(src, tmp)
+            os.replace(tmp, path)
+
+    def delete_prefix(self, prefix: str) -> None:
+        with _timed(self.name, "DELETE"):
+            p = self._abs(prefix)
+            if p.is_dir():
+                shutil.rmtree(p, ignore_errors=True)
+            elif p.is_file():
+                p.unlink()
+
+
+class LocalFSProvider(ObjectStorageProvider):
+    def __init__(self, root: Path):
+        self.root = Path(root)
+
+    def construct_client(self) -> ObjectStorage:
+        return LocalFS(self.root)
+
+    def get_endpoint(self) -> str:
+        return str(self.root)
+
+
+class GcsProvider(ObjectStorageProvider):
+    """GCS backend — primary target on TPU-VMs; requires google-cloud-storage.
+
+    Gated: raises StorageUnavailable when the SDK is absent (this build env
+    has no egress). Mirrors reference src/storage/gcs.rs.
+    """
+
+    def __init__(self, bucket: str):
+        self.bucket = bucket
+
+    def construct_client(self) -> ObjectStorage:
+        try:
+            import google.cloud.storage  # noqa: F401
+        except ImportError as e:
+            raise StorageUnavailable(
+                "google-cloud-storage SDK not installed; use local-store"
+            ) from e
+        raise StorageUnavailable("GCS backend not implemented in this build")
+
+    def get_endpoint(self) -> str:
+        return f"gs://{self.bucket}"
+
+
+class S3Provider(ObjectStorageProvider):
+    """S3 backend (reference src/storage/s3.rs). Gated like GCS."""
+
+    def __init__(self, bucket: str, region: str | None = None, endpoint: str | None = None):
+        self.bucket = bucket
+        self.region = region
+        self.endpoint = endpoint
+
+    def construct_client(self) -> ObjectStorage:
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise StorageUnavailable("boto3 not installed; use local-store") from e
+        raise StorageUnavailable("S3 backend not implemented in this build")
+
+    def get_endpoint(self) -> str:
+        return self.endpoint or f"s3://{self.bucket}"
+
+
+def make_provider(backend: str, **kw) -> ObjectStorageProvider:
+    if backend in ("local-store", "localfs", "drive"):
+        return LocalFSProvider(kw["root"])
+    if backend in ("gcs-store", "gcs"):
+        return GcsProvider(kw["bucket"])
+    if backend in ("s3-store", "s3"):
+        return S3Provider(kw["bucket"], kw.get("region"), kw.get("endpoint"))
+    raise ValueError(f"unknown storage backend {backend!r}")
+
+
+class UploadPool:
+    """Bounded-concurrency uploader with post-upload validation
+    (reference: object_storage.rs:111-290 parallel upload + validation)."""
+
+    def __init__(self, storage: ObjectStorage, concurrency: int = 8):
+        self.storage = storage
+        self.pool = ThreadPoolExecutor(max_workers=concurrency, thread_name_prefix="upload")
+
+    def upload_and_validate(self, key: str, path: Path) -> ObjectMeta:
+        expected = path.stat().st_size
+        start = time.monotonic()
+        self.storage.upload_file(key, path)
+        meta = self.storage.head(key)
+        if meta.size != expected:
+            raise ObjectStorageError(
+                f"uploaded object {key} size mismatch: {meta.size} != {expected}"
+            )
+        meta.last_modified = max(meta.last_modified, start)
+        return meta
+
+    def submit(self, key: str, path: Path):
+        return self.pool.submit(self.upload_and_validate, key, path)
+
+    def shutdown(self) -> None:
+        self.pool.shutdown(wait=True)

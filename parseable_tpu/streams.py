@@ -1,0 +1,340 @@
+"""L3 — Stream staging engine + stream registry.
+
+Parity targets (reference: src/parseable/streams.rs, src/metadata.rs):
+- `Stream.push`                       (streams.rs:235-284)
+- partitioned staging filenames       (streams.rs:286-318)
+- `flush` / `prepare_parquet`         (streams.rs:569-700)
+- `convert_disk_files_to_parquet`     (streams.rs:902-981) — reverse-merged,
+  stats-bearing parquet, `.part` rename, chunked by
+  MAX_ARROW_FILES_PER_PARQUET
+- orphan `.part.arrows` recovery      (streams.rs:1421-1516)
+- `Streams` registry                  (streams.rs:1561-1643)
+- `LogStreamMetadata`                 (metadata.rs:81-202)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import socket
+import threading
+from dataclasses import dataclass, field
+from datetime import UTC, datetime
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY, OBJECT_STORE_DATA_GRANULARITY
+from parseable_tpu.config import Options
+from parseable_tpu.event.format import LogSource, SchemaVersion
+from parseable_tpu.staging.reader import MergedReverseRecordReader
+from parseable_tpu.staging.writer import ARROW_FILE_EXTENSION, PART_FILE_EXTENSION, Writer
+from parseable_tpu.utils.metrics import STAGING_FILES
+from parseable_tpu.utils.timeutil import minute_slot
+
+logger = logging.getLogger(__name__)
+
+_HOSTNAME = re.sub(r"[^A-Za-z0-9_-]", "", socket.gethostname()) or "node"
+
+
+class StagingError(Exception):
+    pass
+
+
+@dataclass
+class LogStreamMetadata:
+    """In-memory per-stream metadata (reference: metadata.rs:81-202)."""
+
+    schema: dict[str, pa.Field] = field(default_factory=dict)
+    schema_version: SchemaVersion = SchemaVersion.V1
+    time_partition: str | None = None
+    time_partition_limit_days: int | None = None
+    custom_partition: str | None = None
+    static_schema_flag: bool = False
+    stream_type: str = "UserDefined"
+    log_source: list[LogSource] = field(default_factory=list)
+    telemetry_type: str = "logs"
+    created_at: str = ""
+    first_event_at: str | None = None
+    retention: dict | None = None
+    hot_tier_enabled: bool = False
+    infer_timestamp: bool = True
+
+
+class Stream:
+    """One log stream's staging state: writers, files, metadata."""
+
+    def __init__(
+        self,
+        name: str,
+        options: Options,
+        metadata: LogStreamMetadata | None = None,
+        ingestor_id: str | None = None,
+        tenant: str | None = None,
+    ):
+        self.name = name
+        self.options = options
+        self.metadata = metadata or LogStreamMetadata()
+        self.ingestor_id = ingestor_id
+        self.tenant = tenant
+        self.data_path = options.staging_dir() / (f"{tenant}.{name}" if tenant else name)
+        self.writer = Writer(
+            enable_memory=options.enable_memory_staging,
+            batch_rows=options.disk_write_batch_rows,
+        )
+        self.lock = threading.RLock()
+
+    # --- filenames ---------------------------------------------------------
+
+    def filename_by_partition(
+        self,
+        schema_key: str,
+        parsed_timestamp: datetime,
+        custom_partition_values: dict[str, str] | None = None,
+    ) -> str:
+        """Staging filename encoding (schema, minute bucket, partitions, node)
+        (reference: streams.rs:286-318)."""
+        hostname = _HOSTNAME + (self.ingestor_id or "")
+        custom = "".join(
+            f"{k}={v}." for k, v in sorted((custom_partition_values or {}).items())
+        )
+        slot = minute_slot(parsed_timestamp.minute, OBJECT_STORE_DATA_GRANULARITY)
+        return (
+            f"{schema_key}.date={parsed_timestamp.date()}"
+            f".hour={parsed_timestamp.hour:02d}.minute={slot}.{custom}{hostname}"
+            f".data.{PART_FILE_EXTENSION}"
+        )
+
+    # --- push --------------------------------------------------------------
+
+    def push(
+        self,
+        schema_key: str,
+        batch: pa.RecordBatch,
+        parsed_timestamp: datetime,
+        custom_partition_values: dict[str, str] | None = None,
+    ) -> None:
+        filename = self.filename_by_partition(schema_key, parsed_timestamp, custom_partition_values)
+        bucket_key = filename[: -len("." + PART_FILE_EXTENSION)]
+        with self.lock:
+            self.writer.push(bucket_key, self.data_path / filename, batch)
+
+    # --- listing -----------------------------------------------------------
+
+    def arrow_files(self) -> list[Path]:
+        if not self.data_path.is_dir():
+            return []
+        return sorted(
+            p
+            for p in self.data_path.iterdir()
+            if p.name.endswith("." + ARROW_FILE_EXTENSION)
+            and not p.name.endswith("." + PART_FILE_EXTENSION)
+        )
+
+    def parquet_files(self) -> list[Path]:
+        if not self.data_path.is_dir():
+            return []
+        return sorted(
+            p
+            for p in self.data_path.iterdir()
+            if p.suffix == ".parquet" and not p.name.endswith(".part.parquet")
+        )
+
+    def staging_batches(self) -> list[pa.RecordBatch]:
+        """Query-visible recent data: memory buffer, else on-disk arrows.
+
+        The reference exposes MemWriter batches plus unflushed disk arrows to
+        queries (writer.rs:357-421, stream_schema_provider.rs:247-307). We
+        flush current writers first so the IPC footers are valid, then read
+        the finished files — same visibility (within the staging window) with
+        one code path.
+        """
+        with self.lock:
+            self.flush(forced=True)
+            files = self.arrow_files()
+        reader = MergedReverseRecordReader(files)
+        return list(reader)
+
+    # --- flush + convert ---------------------------------------------------
+
+    def flush(self, forced: bool = False) -> list[Path]:
+        """Finish disk writers. When not forced, only buckets from minutes
+        before the current one are finished (the live minute keeps filling).
+        """
+        now = datetime.now(UTC)
+        current = f"minute={minute_slot(now.minute, OBJECT_STORE_DATA_GRANULARITY)}"
+        current_date = f"date={now.date()}.hour={now.hour:02d}"
+
+        def is_past_bucket(key: str) -> bool:
+            return not (current in key and current_date in key)
+
+        with self.lock:
+            return self.writer.finish_buckets(None if forced else is_past_bucket)
+
+    def _arrows_group_key(self, arrows_name: str) -> str:
+        """Arrow files that compact into the same parquet share everything
+        except the leading schema key."""
+        return arrows_name.split(".", 1)[1].rsplit(".data.", 1)[0]
+
+    def convert_disk_files_to_parquet(self, shutdown: bool = False) -> list[Path]:
+        """Compact finished `.arrows` into parquet (streams.rs:902-981).
+
+        Groups files by (minute bucket, custom partitions, node), reverse-
+        merges them by p_timestamp, and writes parquet with per-column stats
+        via a `.part.parquet` -> rename protocol. Source arrows are deleted
+        after a successful rename.
+        """
+        outputs: list[Path] = []
+        files = self.arrow_files()
+        if not files:
+            return outputs
+        groups: dict[str, list[Path]] = {}
+        for f in files:
+            groups.setdefault(self._arrows_group_key(f.name), []).append(f)
+
+        max_chunk = max(1, self.options.max_arrow_files_per_parquet)
+        for group_key, group_files in sorted(groups.items()):
+            for ci in range(0, len(group_files), max_chunk):
+                chunk = group_files[ci : ci + max_chunk]
+                out = self._write_parquet_for(group_key, chunk, part_index=ci // max_chunk)
+                if out is not None:
+                    outputs.append(out)
+        STAGING_FILES.labels(self.name).set(len(self.arrow_files()))
+        return outputs
+
+    def _write_parquet_for(self, group_key: str, chunk: list[Path], part_index: int) -> Path | None:
+        reader = MergedReverseRecordReader(chunk)
+        batches = list(reader)
+        if not batches:
+            for f in chunk:
+                f.unlink(missing_ok=True)
+            return None
+        table = pa.Table.from_batches(batches)
+        # global sort newest-first so parquet row groups are time-clustered
+        # (reference sorts descending by p_timestamp; streams.rs:701-764)
+        if DEFAULT_TIMESTAMP_KEY in table.column_names:
+            table = table.sort_by([(DEFAULT_TIMESTAMP_KEY, "descending")])
+        suffix = f".{part_index}" if part_index else ""
+        final = self.data_path / f"{group_key}{suffix}.data.parquet"
+        part = final.with_name(final.name + ".part.parquet")
+        pq.write_table(
+            table,
+            part,
+            row_group_size=self.options.row_group_size,
+            compression=self.options.parquet_compression.to_parquet(),
+            write_statistics=True,
+        )
+        if part.stat().st_size == 0:
+            part.unlink()
+            raise StagingError(f"wrote empty parquet for {group_key}")
+        os.replace(part, final)
+        for f in chunk:
+            f.unlink(missing_ok=True)
+        return final
+
+    def prepare_parquet(self, shutdown: bool = False) -> list[Path]:
+        """flush + convert (reference: streams.rs:569-604)."""
+        self.flush(forced=shutdown)
+        return self.convert_disk_files_to_parquet(shutdown)
+
+    # --- upload path -------------------------------------------------------
+
+    def stream_relative_path(self, parquet_path: Path) -> str:
+        """Object-store key for a staged parquet file.
+
+        `date=D.hour=HH.minute=MM.{custom...}.{host}.data.parquet` ->
+        `<stream>/date=D/hour=HH/minute=MM/{custom.../}{host}.data.parquet`
+        """
+        name = parquet_path.name
+        parts = name.split(".data.")[0].split(".")
+        path_parts: list[str] = []
+        tail: list[str] = []
+        for p in parts:
+            if p.startswith(("date=", "hour=", "minute=")) or ("=" in p and not tail):
+                path_parts.append(p)
+            else:
+                tail.append(p)
+        filename = ".".join(tail + ["data", "parquet"])
+        return "/".join([self.name, *path_parts, filename])
+
+    # --- recovery ----------------------------------------------------------
+
+    def recover_orphans(self) -> None:
+        """Salvage `.part.arrows` left by a crash (streams.rs:1421-1516).
+
+        A part file with a valid IPC footer was fully written minus rename;
+        anything unreadable is discarded. Stale `.part.parquet` is removed.
+        """
+        if not self.data_path.is_dir():
+            return
+        for p in list(self.data_path.iterdir()):
+            if p.name.endswith(".part.parquet"):
+                p.unlink(missing_ok=True)
+            elif p.name.endswith("." + PART_FILE_EXTENSION):
+                try:
+                    import pyarrow.ipc as ipc
+
+                    ipc.open_file(str(p)).schema  # noqa: B018 — validity probe
+                    final = Path(str(p)[: -len(PART_FILE_EXTENSION)] + ARROW_FILE_EXTENSION)
+                    os.replace(p, final)
+                except (pa.ArrowInvalid, pa.ArrowIOError, OSError):
+                    logger.warning("discarding unrecoverable staging file %s", p)
+                    p.unlink(missing_ok=True)
+
+
+class Streams:
+    """Registry of streams per tenant (reference: streams.rs:1561-1643)."""
+
+    def __init__(self, options: Options, ingestor_id: str | None = None):
+        self.options = options
+        self.ingestor_id = ingestor_id
+        self._streams: dict[tuple[str | None, str], Stream] = {}
+        self._lock = threading.RLock()
+
+    def get(self, name: str, tenant: str | None = None) -> Stream | None:
+        with self._lock:
+            return self._streams.get((tenant, name))
+
+    def get_or_create(
+        self, name: str, metadata: LogStreamMetadata | None = None, tenant: str | None = None
+    ) -> Stream:
+        with self._lock:
+            key = (tenant, name)
+            s = self._streams.get(key)
+            if s is None:
+                s = Stream(name, self.options, metadata, self.ingestor_id, tenant)
+                s.recover_orphans()
+                self._streams[key] = s
+            elif metadata is not None:
+                s.metadata = metadata
+            return s
+
+    def contains(self, name: str, tenant: str | None = None) -> bool:
+        with self._lock:
+            return (tenant, name) in self._streams
+
+    def list_names(self, tenant: str | None = None) -> list[str]:
+        with self._lock:
+            return sorted(n for (t, n) in self._streams if t == tenant)
+
+    def delete(self, name: str, tenant: str | None = None) -> None:
+        with self._lock:
+            s = self._streams.pop((tenant, name), None)
+        if s is not None:
+            import shutil
+
+            shutil.rmtree(s.data_path, ignore_errors=True)
+
+    def flush_and_convert(self, shutdown: bool = False) -> dict[str, list[Path]]:
+        """Per-stream prepare_parquet (reference: streams.rs:1518-1556)."""
+        with self._lock:
+            streams = list(self._streams.values())
+        out: dict[str, list[Path]] = {}
+        for s in streams:
+            try:
+                out[s.name] = s.prepare_parquet(shutdown)
+            except Exception:
+                logger.exception("flush_and_convert failed for stream %s", s.name)
+        return out

@@ -1,0 +1,92 @@
+"""Prometheus metrics registry.
+
+Parity target: src/metrics/mod.rs:32-873 (~35 families). The same metric
+names/labels are kept so dashboards scrape identically.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
+
+METRICS_NAMESPACE = "parseable"
+
+REGISTRY = CollectorRegistry()
+
+
+def _gauge(name: str, doc: str, labels: list[str]) -> Gauge:
+    return Gauge(name, doc, labels, namespace=METRICS_NAMESPACE, registry=REGISTRY)
+
+
+def _counter(name: str, doc: str, labels: list[str]) -> Counter:
+    return Counter(name, doc, labels, namespace=METRICS_NAMESPACE, registry=REGISTRY)
+
+
+# --- ingest --------------------------------------------------------------
+EVENTS_INGESTED = _gauge("events_ingested", "Events ingested", ["stream", "format"])
+EVENTS_INGESTED_SIZE = _gauge("events_ingested_size", "Events ingested size bytes", ["stream", "format"])
+LIFETIME_EVENTS_INGESTED = _gauge("lifetime_events_ingested", "Lifetime events ingested", ["stream", "format"])
+LIFETIME_EVENTS_INGESTED_SIZE = _gauge(
+    "lifetime_events_ingested_size", "Lifetime events ingested size", ["stream", "format"]
+)
+EVENTS_INGESTED_DATE = _gauge(
+    "events_ingested_date", "Events ingested on date", ["stream", "format", "date"]
+)
+EVENTS_INGESTED_SIZE_DATE = _gauge(
+    "events_ingested_size_date", "Events ingested size on date", ["stream", "format", "date"]
+)
+
+# --- storage -------------------------------------------------------------
+STORAGE_SIZE = _gauge("storage_size", "Storage size bytes", ["type", "stream", "format"])
+EVENTS_DELETED = _gauge("events_deleted", "Events deleted", ["stream", "format"])
+EVENTS_DELETED_SIZE = _gauge("events_deleted_size", "Events deleted size", ["stream", "format"])
+DELETED_EVENTS_STORAGE_SIZE = _gauge(
+    "deleted_events_storage_size", "Deleted events storage size", ["type", "stream", "format"]
+)
+LIFETIME_EVENTS_STORAGE_SIZE = _gauge(
+    "lifetime_events_storage_size", "Lifetime events storage size", ["type", "stream", "format"]
+)
+EVENTS_STORAGE_SIZE_DATE = _gauge(
+    "events_storage_size_date", "Parquet storage size on date", ["type", "stream", "format", "date"]
+)
+STAGING_FILES = _gauge("staging_files", "Staging files count", ["stream"])
+
+# --- query ---------------------------------------------------------------
+QUERY_EXECUTE_TIME = Histogram(
+    "query_execute_time",
+    "Query execute time seconds",
+    ["stream"],
+    namespace=METRICS_NAMESPACE,
+    registry=REGISTRY,
+)
+QUERY_CACHE_HIT = _counter("query_cache_hit", "Query cache hits", ["stream"])
+TOTAL_QUERY_BYTES_SCANNED_DATE = _gauge(
+    "total_query_bytes_scanned_date", "Bytes scanned by queries on date", ["date"]
+)
+DEVICE_EXECUTE_TIME = Histogram(
+    "tpu_execute_time",
+    "On-device operator execution seconds",
+    ["op"],
+    namespace=METRICS_NAMESPACE,
+    registry=REGISTRY,
+)
+DEVICE_BYTES_TO_DEVICE = _counter("tpu_bytes_to_device", "Bytes shipped host->device", ["op"])
+
+# --- storage layer calls (reference: storage/metrics_layer.rs) ----------
+STORAGE_REQUEST_TIME = Histogram(
+    "storage_request_response_time",
+    "Storage request latency",
+    ["backend", "method"],
+    namespace=METRICS_NAMESPACE,
+    registry=REGISTRY,
+)
+
+# --- hot tier ------------------------------------------------------------
+HOT_TIER_DOWNLOAD_BYTES = _counter("hot_tier_download_bytes", "Hot tier bytes downloaded", ["stream"])
+HOT_TIER_SIZE = _gauge("hot_tier_size", "Hot tier size bytes", ["stream"])
+
+# --- alerts --------------------------------------------------------------
+ALERTS_STATES = _counter("alerts_states", "Alert state transitions", ["name", "state"])
+
+
+def render() -> bytes:
+    return generate_latest(REGISTRY)

@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path; see __graft_entry__.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def options(tmp_path):
+    from parseable_tpu.config import Options
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    return opts
+
+
+@pytest.fixture()
+def parseable(tmp_path):
+    """A fully wired local-store Parseable instance in a temp dir."""
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    storage = StorageOptions(backend="local-store", root=tmp_path / "data")
+    return Parseable(opts, storage)

@@ -1,0 +1,154 @@
+"""Schema inference / widening / conflict-rename tests
+(mirrors reference event/format/mod.rs's 25 inline tests)."""
+
+import pyarrow as pa
+
+from parseable_tpu.event.format import (
+    SchemaVersion,
+    datatype_suffix,
+    decode,
+    detect_schema_conflicts,
+    get_schema_key,
+    infer_json_schema,
+    normalize_field_name,
+    prepare_event,
+    rename_per_record_type_mismatches,
+    value_compatible_with_type,
+)
+
+
+def field_map(schema: pa.Schema) -> dict:
+    return {f.name: f for f in schema}
+
+
+def test_infer_v1_numbers_are_float64():
+    s = infer_json_schema([{"a": 1, "b": 2.5}], SchemaVersion.V1)
+    assert s.field("a").type == pa.float64()
+    assert s.field("b").type == pa.float64()
+
+
+def test_infer_v0_int_stays_int64():
+    s = infer_json_schema([{"a": 1}], SchemaVersion.V0)
+    assert s.field("a").type == pa.int64()
+
+
+def test_infer_bool_string_null():
+    s = infer_json_schema([{"f": True, "g": "x", "h": None}])
+    assert s.field("f").type == pa.bool_()
+    assert s.field("g").type == pa.string()
+    assert s.field("h").type == pa.string()  # all-null falls back to string
+
+
+def test_infer_timestamp_for_time_named_fields():
+    s = infer_json_schema([{"created_time": "2024-01-01T00:00:00Z"}], SchemaVersion.V1)
+    assert pa.types.is_timestamp(s.field("created_time").type)
+
+
+def test_infer_timestamp_gated_off():
+    s = infer_json_schema(
+        [{"created_time": "2024-01-01T00:00:00Z"}], SchemaVersion.V1, infer_timestamp=False
+    )
+    assert s.field("created_time").type == pa.string()
+
+
+def test_non_time_named_string_not_timestamp():
+    s = infer_json_schema([{"message": "2024-01-01T00:00:00Z"}], SchemaVersion.V1)
+    assert s.field("message").type == pa.string()
+
+
+def test_at_prefix_normalized():
+    assert normalize_field_name("@timestamp") == "_timestamp"
+    s = infer_json_schema([{"@timestamp": "x"}])
+    assert "_timestamp" in s.names
+
+
+def test_int_float_widening_across_records():
+    s = infer_json_schema([{"a": 1}, {"a": 2.5}], SchemaVersion.V0)
+    assert s.field("a").type == pa.float64()
+
+
+def test_mixed_types_fall_back_to_string():
+    s = infer_json_schema([{"a": 1}, {"a": "x"}], SchemaVersion.V0)
+    assert s.field("a").type == pa.string()
+
+
+def test_value_compatibility():
+    assert value_compatible_with_type(1, pa.int64())
+    assert not value_compatible_with_type(True, pa.int64())
+    assert value_compatible_with_type(1, pa.float64())
+    assert not value_compatible_with_type("x", pa.float64())
+    assert value_compatible_with_type("2024-01-01T00:00:00Z", pa.timestamp("ms"))
+    assert not value_compatible_with_type("hello", pa.timestamp("ms"))
+    assert value_compatible_with_type(None, pa.int64())
+
+
+def test_detect_schema_conflicts():
+    stored = field_map(pa.schema([pa.field("a", pa.float64())]))
+    renames = detect_schema_conflicts([{"a": "oops"}], stored)
+    assert renames == {"a": "a_str"}
+
+
+def test_detect_no_conflicts():
+    stored = field_map(pa.schema([pa.field("a", pa.float64())]))
+    assert detect_schema_conflicts([{"a": 2.0}], stored) == {}
+
+
+def test_rename_only_offending_record():
+    stored = field_map(pa.schema([pa.field("a", pa.float64())]))
+    records = [{"a": 1.0}, {"a": "bad"}]
+    renames = detect_schema_conflicts(records, stored)
+    out = rename_per_record_type_mismatches(records, stored, renames)
+    assert out[0] == {"a": 1.0}
+    assert out[1] == {"a_str": "bad"}
+
+
+def test_datatype_suffix():
+    assert datatype_suffix(pa.int64()) == "int64"
+    assert datatype_suffix(pa.float64()) == "float64"
+    assert datatype_suffix(pa.string()) == "str"
+    assert datatype_suffix(pa.bool_()) == "bool"
+    assert datatype_suffix(pa.timestamp("ms")) == "ts"
+
+
+def test_prepare_event_first_schema():
+    ev = prepare_event([{"a": 1, "b": "x"}], None)
+    assert ev.is_first
+    assert ev.schema.field("a").type == pa.float64()
+
+
+def test_prepare_event_stored_type_wins():
+    stored = field_map(pa.schema([pa.field("a", pa.int64())]))
+    ev = prepare_event([{"a": 7}], stored)
+    assert not ev.is_first
+    assert ev.schema.field("a").type == pa.int64()
+
+
+def test_prepare_event_timestamp_override():
+    stored = field_map(pa.schema([pa.field("ts", pa.timestamp("ms"))]))
+    ev = prepare_event([{"ts": "2024-01-01T00:00:00Z"}], stored)
+    assert pa.types.is_timestamp(ev.schema.field("ts").type)
+
+
+def test_decode_roundtrip():
+    records = [{"a": 1.5, "b": "x", "c": True}, {"a": 2.0, "b": None, "c": False}]
+    schema = infer_json_schema(records)
+    rb = decode(records, schema)
+    assert rb.num_rows == 2
+    assert rb.column(rb.schema.get_field_index("a")).to_pylist() == [1.5, 2.0]
+    assert rb.column(rb.schema.get_field_index("b")).to_pylist() == ["x", None]
+
+
+def test_decode_timestamp_parsing():
+    records = [{"event_time": "2024-01-01T12:30:00Z"}]
+    schema = infer_json_schema(records)
+    rb = decode(records, schema)
+    v = rb.column(0)[0].as_py()
+    assert v.year == 2024 and v.minute == 30
+
+
+def test_schema_key_stable_and_order_insensitive():
+    k1 = get_schema_key(["b", "a"])
+    k2 = get_schema_key(["a", "b"])
+    assert k1 == k2
+    assert len(k1) == 16
+    assert get_schema_key(["a", "c"]) != k1

@@ -1,0 +1,138 @@
+"""JSON flattening parity tests (mirrors reference flatten.rs unit tests)."""
+
+import pytest
+
+from parseable_tpu.utils.flatten import (
+    JsonFlattenError,
+    flatten,
+    generic_flattening,
+    has_more_than_max_allowed_levels,
+    validate_custom_partition,
+)
+
+
+def test_flatten_single_key():
+    assert flatten({"key": "value"}) == {"key": "value"}
+    assert flatten({"key": 1}) == {"key": 1}
+
+
+def test_flatten_nested_object():
+    got = flatten({"key": "value", "nested_key": {"key": "value"}}, ".")
+    assert got == {"key": "value", "nested_key.key": "value"}
+
+
+def test_flatten_deeply_nested():
+    got = flatten({"a": {"b": {"c": 1}}}, "_")
+    assert got == {"a_b_c": 1}
+
+
+def test_flatten_array_of_objects_to_columns():
+    got = flatten({"a": [{"b": 1}, {"b": 2}]}, "_")
+    assert got == {"a_b": [1, 2]}
+
+
+def test_flatten_array_of_objects_missing_keys_padded():
+    got = flatten({"a": [{"b": 1}, {"c": 2}]}, "_")
+    assert got == {"a_b": [1, None], "a_c": [None, 2]}
+
+
+def test_flatten_array_with_nulls():
+    got = flatten({"a": [{"b": 1}, None, {"b": 3}]}, "_")
+    assert got == {"a_b": [1, None, 3]}
+
+
+def test_flatten_scalar_array_untouched():
+    got = flatten({"a": [1, 2, 3]}, "_")
+    assert got == {"a": [1, 2, 3]}
+
+
+def test_flatten_top_level_array():
+    got = flatten([{"a": {"b": 1}}, {"c": 2}], "_")
+    assert got == [{"a_b": 1}, {"c": 2}]
+
+
+def test_flatten_non_object_fails():
+    with pytest.raises(JsonFlattenError):
+        flatten("just a string")
+    with pytest.raises(JsonFlattenError):
+        flatten(42)
+
+
+def test_flatten_non_object_in_object_array_fails():
+    with pytest.raises(JsonFlattenError):
+        flatten({"a": [{"b": 1}, 5]}, "_")
+
+
+# --- generic_flattening (reference doc examples) ----------------------------
+
+def test_generic_simple():
+    assert generic_flattening({"a": 1}) == [{"a": 1}]
+
+
+def test_generic_array_passthrough():
+    assert generic_flattening([{"a": 1}, {"b": 2}]) == [{"a": 1}, {"b": 2}]
+
+
+def test_generic_nested_array_cross_product():
+    got = generic_flattening([{"a": [{"b": 1}, {"c": 2}]}])
+    assert got == [{"a": {"b": 1}}, {"a": {"c": 2}}]
+
+
+def test_generic_cross_product_with_sibling():
+    got = generic_flattening({"a": [{"b": 1}, {"c": 2}], "d": {"e": 4}})
+    assert {"a": {"b": 1}, "d": {"e": 4}} in got
+    assert {"a": {"c": 2}, "d": {"e": 4}} in got
+    assert len(got) == 2
+
+
+def test_generic_empty_array_kept():
+    assert generic_flattening({"a": [], "b": 1}) == [{"a": [], "b": 1}]
+
+
+# --- depth limit ------------------------------------------------------------
+
+def test_depth_limit_exceeded():
+    deep = {"a": {"b": {"c": {"d": {"e": ["a", "b"]}}}}}
+    assert has_more_than_max_allowed_levels(deep, 4)
+    assert not has_more_than_max_allowed_levels(deep, 10)
+
+
+def test_depth_limit_ok():
+    v = {"a": [{"b": 1}, {"c": 2}], "d": {"e": 4}}
+    assert not has_more_than_max_allowed_levels(v, 4)
+
+
+# --- custom partition validation -------------------------------------------
+
+def test_custom_partition_missing():
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": 1}, "missing")
+
+
+def test_custom_partition_null_or_empty():
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": None}, "a")
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": ""}, "a")
+
+
+def test_custom_partition_object_or_array():
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": {"b": 1}}, "a")
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": [1]}, "a")
+
+
+def test_custom_partition_period_and_float():
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": "x.y"}, "a")
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": 1.5}, "a")
+    # ints and period-free strings are fine
+    validate_custom_partition({"a": 1, "b": "xy"}, "a,b")
+
+
+def test_custom_partition_multiple_fields():
+    validate_custom_partition({"a": 1, "b": "ok"}, "a, b")
+    with pytest.raises(JsonFlattenError):
+        validate_custom_partition({"a": 1}, "a,b")

@@ -1,0 +1,173 @@
+"""Staging engine tests: push -> flush -> convert -> upload -> catalog.
+
+Mirrors the reference's streams.rs / staging tests (filename encoding,
+parquet conversion, orphan recovery) plus the full pipeline through
+Parseable.sync (the reference covers that via docker+quest; here it's unit).
+"""
+
+from datetime import UTC, datetime
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.event.json_format import JsonEvent
+from parseable_tpu.staging.reader import MergedReverseRecordReader
+from parseable_tpu.streams import LogStreamMetadata, Stream
+
+
+def make_batch(ts_values, extra=None):
+    cols = {DEFAULT_TIMESTAMP_KEY: pa.array(ts_values, type=pa.timestamp("ms"))}
+    if extra:
+        cols.update(extra)
+    return pa.RecordBatch.from_pydict(cols)
+
+
+@pytest.fixture()
+def stream(options):
+    return Stream("teststream", options, LogStreamMetadata())
+
+
+def test_filename_encoding(stream):
+    ts = datetime(2020, 1, 21, 10, 30)
+    name = stream.filename_by_partition("abc123", ts, {"key1": "value1"})
+    assert name.startswith("abc123.date=2020-01-21.hour=10.minute=30.key1=value1.")
+    assert name.endswith(".data.part.arrows")
+
+
+def test_push_flush_creates_arrows(stream):
+    ts = datetime(2024, 5, 1, 10, 30)
+    batch = make_batch([datetime(2024, 5, 1, 10, 30, 5)], {"msg": pa.array(["hello"])})
+    stream.push("k1", batch, ts)
+    assert stream.arrow_files() == []  # still open
+    done = stream.flush(forced=True)
+    assert len(done) == 1
+    assert done[0].name.endswith(".data.arrows")
+
+
+def test_convert_to_parquet_sorted_desc(stream):
+    ts = datetime(2024, 5, 1, 10, 30)
+    t0 = datetime(2024, 5, 1, 10, 30, 1)
+    t1 = datetime(2024, 5, 1, 10, 30, 2)
+    t2 = datetime(2024, 5, 1, 10, 30, 3)
+    stream.push("k1", make_batch([t0, t1], {"v": pa.array([1.0, 2.0])}), ts)
+    stream.push("k1", make_batch([t2], {"v": pa.array([3.0])}), ts)
+    outs = stream.prepare_parquet(shutdown=True)
+    assert len(outs) == 1
+    table = pq.read_table(outs[0])
+    tss = table.column(DEFAULT_TIMESTAMP_KEY).to_pylist()
+    assert tss == sorted(tss, reverse=True)
+    assert stream.arrow_files() == []  # consumed
+
+
+def test_convert_merges_different_schemas_same_minute(stream):
+    ts = datetime(2024, 5, 1, 10, 30)
+    stream.push("k1", make_batch([datetime(2024, 5, 1, 10, 30, 1)], {"a": pa.array([1.0])}), ts)
+    stream.push("k2", make_batch([datetime(2024, 5, 1, 10, 30, 2)], {"b": pa.array(["x"])}), ts)
+    outs = stream.prepare_parquet(shutdown=True)
+    assert len(outs) == 1
+    table = pq.read_table(outs[0])
+    assert set(table.column_names) >= {"a", "b", DEFAULT_TIMESTAMP_KEY}
+    assert table.num_rows == 2
+
+
+def test_chunked_by_max_arrow_files(options):
+    options.max_arrow_files_per_parquet = 2
+    stream = Stream("chunked", options, LogStreamMetadata())
+    ts = datetime(2024, 5, 1, 10, 30)
+    for i in range(5):
+        stream.push("k1", make_batch([datetime(2024, 5, 1, 10, 30, i)]), ts)
+        stream.flush(forced=True)  # one arrows file per push
+    assert len(stream.arrow_files()) == 5
+    outs = stream.convert_disk_files_to_parquet()
+    assert len(outs) == 3  # ceil(5/2)
+
+
+def test_reverse_reader_merges_by_ts_desc(stream, options):
+    ts = datetime(2024, 5, 1, 10, 30)
+    stream.push("k1", make_batch([datetime(2024, 5, 1, 10, 30, 1)]), ts)
+    stream.flush(forced=True)
+    stream.push("k1", make_batch([datetime(2024, 5, 1, 10, 30, 9)]), ts)
+    stream.flush(forced=True)
+    reader = MergedReverseRecordReader(stream.arrow_files())
+    batches = list(reader)
+    first_ts = batches[0].column(0)[0].as_py()
+    last_ts = batches[-1].column(0)[0].as_py()
+    assert first_ts > last_ts
+
+
+def test_orphan_part_recovery(options, stream):
+    ts = datetime(2024, 5, 1, 10, 30)
+    stream.push("k1", make_batch([datetime(2024, 5, 1, 10, 30, 1)]), ts)
+    # simulate crash: writer not finished; a finished-but-unrenamed file needs
+    # a valid footer, so emulate by finishing then renaming back to .part
+    done = stream.flush(forced=True)[0]
+    part = done.with_name(done.name.replace(".data.arrows", ".data.part.arrows"))
+    done.rename(part)
+    # plus a garbage part file
+    bad = stream.data_path / "bad.data.part.arrows"
+    bad.write_bytes(b"not arrow")
+    stream.recover_orphans()
+    names = [p.name for p in stream.arrow_files()]
+    assert len(names) == 1
+    assert not bad.exists()
+
+
+def test_stream_relative_path(stream):
+    p = stream.data_path / "date=2024-05-01.hour=10.minute=30.host1.data.parquet"
+    rel = stream.stream_relative_path(p)
+    assert rel == "teststream/date=2024-05-01/hour=10/minute=30/host1.data.parquet"
+
+
+def test_stream_relative_path_custom_partition(stream):
+    p = stream.data_path / "date=2024-05-01.hour=10.minute=30.region=us.host1.data.parquet"
+    rel = stream.stream_relative_path(p)
+    assert rel == "teststream/date=2024-05-01/hour=10/minute=30/region=us/host1.data.parquet"
+
+
+# --- full pipeline through Parseable ---------------------------------------
+
+def test_ingest_convert_upload_catalog(parseable):
+    p = parseable
+    stream = p.create_stream_if_not_exists("app1")
+    records = [
+        {"msg": "hello", "status": 200, "host": "a"},
+        {"msg": "world", "status": 500, "host": "b"},
+    ]
+    ev = JsonEvent(records, "app1").into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    # first event committed the schema through the callback
+    assert "status" in stream.metadata.schema
+
+    p.local_sync(shutdown=True)
+    assert len(stream.parquet_files()) == 1
+    p.sync_all_streams()
+    assert stream.parquet_files() == []
+
+    # catalog updated
+    fmt = p.metastore.get_stream_json("app1")
+    assert len(fmt.snapshot.manifest_list) == 1
+    item = fmt.snapshot.manifest_list[0]
+    assert item.events_ingested == 2
+    manifest = p.metastore.get_manifest(item.manifest_path[: -len("/manifest.json")])
+    assert manifest is not None
+    assert manifest.files[0].num_rows == 2
+    cols = {c.name for c in manifest.files[0].columns}
+    assert DEFAULT_TIMESTAMP_KEY in cols
+
+    # uploaded parquet actually exists in object store at the manifest path
+    data = p.storage.get_object(manifest.files[0].file_path)
+    assert data[:4] == b"PAR1"
+
+
+def test_schema_persisted_and_reloaded(parseable, tmp_path):
+    p = parseable
+    stream = p.create_stream_if_not_exists("app2")
+    ev = JsonEvent([{"a": 1}], "app2").into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    p.commit_schema("app2", ev.rb.schema)
+    schema = p.metastore.get_schema("app2")
+    assert schema is not None
+    assert "a" in schema.names
+    assert DEFAULT_TIMESTAMP_KEY in schema.names

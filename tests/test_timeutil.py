@@ -1,0 +1,144 @@
+"""Time parsing + prefix generation parity tests.
+
+Expected values mirror the reference's documented examples and unit tests
+(/root/reference/src/utils/time.rs doc comments and tests)."""
+
+from datetime import UTC, datetime, timedelta
+
+import pytest
+
+from parseable_tpu.utils.timeutil import (
+    TimeParseError,
+    TimeRange,
+    minute_slot,
+    parse_duration,
+    parse_rfc3339,
+    truncate_to_minute,
+)
+
+
+def test_parse_duration_basic():
+    assert parse_duration("10m") == timedelta(minutes=10)
+    assert parse_duration("1h") == timedelta(hours=1)
+    assert parse_duration("2 days") == timedelta(days=2)
+    assert parse_duration("1h 30m") == timedelta(hours=1, minutes=30)
+
+
+def test_parse_duration_invalid():
+    with pytest.raises(TimeParseError):
+        parse_duration("abc")
+    with pytest.raises(TimeParseError):
+        parse_duration("")
+    with pytest.raises(TimeParseError):
+        parse_duration("10 parsecs")
+
+
+def test_parse_rfc3339():
+    dt = parse_rfc3339("2022-06-11T23:00:01+00:00")
+    assert dt == datetime(2022, 6, 11, 23, 0, 1, tzinfo=UTC)
+    assert parse_rfc3339("2022-06-11T23:00:01Z") == dt
+    # offset normalization
+    assert parse_rfc3339("2022-06-12T01:00:01+02:00") == dt
+
+
+def test_parse_human_time_now():
+    tr = TimeRange.parse_human_time("10m", "now")
+    assert (tr.end - tr.start) == timedelta(minutes=10)
+    assert tr.start.second == 0 and tr.end.second == 0
+
+
+def test_parse_human_time_rfc3339_truncates():
+    tr = TimeRange.parse_human_time("2022-06-11T23:00:59Z", "2022-06-11T23:30:59Z")
+    assert tr.start == datetime(2022, 6, 11, 23, 0, tzinfo=UTC)
+    assert tr.end == datetime(2022, 6, 11, 23, 30, tzinfo=UTC)
+
+
+def test_parse_human_time_start_after_end():
+    with pytest.raises(TimeParseError):
+        TimeRange.parse_human_time("2022-06-12T00:00:00Z", "2022-06-11T00:00:00Z")
+
+
+def test_minute_slot():
+    assert minute_slot(15, 10) == "10-19"
+    assert minute_slot(15, 1) == "15"
+    assert minute_slot(0, 1) == "00"
+    assert minute_slot(59, 15) == "45-59"
+
+
+def test_truncate_to_minute():
+    dt = datetime(2022, 6, 11, 23, 59, 59, 999999, tzinfo=UTC)
+    assert truncate_to_minute(dt) == datetime(2022, 6, 11, 23, 59, tzinfo=UTC)
+
+
+# reference doc example 1 (time.rs:216)
+def test_generate_prefixes_hour_spans():
+    tr = TimeRange(
+        parse_rfc3339("2022-06-11T23:00:01+00:00"),
+        parse_rfc3339("2022-06-12T01:59:59+00:00"),
+    )
+    assert tr.generate_prefixes(1) == [
+        "date=2022-06-11/hour=23/",
+        "date=2022-06-12/hour=00/",
+        "date=2022-06-12/hour=01/",
+    ]
+
+
+# reference doc example 2 (time.rs:217)
+def test_generate_prefixes_minute_spans():
+    tr = TimeRange(
+        parse_rfc3339("2022-06-11T15:59:00+00:00"),
+        parse_rfc3339("2022-06-11T17:01:00+00:00"),
+    )
+    assert tr.generate_prefixes(1) == [
+        "date=2022-06-11/hour=15/minute=59/",
+        "date=2022-06-11/hour=16/",
+        "date=2022-06-11/hour=17/minute=00/",
+    ]
+
+
+# reference test (time.rs:623): single minute
+def test_generate_prefixes_single_minute():
+    tr = TimeRange(
+        parse_rfc3339("2022-06-11T16:30:00+00:00"),
+        parse_rfc3339("2022-06-11T16:31:00+00:00"),
+    )
+    assert tr.generate_prefixes(1) == ["date=2022-06-11/hour=16/minute=30/"]
+
+
+# reference test (time.rs:628): two minutes
+def test_generate_prefixes_two_minutes():
+    tr = TimeRange(
+        parse_rfc3339("2022-06-11T16:57:00+00:00"),
+        parse_rfc3339("2022-06-11T16:59:00+00:00"),
+    )
+    assert tr.generate_prefixes(1) == [
+        "date=2022-06-11/hour=16/minute=57/",
+        "date=2022-06-11/hour=16/minute=58/",
+    ]
+
+
+def test_generate_prefixes_full_hour():
+    tr = TimeRange(
+        parse_rfc3339("2022-06-11T16:00:00+00:00"),
+        parse_rfc3339("2022-06-11T17:00:00+00:00"),
+    )
+    assert tr.generate_prefixes(1) == ["date=2022-06-11/hour=16/"]
+
+
+def test_generate_prefixes_full_days():
+    tr = TimeRange(
+        parse_rfc3339("2022-06-11T00:00:00+00:00"),
+        parse_rfc3339("2022-06-13T00:00:00+00:00"),
+    )
+    prefixes = tr.generate_prefixes(1)
+    assert "date=2022-06-11/" in prefixes
+    assert "date=2022-06-12/" in prefixes
+
+
+def test_granularity_range_contains():
+    ts = parse_rfc3339("2022-06-11T16:30:45+00:00")
+    tr = TimeRange.granularity_range(ts, 1)
+    assert tr.start == datetime(2022, 6, 11, 16, 30, tzinfo=UTC)
+    assert tr.end == datetime(2022, 6, 11, 16, 31, tzinfo=UTC)
+    assert tr.contains(ts)
+    assert not tr.contains(tr.end)
