@@ -1,0 +1,153 @@
+"""Benchmark: time-bucketed GROUP BY aggregation, TPU engine vs CPU baseline.
+
+Reproduces BASELINE.md config 2 (time-bucketed GROUP BY (p_timestamp, status)
+COUNT over a flog-style JSON log stream) through the full stack: staging ->
+parquet -> catalog -> manifest-pruned scan -> engine.
+
+Prints ONE json line:
+    {"metric": ..., "value": rows/sec on TPU, "unit": "rows/s",
+     "vs_baseline": speedup over the CPU pyarrow engine}
+
+Env knobs: BENCH_ROWS (default 2_000_000), BENCH_REPEATS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from datetime import UTC, datetime, timedelta
+
+import numpy as np
+import pyarrow as pa
+
+
+def build_dataset(p, stream_name: str, total_rows: int) -> None:
+    """Synthesize a flog-like access-log stream through the real pipeline."""
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.event import Event
+
+    rng = np.random.default_rng(42)
+    stream = p.create_stream_if_not_exists(stream_name)
+    base = datetime(2024, 5, 1, 0, 0, tzinfo=UTC)
+    batch_rows = 250_000
+    statuses = np.array([200, 200, 200, 200, 301, 404, 500, 503])
+    hosts = np.array([f"10.0.{i}.{j}" for i in range(4) for j in range(8)])
+    methods = np.array(["GET", "GET", "GET", "POST", "PUT", "DELETE"])
+    paths = np.array([f"/api/v1/resource{i}" for i in range(64)])
+    written = 0
+    minute = 0
+    while written < total_rows:
+        n = min(batch_rows, total_rows - written)
+        ts_offsets = np.sort(rng.integers(0, 60_000, n))
+        ts = [base + timedelta(minutes=minute, milliseconds=int(o)) for o in ts_offsets]
+        batch = pa.RecordBatch.from_pydict(
+            {
+                DEFAULT_TIMESTAMP_KEY: pa.array(
+                    [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
+                ),
+                "host": pa.array(hosts[rng.integers(0, len(hosts), n)]),
+                "method": pa.array(methods[rng.integers(0, len(methods), n)]),
+                "path": pa.array(paths[rng.integers(0, len(paths), n)]),
+                "status": pa.array(statuses[rng.integers(0, len(statuses), n)].astype(np.float64)),
+                "bytes": pa.array(rng.integers(100, 50_000, n).astype(np.float64)),
+                "latency_ms": pa.array((rng.random(n) * 500).astype(np.float64)),
+            }
+        )
+        ev = Event(
+            stream_name=stream_name,
+            rb=batch,
+            origin_size=n * 120,
+            is_first_event=written == 0,
+            parsed_timestamp=base + timedelta(minutes=minute),
+        )
+        ev.process(stream, commit_schema=p.commit_schema)
+        written += n
+        minute += 1
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+
+QUERY = (
+    "SELECT date_bin(interval '1 minute', p_timestamp) AS t, status, count(*) AS c, "
+    "sum(bytes) AS b, avg(latency_ms) AS l FROM {stream} GROUP BY t, status"
+)
+
+
+def run_engine(p, stream: str, engine: str, repeats: int) -> tuple[float, int, list]:
+    from parseable_tpu.query.session import QuerySession
+
+    sess = QuerySession(p, engine=engine)
+    best = float("inf")
+    rows_scanned = 0
+    result_rows = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = sess.query(QUERY.format(stream=stream))
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        rows_scanned = res.stats["rows_scanned"]
+        result_rows = sorted(
+            (str(r.get("t")), r.get("status"), r.get("c")) for r in res.to_json_rows()
+        )
+    return best, rows_scanned, result_rows
+
+
+def main() -> None:
+    total_rows = int(os.environ.get("BENCH_ROWS", "2000000"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+
+    workdir = tempfile.mkdtemp(prefix="ptpu-bench-")
+    try:
+        from parseable_tpu.config import Options, StorageOptions
+        from parseable_tpu.core import Parseable
+
+        opts = Options()
+        opts.local_staging_path = __import__("pathlib").Path(workdir) / "staging"
+        storage = StorageOptions(backend="local-store", root=__import__("pathlib").Path(workdir) / "data")
+        p = Parseable(opts, storage)
+
+        t0 = time.perf_counter()
+        build_dataset(p, "bench", total_rows)
+        print(f"# dataset: {total_rows} rows built+cataloged in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+        import jax
+
+        print(f"# devices: {jax.devices()}", file=sys.stderr)
+
+        # warm both engines (first TPU call pays XLA compile)
+        run_engine(p, "bench", "cpu", 1)
+        run_engine(p, "bench", "tpu", 1)
+
+        cpu_t, rows, cpu_rows = run_engine(p, "bench", "cpu", repeats)
+        tpu_t, _, tpu_rows = run_engine(p, "bench", "tpu", repeats)
+
+        if cpu_rows != tpu_rows:
+            print("# WARNING: engine results differ!", file=sys.stderr)
+            print(f"#   cpu: {cpu_rows[:3]}... tpu: {tpu_rows[:3]}...", file=sys.stderr)
+
+        tpu_rps = rows / tpu_t
+        cpu_rps = rows / cpu_t
+        print(
+            f"# cpu: {cpu_t:.3f}s ({cpu_rps:,.0f} rows/s)  tpu: {tpu_t:.3f}s ({tpu_rps:,.0f} rows/s)",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "groupby_scan_rows_per_sec_tpu",
+                    "value": round(tpu_rps, 1),
+                    "unit": "rows/s",
+                    "vs_baseline": round(cpu_t / tpu_t, 3),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+"""Host <-> device columnar encoding.
+
+TPU/XLA wants static shapes, fixed-width dtypes, and no strings. This module
+turns pyarrow columns into device-friendly ndarrays:
+
+- numerics -> float32 / int32 (+ validity mask)
+- timestamps -> int32 *relative* values: offset from the query range start,
+  in ms when the span fits int32, else seconds (avoids int64/x64 on TPU)
+- strings -> host-side dictionary encode; int32 codes go to device, the
+  dictionary stays on host. String predicates (=, LIKE, regex) evaluate over
+  the (small) dictionary once, then become an O(1) boolean LUT gather on
+  device — this is why the "regex filter over 10 GB of logs" benchmark maps
+  so well to TPU: the regex runs over unique values only.
+- rows are padded to power-of-two block sizes so XLA compiles a handful of
+  kernel shapes, not one per batch. Padding rows carry mask=0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import UTC, datetime
+from typing import Any
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+MS_INT32_SPAN = 2**31 - 1
+
+
+def pow2_block(n: int, minimum: int = 1024, maximum: int = 1 << 22) -> int:
+    b = minimum
+    while b < n and b < maximum:
+        b <<= 1
+    return b
+
+
+@dataclass
+class EncodedColumn:
+    """One column ready for device transfer."""
+
+    name: str
+    kind: str  # "num" | "dict" | "time" | "bool"
+    values: np.ndarray  # float32/int32 data or int32 codes
+    valid: np.ndarray  # bool validity
+    dictionary: list[Any] | None = None  # host-side dict values (kind=dict)
+    all_valid: bool = False  # True -> `valid` need not ship to device
+    vmin: int | None = None  # time cols: min/max of valid values (rel units)
+    vmax: int | None = None
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.dictionary) if self.dictionary is not None else 0
+
+
+@dataclass
+class EncodedBatch:
+    """A padded row block: every column padded to `block_rows`."""
+
+    num_rows: int
+    block_rows: int
+    columns: dict[str, EncodedColumn]
+    row_mask: np.ndarray  # bool [block_rows]; False on padding
+    time_origin_ms: int = 0
+    time_unit_ms: int = 1  # 1 = ms resolution, 1000 = seconds
+
+
+def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
+    if len(a) == n:
+        return a
+    out = np.full(n, fill, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def encode_column(
+    name: str,
+    col: pa.ChunkedArray | pa.Array,
+    block_rows: int,
+    time_origin_ms: int,
+    time_unit_ms: int,
+    force_dict: bool = False,
+) -> EncodedColumn | None:
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    t = col.type
+    all_valid = col.null_count == 0
+    if all_valid:
+        valid = np.ones(block_rows, dtype=bool)
+        valid[len(col) :] = False
+    else:
+        valid = np.asarray(pc.is_valid(col).to_numpy(zero_copy_only=False), dtype=bool)
+        valid = _pad(valid, block_rows, False)
+    # padding rows are invalid, but a fully-populated block still ships no mask
+    all_valid = all_valid and len(col) == block_rows
+
+    if force_dict and not (
+        pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_dictionary(t)
+    ):
+        # group-by keys of any type become dictionary codes (GROUP BY status
+        # on a float column, GROUP BY a bool flag, ...)
+        denc = pc.dictionary_encode(col)
+        if isinstance(denc, pa.ChunkedArray):
+            denc = denc.combine_chunks()
+        codes = np.asarray(denc.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+        dictionary = denc.dictionary.to_pylist()
+        codes = np.where(codes < 0, len(dictionary), codes)
+        return EncodedColumn(
+            name,
+            "dict",
+            _pad(codes, block_rows, len(dictionary)),
+            valid,
+            dictionary + [None],
+            all_valid=all_valid,
+        )
+    if pa.types.is_timestamp(t):
+        ms = np.asarray(pc.cast(col, pa.int64()).fill_null(0).to_numpy(zero_copy_only=False))
+        if str(t).startswith("timestamp[us"):
+            ms = ms // 1000
+        elif str(t).startswith("timestamp[ns"):
+            ms = ms // 1_000_000
+        rel = (ms - time_origin_ms) // time_unit_ms
+        if len(rel) and (rel.min() < -MS_INT32_SPAN or rel.max() > MS_INT32_SPAN):
+            return None  # would wrap int32 -> caller takes the CPU path
+        vals = _pad(rel.astype(np.int32), block_rows)
+        if col.null_count == len(col):
+            vmin = vmax = None
+        elif col.null_count == 0:
+            vmin, vmax = int(rel.min()) if len(rel) else None, int(rel.max()) if len(rel) else None
+        else:
+            live = rel[np.asarray(pc.is_valid(col).to_numpy(zero_copy_only=False), bool)]
+            vmin, vmax = (int(live.min()), int(live.max())) if len(live) else (None, None)
+        return EncodedColumn(
+            name, "time", vals, valid, all_valid=all_valid, vmin=vmin, vmax=vmax
+        )
+    if pa.types.is_boolean(t):
+        vals = np.asarray(col.fill_null(False).to_numpy(zero_copy_only=False), dtype=np.float32)
+        return EncodedColumn(name, "bool", _pad(vals, block_rows), valid, all_valid=all_valid)
+    if pa.types.is_integer(t) or pa.types.is_floating(t):
+        vals = np.asarray(
+            pc.cast(col, pa.float64()).fill_null(0.0).to_numpy(zero_copy_only=False)
+        ).astype(np.float32)
+        return EncodedColumn(name, "num", _pad(vals, block_rows), valid, all_valid=all_valid)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        denc = pc.dictionary_encode(col)
+        if isinstance(denc, pa.ChunkedArray):
+            denc = denc.combine_chunks()
+        codes = np.asarray(denc.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+        # null -> extra slot at end so gathers stay in-bounds
+        dictionary = denc.dictionary.to_pylist()
+        codes = np.where(codes < 0, len(dictionary), codes)
+        return EncodedColumn(
+            name,
+            "dict",
+            _pad(codes, block_rows, len(dictionary)),
+            valid,
+            dictionary + [None],
+            all_valid=all_valid,
+        )
+    if pa.types.is_dictionary(t):
+        codes = np.asarray(col.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+        dictionary = col.dictionary.to_pylist()
+        codes = np.where(codes < 0, len(dictionary), codes)
+        return EncodedColumn(
+            name,
+            "dict",
+            _pad(codes, block_rows, len(dictionary)),
+            valid,
+            dictionary + [None],
+            all_valid=all_valid,
+        )
+    return None  # unsupported (lists, nested) -> caller falls back to CPU
+
+
+def choose_time_encoding(low: datetime | None, high: datetime | None) -> tuple[int, int]:
+    """(origin_ms, unit_ms) for relative int32 timestamps.
+
+    ms resolution only when both bounds exist and the span fits int32;
+    otherwise seconds (int32 seconds from origin covers ±68 years, so an
+    open-ended range can never wrap). Sub-second WHERE comparisons on an
+    unbounded range lose precision — the scan-level time filter (applied
+    exactly on host) still guards the API range.
+    """
+    origin = int(low.timestamp() * 1000) if low is not None else 0
+    if low is not None and high is not None:
+        span = int((high - low).total_seconds() * 1000)
+        unit = 1 if span < MS_INT32_SPAN else 1000
+    else:
+        unit = 1000
+    return origin, unit
+
+
+def encode_table(
+    table: pa.Table,
+    needed: set[str] | None,
+    time_low: datetime | None,
+    time_high: datetime | None,
+    block_rows: int | None = None,
+    dict_columns: set[str] | None = None,
+) -> EncodedBatch | None:
+    """Encode a table for device execution; None if a needed column can't be.
+
+    `dict_columns` forces dictionary encoding (group-by keys of any type).
+    """
+    n = table.num_rows
+    block = block_rows or pow2_block(n)
+    origin, unit = choose_time_encoding(time_low, time_high)
+    cols: dict[str, EncodedColumn] = {}
+    for name in table.column_names:
+        if needed is not None and name not in needed:
+            continue
+        enc = encode_column(
+            name,
+            table.column(name),
+            block,
+            origin,
+            unit,
+            force_dict=bool(dict_columns and name in dict_columns),
+        )
+        if enc is None:
+            return None
+        cols[name] = enc
+    mask = np.zeros(block, dtype=bool)
+    mask[:n] = True
+    return EncodedBatch(
+        num_rows=n,
+        block_rows=block,
+        columns=cols,
+        row_mask=mask,
+        time_origin_ms=origin,
+        time_unit_ms=unit,
+    )
+
+
+def rel_time_value(dt: datetime, origin_ms: int, unit_ms: int) -> int:
+    ms = int(dt.timestamp() * 1000)
+    return (ms - origin_ms) // unit_ms
+
+
+def abs_time_from_rel(rel: int, origin_ms: int, unit_ms: int) -> datetime:
+    return datetime.fromtimestamp((rel * unit_ms + origin_ms) / 1000.0, UTC).replace(tzinfo=None)

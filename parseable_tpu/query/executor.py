@@ -1,0 +1,669 @@
+"""CPU query executor over pyarrow.compute — the measured baseline engine.
+
+Structure mirrors what the TPU backend needs: scans produce tables, each
+table contributes a *partial aggregate*, partials merge associatively, and a
+finalize step evaluates the select list. The TPU engine (ops/, executor_tpu)
+plugs into the same frame with device kernels producing the partials — and a
+mesh psum replacing the host merge loop in distributed mode.
+
+Reference analogue: DataFusion physical operators under src/query/mod.rs.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from datetime import UTC, datetime, timedelta
+from typing import Any, Iterator
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from parseable_tpu.query import sql as S
+from parseable_tpu.query.planner import LogicalPlan
+from parseable_tpu.utils.timeutil import parse_duration
+
+
+class ExecError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------- expression eval
+
+
+def _interval_to_timedelta(text: str) -> timedelta:
+    return parse_duration(text)
+
+
+def evaluate(e: S.Expr, table: pa.Table) -> Any:
+    """Evaluate a scalar (non-aggregate) expression -> Array or python scalar."""
+    if isinstance(e, S.Literal):
+        return e.value
+    if isinstance(e, S.Column):
+        if e.name not in table.column_names:
+            return pa.nulls(table.num_rows)
+        return table.column(e.name).combine_chunks()
+    if isinstance(e, S.Star):
+        raise ExecError("'*' outside count()")
+    if isinstance(e, S.IntervalLit):
+        return _interval_to_timedelta(e.text)
+    if isinstance(e, S.UnaryOp):
+        v = evaluate(e.operand, table)
+        if e.op == "-":
+            return pc.negate(_arr(v, table)) if _is_arr(v) else -v
+        if e.op == "not":
+            return pc.invert(_arr(v, table))
+        raise ExecError(f"unknown unary op {e.op}")
+    if isinstance(e, S.BinaryOp):
+        return _eval_binary(e, table)
+    if isinstance(e, S.InList):
+        arr = _arr(evaluate(e.expr, table), table)
+        values = [i.value if isinstance(i, S.Literal) else evaluate(i, table) for i in e.items]
+        mask = pc.is_in(arr, value_set=pa.array(values))
+        return pc.invert(mask) if e.negated else mask
+    if isinstance(e, S.Between):
+        arr = _arr(evaluate(e.expr, table), table)
+        lo = _coerce_scalar(evaluate(e.low, table), arr.type)
+        hi = _coerce_scalar(evaluate(e.high, table), arr.type)
+        mask = pc.and_(pc.greater_equal(arr, lo), pc.less_equal(arr, hi))
+        return pc.invert(mask) if e.negated else mask
+    if isinstance(e, S.IsNull):
+        arr = _arr(evaluate(e.expr, table), table)
+        return pc.is_valid(arr) if e.negated else pc.is_null(arr)
+    if isinstance(e, S.Cast):
+        return _eval_cast(e, table)
+    if isinstance(e, S.Case):
+        return _eval_case(e, table)
+    if isinstance(e, S.FunctionCall):
+        return _eval_function(e, table)
+    raise ExecError(f"cannot evaluate {e!r}")
+
+
+def _is_arr(v: Any) -> bool:
+    return isinstance(v, (pa.Array, pa.ChunkedArray))
+
+
+def _arr(v: Any, table: pa.Table) -> pa.Array:
+    if isinstance(v, pa.ChunkedArray):
+        return v.combine_chunks()
+    if isinstance(v, pa.Array):
+        return v
+    return pa.array([v] * table.num_rows)
+
+
+def _coerce_scalar(v: Any, t: pa.DataType) -> Any:
+    if pa.types.is_timestamp(t):
+        if isinstance(v, str):
+            from parseable_tpu.utils.timeutil import parse_rfc3339
+
+            return pa.scalar(parse_rfc3339(v).replace(tzinfo=None), type=t)
+        if isinstance(v, datetime):
+            return pa.scalar(v.replace(tzinfo=None) if v.tzinfo else v, type=t)
+    return v
+
+
+def _eval_binary(e: S.BinaryOp, table: pa.Table) -> Any:
+    op = e.op
+    if op in ("and", "or"):
+        l = _arr(evaluate(e.left, table), table)
+        r = _arr(evaluate(e.right, table), table)
+        return pc.and_kleene(l, r) if op == "and" else pc.or_kleene(l, r)
+    if op in ("like", "ilike", "not_like", "not_ilike"):
+        arr = _arr(evaluate(e.left, table), table)
+        pattern = evaluate(e.right, table)
+        if not isinstance(pattern, str):
+            raise ExecError("LIKE pattern must be a string literal")
+        mask = pc.match_like(arr, pattern, ignore_case="ilike" in op)
+        return pc.invert(mask) if op.startswith("not_") else mask
+    if op == "||":
+        l = _arr(evaluate(e.left, table), table)
+        r = _arr(evaluate(e.right, table), table)
+        return pc.binary_join_element_wise(pc.cast(l, pa.string()), pc.cast(r, pa.string()), "")
+
+    lv = evaluate(e.left, table)
+    rv = evaluate(e.right, table)
+    # timestamp +/- interval
+    if isinstance(rv, timedelta) and op in ("+", "-"):
+        arr = _arr(lv, table)
+        delta = pa.scalar(rv, type=pa.duration("ms"))
+        return pc.add(arr, delta) if op == "+" else pc.subtract(arr, delta)
+    larr = _is_arr(lv)
+    rarr = _is_arr(rv)
+    if not larr and not rarr:
+        return _python_binop(op, lv, rv)
+    a = _arr(lv, table) if larr else lv
+    b = _arr(rv, table) if rarr else rv
+    # coerce scalar side for timestamp comparisons
+    if larr and not rarr:
+        b = _coerce_scalar(b, a.type)
+    if rarr and not larr:
+        a = _coerce_scalar(a, b.type)
+    fns = {
+        "+": pc.add,
+        "-": pc.subtract,
+        "*": pc.multiply,
+        "/": pc.divide,
+        "%": lambda x, y: pc.subtract(x, pc.multiply(pc.floor(pc.divide(x, y)), y)),
+        "=": pc.equal,
+        "!=": pc.not_equal,
+        "<": pc.less,
+        "<=": pc.less_equal,
+        ">": pc.greater,
+        ">=": pc.greater_equal,
+    }
+    if op not in fns:
+        raise ExecError(f"unknown operator {op}")
+    return fns[op](a, b)
+
+
+def _python_binop(op: str, a: Any, b: Any) -> Any:
+    import operator
+
+    fns = {
+        "+": operator.add, "-": operator.sub, "*": operator.mul,
+        "/": operator.truediv, "%": operator.mod, "=": operator.eq,
+        "!=": operator.ne, "<": operator.lt, "<=": operator.le,
+        ">": operator.gt, ">=": operator.ge,
+    }
+    return fns[op](a, b)
+
+
+_CAST_TYPES = {
+    "int": pa.int64(), "integer": pa.int64(), "bigint": pa.int64(),
+    "float": pa.float64(), "double": pa.float64(), "real": pa.float64(),
+    "text": pa.string(), "varchar": pa.string(), "string": pa.string(),
+    "bool": pa.bool_(), "boolean": pa.bool_(),
+    "timestamp": pa.timestamp("ms"), "date": pa.date32(),
+}
+
+
+def _eval_cast(e: S.Cast, table: pa.Table) -> Any:
+    v = evaluate(e.expr, table)
+    t = _CAST_TYPES.get(e.type_name)
+    if t is None:
+        raise ExecError(f"unknown cast type {e.type_name}")
+    if _is_arr(v):
+        return pc.cast(_arr(v, table), t, safe=False)
+    return pa.scalar(v, type=t).as_py() if v is not None else None
+
+
+def _eval_case(e: S.Case, table: pa.Table) -> Any:
+    result = None
+    if e.else_expr is not None:
+        result = _arr(evaluate(e.else_expr, table), table)
+    for cond, then in reversed(e.whens):
+        mask = _arr(evaluate(cond, table), table)
+        then_v = _arr(evaluate(then, table), table)
+        if result is None:
+            result = pc.if_else(mask, then_v, pa.nulls(table.num_rows, then_v.type))
+        else:
+            result = pc.if_else(mask, then_v, result)
+    return result
+
+
+def date_bin(interval: timedelta, arr: pa.Array, origin: datetime | None = None) -> pa.Array:
+    """Floor timestamps to interval buckets (DataFusion date_bin parity)."""
+    step_ms = int(interval.total_seconds() * 1000)
+    if step_ms <= 0:
+        raise ExecError("date_bin interval must be positive")
+    origin_ms = int(origin.timestamp() * 1000) if origin else 0
+    ints = pc.cast(arr, pa.int64())
+    binned = pc.add(
+        pc.multiply(
+            pc.floor(pc.divide(pc.cast(pc.subtract(ints, origin_ms), pa.float64()), step_ms)),
+            float(step_ms),
+        ),
+        float(origin_ms),
+    )
+    return pc.cast(pc.cast(binned, pa.int64()), arr.type)
+
+
+def _eval_function(e: S.FunctionCall, table: pa.Table) -> Any:
+    name = e.name
+    if name == "date_bin":
+        if len(e.args) < 2:
+            raise ExecError("date_bin(interval, column[, origin])")
+        interval = evaluate(e.args[0], table)
+        if not isinstance(interval, timedelta):
+            interval = _interval_to_timedelta(str(interval))
+        arr = _arr(evaluate(e.args[1], table), table)
+        origin = None
+        if len(e.args) > 2:
+            o = evaluate(e.args[2], table)
+            if isinstance(o, str):
+                from parseable_tpu.utils.timeutil import parse_rfc3339
+
+                origin = parse_rfc3339(o)
+        return date_bin(interval, arr, origin)
+    if name == "date_trunc":
+        if len(e.args) != 2:
+            raise ExecError("date_trunc(unit, column)")
+        unit = evaluate(e.args[0], table)
+        arr = _arr(evaluate(e.args[1], table), table)
+        return pc.floor_temporal(arr, unit=str(unit).lower())
+    if name == "to_timestamp" or name == "to_timestamp_millis":
+        v = evaluate(e.args[0], table)
+        if _is_arr(v):
+            return pc.cast(_arr(v, table), pa.timestamp("ms"), safe=False)
+        from parseable_tpu.utils.timeutil import parse_rfc3339
+
+        return parse_rfc3339(v).replace(tzinfo=None) if isinstance(v, str) else v
+    if name in ("lower", "upper", "length", "abs", "floor", "ceil", "trim"):
+        arr = _arr(evaluate(e.args[0], table), table)
+        fn = {
+            "lower": pc.utf8_lower, "upper": pc.utf8_upper,
+            "length": pc.utf8_length, "abs": pc.abs, "floor": pc.floor,
+            "ceil": pc.ceil, "trim": pc.utf8_trim_whitespace,
+        }[name]
+        return fn(arr)
+    if name == "round":
+        arr = _arr(evaluate(e.args[0], table), table)
+        digits = evaluate(e.args[1], table) if len(e.args) > 1 else 0
+        return pc.round(arr, ndigits=int(digits))
+    if name == "coalesce":
+        args = [_arr(evaluate(a, table), table) for a in e.args]
+        out = args[0]
+        for nxt in args[1:]:
+            out = pc.if_else(pc.is_valid(out), out, nxt)
+        return out
+    if name == "now":
+        return datetime.now(UTC).replace(tzinfo=None)
+    if name in ("regexp_match", "regexp_like"):
+        arr = _arr(evaluate(e.args[0], table), table)
+        pattern = evaluate(e.args[1], table)
+        return pc.match_substring_regex(arr, str(pattern))
+    if name == "strpos":
+        arr = _arr(evaluate(e.args[0], table), table)
+        sub = evaluate(e.args[1], table)
+        return pc.add(pc.find_substring(arr, str(sub)), 1)
+    raise ExecError(f"unknown function {name}")
+
+
+# ---------------------------------------------------------------- aggregation
+
+
+@dataclass
+class AggSpec:
+    func: str  # count | count_star | sum | min | max | avg | count_distinct
+    arg: S.Expr | None
+    out_name: str
+
+
+def _collect_aggs(e: S.Expr, out: list[AggSpec], counter: list[int]) -> S.Expr:
+    """Replace aggregate calls in `e` with Column refs to computed agg slots;
+    append specs to `out`. Returns the rewritten expression."""
+    if isinstance(e, S.FunctionCall) and e.name in S.AGGREGATE_FUNCS:
+        func = e.name
+        arg: S.Expr | None = None
+        if func == "count" and (not e.args or isinstance(e.args[0], S.Star)):
+            func = "count_star"
+        elif e.args:
+            arg = e.args[0]
+        if func == "approx_distinct":
+            func = "count_distinct"
+        slot = f"__agg{counter[0]}"
+        counter[0] += 1
+        out.append(AggSpec(func, arg, slot))
+        return S.Column(slot)
+    if isinstance(e, S.BinaryOp):
+        return S.BinaryOp(e.op, _collect_aggs(e.left, out, counter), _collect_aggs(e.right, out, counter))
+    if isinstance(e, S.UnaryOp):
+        return S.UnaryOp(e.op, _collect_aggs(e.operand, out, counter))
+    if isinstance(e, S.Cast):
+        return S.Cast(_collect_aggs(e.expr, out, counter), e.type_name)
+    if isinstance(e, S.Case):
+        return S.Case(
+            [(_collect_aggs(w, out, counter), _collect_aggs(t, out, counter)) for w, t in e.whens],
+            _collect_aggs(e.else_expr, out, counter) if e.else_expr else None,
+        )
+    return e
+
+
+@dataclass
+class GroupState:
+    count: list[int]
+    sums: list[float]
+    mins: list[Any]
+    maxs: list[Any]
+    distincts: list[set]
+
+
+class HashAggregator:
+    """Streaming partial aggregation keyed by group tuples.
+
+    `update(table)` folds one table in; `merge(other)` combines partials
+    (used by the distributed tree); `finalize()` emits one row per group.
+    """
+
+    def __init__(self, group_exprs: list[S.Expr], specs: list[AggSpec]):
+        self.group_exprs = group_exprs
+        self.specs = specs
+        self.groups: dict[tuple, GroupState] = {}
+
+    def _new_state(self) -> GroupState:
+        n = len(self.specs)
+        return GroupState(
+            count=[0] * n,
+            sums=[0.0] * n,
+            mins=[None] * n,
+            maxs=[None] * n,
+            distincts=[set() for _ in range(n)],
+        )
+
+    def update(self, table: pa.Table, mask: pa.Array | None = None) -> None:
+        """Vectorized partial aggregation via pyarrow group_by (the hash
+        aggregate runs in Arrow's C++ kernels; only the per-*group* merge is
+        Python)."""
+        if mask is not None:
+            table = table.filter(mask)
+        if table.num_rows == 0:
+            return
+        n = table.num_rows
+        cols: dict[str, pa.Array] = {}
+        key_names = []
+        for i, g in enumerate(self.group_exprs):
+            key_names.append(f"__k{i}")
+            cols[f"__k{i}"] = _arr(evaluate(g, table), table)
+        aggs: list[tuple[str, str]] = []
+        for si, spec in enumerate(self.specs):
+            if spec.func == "count_star":
+                continue
+            cols[f"__a{si}"] = _arr(evaluate(spec.arg, table), table)
+            if spec.func in ("sum", "avg"):
+                aggs.append((f"__a{si}", "sum"))
+                aggs.append((f"__a{si}", "count"))
+            elif spec.func == "min":
+                aggs.append((f"__a{si}", "min"))
+            elif spec.func == "max":
+                aggs.append((f"__a{si}", "max"))
+            elif spec.func == "count":
+                aggs.append((f"__a{si}", "count"))
+        aggs.append(([], "count_all"))
+        tmp = pa.table(cols) if cols else pa.table({"__dummy": pa.nulls(n, pa.int8())})
+        grouped = tmp.group_by(key_names, use_threads=False).aggregate(aggs)
+
+        gcols = {name: grouped.column(name).to_pylist() for name in grouped.column_names}
+        keys_lists = [gcols[k] for k in key_names]
+        rows_out = len(grouped)
+        for r in range(rows_out):
+            key = tuple(kl[r] for kl in keys_lists)
+            st = self.groups.get(key)
+            if st is None:
+                st = self._new_state()
+                self.groups[key] = st
+            for si, spec in enumerate(self.specs):
+                if spec.func == "count_star":
+                    st.count[si] += gcols["count_all"][r]
+                elif spec.func in ("sum", "avg"):
+                    st.count[si] += gcols[f"__a{si}_count"][r]
+                    s = gcols[f"__a{si}_sum"][r]
+                    if s is not None:
+                        st.sums[si] += s
+                elif spec.func == "min":
+                    v = gcols[f"__a{si}_min"][r]
+                    if v is not None:
+                        st.count[si] += 1
+                        st.mins[si] = v if st.mins[si] is None else min(st.mins[si], v)
+                elif spec.func == "max":
+                    v = gcols[f"__a{si}_max"][r]
+                    if v is not None:
+                        st.count[si] += 1
+                        st.maxs[si] = v if st.maxs[si] is None else max(st.maxs[si], v)
+                elif spec.func == "count":
+                    st.count[si] += gcols[f"__a{si}_count"][r]
+
+        # exact distinct: unique (keys, value) combos per chunk -> host sets
+        for si, spec in enumerate(self.specs):
+            if spec.func != "count_distinct":
+                continue
+            sel = key_names + [f"__a{si}"]
+            uniq = tmp.select(sel).group_by(sel, use_threads=False).aggregate([])
+            ucols = {name: uniq.column(name).to_pylist() for name in uniq.column_names}
+            for r in range(len(uniq)):
+                key = tuple(ucols[k][r] for k in key_names)
+                v = ucols[f"__a{si}"][r]
+                if v is None:
+                    continue
+                st = self.groups.get(key)
+                if st is None:
+                    st = self._new_state()
+                    self.groups[key] = st
+                st.distincts[si].add(v)
+
+    def merge(self, other: "HashAggregator") -> None:
+        for key, st in other.groups.items():
+            mine = self.groups.get(key)
+            if mine is None:
+                self.groups[key] = st
+                continue
+            for si, spec in enumerate(self.specs):
+                mine.count[si] += st.count[si]
+                mine.sums[si] += st.sums[si]
+                for attr, fn in (("mins", min), ("maxs", max)):
+                    a = getattr(mine, attr)[si]
+                    b = getattr(st, attr)[si]
+                    getattr(mine, attr)[si] = b if a is None else (a if b is None else fn(a, b))
+                mine.distincts[si] |= st.distincts[si]
+
+    def merge_raw(self, key: tuple, counts: list[int], sums: list[float], mins: list, maxs: list) -> None:
+        """Merge one group's partials produced by a device kernel."""
+        st = self.groups.get(key)
+        if st is None:
+            st = self._new_state()
+            self.groups[key] = st
+        for si in range(len(self.specs)):
+            st.count[si] += counts[si]
+            st.sums[si] += sums[si]
+            for attr, vals, fn in (("mins", mins, min), ("maxs", maxs, max)):
+                a = getattr(st, attr)[si]
+                b = vals[si]
+                getattr(st, attr)[si] = b if a is None else (a if b is None else fn(a, b))
+
+    def finalize_value(self, st: GroupState, si: int) -> Any:
+        spec = self.specs[si]
+        if spec.func in ("count_star", "count"):
+            return st.count[si]
+        if spec.func == "sum":
+            return st.sums[si] if st.count[si] else None
+        if spec.func == "avg":
+            return st.sums[si] / st.count[si] if st.count[si] else None
+        if spec.func == "min":
+            return st.mins[si]
+        if spec.func == "max":
+            return st.maxs[si]
+        if spec.func == "count_distinct":
+            return len(st.distincts[si])
+        raise ExecError(f"unknown aggregate {spec.func}")
+
+
+# ------------------------------------------------------------------- executor
+
+
+class QueryExecutor:
+    """Execute a LogicalPlan over an iterator of tables (CPU engine)."""
+
+    def __init__(self, plan: LogicalPlan):
+        self.plan = plan
+
+    # -- shared pieces -------------------------------------------------------
+
+    def _where_mask(self, table: pa.Table) -> pa.Array | None:
+        w = self.plan.select.where
+        if w is None:
+            return None
+        mask = _arr(evaluate(w, table), table)
+        if not pa.types.is_boolean(mask.type):
+            raise ExecError("WHERE must be boolean")
+        return mask
+
+    def execute(self, tables: Iterator[pa.Table]) -> pa.Table:
+        if self.plan.is_aggregate:
+            return self._execute_aggregate(tables)
+        return self._execute_select(tables)
+
+    # -- plain select --------------------------------------------------------
+
+    def _execute_select(self, tables: Iterator[pa.Table]) -> pa.Table:
+        sel = self.plan.select
+        out_parts: list[pa.Table] = []
+        rows_needed = None
+        if sel.limit is not None and not sel.order_by and not sel.distinct:
+            rows_needed = sel.limit + (sel.offset or 0)
+        total = 0
+        for table in tables:
+            mask = self._where_mask(table)
+            if mask is not None:
+                table = table.filter(mask)
+            if table.num_rows == 0:
+                continue
+            out_parts.append(self._project(table))
+            total += table.num_rows
+            if rows_needed is not None and total >= rows_needed:
+                break
+        if not out_parts:
+            return self._project(_empty_like(self.plan))
+        from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+        schema = merge_schemas([t.schema for t in out_parts])
+        unified = []
+        for t in out_parts:
+            for b in t.to_batches():
+                unified.append(adapt_batch(schema, b))
+        result = pa.Table.from_batches(unified, schema=schema)
+        if sel.distinct:
+            result = result.group_by(result.column_names).aggregate([])
+        result = self._order_limit(result)
+        return result
+
+    def _project(self, table: pa.Table) -> pa.Table:
+        sel = self.plan.select
+        names: list[str] = []
+        arrays: list[pa.Array] = []
+        for item in sel.items:
+            if isinstance(item.expr, S.Star):
+                for name in table.column_names:
+                    names.append(name)
+                    arrays.append(table.column(name).combine_chunks())
+                continue
+            names.append(item.alias or S.expr_name(item.expr))
+            arrays.append(_arr(evaluate(item.expr, table), table))
+        return pa.table(dict(zip(names, arrays)) if len(set(names)) == len(names) else _dedup(names, arrays))
+
+    # -- aggregate -----------------------------------------------------------
+
+    def build_aggregator(self) -> tuple[HashAggregator, list[S.SelectItem], list[str]]:
+        """Construct the aggregator + rewritten post-agg select items."""
+        sel = self.plan.select
+        specs: list[AggSpec] = []
+        counter = [0]
+        rewritten: list[S.SelectItem] = []
+        for item in sel.items:
+            new_expr = _collect_aggs(item.expr, specs, counter)
+            rewritten.append(S.SelectItem(new_expr, item.alias or S.expr_name(item.expr)))
+        having = _collect_aggs(sel.having, specs, counter) if sel.having else None
+        group_names = [S.expr_name(g) for g in sel.group_by]
+        agg = HashAggregator(sel.group_by, specs)
+        self._having = having
+        return agg, rewritten, group_names
+
+    def _execute_aggregate(self, tables: Iterator[pa.Table]) -> pa.Table:
+        agg, rewritten, group_names = self.build_aggregator()
+        for table in tables:
+            mask = self._where_mask(table)
+            agg.update(table, mask)
+        return self.finalize_aggregate(agg, rewritten, group_names)
+
+    def finalize_aggregate(
+        self, agg: HashAggregator, rewritten: list[S.SelectItem], group_names: list[str]
+    ) -> pa.Table:
+        sel = self.plan.select
+        if not agg.groups and not sel.group_by:
+            agg.groups[()] = agg._new_state()
+        # build a table of group keys + agg slots
+        cols: dict[str, list] = {f"__g{i}": [] for i in range(len(sel.group_by))}
+        for si in range(len(agg.specs)):
+            cols[f"__agg{si}"] = []
+        for key, st in agg.groups.items():
+            for i, kv in enumerate(key):
+                cols[f"__g{i}"].append(kv)
+            for si in range(len(agg.specs)):
+                cols[f"__agg{si}"].append(agg.finalize_value(st, si))
+        interim = pa.table(cols) if cols else pa.table({"__dummy": [None] * len(agg.groups)})
+
+        # group exprs referenced post-agg resolve to the key columns
+        remap: dict[str, str] = {}
+        for i, g in enumerate(sel.group_by):
+            remap[S.expr_name(g)] = f"__g{i}"
+
+        def rewrite_groups(e: S.Expr) -> S.Expr:
+            nm = S.expr_name(e)
+            if nm in remap:
+                return S.Column(remap[nm])
+            if isinstance(e, S.BinaryOp):
+                return S.BinaryOp(e.op, rewrite_groups(e.left), rewrite_groups(e.right))
+            if isinstance(e, S.UnaryOp):
+                return S.UnaryOp(e.op, rewrite_groups(e.operand))
+            if isinstance(e, S.Cast):
+                return S.Cast(rewrite_groups(e.expr), e.type_name)
+            return e
+
+        if getattr(self, "_having", None) is not None:
+            hmask = _arr(evaluate(rewrite_groups(self._having), interim), interim)
+            interim = interim.filter(hmask)
+
+        names, arrays = [], []
+        for item in rewritten:
+            names.append(item.alias)
+            arrays.append(_arr(evaluate(rewrite_groups(item.expr), interim), interim))
+        result = pa.table(_dedup(names, arrays))
+        result = self._order_limit(result)
+        return result
+
+    # -- order / limit -------------------------------------------------------
+
+    def _order_limit(self, table: pa.Table) -> pa.Table:
+        sel = self.plan.select
+        if sel.order_by:
+            keys = []
+            aux_cols = 0
+            for o in sel.order_by:
+                name = S.expr_name(o.expr)
+                if isinstance(o.expr, S.Column) and o.expr.name in table.column_names:
+                    keys.append((o.expr.name, "descending" if o.desc else "ascending"))
+                elif name in table.column_names:
+                    keys.append((name, "descending" if o.desc else "ascending"))
+                else:
+                    aux = f"__sort{aux_cols}"
+                    aux_cols += 1
+                    table = table.append_column(aux, _arr(evaluate(o.expr, table), table))
+                    keys.append((aux, "descending" if o.desc else "ascending"))
+            table = table.sort_by(keys)
+            table = table.select([c for c in table.column_names if not c.startswith("__sort")])
+        off = sel.offset or 0
+        if off:
+            table = table.slice(off)
+        if sel.limit is not None:
+            table = table.slice(0, sel.limit)
+        return table
+
+
+def _dedup(names: list[str], arrays: list) -> dict:
+    out = {}
+    for n, a in zip(names, arrays):
+        base, k = n, 1
+        while n in out:
+            n = f"{base}_{k}"
+            k += 1
+        out[n] = a
+    return out
+
+
+def _empty_like(plan: LogicalPlan) -> pa.Table:
+    """Zero-row table typed from the stream schema (string for unknowns) so
+    select-list expressions still evaluate when the scan matched nothing."""
+    hint: pa.Schema | None = plan.schema_hint  # type: ignore[assignment]
+    known = {f.name: f.type for f in hint} if hint is not None else {}
+    cols = plan.needed_columns if plan.needed_columns is not None else set(known)
+    out = {c: pa.array([], type=known.get(c, pa.string())) for c in sorted(cols)}
+    return pa.table(out or {"__empty": pa.array([], pa.int64())})

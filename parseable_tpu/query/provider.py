@@ -1,0 +1,208 @@
+"""Scan provider: union of staging + hot tier + object-store parquet.
+
+Parity target (reference: src/query/stream_schema_provider.rs:533-666 scan).
+The scan resolves, in order:
+
+1. **staging** — recent in-memory/disk arrows on this node, included when the
+   query range touches the staging window (last ~LOCAL_SYNC_INTERVAL secs);
+2. **hot tier** — parquet files already cached on local NVMe;
+3. **object store** — manifest-pruned parquet (time overlap + column min/max
+   stats), downloaded through the storage client.
+
+Returns pyarrow Tables column-pruned to what the plan needs. All sources are
+adapted to the merged stream schema so mixed-schema files union cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from datetime import UTC, datetime, timedelta
+from pathlib import Path
+from typing import Iterator
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY, LOCAL_SYNC_INTERVAL
+from parseable_tpu.catalog import ManifestFile, Snapshot
+from parseable_tpu.core import Parseable
+from parseable_tpu.query.planner import LogicalPlan, prune_file
+from parseable_tpu.utils.metrics import TOTAL_QUERY_BYTES_SCANNED_DATE
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ScanStats:
+    files_total: int = 0
+    files_pruned: int = 0
+    bytes_scanned: int = 0
+    rows_scanned: int = 0
+    staging_batches: int = 0
+
+
+class StreamScan:
+    """Materialize a stream's sources for one query."""
+
+    def __init__(self, parseable: Parseable, plan: LogicalPlan, hot_tier_dir: Path | None = None):
+        self.p = parseable
+        self.plan = plan
+        self.hot_tier_dir = hot_tier_dir
+        self.stats = ScanStats()
+
+    # ---------------------------------------------------------------- helpers
+
+    def merged_snapshot(self) -> Snapshot:
+        """Union of all nodes' snapshots for this stream
+        (reference: stream_schema_provider.rs:566-585)."""
+        merged = Snapshot()
+        for fmt in self.p.metastore.get_all_stream_jsons(self.plan.stream):
+            merged.manifest_list.extend(fmt.snapshot.manifest_list)
+        return merged
+
+    def _within_staging_window(self) -> bool:
+        """Does the query range touch data still in staging?
+        (reference: stream_schema_provider.rs:849-871)."""
+        high = self.plan.time_bounds.high
+        if high is None:
+            return True
+        window_start = datetime.now(UTC) - timedelta(seconds=2 * LOCAL_SYNC_INTERVAL)
+        return high >= window_start
+
+    def _columns_for_read(self, available: list[str]) -> list[str] | None:
+        needed = self.plan.needed_columns
+        if needed is None:
+            return None
+        cols = [c for c in available if c in needed]
+        # always carry the timestamp column for time filtering
+        if DEFAULT_TIMESTAMP_KEY in available and DEFAULT_TIMESTAMP_KEY not in cols:
+            cols.append(DEFAULT_TIMESTAMP_KEY)
+        return cols
+
+    # ---------------------------------------------------------------- sources
+
+    def manifest_files(self) -> list[ManifestFile]:
+        """Manifest entries after time + stats pruning."""
+        snapshot = self.merged_snapshot()
+        items = snapshot.manifests_for_range(self.plan.time_bounds.low, self.plan.time_bounds.high)
+        files: list[ManifestFile] = []
+        seen: set[str] = set()
+        for item in items:
+            prefix = item.manifest_path[: -len("/manifest.json")]
+            manifest = self.p.metastore.get_manifest(prefix)
+            if manifest is None:
+                continue
+            for f in manifest.files:
+                if f.file_path in seen:
+                    continue
+                seen.add(f.file_path)
+                self.stats.files_total += 1
+                if not self._file_overlaps_time(f):
+                    self.stats.files_pruned += 1
+                    continue
+                if not prune_file(f, self.plan.constraints):
+                    self.stats.files_pruned += 1
+                    continue
+                files.append(f)
+        return files
+
+    def _file_overlaps_time(self, f: ManifestFile) -> bool:
+        tb = self.plan.time_bounds
+        if tb.low is None and tb.high is None:
+            return True
+        for col in f.columns:
+            if col.name == DEFAULT_TIMESTAMP_KEY and col.stats is not None:
+                lo = datetime.fromtimestamp(col.stats.min / 1000, UTC)
+                hi = datetime.fromtimestamp(col.stats.max / 1000, UTC)
+                if tb.low is not None and hi < tb.low:
+                    return False
+                if tb.high is not None and lo >= tb.high:
+                    return False
+        return True
+
+    def _read_parquet(self, f: ManifestFile) -> pa.Table | None:
+        """Read a manifest entry: hot tier first, else object store."""
+        local: Path | None = None
+        if self.hot_tier_dir is not None:
+            cand = self.hot_tier_dir / f.file_path
+            if cand.is_file():
+                local = cand
+        try:
+            if local is None:
+                import io
+
+                data = self.p.storage.get_object(f.file_path)
+                self.stats.bytes_scanned += len(data)
+                src = io.BytesIO(data)
+            else:
+                self.stats.bytes_scanned += local.stat().st_size
+                src = local
+            pf = pq.ParquetFile(src)
+            cols = self._columns_for_read(pf.schema_arrow.names)
+            table = pf.read(columns=cols)
+            self.stats.rows_scanned += table.num_rows
+            return table
+        except Exception:
+            logger.exception("failed reading parquet %s", f.file_path)
+            return None
+
+    def staging_tables(self) -> Iterator[pa.Table]:
+        """This node's staging data (arrows not yet converted + local parquet
+        not yet uploaded)."""
+        stream = self.p.streams.get(self.plan.stream)
+        if stream is None:
+            return
+        batches = stream.staging_batches()
+        if batches:
+            self.stats.staging_batches += len(batches)
+            table = pa.Table.from_batches(batches)
+            cols = self._columns_for_read(table.column_names)
+            if cols is not None:
+                table = table.select(cols)
+            yield table
+        for f in stream.parquet_files():
+            try:
+                pf = pq.ParquetFile(f)
+                cols = self._columns_for_read(pf.schema_arrow.names)
+                t = pf.read(columns=cols)
+                self.stats.rows_scanned += t.num_rows
+                yield t
+            except Exception:
+                logger.exception("failed reading staged parquet %s", f)
+
+    # ------------------------------------------------------------------ scan
+
+    def tables(self) -> Iterator[pa.Table]:
+        """All sources, time-filtered at row level."""
+        if self._within_staging_window():
+            for t in self.staging_tables():
+                t = self._apply_time_filter(t)
+                if t.num_rows:
+                    yield t
+        for f in self.manifest_files():
+            t = self._read_parquet(f)
+            if t is None:
+                continue
+            t = self._apply_time_filter(t)
+            if t.num_rows:
+                yield t
+        TOTAL_QUERY_BYTES_SCANNED_DATE.labels(datetime.now(UTC).date().isoformat()).inc(
+            self.stats.bytes_scanned
+        )
+
+    def _apply_time_filter(self, table: pa.Table) -> pa.Table:
+        tb = self.plan.time_bounds
+        if (tb.low is None and tb.high is None) or DEFAULT_TIMESTAMP_KEY not in table.column_names:
+            return table
+        import pyarrow.compute as pc
+
+        col = table.column(DEFAULT_TIMESTAMP_KEY)
+        mask = None
+        if tb.low is not None:
+            mask = pc.greater_equal(col, pa.scalar(tb.low.replace(tzinfo=None), type=col.type))
+        if tb.high is not None:
+            m2 = pc.less(col, pa.scalar(tb.high.replace(tzinfo=None), type=col.type))
+            mask = m2 if mask is None else pc.and_(mask, m2)
+        return table.filter(mask)
+

@@ -1,0 +1,145 @@
+"""Query session: SQL + time range -> plan -> scan -> execute -> JSON rows.
+
+Parity target (reference: src/query/mod.rs QUERY_SESSION / Query::execute,
+handlers/http/query.rs::query): API callers pass SQL plus startTime/endTime;
+time filters are injected into the plan exactly like the reference's
+`final_logical_plan`, the count(*) fast path is served from manifest row
+counts, and everything else runs on the selected engine (tpu|cpu).
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+from dataclasses import dataclass, field
+from datetime import UTC
+from typing import Any
+
+import pyarrow as pa
+
+from parseable_tpu.core import Parseable
+from parseable_tpu.query import sql as S
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.planner import LogicalPlan, TimeBounds, plan as build_plan
+from parseable_tpu.query.provider import StreamScan
+from parseable_tpu.utils.arrowutil import record_batches_to_json
+from parseable_tpu.utils.metrics import QUERY_EXECUTE_TIME
+from parseable_tpu.utils.timeutil import TimeRange
+
+logger = logging.getLogger(__name__)
+
+
+class QueryError(ValueError):
+    pass
+
+
+@dataclass
+class QueryResult:
+    table: pa.Table
+    fields: list[str]
+    stats: dict[str, Any] = field(default_factory=dict)
+
+    def to_json_rows(self) -> list[dict]:
+        return record_batches_to_json(self.table.to_batches())
+
+
+class QuerySession:
+    """One engine-backed session over a Parseable instance."""
+
+    def __init__(self, parseable: Parseable, engine: str | None = None):
+        self.p = parseable
+        self.engine = engine or parseable.options.query_engine
+
+    def resolve_stream(self, name: str) -> None:
+        """Make sure the stream exists locally, loading from storage when a
+        querier sees it for the first time (query.rs:558-618)."""
+        if self.p.streams.get(name) is None:
+            self.p.load_streams_from_storage()
+        if self.p.streams.get(name) is None:
+            raise QueryError(f"stream {name!r} does not exist")
+
+    def query(
+        self,
+        sql_text: str,
+        start_time: str | None = None,
+        end_time: str | None = None,
+    ) -> QueryResult:
+        t0 = _time.monotonic()
+        select = S.parse_sql(sql_text)
+        lp = build_plan(select)
+        self.resolve_stream(lp.stream)
+        stream = self.p.streams.get(lp.stream)
+        if stream is not None and stream.metadata.schema:
+            lp.schema_hint = pa.schema(list(stream.metadata.schema.values()))
+
+        if start_time and end_time:
+            tr = TimeRange.parse_human_time(start_time, end_time)
+            api_bounds = TimeBounds(low=tr.start, high=tr.end)
+            lp.time_bounds = lp.time_bounds.intersect(api_bounds)
+
+        scan = StreamScan(self.p, lp, hot_tier_dir=self.p.options.hot_tier_storage_path)
+        result = self._execute(lp, scan)
+        elapsed = _time.monotonic() - t0
+        QUERY_EXECUTE_TIME.labels(lp.stream).observe(elapsed)
+        result.stats.update(
+            {
+                "elapsed_secs": round(elapsed, 6),
+                "engine": self.engine,
+                "files_total": scan.stats.files_total,
+                "files_pruned": scan.stats.files_pruned,
+                "bytes_scanned": scan.stats.bytes_scanned,
+                "rows_scanned": scan.stats.rows_scanned,
+            }
+        )
+        return result
+
+    def _execute(self, lp: LogicalPlan, scan: StreamScan) -> QueryResult:
+        # count(*) fast path off manifest row counts, only when every
+        # overlapping file lies fully inside the time bounds
+        if lp.count_star_only:
+            fast = self._try_manifest_count(lp, scan)
+            if fast is not None:
+                name = lp.select.items[0].alias or "count(*)"
+                table = pa.table({name: pa.array([fast], pa.int64())})
+                return QueryResult(table, [name], {"fast_path": "manifest_count"})
+
+        if self.engine == "tpu":
+            from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+
+            executor: QueryExecutor = TpuQueryExecutor(lp, self.p.options)
+        else:
+            executor = QueryExecutor(lp)
+        table = executor.execute(scan.tables())
+        return QueryResult(table, table.column_names)
+
+    def _try_manifest_count(self, lp: LogicalPlan, scan: StreamScan) -> int | None:
+        from datetime import datetime
+
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+        tb = lp.time_bounds
+        total = 0
+        partial = False
+        for f in scan.manifest_files():
+            lo = hi = None
+            for col in f.columns:
+                if col.name == DEFAULT_TIMESTAMP_KEY and col.stats is not None:
+                    lo = datetime.fromtimestamp(col.stats.min / 1000, UTC)
+                    hi = datetime.fromtimestamp(col.stats.max / 1000, UTC)
+            if lo is None:
+                partial = True
+                break
+            inside = (tb.low is None or lo >= tb.low) and (tb.high is None or hi < tb.high)
+            if not inside:
+                partial = True
+                break
+            total += f.num_rows
+        if partial:
+            return None
+        # staging rows within range still need counting
+        stream = self.p.streams.get(lp.stream)
+        if stream is not None and scan._within_staging_window():
+            for t in scan.staging_tables():
+                t = scan._apply_time_filter(t)
+                total += t.num_rows
+        return total

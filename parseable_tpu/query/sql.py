@@ -1,0 +1,582 @@
+"""SQL front end: lexer + recursive-descent parser -> AST.
+
+The reference embeds DataFusion for SQL (src/query/mod.rs); this build has no
+embeddable SQL engine available, so we parse the observability SQL dialect
+ourselves. Coverage targets every query shape the reference's handlers,
+alerts and benchmarks issue:
+
+    SELECT [DISTINCT] exprs FROM stream
+      [WHERE expr] [GROUP BY exprs] [HAVING expr]
+      [ORDER BY expr [ASC|DESC], ...] [LIMIT n [OFFSET m]]
+
+with operators AND/OR/NOT, comparisons, arithmetic, IN, BETWEEN, LIKE/ILIKE,
+IS [NOT] NULL, CASE WHEN, CAST, and functions (count/sum/avg/min/max,
+count(distinct), approx_distinct, date_bin, date_trunc, to_timestamp,
+lower/upper/length/coalesce, ...).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+# --------------------------------------------------------------------- lexer
+
+KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having", "order",
+    "limit", "offset", "and", "or", "not", "in", "between", "like", "ilike",
+    "is", "null", "as", "asc", "desc", "case", "when", "then", "else", "end",
+    "cast", "true", "false", "interval",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # kw | ident | number | string | op | eof
+    value: Any
+    pos: int
+
+
+class SqlError(ValueError):
+    pass
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and i + 1 < n and sql[i + 1] == "-":  # line comment
+            while i < n and sql[i] != "\n":
+                i += 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            while j < n and (sql[j].isdigit() or (sql[j] == "." and not seen_dot) or sql[j] in "eE" or (sql[j] in "+-" and sql[j - 1] in "eE")):
+                if sql[j] == ".":
+                    seen_dot = True
+                j += 1
+            text = sql[i:j]
+            try:
+                value = int(text)
+            except ValueError:
+                value = float(text)
+            tokens.append(Token("number", value, i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            lw = word.lower()
+            if lw in KEYWORDS:
+                tokens.append(Token("kw", lw, i))
+            else:
+                tokens.append(Token("ident", word, i))
+            i = j
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "'" and j + 1 < n and sql[j + 1] == "'":
+                    buf.append("'")
+                    j += 2
+                elif sql[j] == "'":
+                    break
+                else:
+                    buf.append(sql[j])
+                    j += 1
+            if j >= n:
+                raise SqlError(f"unterminated string at {i}")
+            tokens.append(Token("string", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == '"':
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise SqlError(f"unterminated quoted identifier at {i}")
+            tokens.append(Token("ident", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        if c == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                raise SqlError(f"unterminated quoted identifier at {i}")
+            tokens.append(Token("ident", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        two = sql[i : i + 2]
+        if two in ("<=", ">=", "!=", "<>", "||"):
+            tokens.append(Token("op", "!=" if two == "<>" else two, i))
+            i += 2
+            continue
+        if c in "+-*/%(),.<>=;":
+            tokens.append(Token("op", c, i))
+            i += 1
+            continue
+        raise SqlError(f"unexpected character {c!r} at {i}")
+    tokens.append(Token("eof", None, n))
+    return tokens
+
+
+# ----------------------------------------------------------------------- AST
+
+
+@dataclass
+class Expr:
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: Any  # int | float | str | bool | None
+
+
+@dataclass
+class Column(Expr):
+    name: str
+    table: str | None = None
+
+
+@dataclass
+class Star(Expr):
+    pass
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str  # "-" | "not"
+    operand: Expr
+
+
+@dataclass
+class BinaryOp(Expr):
+    op: str  # + - * / % = != < <= > >= and or like ilike ||
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class InList(Expr):
+    expr: Expr
+    items: list[Expr]
+    negated: bool = False
+
+
+@dataclass
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+@dataclass
+class FunctionCall(Expr):
+    name: str  # lowercase
+    args: list[Expr]
+    distinct: bool = False
+
+
+@dataclass
+class Cast(Expr):
+    expr: Expr
+    type_name: str
+
+
+@dataclass
+class Case(Expr):
+    whens: list[tuple[Expr, Expr]]
+    else_expr: Expr | None = None
+
+
+@dataclass
+class IntervalLit(Expr):
+    text: str  # e.g. "1 minute"
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: str | None = None
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+
+
+@dataclass
+class Select:
+    items: list[SelectItem]
+    table: str | None = None
+    where: Expr | None = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Expr | None = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: int | None = None
+    offset: int | None = None
+    distinct: bool = False
+
+
+AGGREGATE_FUNCS = {"count", "sum", "min", "max", "avg", "approx_distinct", "count_distinct", "stddev", "var"}
+
+
+def is_aggregate(e: Expr) -> bool:
+    if isinstance(e, FunctionCall):
+        if e.name in AGGREGATE_FUNCS:
+            return True
+        return any(is_aggregate(a) for a in e.args)
+    if isinstance(e, BinaryOp):
+        return is_aggregate(e.left) or is_aggregate(e.right)
+    if isinstance(e, UnaryOp):
+        return is_aggregate(e.operand)
+    if isinstance(e, Cast):
+        return is_aggregate(e.expr)
+    if isinstance(e, Case):
+        return any(is_aggregate(w) or is_aggregate(t) for w, t in e.whens) or (
+            e.else_expr is not None and is_aggregate(e.else_expr)
+        )
+    return False
+
+
+# -------------------------------------------------------------------- parser
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -------------------------------------------------------
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> str | None:
+        t = self.peek()
+        if t.kind == "kw" and t.value in kws:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SqlError(f"expected {kw.upper()} near position {self.peek().pos}")
+
+    def accept_op(self, *ops: str) -> str | None:
+        t = self.peek()
+        if t.kind == "op" and t.value in ops:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SqlError(f"expected {op!r} near position {self.peek().pos}, got {self.peek().value!r}")
+
+    # -- entry ---------------------------------------------------------------
+    def parse(self) -> Select:
+        self.expect_kw("select")
+        sel = self.parse_select_body()
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            raise SqlError(f"trailing tokens at {self.peek().pos}")
+        return sel
+
+    def parse_select_body(self) -> Select:
+        distinct = bool(self.accept_kw("distinct"))
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        table = None
+        if self.accept_kw("from"):
+            t = self.next()
+            if t.kind != "ident":
+                raise SqlError(f"expected table name at {t.pos}")
+            table = t.value
+            # optional alias
+            if self.peek().kind == "ident":
+                self.next()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        group_by: list[Expr] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = None
+        if self.accept_kw("having"):
+            having = self.parse_expr()
+        order_by: list[OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = offset = None
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "number":
+                raise SqlError(f"expected LIMIT count at {t.pos}")
+            limit = int(t.value)
+        if self.accept_kw("offset"):
+            t = self.next()
+            if t.kind != "number":
+                raise SqlError(f"expected OFFSET count at {t.pos}")
+            offset = int(t.value)
+        return Select(
+            items=items,
+            table=table,
+            where=where,
+            group_by=group_by,
+            having=having,
+            order_by=order_by,
+            limit=limit,
+            offset=offset,
+            distinct=distinct,
+        )
+
+    def parse_select_item(self) -> SelectItem:
+        if self.accept_op("*"):
+            return SelectItem(Star())
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            t = self.next()
+            if t.kind not in ("ident", "string"):
+                raise SqlError(f"expected alias at {t.pos}")
+            alias = t.value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    def parse_order_item(self) -> OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        elif self.accept_kw("asc"):
+            desc = False
+        return OrderItem(e, desc)
+
+    # -- expressions (precedence climbing) ----------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        left = self.parse_and()
+        while self.accept_kw("or"):
+            left = BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Expr:
+        left = self.parse_not()
+        while self.accept_kw("and"):
+            left = BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Expr:
+        if self.accept_kw("not"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expr:
+        left = self.parse_additive()
+        negated = bool(self.accept_kw("not"))
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return InList(left, items, negated)
+        if self.accept_kw("between"):
+            low = self.parse_additive()
+            self.expect_kw("and")
+            high = self.parse_additive()
+            return Between(left, low, high, negated)
+        if self.accept_kw("like"):
+            return BinaryOp("not_like" if negated else "like", left, self.parse_additive())
+        if self.accept_kw("ilike"):
+            return BinaryOp("not_ilike" if negated else "ilike", left, self.parse_additive())
+        if negated:
+            raise SqlError(f"unexpected NOT at {self.peek().pos}")
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return IsNull(left, neg)
+        op = self.accept_op("=", "!=", "<", "<=", ">", ">=")
+        if op:
+            return BinaryOp(op, left, self.parse_additive())
+        return left
+
+    def parse_additive(self) -> Expr:
+        left = self.parse_multiplicative()
+        while True:
+            op = self.accept_op("+", "-", "||")
+            if not op:
+                return left
+            left = BinaryOp(op, left, self.parse_multiplicative())
+
+    def parse_multiplicative(self) -> Expr:
+        left = self.parse_unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return left
+            left = BinaryOp(op, left, self.parse_unary())
+
+    def parse_unary(self) -> Expr:
+        if self.accept_op("-"):
+            return UnaryOp("-", self.parse_unary())
+        self.accept_op("+")
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return Literal(t.value)
+        if t.kind == "string":
+            self.next()
+            return Literal(t.value)
+        if t.kind == "kw":
+            if t.value == "null":
+                self.next()
+                return Literal(None)
+            if t.value == "true":
+                self.next()
+                return Literal(True)
+            if t.value == "false":
+                self.next()
+                return Literal(False)
+            if t.value == "interval":
+                self.next()
+                lit = self.next()
+                if lit.kind != "string":
+                    raise SqlError(f"expected interval string at {lit.pos}")
+                return IntervalLit(lit.value)
+            if t.value == "case":
+                return self.parse_case()
+            if t.value == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                ty = self.next()
+                if ty.kind not in ("ident", "kw"):
+                    raise SqlError(f"expected type name at {ty.pos}")
+                type_name = str(ty.value).lower()
+                # types like timestamp(3) / varchar(10)
+                if self.accept_op("("):
+                    while not self.accept_op(")"):
+                        self.next()
+                self.expect_op(")")
+                return Cast(e, type_name)
+            if t.value == "distinct":
+                # inside count(DISTINCT x) handled in function parse; bare =error
+                raise SqlError(f"unexpected DISTINCT at {t.pos}")
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "op" and t.value == "*":
+            self.next()
+            return Star()
+        if t.kind == "ident":
+            self.next()
+            name = t.value
+            if self.accept_op("("):
+                return self.parse_function(name)
+            if self.accept_op("."):
+                col = self.next()
+                if col.kind == "op" and col.value == "*":
+                    return Star()
+                if col.kind != "ident":
+                    raise SqlError(f"expected column after '.' at {col.pos}")
+                return Column(col.value, table=name)
+            return Column(name)
+        raise SqlError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_function(self, name: str) -> Expr:
+        lname = name.lower()
+        distinct = bool(self.accept_kw("distinct"))
+        args: list[Expr] = []
+        if not self.accept_op(")"):
+            if self.accept_op("*"):
+                args.append(Star())
+            else:
+                args.append(self.parse_expr())
+            while self.accept_op(","):
+                if self.accept_op("*"):
+                    args.append(Star())
+                else:
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+        if lname == "count" and distinct:
+            return FunctionCall("count_distinct", args)
+        return FunctionCall(lname, args, distinct)
+
+    def parse_case(self) -> Expr:
+        self.expect_kw("case")
+        whens: list[tuple[Expr, Expr]] = []
+        base: Expr | None = None
+        if not (self.peek().kind == "kw" and self.peek().value == "when"):
+            base = self.parse_expr()
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            if base is not None:
+                cond = BinaryOp("=", base, cond)
+            self.expect_kw("then")
+            whens.append((cond, self.parse_expr()))
+        else_expr = None
+        if self.accept_kw("else"):
+            else_expr = self.parse_expr()
+        self.expect_kw("end")
+        return Case(whens, else_expr)
+
+
+def parse_sql(sql: str) -> Select:
+    return Parser(sql).parse()
+
+
+def expr_name(e: Expr) -> str:
+    """Display name for an unaliased select expression."""
+    if isinstance(e, Column):
+        return e.name
+    if isinstance(e, Star):
+        return "*"
+    if isinstance(e, FunctionCall):
+        if e.name == "count" and e.args and isinstance(e.args[0], Star):
+            return "count(*)"
+        return f"{e.name}({','.join(expr_name(a) for a in e.args)})"
+    if isinstance(e, Literal):
+        return repr(e.value)
+    if isinstance(e, BinaryOp):
+        return f"{expr_name(e.left)} {e.op} {expr_name(e.right)}"
+    if isinstance(e, Cast):
+        return expr_name(e.expr)
+    if isinstance(e, IntervalLit):
+        return f"interval '{e.text}'"
+    return e.__class__.__name__.lower()

@@ -1,16 +1,24 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic is
+Tests run JAX on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-runs the multichip
 path; see __graft_entry__.py).
+
+This environment pins JAX to the real TPU chip through a sitecustomize hook
+(axon PJRT plugin) that runs at interpreter start, so plain env vars in this
+file are too late — steer the platform through jax.config instead, before
+any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,93 @@
+"""Regression tests for verified code-review findings."""
+
+from datetime import UTC, datetime, timedelta
+
+import pyarrow as pa
+import pytest
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+BASE = datetime(2024, 5, 1, 10, 0)
+
+
+def table_with_span(days: float, n: int = 100):
+    ts = [BASE + timedelta(seconds=i * days * 86400 / n) for i in range(n)]
+    return pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "status": pa.array([float(200 if i % 2 else 500) for i in range(n)]),
+        }
+    )
+
+
+def rows(t):
+    return sorted(tuple(r[k] for k in sorted(r)) for r in t.to_pylist())
+
+
+def test_min_max_timestamp_matches_cpu():
+    """min/max over timestamp columns must return datetimes on both engines
+    (TPU f32 encoding would corrupt them; it must fall back)."""
+    t = table_with_span(0.01)
+    sql = "SELECT status, min(p_timestamp) mn, max(p_timestamp) mx FROM t GROUP BY status"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t]))
+    tpu = TpuQueryExecutor(lp2).execute(iter([t]))
+    assert rows(cpu) == rows(tpu)
+    assert isinstance(cpu.to_pylist()[0]["mn"], datetime)
+
+
+def test_open_ended_bound_long_span_no_wraparound():
+    """Rows >24.8 days past an open lower bound must not vanish (int32 ms
+    wrap); the encoder now picks seconds or bails to CPU."""
+    t = table_with_span(60)  # 60-day span
+    sql = f"SELECT count(*) c FROM t WHERE p_timestamp >= '{BASE.isoformat()}Z'"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t]))
+    tpu = TpuQueryExecutor(lp2).execute(iter([t]))
+    assert cpu.to_pylist() == tpu.to_pylist() == [{"c": 100}]
+
+
+def test_count_fast_path_rejects_or_time_predicates():
+    sql = (
+        "SELECT count(*) FROM t WHERE p_timestamp < '2020-01-01T00:00:00Z' "
+        "OR p_timestamp > '2025-01-01T00:00:00Z'"
+    )
+    lp = build_plan(parse_sql(sql))
+    assert not lp.count_star_only
+    # pure conjunctive ranges still qualify
+    sql2 = (
+        "SELECT count(*) FROM t WHERE p_timestamp >= '2024-01-01T00:00:00Z' "
+        "AND p_timestamp < '2025-01-01T00:00:00Z'"
+    )
+    assert build_plan(parse_sql(sql2)).count_star_only
+    # non-time columns or IS NULL disqualify
+    sql3 = "SELECT count(*) FROM t WHERE p_timestamp IS NULL"
+    assert not build_plan(parse_sql(sql3)).count_star_only
+
+
+def test_empty_scan_with_arithmetic_projection():
+    """Numeric expressions in the select list must survive a zero-table scan
+    (typed empty table from the schema hint)."""
+    sql = "SELECT bytes + 1 AS b1 FROM t WHERE status = 999"
+    lp = build_plan(parse_sql(sql))
+    lp.schema_hint = pa.schema([pa.field("bytes", pa.float64()), pa.field("status", pa.float64())])
+    out = QueryExecutor(lp).execute(iter([]))
+    assert out.num_rows == 0
+
+
+def test_date_bin_with_origin_falls_back():
+    """Custom date_bin origin must produce CPU-identical buckets on the TPU
+    engine (it falls back rather than mis-binning)."""
+    t = table_with_span(0.01)
+    sql = (
+        "SELECT date_bin(interval '90s', p_timestamp, '2024-05-01T10:00:30Z') b, count(*) c "
+        "FROM t GROUP BY b"
+    )
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t]))
+    tpu = TpuQueryExecutor(lp2).execute(iter([t]))
+    assert rows(cpu) == rows(tpu)

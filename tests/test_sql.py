@@ -1,0 +1,95 @@
+"""SQL parser tests."""
+
+import pytest
+
+from parseable_tpu.query import sql as S
+from parseable_tpu.query.sql import parse_sql
+
+
+def test_simple_select():
+    q = parse_sql("SELECT * FROM logs")
+    assert q.table == "logs"
+    assert isinstance(q.items[0].expr, S.Star)
+
+
+def test_count_star():
+    q = parse_sql("SELECT count(*) FROM demo WHERE host = 'a'")
+    f = q.items[0].expr
+    assert isinstance(f, S.FunctionCall) and f.name == "count"
+    assert isinstance(q.where, S.BinaryOp) and q.where.op == "="
+
+
+def test_group_by_order_limit():
+    q = parse_sql(
+        "SELECT status, count(*) as c FROM demo GROUP BY status ORDER BY c DESC LIMIT 10"
+    )
+    assert len(q.group_by) == 1
+    assert q.order_by[0].desc
+    assert q.limit == 10
+    assert q.items[1].alias == "c"
+
+
+def test_date_bin():
+    q = parse_sql(
+        "SELECT date_bin(interval '1 minute', p_timestamp) as t, count(*) FROM x GROUP BY t"
+    )
+    f = q.items[0].expr
+    assert isinstance(f, S.FunctionCall) and f.name == "date_bin"
+    assert isinstance(f.args[0], S.IntervalLit)
+
+
+def test_operators_precedence():
+    q = parse_sql("SELECT a FROM t WHERE a = 1 AND b = 2 OR c = 3")
+    assert isinstance(q.where, S.BinaryOp) and q.where.op == "or"
+    assert q.where.left.op == "and"
+
+
+def test_between_in_like():
+    q = parse_sql(
+        "SELECT a FROM t WHERE a BETWEEN 1 AND 5 AND b IN ('x','y') AND c LIKE '%err%' AND d NOT IN (1)"
+    )
+    s = str(q.where)
+    assert "Between" in s and "InList" in s
+
+
+def test_is_null_and_not():
+    q = parse_sql("SELECT a FROM t WHERE a IS NOT NULL AND NOT b = 2")
+    assert isinstance(q.where.left, S.IsNull) and q.where.left.negated
+
+
+def test_count_distinct():
+    q = parse_sql("SELECT count(DISTINCT host) FROM t")
+    f = q.items[0].expr
+    assert f.name == "count_distinct"
+
+
+def test_case_when():
+    q = parse_sql("SELECT CASE WHEN a > 1 THEN 'hi' ELSE 'lo' END FROM t")
+    assert isinstance(q.items[0].expr, S.Case)
+
+
+def test_cast():
+    q = parse_sql("SELECT CAST(a AS integer) FROM t")
+    assert isinstance(q.items[0].expr, S.Cast)
+
+
+def test_quoted_identifiers_and_strings():
+    q = parse_sql("SELECT \"weird col\" FROM t WHERE msg = 'it''s'")
+    assert q.items[0].expr.name == "weird col"
+    assert q.where.right.value == "it's"
+
+
+def test_errors():
+    with pytest.raises(S.SqlError):
+        parse_sql("SELECT FROM t")
+    with pytest.raises(S.SqlError):
+        parse_sql("SELECT a FROM t WHERE")
+    with pytest.raises(S.SqlError):
+        parse_sql("SELECT a FROM t extra garbage ,")
+
+
+def test_aggregate_detection():
+    q = parse_sql("SELECT sum(a) + 1 FROM t")
+    assert S.is_aggregate(q.items[0].expr)
+    q2 = parse_sql("SELECT a + 1 FROM t")
+    assert not S.is_aggregate(q2.items[0].expr)
