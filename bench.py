@@ -33,7 +33,7 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
     rng = np.random.default_rng(42)
     stream = p.create_stream_if_not_exists(stream_name)
     base = datetime(2024, 5, 1, 0, 0, tzinfo=UTC)
-    batch_rows = 250_000
+    batch_rows = 1_000_000  # one "minute" of a high-throughput stream
     statuses = np.array([200, 200, 200, 200, 301, 404, 500, 503])
     hosts = np.array([f"10.0.{i}.{j}" for i in range(4) for j in range(8)])
     methods = np.array(["GET", "GET", "GET", "POST", "PUT", "DELETE"])
@@ -44,7 +44,7 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
         n = min(batch_rows, total_rows - written)
         ts_offsets = np.sort(rng.integers(0, 60_000, n))
         ts = [base + timedelta(minutes=minute, milliseconds=int(o)) for o in ts_offsets]
-        batch = pa.RecordBatch.from_pydict(
+        tbl = pa.table(
             {
                 DEFAULT_TIMESTAMP_KEY: pa.array(
                     [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
@@ -56,15 +56,16 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
                 "bytes": pa.array(rng.integers(100, 50_000, n).astype(np.float64)),
                 "latency_ms": pa.array((rng.random(n) * 500).astype(np.float64)),
             }
-        )
-        ev = Event(
-            stream_name=stream_name,
-            rb=batch,
-            origin_size=n * 120,
-            is_first_event=written == 0,
-            parsed_timestamp=base + timedelta(minutes=minute),
-        )
-        ev.process(stream, commit_schema=p.commit_schema)
+        ).combine_chunks()
+        for batch in tbl.to_batches():
+            ev = Event(
+                stream_name=stream_name,
+                rb=batch,
+                origin_size=batch.num_rows * 120,
+                is_first_event=written == 0,
+                parsed_timestamp=base + timedelta(minutes=minute),
+            )
+            ev.process(stream, commit_schema=p.commit_schema)
         written += n
         minute += 1
     p.local_sync(shutdown=True)
@@ -97,7 +98,7 @@ def run_engine(p, stream: str, engine: str, repeats: int) -> tuple[float, int, l
 
 
 def main() -> None:
-    total_rows = int(os.environ.get("BENCH_ROWS", "2000000"))
+    total_rows = int(os.environ.get("BENCH_ROWS", "32000000"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
 
     workdir = tempfile.mkdtemp(prefix="ptpu-bench-")

@@ -4,8 +4,8 @@ TPU/XLA wants static shapes, fixed-width dtypes, and no strings. This module
 turns pyarrow columns into device-friendly ndarrays:
 
 - numerics -> float32 / int32 (+ validity mask)
-- timestamps -> int32 *relative* values: offset from the query range start,
-  in ms when the span fits int32, else seconds (avoids int64/x64 on TPU)
+- timestamps -> canonical int32 seconds since 2020-01-01 (CANON_TIME_*),
+  query-independent so encoded blocks are hot-set cacheable
 - strings -> host-side dictionary encode; int32 codes go to device, the
   dictionary stays on host. String predicates (=, LIKE, regex) evaluate over
   the (small) dictionary once, then become an O(1) boolean LUT gather on
@@ -172,39 +172,34 @@ def encode_column(
     return None  # unsupported (lists, nested) -> caller falls back to CPU
 
 
-def choose_time_encoding(low: datetime | None, high: datetime | None) -> tuple[int, int]:
-    """(origin_ms, unit_ms) for relative int32 timestamps.
+# Canonical device time encoding: int32 seconds since 2020-01-01 (covers
+# 1952..2088). Making the encoding *query-independent* is what lets encoded
+# blocks live in a device-resident hot set across queries. Device-side time
+# comparisons are exact at second granularity only for `<` and `>=`
+# (floor(x) < n ⟺ x < n and floor(x) >= n ⟺ x >= n for integer n); the
+# complements `>`/`<=`, equality, and sub-second literals fall back to the
+# CPU path, and the scan-level host time filter always applies the API
+# range at full precision.
+CANON_TIME_ORIGIN_MS = 1_577_836_800_000  # 2020-01-01T00:00:00Z
+CANON_TIME_UNIT_MS = 1000
 
-    ms resolution only when both bounds exist and the span fits int32;
-    otherwise seconds (int32 seconds from origin covers ±68 years, so an
-    open-ended range can never wrap). Sub-second WHERE comparisons on an
-    unbounded range lose precision — the scan-level time filter (applied
-    exactly on host) still guards the API range.
-    """
-    origin = int(low.timestamp() * 1000) if low is not None else 0
-    if low is not None and high is not None:
-        span = int((high - low).total_seconds() * 1000)
-        unit = 1 if span < MS_INT32_SPAN else 1000
-    else:
-        unit = 1000
-    return origin, unit
 
 
 def encode_table(
     table: pa.Table,
     needed: set[str] | None,
-    time_low: datetime | None,
-    time_high: datetime | None,
     block_rows: int | None = None,
     dict_columns: set[str] | None = None,
 ) -> EncodedBatch | None:
     """Encode a table for device execution; None if a needed column can't be.
 
     `dict_columns` forces dictionary encoding (group-by keys of any type).
+    The time encoding is always canonical (CANON_TIME_*), which is what
+    makes encodings query-independent and hot-set cacheable.
     """
     n = table.num_rows
     block = block_rows or pow2_block(n)
-    origin, unit = choose_time_encoding(time_low, time_high)
+    origin, unit = CANON_TIME_ORIGIN_MS, CANON_TIME_UNIT_MS
     cols: dict[str, EncodedColumn] = {}
     for name in table.column_names:
         if needed is not None and name not in needed:

@@ -1,0 +1,93 @@
+"""Device-resident hot set: encoded column blocks cached in HBM.
+
+The reference keeps a hot tier of parquet on local NVMe so queries skip
+object-store GETs (reference: src/hottier.rs). The TPU-native equivalent
+keeps *encoded device arrays* resident in HBM: once a parquet file's columns
+have been encoded and shipped, subsequent queries over the same data run with
+ZERO host->device transfer — which, on any real deployment (PCIe) and
+especially on tunneled dev setups, is the dominant cost of a scan.
+
+Entries are keyed by a source id (file path + mtime + size, or a staging
+batch fingerprint) plus the column-set signature. Eviction is LRU by byte
+budget (P_TPU_HOT_BYTES, default 8 GiB — leaves headroom on a 16 GiB v5e).
+
+Cache contents are the *canonical* encodings (ops/device.py): batch-local
+dictionary codes, epoch-2020 int32-second timestamps, f32 numerics. Query-
+specific adjustments (global dictionary remaps, predicate LUTs) are small
+arrays gathered on device at run time, so a cached block serves any query.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any
+
+from parseable_tpu.utils.metrics import QUERY_CACHE_HIT
+
+
+@dataclass
+class HotEntry:
+    dev: dict[str, Any]  # name -> device array (values; valid where needed)
+    meta: Any  # EncodedBatch with .columns values stripped host-side
+    nbytes: int
+
+
+class DeviceHotSet:
+    """LRU byte-budgeted cache of encoded device blocks."""
+
+    def __init__(self, budget_bytes: int | None = None):
+        self.budget = budget_bytes or int(os.environ.get("P_TPU_HOT_BYTES", 8 << 30))
+        self._entries: OrderedDict[tuple, HotEntry] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> HotEntry | None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            QUERY_CACHE_HIT.labels("device_hotset").inc()
+            return e
+
+    def put(self, key: tuple, entry: HotEntry) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            if entry.nbytes > self.budget:
+                return  # would never fit; don't evict others for it
+            while self._bytes + entry.nbytes > self.budget and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_GLOBAL_HOTSET: DeviceHotSet | None = None
+
+
+def get_hotset() -> DeviceHotSet:
+    global _GLOBAL_HOTSET
+    if _GLOBAL_HOTSET is None:
+        _GLOBAL_HOTSET = DeviceHotSet()
+    return _GLOBAL_HOTSET

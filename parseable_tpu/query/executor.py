@@ -497,6 +497,23 @@ class QueryExecutor:
             raise ExecError("WHERE must be boolean")
         return mask
 
+    def _bounds_filter(self, table: pa.Table) -> pa.Table:
+        """Row-level time-bounds filter (scan tables arrive unfiltered so
+        their device encodings stay query-independent)."""
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+        tb = self.plan.time_bounds
+        if (tb.low is None and tb.high is None) or DEFAULT_TIMESTAMP_KEY not in table.column_names:
+            return table
+        col = table.column(DEFAULT_TIMESTAMP_KEY)
+        mask = None
+        if tb.low is not None:
+            mask = pc.greater_equal(col, pa.scalar(tb.low.replace(tzinfo=None), type=col.type))
+        if tb.high is not None:
+            m2 = pc.less(col, pa.scalar(tb.high.replace(tzinfo=None), type=col.type))
+            mask = m2 if mask is None else pc.and_(mask, m2)
+        return table.filter(mask)
+
     def execute(self, tables: Iterator[pa.Table]) -> pa.Table:
         if self.plan.is_aggregate:
             return self._execute_aggregate(tables)
@@ -512,6 +529,7 @@ class QueryExecutor:
             rows_needed = sel.limit + (sel.offset or 0)
         total = 0
         for table in tables:
+            table = self._bounds_filter(table)
             mask = self._where_mask(table)
             if mask is not None:
                 table = table.filter(mask)
@@ -570,6 +588,7 @@ class QueryExecutor:
     def _execute_aggregate(self, tables: Iterator[pa.Table]) -> pa.Table:
         agg, rewritten, group_names = self.build_aggregator()
         for table in tables:
+            table = self._bounds_filter(table)
             mask = self._where_mask(table)
             agg.update(table, mask)
         return self.finalize_aggregate(agg, rewritten, group_names)

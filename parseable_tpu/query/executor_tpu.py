@@ -1,40 +1,39 @@
 """TPU query executor: predicate + group-by aggregation on device.
 
 This is the "TPU execution backend" the whole build centers on (SURVEY §7
-step 5). Per scanned table:
+step 5). Per scanned block:
 
-1. columns encode host-side (ops/device.py): numerics -> f32, strings ->
-   dictionary codes remapped into *global* per-column dictionaries,
-   timestamps -> relative int32;
-2. the WHERE tree compiles to a device boolean mask (string predicates become
-   dictionary LUT gathers — the regex runs once per unique value, not per
-   row);
-3. group keys combine into one dense int32 id (dict codes x time bins) with
-   power-of-two capacities so XLA sees a handful of static shapes;
-4. ONE jitted program per (layout, block-shape) runs mask + group ids +
-   `fused_groupby_block` in a single dispatch per batch. Dispatches and
-   device->host copies are fully asynchronous; the host syncs once per
-   flush, then accumulates G-sized partials in float64.
+1. columns encode host-side once (ops/device.py): numerics -> f32, strings ->
+   batch-local dictionary codes, timestamps -> canonical int32 epoch-2020
+   seconds — and the encoded block can then live in the **device hot set**
+   (ops/hotset.py), so repeated queries over hot data ship zero bytes;
+2. the WHERE tree compiles to a device boolean mask; string predicates become
+   dictionary LUT gathers (the regex runs once per unique value, not per
+   row), numeric/time predicates are branchless compares;
+3. group keys combine into one dense int32 id: dict codes go through a
+   per-batch device-side remap (batch-local -> global dictionary), time bins
+   are epoch-aligned; capacities are powers of two so XLA sees few shapes;
+4. ONE jitted program per (plan, layout, block-shape) runs mask + remap +
+   group ids + `fused_groupby_block` in a single dispatch per block, folding
+   into a device accumulator; the host syncs once per flush and accumulates
+   G-sized partials in float64.
 
-The single-dispatch + async design is what makes the path fast in practice:
-device round-trips cost O(100ms) on tunneled setups while the fused kernel
-itself sustains >1 G rows/s — so the number of synchronizing calls per
-query, not FLOPs, is the budget.
-
-Capacity growth (a new dictionary value or time bin overflowing the current
-stride space) flushes the dense accumulator into the sparse host aggregator
-and re-plans with doubled capacity — amortized O(log G) flushes. Predicate
-LUTs are *runtime inputs* padded to pow2 length, so dictionary growth within
-a capacity bucket does not retrace.
+The single-dispatch + async + resident-data design is what makes the path
+fast: device round-trips cost O(100ms) on tunneled setups and the fused
+kernel sustains >10 G rows/s, so per-query host<->device traffic — not
+FLOPs — is the budget.
 
 Anything the device path can't express (nested types, aggregates over
-expressions or timestamps, count_distinct, date_bin with custom origin, ...)
-falls back to the CPU executor — whole-query when detected at plan time,
-per-table otherwise — merging into the same aggregator, so results are
-always complete.
+expressions or timestamps, count_distinct, sub-second time predicates,
+timestamp equality, date_bin with custom origin or sub-second bins) falls
+back to the CPU executor — whole-query when detected at plan time, per-table
+otherwise — merging into the same aggregator, so results stay complete and
+exact.
 
 Precision: per-block reductions run in f32 (blocks <= 2^22 rows keep counts
-exact); cross-block accumulation is f64 on host.
+exact; sums carry ~1e-5 relative error vs the CPU engine's f64); cross-block
+accumulation is f64 on host. Device time comparisons support `<`/`>=` at
+second granularity exactly (see ops/device.py); `>`/`<=`/`=` fall back.
 """
 
 from __future__ import annotations
@@ -51,11 +50,13 @@ import pyarrow as pa
 from parseable_tpu.config import Options
 from parseable_tpu.ops import kernels
 from parseable_tpu.ops.device import (
+    CANON_TIME_ORIGIN_MS,
+    CANON_TIME_UNIT_MS,
     EncodedBatch,
     EncodedColumn,
     encode_table,
-    rel_time_value,
 )
+from parseable_tpu.ops.hotset import HotEntry, get_hotset
 from parseable_tpu.query import sql as S
 from parseable_tpu.query.executor import (
     AggSpec,
@@ -68,9 +69,41 @@ from parseable_tpu.utils.timeutil import parse_duration, parse_rfc3339
 
 logger = logging.getLogger(__name__)
 
+SOURCE_ID_META = b"ptpu_source_id"
+STUB_META = b"ptpu_hot_stub"
+
 
 class UnsupportedOnDevice(Exception):
     pass
+
+
+def dict_group_columns(select: S.Select) -> set[str]:
+    """Group-by columns that device-encode as dictionaries (plain columns)."""
+    out = set()
+    for g in select.group_by:
+        e = g.expr if isinstance(g, S.Cast) else g
+        if isinstance(e, S.Column):
+            out.add(e.name)
+    return out
+
+
+def hot_key(source_id: bytes, needed: set[str] | None, dict_cols: set[str]) -> tuple:
+    return (
+        source_id,
+        tuple(sorted(needed)) if needed is not None else None,
+        tuple(sorted(dict_cols)),
+    )
+
+
+def is_stub(table: pa.Table) -> bool:
+    return (table.schema.metadata or {}).get(STUB_META) is not None
+
+
+def make_stub(source_id: bytes, num_rows: int) -> pa.Table:
+    """Zero-copy placeholder for a device-resident block."""
+    return pa.table({}).replace_schema_metadata(
+        {SOURCE_ID_META: source_id, STUB_META: str(num_rows).encode()}
+    )
 
 
 def _pow2(n: int, minimum: int = 8) -> int:
@@ -84,37 +117,28 @@ def _pow2(n: int, minimum: int = 8) -> int:
 
 
 class GlobalDict:
-    """Union of per-batch dictionaries for one column, with code remapping."""
+    """Union of per-batch dictionaries for one column, plus device remaps."""
 
     def __init__(self) -> None:
         self.values: list[Any] = []
         self.index: dict[Any, int] = {}
 
-    def remap(self, batch_dict: list[Any], codes: np.ndarray) -> np.ndarray:
-        """Translate batch-local codes (with trailing null slot) to global
-        codes; nulls map to a large sentinel (validity masks cover them, and
-        out-of-range gathers clamp to the LUT's null slot)."""
-        lookup = np.empty(len(batch_dict), dtype=np.int32)
-        identity = True
+    def absorb(self, batch_dict: list[Any]) -> np.ndarray:
+        """Register a batch dictionary; return the batch->global int32 remap,
+        padded to pow2 with a large sentinel (nulls + padding decode as the
+        null group)."""
+        card = len(batch_dict)
+        lut = np.full(_pow2(card + 1), np.int32(2**30), dtype=np.int32)
         for i, v in enumerate(batch_dict):
             if v is None:
-                lookup[i] = -1
-                identity = False
                 continue
             gi = self.index.get(v)
             if gi is None:
                 gi = len(self.values)
                 self.values.append(v)
                 self.index[v] = gi
-            lookup[i] = gi
-            identity = identity and gi == i
-        if identity and len(batch_dict) == len(self.values):
-            # batch dict == global dict in order: codes already ARE global
-            # ids, and the null slot (== len(values)) stays past every real
-            # code, clamping safely in LUT gathers / group-code minimums
-            return codes
-        out = lookup[np.clip(codes, 0, len(batch_dict) - 1)]
-        return np.where(out < 0, np.int32(2**30), out).astype(np.int32)
+            lut[i] = gi
+        return lut
 
     def __len__(self) -> int:
         return len(self.values)
@@ -175,8 +199,9 @@ def classify_group_expr(e: S.Expr) -> KeySpec:
             raise UnsupportedOnDevice("date_bin with explicit origin")
         ms = _interval_ms(e.args[0])
         col = e.args[1]
-        if ms and isinstance(col, S.Column):
+        if ms and ms % CANON_TIME_UNIT_MS == 0 and isinstance(col, S.Column):
             return KeySpec("timebin", col.name, e, bin_ms=ms)
+        raise UnsupportedOnDevice("sub-second date_bin")
     if isinstance(e, S.FunctionCall) and e.name == "date_trunc" and len(e.args) == 2:
         unit = e.args[0].value if isinstance(e.args[0], S.Literal) else None
         col = e.args[1]
@@ -194,18 +219,14 @@ def classify_group_expr(e: S.Expr) -> KeySpec:
 class PredicateCompiler:
     """Compile a WHERE tree into device ops, in two phases per batch:
 
-    - `collect_luts(e, enc)` (host): evaluate string/dict predicates over the
-      global dictionaries into boolean LUTs, padded to pow2 length. Cached by
-      (predicate, dictionary size) so the regex work amortizes across
-      batches.
+    - `collect_luts(e, enc)` (host): evaluate string predicates over the
+      *batch* dictionary into boolean LUTs, padded to pow2. Cached on the
+      EncodedBatch (lifetime == dictionary lifetime), so for hot-set-resident
+      blocks the regex work happens exactly once per (pattern, block).
     - `trace(e, enc, dev, luts)` (traced or eager): emit jnp ops, consuming
       the LUT arrays positionally. Runs identically under jax.jit (LUTs as
       runtime args) and eagerly.
     """
-
-    def __init__(self, gdicts: dict[str, GlobalDict]):
-        self.gdicts = gdicts
-        self._lut_cache: dict[tuple, np.ndarray] = {}
 
     # ---------------------------------------------------------- phase A
 
@@ -224,13 +245,14 @@ class PredicateCompiler:
             if e.op in ("=", "!=", "<", "<=", ">", ">="):
                 col, op, lit = self._cmp_parts(e, enc)
                 if col.kind == "dict":
-                    out.append(self._dict_lut(col, op, lit))
+                    out.append(self._dict_lut(enc, col, op, lit))
                 return
             if e.op in ("like", "ilike", "not_like", "not_ilike"):
                 col = self._column_of(e.left, enc)
                 raw = str(self._literal_of(e.right))
                 out.append(
                     self._regex_lut(
+                        enc,
                         col,
                         _like_to_regex(raw),
                         re.IGNORECASE if "ilike" in e.op else 0,
@@ -248,11 +270,11 @@ class PredicateCompiler:
         if isinstance(e, S.InList):
             col = self._column_of(e.expr, enc)
             if col.kind == "dict":
-                out.append(self._in_lut(e, col))
+                out.append(self._in_lut(enc, e, col))
             return
         if isinstance(e, S.FunctionCall) and e.name in ("regexp_match", "regexp_like"):
             col = self._column_of(e.args[0], enc)
-            out.append(self._regex_lut(col, str(self._literal_of(e.args[1])), 0, False))
+            out.append(self._regex_lut(enc, col, str(self._literal_of(e.args[1])), 0, False))
             return
         if isinstance(e, (S.IsNull, S.Literal)):
             return
@@ -264,7 +286,7 @@ class PredicateCompiler:
         import jax.numpy as jnp
 
         if e is None:
-            return jnp.ones(enc.block_rows, dtype=bool)
+            return dev["__ones"] if "__ones" in dev else jnp.ones(enc.block_rows, bool)
         it = iter(luts)
         return self._visit(e, enc, dev, it)
 
@@ -350,14 +372,7 @@ class PredicateCompiler:
             lut = next(luts)
             mask = lut[values]
         elif col.kind == "time":
-            if isinstance(lit, str):
-                lit_dt = parse_rfc3339(lit)
-            elif isinstance(lit, datetime):
-                lit_dt = lit
-            else:
-                raise UnsupportedOnDevice("timestamp compared to non-time literal")
-            rel = rel_time_value(lit_dt, enc.time_origin_ms, enc.time_unit_ms)
-            mask = _num_cmp(values, op, rel)
+            mask = _num_cmp(values, op, self._time_threshold(op, lit))
         elif col.kind in ("num", "bool"):
             if not isinstance(lit, (int, float, bool)):
                 raise UnsupportedOnDevice("numeric compared to non-numeric literal")
@@ -365,6 +380,28 @@ class PredicateCompiler:
         else:
             raise UnsupportedOnDevice(f"cannot compare column kind {col.kind}")
         return jnp.logical_and(mask, valid)
+
+    @staticmethod
+    def _time_threshold(op: str, lit: Any) -> int:
+        """Integer-second threshold for floored-second row values.
+
+        Only `<` and `>=` are exactly representable: for integer n,
+        floor(x) >= n ⟺ x >= n and floor(x) < n ⟺ x < n. The complements
+        (`>`, `<=`), equality, and sub-second literals cannot distinguish
+        rows inside the boundary second — those fall back to the CPU path.
+        """
+        if isinstance(lit, str):
+            lit_dt = parse_rfc3339(lit)
+        elif isinstance(lit, datetime):
+            lit_dt = lit if lit.tzinfo else lit.replace(tzinfo=UTC)
+        else:
+            raise UnsupportedOnDevice("timestamp compared to non-time literal")
+        lit_ms = int(lit_dt.timestamp() * 1000)
+        if op not in ("<", ">="):
+            raise UnsupportedOnDevice(f"timestamp {op} needs ms precision")
+        if lit_ms % CANON_TIME_UNIT_MS:
+            raise UnsupportedOnDevice("sub-second time literal")
+        return (lit_ms - CANON_TIME_ORIGIN_MS) // CANON_TIME_UNIT_MS
 
     def _in_list(self, e: S.InList, enc: EncodedBatch, dev, luts):
         import jax.numpy as jnp
@@ -385,10 +422,16 @@ class PredicateCompiler:
         raise UnsupportedOnDevice("IN on unsupported column kind")
 
     # ---------------------------------------------------------- LUT builders
+    # LUTs are built over the BATCH dictionary (codes index it directly) and
+    # cached on the EncodedBatch so hot blocks never re-evaluate a predicate.
 
-    def _gdict_values(self, col: EncodedColumn) -> list:
-        gdict = self.gdicts.get(col.column if hasattr(col, "column") else col.name)
-        return gdict.values if gdict is not None and len(gdict) else col.dictionary[:-1]
+    @staticmethod
+    def _batch_cache(enc: EncodedBatch) -> dict:
+        cache = getattr(enc, "lut_cache", None)
+        if cache is None:
+            cache = {}
+            enc.lut_cache = cache
+        return cache
 
     def _padded(self, lut: np.ndarray) -> np.ndarray:
         n = _pow2(len(lut))
@@ -398,14 +441,15 @@ class PredicateCompiler:
         out[: len(lut)] = lut
         return out
 
-    def _dict_lut(self, col: EncodedColumn, op: str, lit: Any) -> np.ndarray:
-        values = self._gdict_values(col)
-        key = (col.name, op, repr(lit), len(values))
-        hit = self._lut_cache.get(key)
+    def _dict_lut(self, enc: EncodedBatch, col: EncodedColumn, op: str, lit: Any) -> np.ndarray:
+        cache = self._batch_cache(enc)
+        key = (col.name, op, repr(lit))
+        hit = cache.get(key)
         if hit is not None:
             return hit
         import operator as _op
 
+        values = col.dictionary[:-1]
         fns = {"=": _op.eq, "!=": _op.ne, "<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge}
         f = fns[op]
         lut = np.zeros(len(values) + 1, dtype=bool)  # +1 null slot -> False
@@ -417,42 +461,44 @@ class PredicateCompiler:
             except TypeError:
                 lut[i] = False
         lut = self._padded(lut)
-        self._lut_cache[key] = lut
+        cache[key] = lut
         return lut
 
-    def _regex_lut(self, col: EncodedColumn, pattern: str, flags: int, negate: bool) -> np.ndarray:
+    def _regex_lut(
+        self, enc: EncodedBatch, col: EncodedColumn, pattern: str, flags: int, negate: bool
+    ) -> np.ndarray:
         if col.kind != "dict":
             raise UnsupportedOnDevice("string predicate on non-string column")
-        values = self._gdict_values(col)
-        key = (col.name, pattern, flags, negate, len(values))
-        hit = self._lut_cache.get(key)
+        cache = self._batch_cache(enc)
+        key = (col.name, pattern, flags, negate)
+        hit = cache.get(key)
         if hit is not None:
             return hit
         rx = re.compile(pattern, flags)
+        values = col.dictionary[:-1]
         lut = np.zeros(len(values) + 1, dtype=bool)
         for i, v in enumerate(values):
             if isinstance(v, str):
                 m = rx.search(v) is not None
                 lut[i] = (not m) if negate else m
         lut = self._padded(lut)
-        self._lut_cache[key] = lut
+        cache[key] = lut
         return lut
 
-    def _in_lut(self, e: S.InList, col: EncodedColumn) -> np.ndarray:
-        values = self._gdict_values(col)
-        lits = set()
-        for i in e.items:
-            lits.add(self._literal_of(i))
-        key = (col.name, "in", repr(sorted(map(repr, lits))), e.negated, len(values))
-        hit = self._lut_cache.get(key)
+    def _in_lut(self, enc: EncodedBatch, e: S.InList, col: EncodedColumn) -> np.ndarray:
+        cache = self._batch_cache(enc)
+        lits = {self._literal_of(i) for i in e.items}
+        key = (col.name, "in", repr(sorted(map(repr, lits))), e.negated)
+        hit = cache.get(key)
         if hit is not None:
             return hit
+        values = col.dictionary[:-1]
         lut = np.zeros(len(values) + 1, dtype=bool)
         for i, v in enumerate(values):
             inside = v in lits
             lut[i] = (not inside) if e.negated else inside
         lut = self._padded(lut)
-        self._lut_cache[key] = lut
+        cache[key] = lut
         return lut
 
 
@@ -485,21 +531,6 @@ class DenseState:
     mins: np.ndarray
     maxs: np.ndarray
 
-    @classmethod
-    def create(cls, capacities: tuple[int, ...], n_all: int, n_sum: int, n_min: int, n_max: int):
-        g = 1
-        for c in capacities:
-            g *= c
-        return cls(
-            capacities=capacities,
-            num_groups=g,
-            count=np.zeros(g, np.float64),
-            per_agg_count=np.zeros((n_all, g), np.float64),
-            sums=np.zeros((n_sum, g), np.float64),
-            mins=np.full((n_min, g), np.inf, np.float64),
-            maxs=np.full((n_max, g), -np.inf, np.float64),
-        )
-
 
 @dataclass
 class PlanLayout:
@@ -512,8 +543,6 @@ class PlanLayout:
     min_cols: list[str]
     max_cols: list[str]
     stacked_cols: list[str]
-    time_origin_ms: int
-    time_unit_ms: int
 
 
 # Jitted programs cached process-wide: two identical queries (or two
@@ -523,6 +552,21 @@ _PROGRAM_CACHE: dict[tuple, Callable] = {}
 
 def _expr_fingerprint(e: S.Expr | None) -> str:
     return repr(e)  # dataclass repr is structural and stable
+
+
+# device-resident all-true masks per block size; eagerly computing jnp.ones
+# per batch costs a full dispatch round trip on tunneled backends
+_ONES_CACHE: dict[int, Any] = {}
+
+
+def _device_ones(block_rows: int):
+    import jax.numpy as jnp
+
+    ones = _ONES_CACHE.get(block_rows)
+    if ones is None:
+        ones = jnp.asarray(np.ones(block_rows, dtype=bool))
+        _ONES_CACHE[block_rows] = ones
+    return ones
 
 
 class TpuQueryExecutor(QueryExecutor):
@@ -539,8 +583,10 @@ class TpuQueryExecutor(QueryExecutor):
             try:
                 return self._execute_aggregate_tpu(tables)
             except UnsupportedOnDevice as e:
+                # plan-time rejection: the iterator is untouched; materialize
+                # any hot stubs for the CPU engine
                 logger.info("TPU path unsupported (%s); falling back to CPU", e)
-                return super()._execute_aggregate(tables)
+                return super()._execute_aggregate(self._materialize(t) for t in tables)
         return self._execute_select_tpu(tables)
 
     # ------------------------------------------------- select (mask on device)
@@ -551,25 +597,24 @@ class TpuQueryExecutor(QueryExecutor):
         Wrapped per-table so unsupported predicates degrade to CPU eval."""
         sel = self.plan.select
 
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+        from parseable_tpu.query.planner import referenced_columns
+
+        # the device only evaluates the WHERE mask here, so encode (and
+        # cache) just the predicate's columns, not the whole projection
+        mask_needed = referenced_columns(sel.where) | {DEFAULT_TIMESTAMP_KEY}
+
         def filtered() -> Iterator[pa.Table]:
+            # bounds filtering happens once, in the inner executor's loop
             from parseable_tpu.query.executor import _arr, evaluate
 
-            gdicts: dict[str, GlobalDict] = {}
-            compiler = PredicateCompiler(gdicts)
+            compiler = PredicateCompiler()
             for table in tables:
                 if sel.where is None:
                     yield table
                     continue
                 try:
-                    enc = encode_table(
-                        table,
-                        None,
-                        self.plan.time_bounds.low,
-                        self.plan.time_bounds.high,
-                    )
-                    if enc is None:
-                        raise UnsupportedOnDevice("unencodable column")
-                    dev = _to_device(enc, gdicts)
+                    enc, dev = self._encoded_block(table, mask_needed, set())
                     import jax.numpy as jnp
 
                     luts = [jnp.asarray(l) for l in compiler.collect_luts(sel.where, enc)]
@@ -588,6 +633,49 @@ class TpuQueryExecutor(QueryExecutor):
             return inner._execute_select(filtered())
         finally:
             inner.plan.select = sel
+
+    # ----------------------------------------------------------- block cache
+
+    # set by the session: re-reads a source when a stubbed block got evicted
+    # between the provider's hot check and execution
+    source_loader: Callable[[bytes], pa.Table] | None = None
+
+    def _materialize(self, table: pa.Table) -> pa.Table:
+        """Real rows for a table (loads the source when it's a hot stub)."""
+        if not is_stub(table):
+            return table
+        source = (table.schema.metadata or {})[SOURCE_ID_META]
+        if self.source_loader is None:
+            raise UnsupportedOnDevice("stub block without a source loader")
+        return self.source_loader(source)
+
+    def _encoded_block(
+        self, table: pa.Table, needed: set[str] | None, dict_cols: set[str]
+    ) -> tuple[EncodedBatch, dict]:
+        """Encode a table (or fetch its device-resident encoding).
+
+        Hot-set keys carry the source id the provider stamped into the table
+        metadata plus the column-set signature. Staging data (no source id)
+        is never cached.
+        """
+        hotset = get_hotset()
+        meta = table.schema.metadata or {}
+        source = meta.get(SOURCE_ID_META)
+        key = None
+        if source is not None:
+            key = hot_key(source, needed, dict_cols)
+            entry = hotset.get(key)
+            if entry is not None:
+                return entry.meta, entry.dev
+        table = self._materialize(table)
+        enc = encode_table(table, needed, dict_columns=dict_cols)
+        if enc is None:
+            raise UnsupportedOnDevice("unencodable column in batch")
+        dev, nbytes = _transfer(enc)
+        if key is not None:
+            _strip_host_values(enc)
+            hotset.put(key, HotEntry(dev=dev, meta=enc, nbytes=nbytes))
+        return enc, dev
 
     # -------------------------------------------------------------- aggregate
 
@@ -626,17 +714,11 @@ class TpuQueryExecutor(QueryExecutor):
         n_sum, n_min, n_max = len(sum_idx), len(min_idx), len(max_idx)
         n_all = len(stacked_idx)
 
-        gdicts: dict[str, GlobalDict] = {}
-        for ks in key_specs:
-            if ks.kind == "dict":
-                gdicts[ks.column] = ks.gdict
-        compiler = PredicateCompiler(gdicts)
+        compiler = PredicateCompiler()
         dict_cols = {ks.column for ks in key_specs if ks.kind == "dict"}
 
         acc = None  # device-resident packed accumulator (R, G) f32
         acc_groups = 0
-        time_origin: int | None = None
-        time_unit = 1
 
         def new_acc(num_groups: int):
             """Packed accumulator rows: count | per-agg counts | sums | mins | maxs."""
@@ -659,16 +741,21 @@ class TpuQueryExecutor(QueryExecutor):
                 mins=arr[1 + n_all + n_sum : 1 + n_all + n_sum + n_min],
                 maxs=arr[1 + n_all + n_sum + n_min :],
             )
-            self._flush_state(state, key_specs, agg, specs, time_origin or 0, time_unit)
+            self._flush_state(state, key_specs, agg, specs)
 
         # Coalesce scan tables into larger device blocks: dispatch latency is
         # the budget, so fewer/bigger blocks win (Options.device_block_rows).
+        # Tables carrying a source id stay un-coalesced so their encodings
+        # are reusable across queries via the hot set.
         target_rows = max(1 << 16, self.options.device_block_rows)
 
-        def coalesced(src: Iterator[pa.Table]) -> Iterator[pa.Table]:
+        def blocks(src: Iterator[pa.Table]) -> Iterator[pa.Table]:
             buf: list[pa.Table] = []
             rows = 0
             for t in src:
+                if (t.schema.metadata or {}).get(SOURCE_ID_META) is not None:
+                    yield t
+                    continue
                 buf.append(t)
                 rows += t.num_rows
                 if rows >= target_rows:
@@ -677,37 +764,90 @@ class TpuQueryExecutor(QueryExecutor):
             if buf:
                 yield _concat_tables(buf)
 
-        t_start = _t.monotonic()
-        for table in coalesced(tables):
-            try:
-                enc = encode_table(
-                    table,
-                    self.plan.needed_columns,
-                    self.plan.time_bounds.low,
-                    self.plan.time_bounds.high,
-                    dict_columns=dict_cols,
-                )
-                if enc is None:
-                    raise UnsupportedOnDevice("unencodable column in batch")
-                for i in stacked_idx:
-                    kind = enc.columns[specs[i].arg.name].kind if specs[i].arg.name in enc.columns else None
-                    if kind is None:
-                        raise UnsupportedOnDevice(f"aggregate column {specs[i].arg.name} missing")
-                    if kind == "dict" and i not in countcol_idx:
-                        raise UnsupportedOnDevice("numeric aggregate over string column")
-                    if kind == "time" and i not in countcol_idx:
-                        # f32 cannot carry epoch times without rounding
-                        raise UnsupportedOnDevice("min/max/sum over timestamp column")
-                if time_origin is None:
-                    time_origin, time_unit = enc.time_origin_ms, enc.time_unit_ms
-                dev = _to_device(enc, gdicts)
-                luts = compiler.collect_luts(sel.where, enc)
+        # Validate the device representation of the query's time bounds up
+        # front: raising here (before the table iterator is touched) lets
+        # execute() fall back to a clean whole-query CPU run.
+        self._bounds_seconds()
 
-                layouts = [self._required_layout(ks, enc, gdicts) for ks in key_specs]
+        # Blocks with identical shape signatures batch into one dispatch of
+        # up to GROUP_N unrolled folds — per-dispatch latency dominates on
+        # tunneled backends, so 8 blocks per round trip is an 8x cut.
+        GROUP_N = 8
+        pending: list[tuple] = []  # (table, enc, dev, dev_luts, dev_remaps, row_mask)
+        pending_sig: tuple | None = None
+
+        def fold_pending_on_cpu() -> None:
+            """Program build/trace failed: aggregate the buffered blocks'
+            source tables on the CPU instead (never raises past here)."""
+            for x in pending:
+                t = self._bounds_filter(self._materialize(x[0]))
+                agg.update(t, self._where_mask(t))
+            pending.clear()
+
+        def dispatch_pending() -> None:
+            nonlocal acc
+            if not pending:
+                return
+            enc0 = pending[0][1]
+            layout = PlanLayout(
+                key_specs=key_specs,
+                caps=tuple(ks.capacity for ks in key_specs),
+                origins=tuple(ks.origin_rel or 0 for ks in key_specs),
+                sum_cols=[specs[i].arg.name for i in sum_idx],
+                min_cols=[specs[i].arg.name for i in min_idx],
+                max_cols=[specs[i].arg.name for i in max_idx],
+                stacked_cols=[specs[i].arg.name for i in stacked_idx],
+            )
+            try:
+                program = self._get_program(
+                    enc0,
+                    layout,
+                    acc_groups,
+                    pending_sig[1],
+                    pending_sig[2],
+                    n_blocks=len(pending),
+                )
+                acc = program(
+                    acc,
+                    tuple(x[2] for x in pending),
+                    tuple(x[3] for x in pending),
+                    tuple(x[4] for x in pending),
+                    tuple(x[5] for x in pending),
+                )
+                pending.clear()
+            except UnsupportedOnDevice as e:
+                logger.debug("pending blocks on CPU (%s)", e)
+                fold_pending_on_cpu()
+            except Exception:
+                logger.exception("device dispatch failed; CPU fallback for pending blocks")
+                fold_pending_on_cpu()
+
+        t_start = _t.monotonic()
+        for table in blocks(tables):
+            try:
+                enc, dev = self._encoded_block(table, self.plan.needed_columns, dict_cols)
+                for i in stacked_idx:
+                    col = enc.columns.get(specs[i].arg.name)
+                    if col is None:
+                        raise UnsupportedOnDevice(f"aggregate column {specs[i].arg.name} missing")
+                    if col.kind in ("dict", "time") and i not in countcol_idx:
+                        raise UnsupportedOnDevice(f"numeric aggregate over {col.kind} column")
+                luts = compiler.collect_luts(sel.where, enc)
+                remaps = [
+                    ks.gdict.absorb(enc.columns[ks.column].dictionary)
+                    if ks.kind == "dict" and ks.column in enc.columns
+                    else None
+                    for ks in key_specs
+                ]
+                if any(r is None and ks.kind == "dict" for r, ks in zip(remaps, key_specs)):
+                    raise UnsupportedOnDevice("group key column missing from batch")
+
+                layouts = [self._required_layout(ks, enc) for ks in key_specs]
                 caps = tuple(c for _, c in layouts)
                 origins = tuple(o for o, _ in layouts)
                 current = tuple((ks.origin_rel or 0, ks.capacity) for ks in key_specs)
                 if acc is None or tuple(zip(origins, caps)) != current:
+                    dispatch_pending()  # under the old epoch's layout
                     if acc is not None:
                         flush(acc, acc_groups)
                     for ks, (o, c) in zip(key_specs, layouts):
@@ -719,32 +859,31 @@ class TpuQueryExecutor(QueryExecutor):
                     acc_groups = max(acc_groups, 1)
                     acc = new_acc(acc_groups)
 
-                layout = PlanLayout(
-                    key_specs=key_specs,
-                    caps=caps,
-                    origins=origins,
-                    sum_cols=[specs[i].arg.name for i in sum_idx],
-                    min_cols=[specs[i].arg.name for i in min_idx],
-                    max_cols=[specs[i].arg.name for i in max_idx],
-                    stacked_cols=[specs[i].arg.name for i in stacked_idx],
-                    time_origin_ms=enc.time_origin_ms,
-                    time_unit_ms=enc.time_unit_ms,
+                kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
+                sig = (
+                    (enc.block_rows, kinds, "__rowmask" in dev),
+                    tuple(l.shape for l in luts),
+                    tuple(r.shape if r is not None else None for r in remaps),
                 )
-                program = self._get_program(enc, layout, acc_groups, tuple(l.shape for l in luts))
-                row_mask = (
-                    dev["__ones"]
-                    if enc.num_rows == enc.block_rows
-                    else jnp.asarray(enc.row_mask)
-                )
-                # single async dispatch folding this block into the accumulator
-                acc = program(acc, dev, tuple(jnp.asarray(l) for l in luts), row_mask)
+                if pending and sig != pending_sig:
+                    dispatch_pending()
+                pending_sig = sig
+                dev_luts = tuple(jnp.asarray(l) for l in luts)
+                dev_remaps = tuple(jnp.asarray(r) for r in remaps if r is not None)
+                row_mask = dev.get("__rowmask", dev["__ones"])
+                pending.append((table, enc, dev, dev_luts, dev_remaps, row_mask))
+                if len(pending) >= GROUP_N:
+                    dispatch_pending()
             except UnsupportedOnDevice as e:
                 logger.debug("batch on CPU (%s)", e)
-                agg.update(table, self._where_mask(table))
+                t = self._bounds_filter(self._materialize(table))
+                agg.update(t, self._where_mask(t))
             except Exception:
                 logger.exception("device aggregation failed for a batch; CPU fallback")
-                agg.update(table, self._where_mask(table))
+                t = self._bounds_filter(self._materialize(table))
+                agg.update(t, self._where_mask(t))
 
+        dispatch_pending()
         if acc is not None:
             flush(acc, acc_groups)
         DEVICE_EXECUTE_TIME.labels("groupby").observe(_t.monotonic() - t_start)
@@ -753,18 +892,24 @@ class TpuQueryExecutor(QueryExecutor):
     # ------------------------------------------------------------- programs
 
     def _get_program(
-        self, enc: EncodedBatch, layout: PlanLayout, num_groups: int, lut_shapes: tuple
+        self,
+        enc: EncodedBatch,
+        layout: PlanLayout,
+        num_groups: int,
+        lut_shapes: tuple,
+        remap_shapes: tuple,
+        n_blocks: int = 1,
     ) -> Callable:
-        """One jitted dispatch: WHERE mask + group ids + fused aggregate +
-        fold into the donated device accumulator.
+        """One jitted dispatch: WHERE mask + dict remap + group ids + fused
+        aggregate + fold into the device accumulator.
 
-        Cached process-wide; the key covers everything baked into the trace:
-        the predicate tree, block shape, column kinds, capacities/origins,
-        LUT shapes, time encoding.
+        Cached process-wide; the key covers everything baked into the trace.
         """
         kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
+        bounds_s = self._bounds_seconds()
         key = (
             _expr_fingerprint(self.plan.select.where),
+            bounds_s,
             tuple(S.expr_name(ks.expr) for ks in layout.key_specs),
             tuple(layout.stacked_cols),
             tuple(layout.sum_cols),
@@ -775,9 +920,9 @@ class TpuQueryExecutor(QueryExecutor):
             layout.caps,
             layout.origins,
             lut_shapes,
-            layout.time_origin_ms,
-            layout.time_unit_ms,
+            remap_shapes,
             num_groups,
+            n_blocks,
         )
         prog = _PROGRAM_CACHE.get(key)
         if prog is not None:
@@ -787,33 +932,43 @@ class TpuQueryExecutor(QueryExecutor):
         import jax.numpy as jnp
 
         sel_where = self.plan.select.where
-        compiler_gdicts = {ks.column: ks.gdict for ks in layout.key_specs if ks.kind == "dict"}
-        compiler = PredicateCompiler(compiler_gdicts)
+        compiler = PredicateCompiler()
         n_sum, n_min, n_max = len(layout.sum_cols), len(layout.min_cols), len(layout.max_cols)
-        n_all = len(layout.stacked_cols)
         key_specs = [
             KeySpec(ks.kind, ks.column, ks.expr, ks.bin_ms, ks.gdict, cap, orig)
             for ks, cap, orig in zip(layout.key_specs, layout.caps, layout.origins)
         ]
-        time_origin_ms, time_unit_ms = layout.time_origin_ms, layout.time_unit_ms
         block_rows = enc.block_rows
+        origin_units = CANON_TIME_ORIGIN_MS // CANON_TIME_UNIT_MS
 
-        def prog_fn(acc, dev: dict, luts: tuple, row_mask):
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+        def fold_one(acc, dev: dict, luts: tuple, remaps: tuple, row_mask):
             mask = compiler.trace(sel_where, enc, dev, list(luts))
             mask = jnp.logical_and(mask, row_mask)
+            if bounds_s != (None, None) and DEFAULT_TIMESTAMP_KEY in enc.columns:
+                ts = dev[DEFAULT_TIMESTAMP_KEY]
+                lo, hi = bounds_s
+                if lo is not None:
+                    mask = jnp.logical_and(mask, ts >= jnp.int32(lo))
+                if hi is not None:
+                    mask = jnp.logical_and(mask, ts < jnp.int32(hi))
+                mask = jnp.logical_and(mask, dev[f"{DEFAULT_TIMESTAMP_KEY}__valid"])
             if not key_specs:
                 ids = jnp.zeros(block_rows, dtype=jnp.int32)
             else:
                 ids = None
                 stride = 1
+                ri = 0
                 for ks in key_specs:
                     cap = ks.capacity
                     if ks.kind == "dict":
-                        codes = jnp.minimum(dev[ks.column], cap - 1)
+                        codes = jnp.minimum(remaps[ri][dev[ks.column]], cap - 1)
+                        ri += 1
                     else:
-                        bin_units = max(1, ks.bin_ms // time_unit_ms)
+                        bin_units = max(1, ks.bin_ms // CANON_TIME_UNIT_MS)
                         origin_bin = ks.origin_rel or 0
-                        base_units = origin_bin * bin_units - time_origin_ms // time_unit_ms
+                        base_units = origin_bin * bin_units - origin_units
                         codes = jnp.clip(
                             (dev[ks.column] - jnp.int32(base_units)) // jnp.int32(bin_units),
                             0,
@@ -847,7 +1002,7 @@ class TpuQueryExecutor(QueryExecutor):
                 n_max,
             )
             adds = jnp.concatenate([count[None, :], pac, sums], axis=0)
-            a0 = 1 + n_all + n_sum
+            a0 = adds.shape[0]
             new_acc = jnp.concatenate(
                 [
                     acc[:a0] + adds,
@@ -858,6 +1013,13 @@ class TpuQueryExecutor(QueryExecutor):
             )
             return new_acc
 
+        def prog_fn(acc, devs: tuple, luts_all: tuple, remaps_all: tuple, row_masks: tuple):
+            # unrolled folds: N blocks per dispatch amortize round-trip
+            # latency; XLA sees one big program and schedules it as a unit
+            for i in range(n_blocks):
+                acc = fold_one(acc, devs[i], luts_all[i], remaps_all[i], row_masks[i])
+            return acc
+
         # NOTE: no donate_argnums — buffer donation forces a synchronous
         # round trip on tunneled PJRT backends (measured 424ms vs 10ms per
         # call); the G-sized accumulator copy is far cheaper
@@ -867,11 +1029,26 @@ class TpuQueryExecutor(QueryExecutor):
 
     # ------------------------------------------------------------- internals
 
-    def _required_layout(self, ks: KeySpec, enc: EncodedBatch, gdicts) -> tuple[int, int]:
+    def _bounds_seconds(self) -> tuple[int | None, int | None]:
+        """Time bounds as canonical int32 seconds; raises when not
+        second-aligned (the CPU path then enforces them exactly)."""
+        tb = self.plan.time_bounds
+        out = []
+        for b in (tb.low, tb.high):
+            if b is None:
+                out.append(None)
+                continue
+            ms = int(b.timestamp() * 1000)
+            if ms % CANON_TIME_UNIT_MS:
+                raise UnsupportedOnDevice("sub-second time bound")
+            out.append((ms - CANON_TIME_ORIGIN_MS) // CANON_TIME_UNIT_MS)
+        return tuple(out)
+
+    def _required_layout(self, ks: KeySpec, enc: EncodedBatch) -> tuple[int, int]:
         """(origin, capacity) this key needs for the incoming batch. A change
         in either forces a dense-state flush before processing the batch."""
         if ks.kind == "dict":
-            card = max(1, len(gdicts[ks.column]) + 1)  # +1 null slot
+            card = max(1, len(ks.gdict) + 1)  # +1 null slot
             cap = max(ks.capacity, 2)
             while cap < card:
                 cap *= 2
@@ -879,12 +1056,19 @@ class TpuQueryExecutor(QueryExecutor):
         col = enc.columns.get(ks.column)
         if col is None:
             raise UnsupportedOnDevice(f"time column {ks.column} missing")
-        if ks.bin_ms % enc.time_unit_ms or enc.time_origin_ms % enc.time_unit_ms:
-            raise UnsupportedOnDevice("bin finer than time encoding unit")
         if col.vmin is None or col.vmax is None:
             return ks.origin_rel or 0, max(ks.capacity, 2)
-        lo_bin = (col.vmin * enc.time_unit_ms + enc.time_origin_ms) // ks.bin_ms
-        hi_bin = (col.vmax * enc.time_unit_ms + enc.time_origin_ms) // ks.bin_ms
+        lo_bin = (col.vmin * CANON_TIME_UNIT_MS + CANON_TIME_ORIGIN_MS) // ks.bin_ms
+        hi_bin = (col.vmax * CANON_TIME_UNIT_MS + CANON_TIME_ORIGIN_MS) // ks.bin_ms
+        if ks.origin_rel is None and self.plan.scan_time_hint is not None:
+            # pre-size from the scan's manifest time range: one capacity
+            # epoch, one flush, one readback for the whole query
+            h_lo, h_hi = self.plan.scan_time_hint
+            hint_lo_bin = int(h_lo.timestamp() * 1000) // ks.bin_ms
+            hint_hi_bin = int(h_hi.timestamp() * 1000) // ks.bin_ms
+            if 0 < hint_hi_bin - hint_lo_bin <= (1 << 22):
+                lo_bin = min(lo_bin, hint_lo_bin)
+                hi_bin = max(hi_bin, hint_hi_bin)
         origin_bin = lo_bin if ks.origin_rel is None else min(ks.origin_rel, lo_bin)
         span = hi_bin - origin_bin + 1
         cap = max(ks.capacity, 2)
@@ -902,8 +1086,6 @@ class TpuQueryExecutor(QueryExecutor):
         key_specs: list[KeySpec],
         agg: HashAggregator,
         specs: list[AggSpec],
-        time_origin: int,
-        time_unit: int,
     ) -> None:
         """Dense accumulators -> sparse host aggregator, decoding group ids."""
         idxs = np.nonzero(state.count > 0)[0]
@@ -962,27 +1144,11 @@ class TpuQueryExecutor(QueryExecutor):
 # --------------------------------------------------------------- device util
 
 
-# device-resident all-true masks per block size; eagerly computing jnp.ones
-# per batch costs a full dispatch round trip on tunneled backends
-_ONES_CACHE: dict[int, Any] = {}
-
-
-def _device_ones(block_rows: int):
-    import jax.numpy as jnp
-
-    ones = _ONES_CACHE.get(block_rows)
-    if ones is None:
-        ones = jnp.asarray(np.ones(block_rows, dtype=bool))
-        _ONES_CACHE[block_rows] = ones
-    return ones
-
-
-def _to_device(enc: EncodedBatch, gdicts: dict[str, GlobalDict]):
-    """Ship encoded columns to device, remapping dict codes to global ids.
+def _transfer(enc: EncodedBatch) -> tuple[dict, int]:
+    """Ship encoded columns to device.
 
     Null-free columns share ONE device `ones` mask instead of shipping a
-    validity array each — on tunneled backends transfer bytes are the query
-    budget.
+    validity array each — transfer bytes are the scan budget.
     """
     import jax.numpy as jnp
 
@@ -990,22 +1156,31 @@ def _to_device(enc: EncodedBatch, gdicts: dict[str, GlobalDict]):
     nbytes = 0
     ones = _device_ones(enc.block_rows)
     for name, col in enc.columns.items():
-        vals = col.values
-        if col.kind == "dict":
-            # every string column gets a global dictionary so predicate LUTs
-            # and group codes stay stable across batches
-            gd = gdicts.setdefault(name, GlobalDict())
-            vals = gd.remap(col.dictionary, col.values)
-        dev[name] = jnp.asarray(vals)
-        nbytes += vals.nbytes
+        dev[name] = jnp.asarray(col.values)
+        nbytes += col.values.nbytes
         if col.all_valid:
             dev[f"{name}__valid"] = ones
         else:
             dev[f"{name}__valid"] = jnp.asarray(col.valid)
             nbytes += col.valid.nbytes
     dev["__ones"] = ones
+    if enc.num_rows != enc.block_rows:
+        # padding mask must live with the block (host copy gets stripped
+        # when the block enters the hot set)
+        dev["__rowmask"] = jnp.asarray(enc.row_mask)
+        nbytes += enc.row_mask.nbytes
     DEVICE_BYTES_TO_DEVICE.labels("scan").inc(nbytes)
-    return dev
+    return dev, nbytes
+
+
+def _strip_host_values(enc: EncodedBatch) -> None:
+    """Free the host-side ndarray copies before caching (dictionaries,
+    vmin/vmax and flags stay — they're what queries need)."""
+    empty = np.empty(0, np.int32)
+    for col in enc.columns.values():
+        col.values = empty
+        col.valid = empty
+    enc.row_mask = np.empty(0, bool)
 
 
 def _concat_tables(tables: list[pa.Table]) -> pa.Table:

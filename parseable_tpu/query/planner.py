@@ -256,6 +256,9 @@ class LogicalPlan:
     is_aggregate: bool = False
     # stream schema, when known — typed empty results, projection validation
     schema_hint: object | None = None  # pa.Schema
+    # scan's overall [min, max] event time (from manifests): lets the TPU
+    # engine pre-size time-bin group capacities and flush exactly once
+    scan_time_hint: tuple[datetime, datetime] | None = None
 
     @property
     def count_star_only(self) -> bool:
@@ -335,6 +338,9 @@ def plan(select: S.Select) -> LogicalPlan:
         needed |= referenced_columns(select.having)
         for o in select.order_by:
             needed |= referenced_columns(o.expr)
+        # engines row-filter by time bounds themselves (scan tables arrive
+        # unfiltered so device encodings stay query-independent)
+        needed.add(DEFAULT_TIMESTAMP_KEY)
 
     is_agg = bool(select.group_by) or any(S.is_aggregate(i.expr) for i in select.items)
     return LogicalPlan(

@@ -45,10 +45,20 @@ class ScanStats:
 class StreamScan:
     """Materialize a stream's sources for one query."""
 
-    def __init__(self, parseable: Parseable, plan: LogicalPlan, hot_tier_dir: Path | None = None):
+    def __init__(
+        self,
+        parseable: Parseable,
+        plan: LogicalPlan,
+        hot_tier_dir: Path | None = None,
+        use_hot_stubs: bool = False,
+    ):
         self.p = parseable
         self.plan = plan
         self.hot_tier_dir = hot_tier_dir
+        # device-resident blocks skip the parquet read entirely: the scan
+        # yields a stub the TPU executor resolves from the hot set
+        self.use_hot_stubs = use_hot_stubs
+        self._sources: dict[bytes, ManifestFile] = {}
         self.stats = ScanStats()
 
     # ---------------------------------------------------------------- helpers
@@ -174,22 +184,64 @@ class StreamScan:
     # ------------------------------------------------------------------ scan
 
     def tables(self) -> Iterator[pa.Table]:
-        """All sources, time-filtered at row level."""
+        """All sources.
+
+        Staging tables are row-filtered here (they're query-local and never
+        cached). Parquet tables yield *unfiltered* but stamped with a source
+        id so their device encodings are query-independent and hot-set
+        cacheable — the engines apply the row-level time filter themselves
+        (host filter on CPU, device mask on TPU).
+        """
         if self._within_staging_window():
             for t in self.staging_tables():
                 t = self._apply_time_filter(t)
                 if t.num_rows:
                     yield t
+        hotset = key_fn = None
+        if self.use_hot_stubs:
+            from parseable_tpu.ops.hotset import get_hotset
+            from parseable_tpu.query.executor_tpu import (
+                dict_group_columns,
+                hot_key,
+                make_stub,
+            )
+
+            hotset = get_hotset()
+            dict_cols = dict_group_columns(self.plan.select)
+            key_fn = lambda sid: hot_key(sid, self.plan.needed_columns, dict_cols)
+            make_stub_fn = make_stub
         for f in self.manifest_files():
+            # size + row count make the id content-sensitive: a rewritten
+            # object at the same path must not serve a stale cached block
+            source_id = f"{f.file_path}|{f.file_size}|{f.num_rows}".encode()
+            self._sources[source_id] = f
+            if hotset is not None:
+                entry = hotset.get(key_fn(source_id))
+                if entry is not None:
+                    self.stats.rows_scanned += entry.meta.num_rows
+                    yield make_stub_fn(source_id, entry.meta.num_rows)
+                    continue
             t = self._read_parquet(f)
-            if t is None:
+            if t is None or t.num_rows == 0:
                 continue
-            t = self._apply_time_filter(t)
-            if t.num_rows:
-                yield t
+            meta = dict(t.schema.metadata or {})
+            meta[b"ptpu_source_id"] = source_id
+            yield t.replace_schema_metadata(meta)
         TOTAL_QUERY_BYTES_SCANNED_DATE.labels(datetime.now(UTC).date().isoformat()).inc(
             self.stats.bytes_scanned
         )
+
+    def read_source(self, source_id: bytes) -> pa.Table:
+        """Re-read a stubbed source (hot-set eviction race / CPU fallback)."""
+        f = self._sources.get(source_id)
+        if f is None:
+            raise KeyError(f"unknown scan source {source_id!r}")
+        t = self._read_parquet(f)
+        if t is None:
+            raise OSError(f"failed to re-read {f.file_path}")
+        meta = dict(t.schema.metadata or {})
+        meta[b"ptpu_source_id"] = source_id
+        return t.replace_schema_metadata(meta)
 
     def _apply_time_filter(self, table: pa.Table) -> pa.Table:
         tb = self.plan.time_bounds

@@ -77,7 +77,12 @@ class QuerySession:
             api_bounds = TimeBounds(low=tr.start, high=tr.end)
             lp.time_bounds = lp.time_bounds.intersect(api_bounds)
 
-        scan = StreamScan(self.p, lp, hot_tier_dir=self.p.options.hot_tier_storage_path)
+        scan = StreamScan(
+            self.p,
+            lp,
+            hot_tier_dir=self.p.options.hot_tier_storage_path,
+            use_hot_stubs=self.engine == "tpu" and lp.is_aggregate,
+        )
         result = self._execute(lp, scan)
         elapsed = _time.monotonic() - t0
         QUERY_EXECUTE_TIME.labels(lp.stream).observe(elapsed)
@@ -106,11 +111,39 @@ class QuerySession:
         if self.engine == "tpu":
             from parseable_tpu.query.executor_tpu import TpuQueryExecutor
 
+            self._set_scan_time_hint(lp, scan)
             executor: QueryExecutor = TpuQueryExecutor(lp, self.p.options)
+            executor.source_loader = scan.read_source
         else:
             executor = QueryExecutor(lp)
         table = executor.execute(scan.tables())
         return QueryResult(table, table.column_names)
+
+    @staticmethod
+    def _set_scan_time_hint(lp: LogicalPlan, scan: StreamScan) -> None:
+        """Overall scan time range from per-file p_timestamp stats — lets the
+        TPU engine pre-size time-bin group capacities exactly (a loose hint
+        inflates the dense group space and with it the scatter cost)."""
+        from datetime import datetime
+
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+        lo_ms = hi_ms = None
+        for f in scan.manifest_files():
+            for col in f.columns:
+                if col.name == DEFAULT_TIMESTAMP_KEY and col.stats is not None:
+                    lo_ms = col.stats.min if lo_ms is None else min(lo_ms, col.stats.min)
+                    hi_ms = col.stats.max if hi_ms is None else max(hi_ms, col.stats.max)
+        if lo_ms is None:
+            return
+        lo = datetime.fromtimestamp(lo_ms / 1000, UTC)
+        hi = datetime.fromtimestamp(hi_ms / 1000, UTC)
+        if lp.time_bounds.low is not None:
+            lo = max(lo, lp.time_bounds.low)
+        if lp.time_bounds.high is not None:
+            hi = min(hi, lp.time_bounds.high)
+        if lo <= hi:
+            lp.scan_time_hint = (lo, hi)
 
     def _try_manifest_count(self, lp: LogicalPlan, scan: StreamScan) -> int | None:
         from datetime import datetime

@@ -91,3 +91,40 @@ def test_date_bin_with_origin_falls_back():
     cpu = QueryExecutor(lp1).execute(iter([t]))
     tpu = TpuQueryExecutor(lp2).execute(iter([t]))
     assert rows(cpu) == rows(tpu)
+
+
+def test_boundary_second_time_predicates_match_cpu():
+    """`>` / `<=` on ms-precision rows are not representable at floored
+    seconds; the TPU engine must fall back rather than misclassify rows in
+    the boundary second (review finding)."""
+    n = 10
+    ts = [BASE + timedelta(milliseconds=500 * i) for i in range(n)]  # sub-second parts
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "v": pa.array([1.0] * n),
+        }
+    )
+    lit = (BASE + timedelta(seconds=1)).isoformat() + "Z"
+    for op in (">", "<=", ">=", "<"):
+        sql = f"SELECT count(*) c FROM t WHERE p_timestamp {op} '{lit}'"
+        lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+        cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+        tpu = TpuQueryExecutor(lp2).execute(iter([t])).to_pylist()
+        assert cpu == tpu, f"op {op}: cpu={cpu} tpu={tpu}"
+
+
+def test_unrepresentable_bounds_fall_back_cleanly():
+    """WHERE `<=` produces a +1ms upper bound; the plan-time bounds check
+    must reject the device path BEFORE consuming the scan so the CPU
+    fallback sees all tables (review finding: silent empty results)."""
+    t = table_with_span(0.01)
+    sql = (
+        "SELECT status, count(*) c FROM t "
+        "WHERE p_timestamp <= '2024-05-01T10:30:00Z' GROUP BY status"
+    )
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+    tpu = TpuQueryExecutor(lp2).execute(iter([t])).to_pylist()
+    assert sorted(map(str, cpu)) == sorted(map(str, tpu))
+    assert sum(r["c"] for r in cpu) == 100  # nothing dropped
