@@ -1,0 +1,3 @@
+from parseable_tpu.server.app import main
+
+main()

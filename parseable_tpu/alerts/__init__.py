@@ -1,1 +1,167 @@
+"""Alerts: threshold alerts evaluated over the query engine.
 
+Parity target (reference: src/alerts/ — 5,858 LoC over 8 files):
+- alert config CRUD lives in the metastore ("alerts"/"targets" collections,
+  wired in server/app.py);
+- `evaluate_alert` builds an aggregate SQL from the alert's query +
+  threshold condition and runs it over the rolling window
+  (alerts_utils.rs:58-165), feeding a triggered/resolved state machine
+  (alert_structs.rs:766-910);
+- targets (webhook / slack / alertmanager) receive notifications with a
+  retry policy (target.rs). This environment has no egress, so deliveries
+  log + record to the metastore ("alert_state" collection) — the transport
+  call is isolated in `_deliver` for real deployments.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from datetime import UTC, datetime
+
+from parseable_tpu.storage import rfc3339_now
+
+logger = logging.getLogger(__name__)
+
+OPERATORS = {
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+AGGREGATES = {"count", "sum", "avg", "min", "max"}
+
+
+def validate_alert(config: dict) -> None:
+    """Minimal structural validation of an AlertRequest-shaped document
+    (reference: alert_structs.rs:280-503)."""
+    if not config.get("title"):
+        raise ValueError("alert needs a title")
+    if not config.get("stream") and not config.get("query"):
+        raise ValueError("alert needs a stream or query")
+    cond = config.get("threshold_config") or config.get("thresholdConfig")
+    if not cond:
+        raise ValueError("alert needs threshold_config")
+    agg = cond.get("agg", "count").lower()
+    if agg not in AGGREGATES:
+        raise ValueError(f"unknown aggregate {agg!r}")
+    if cond.get("operator", ">") not in OPERATORS:
+        raise ValueError(f"unknown operator {cond.get('operator')!r}")
+    float(cond.get("value", 0))
+
+
+@dataclass
+class AlertOutcome:
+    alert_id: str
+    state: str  # "triggered" | "resolved"
+    actual: float | None
+    message: str
+
+
+def build_alert_sql(config: dict) -> tuple[str, str]:
+    """(sql, window) for the alert (reference: condition->SQL compile,
+    alerts_utils.rs:390-671)."""
+    cond = config.get("threshold_config") or config.get("thresholdConfig") or {}
+    agg = cond.get("agg", "count").lower()
+    column = cond.get("column", "*")
+    where = config.get("where") or cond.get("where")
+    if config.get("query"):
+        sql = config["query"]
+    else:
+        target = "*" if agg == "count" and column in ("*", None) else column
+        sql = f"SELECT {agg}({target}) AS value FROM {config['stream']}"
+        if where:
+            sql += f" WHERE {where}"
+    window = config.get("eval_config", {}).get("rollingWindow", {}).get(
+        "evalStart", config.get("window", "5m")
+    )
+    return sql, window
+
+
+def evaluate_alert(parseable, config: dict) -> AlertOutcome:
+    """Run one alert evaluation (reference: alerts_utils.rs:58-165)."""
+    from parseable_tpu.query.session import QuerySession
+
+    alert_id = config.get("id", "unknown")
+    sql, window = build_alert_sql(config)
+    sess = QuerySession(parseable)
+    res = sess.query(sql, window, "now")
+    rows = res.to_json_rows()
+    actual = None
+    if rows:
+        first = rows[0]
+        actual = next((v for v in first.values() if isinstance(v, (int, float))), None)
+    cond = config.get("threshold_config") or config.get("thresholdConfig") or {}
+    op = OPERATORS[cond.get("operator", ">")]
+    threshold = float(cond.get("value", 0))
+    triggered = actual is not None and op(float(actual), threshold)
+    state = "triggered" if triggered else "resolved"
+    msg = (
+        f"alert {config.get('title')!r}: value {actual} {cond.get('operator', '>')} "
+        f"{threshold} -> {state}"
+    )
+    return AlertOutcome(alert_id, state, actual, msg)
+
+
+def _deliver(target: dict, outcome: AlertOutcome) -> None:
+    """Notification transport (webhook/slack/alertmanager). No egress in
+    this environment: log only. Deployments implement the POST here."""
+    logger.info(
+        "notify target=%s type=%s: %s", target.get("id"), target.get("type"), outcome.message
+    )
+
+
+def alert_tick(state) -> None:
+    """Per-minute evaluation loop body (reference: sync.rs:371-435 runtime).
+
+    Respects per-alert eval frequency; transitions write to the metastore's
+    alert_state collection and bump the state-transition metric.
+    """
+    from parseable_tpu.utils.metrics import ALERTS_STATES
+
+    p = state.p
+    try:
+        alerts = p.metastore.list_documents("alerts")
+    except Exception:
+        return
+    now = datetime.now(UTC)
+    for config in alerts:
+        alert_id = config.get("id")
+        if not alert_id or config.get("state") == "disabled":
+            continue
+        freq_mins = int(config.get("eval_frequency", config.get("evalFrequency", 1)) or 1)
+        prev = p.metastore.get_document("alert_state", alert_id) or {}
+        last = prev.get("last_eval")
+        if last:
+            try:
+                from parseable_tpu.utils.timeutil import parse_rfc3339
+
+                if (now - parse_rfc3339(last)).total_seconds() < freq_mins * 60 - 1:
+                    continue
+            except ValueError:
+                pass
+        try:
+            outcome = evaluate_alert(p, config)
+        except Exception as e:
+            logger.warning("alert %s evaluation failed: %s", alert_id, e)
+            continue
+        prev_state = prev.get("state")
+        record = {
+            "id": alert_id,
+            "state": outcome.state,
+            "actual": outcome.actual,
+            "message": outcome.message,
+            "last_eval": rfc3339_now(),
+            "since": prev.get("since") if prev_state == outcome.state else rfc3339_now(),
+        }
+        p.metastore.put_document("alert_state", alert_id, record)
+        if prev_state != outcome.state:
+            ALERTS_STATES.labels(config.get("title", alert_id), outcome.state).inc()
+            logger.info("%s", outcome.message)
+            for target_id in config.get("targets", []):
+                target = p.metastore.get_document("targets", target_id)
+                if target:
+                    _deliver(target, outcome)

@@ -25,13 +25,14 @@ from parseable_tpu import DEFAULT_TIMESTAMP_KEY
 from parseable_tpu.catalog import (
     Manifest,
     ManifestItem,
+    Snapshot,
     create_from_parquet_file,
     partition_path,
 )
 from parseable_tpu.config import Mode, Options, StorageOptions, generate_node_id
 from parseable_tpu.event.format import LogSource, SchemaVersion
 from parseable_tpu.metastore import MetastoreError, ObjectStoreMetastore
-from parseable_tpu.storage import ObjectStoreFormat, rfc3339_now
+from parseable_tpu.storage import FullStats, ObjectStoreFormat, rfc3339_now
 from parseable_tpu.storage.object_storage import UploadPool, make_provider
 from parseable_tpu.streams import LogStreamMetadata, Stream, Streams
 from parseable_tpu.utils.arrowutil import merge_schemas
@@ -150,6 +151,15 @@ class Parseable:
             schema = self.metastore.get_schema(name)
             if schema is not None:
                 meta.schema = {f.name: f for f in schema}
+            if self._node_suffix is not None:
+                # each ingestor owns a per-node stream json for its snapshot
+                try:
+                    self.metastore.get_stream_json(name, self._node_suffix)
+                except MetastoreError:
+                    base = ObjectStoreFormat.from_json(fmts[0].to_json())
+                    base.snapshot = Snapshot()
+                    base.stats = FullStats()
+                    self.metastore.put_stream_json(name, base, self._node_suffix)
         if meta is None:
             meta = LogStreamMetadata(
                 time_partition=time_partition,

@@ -51,7 +51,16 @@ class Event:
     def process(self, stream: Stream, livetail=None, commit_schema=None) -> None:
         """[HOT LOOP] push into staging + stats (reference: event/mod.rs:76-129)."""
         schema_key = get_schema_key(list(self.rb.schema.names))
-        if self.is_first_event and commit_schema is not None:
+        if (
+            commit_schema is not None
+            and not stream.metadata.static_schema_flag
+            and (
+                self.is_first_event
+                or any(
+                    name not in (stream.metadata.schema or {}) for name in self.rb.schema.names
+                )
+            )
+        ):
             commit_schema(self.stream_name, self.rb.schema)
         ts = self.parsed_timestamp
         if ts.tzinfo is not None:

@@ -54,6 +54,16 @@ class JsonEvent:
         return values
 
     def into_event(self, metadata: LogStreamMetadata, stream_type: str = "UserDefined") -> Event:
+        if metadata.static_schema_flag and metadata.schema:
+            # static-schema streams reject undeclared fields outright
+            # (reference: static_schema.rs contract — no inference)
+            declared = set(metadata.schema)
+            extra = sorted({k for r in self.records for k in r} - declared)
+            if extra:
+                raise EventError(
+                    f"fields {extra} are not part of the static schema for "
+                    f"stream {self.stream_name!r}"
+                )
         prepared = prepare_event(
             self.records,
             metadata.schema or None,

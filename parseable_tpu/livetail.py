@@ -1,0 +1,68 @@
+"""Livetail: fan ingested batches out to live subscribers.
+
+Parity target (reference: src/livetail.rs): a global pipe registry with one
+bounded queue per subscriber; slow consumers drop batches (backpressure by
+shedding, livetail.rs:100-165). The reference serves tails over Arrow
+Flight; here they stream over HTTP SSE (the DCN data plane of this build is
+HTTP + Arrow/JSON rather than gRPC — see SURVEY §5 comm-backend mapping).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+
+CHANNEL_CAPACITY = 1000
+
+
+@dataclass
+class _Pipe:
+    id: str
+    stream: str
+    q: "queue.Queue[pa.RecordBatch]" = field(
+        default_factory=lambda: queue.Queue(maxsize=CHANNEL_CAPACITY)
+    )
+    dropped: int = 0
+
+
+class Livetail:
+    """Registry of per-client pipes, keyed by stream name."""
+
+    def __init__(self) -> None:
+        self._pipes: dict[str, list[_Pipe]] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, stream: str) -> _Pipe:
+        pipe = _Pipe(id=uuid.uuid4().hex, stream=stream)
+        with self._lock:
+            self._pipes.setdefault(stream, []).append(pipe)
+        return pipe
+
+    def unsubscribe(self, pipe: _Pipe) -> None:
+        with self._lock:
+            pipes = self._pipes.get(pipe.stream, [])
+            if pipe in pipes:
+                pipes.remove(pipe)
+            if not pipes:
+                self._pipes.pop(pipe.stream, None)
+
+    def process(self, stream: str, batch: pa.RecordBatch) -> None:
+        """Called from the ingest hot path; never blocks (drops on full)."""
+        with self._lock:
+            pipes = list(self._pipes.get(stream, []))
+        for pipe in pipes:
+            try:
+                pipe.q.put_nowait(batch)
+            except queue.Full:
+                pipe.dropped += 1
+
+    def has_subscribers(self, stream: str) -> bool:
+        with self._lock:
+            return bool(self._pipes.get(stream))
+
+
+LIVETAIL = Livetail()

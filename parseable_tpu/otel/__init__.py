@@ -1,1 +1,14 @@
+"""OTel protobuf-JSON flatteners (logs / metrics / traces).
 
+Parity targets (reference: src/otel/{logs,metrics,traces,otel_utils}.rs):
+OTLP/HTTP JSON payloads (`resourceLogs`/`resourceMetrics`/`resourceSpans`)
+flatten into one row per record with resource/scope attributes prefixed, enum
+severities/kinds/status codes enriched with their text names, and
+nanosecond timestamps converted to RFC3339 strings.
+"""
+
+from parseable_tpu.otel.logs import flatten_otel_logs
+from parseable_tpu.otel.metrics import flatten_otel_metrics
+from parseable_tpu.otel.traces import flatten_otel_traces
+
+__all__ = ["flatten_otel_logs", "flatten_otel_metrics", "flatten_otel_traces"]

@@ -1,0 +1,70 @@
+"""OTLP logs flattener (reference: src/otel/logs.rs:298 flatten_otel_logs).
+
+One row per logRecord; severity number enriched with its text name; body
+converted from AnyValue; resource/scope attrs prefixed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from parseable_tpu.otel.otel_utils import (
+    convert_anyvalue,
+    flatten_attributes,
+    nanos_to_rfc3339,
+    scope_and_resource_fields,
+)
+
+SEVERITY_TEXT = {
+    0: "SEVERITY_NUMBER_UNSPECIFIED",
+    1: "SEVERITY_NUMBER_TRACE", 2: "SEVERITY_NUMBER_TRACE2",
+    3: "SEVERITY_NUMBER_TRACE3", 4: "SEVERITY_NUMBER_TRACE4",
+    5: "SEVERITY_NUMBER_DEBUG", 6: "SEVERITY_NUMBER_DEBUG2",
+    7: "SEVERITY_NUMBER_DEBUG3", 8: "SEVERITY_NUMBER_DEBUG4",
+    9: "SEVERITY_NUMBER_INFO", 10: "SEVERITY_NUMBER_INFO2",
+    11: "SEVERITY_NUMBER_INFO3", 12: "SEVERITY_NUMBER_INFO4",
+    13: "SEVERITY_NUMBER_WARN", 14: "SEVERITY_NUMBER_WARN2",
+    15: "SEVERITY_NUMBER_WARN3", 16: "SEVERITY_NUMBER_WARN4",
+    17: "SEVERITY_NUMBER_ERROR", 18: "SEVERITY_NUMBER_ERROR2",
+    19: "SEVERITY_NUMBER_ERROR3", 20: "SEVERITY_NUMBER_ERROR4",
+    21: "SEVERITY_NUMBER_FATAL", 22: "SEVERITY_NUMBER_FATAL2",
+    23: "SEVERITY_NUMBER_FATAL3", 24: "SEVERITY_NUMBER_FATAL4",
+}
+
+
+def flatten_otel_logs(payload: dict) -> list[dict[str, Any]]:
+    rows: list[dict[str, Any]] = []
+    for rl in payload.get("resourceLogs", []):
+        resource = rl.get("resource")
+        for sl in rl.get("scopeLogs", []):
+            scope = sl.get("scope")
+            base = scope_and_resource_fields(resource, scope)
+            if sl.get("schemaUrl"):
+                base["schema_url"] = sl["schemaUrl"]
+            for rec in sl.get("logRecords", []):
+                row = dict(base)
+                row["time_unix_nano"] = nanos_to_rfc3339(rec.get("timeUnixNano"))
+                row["observed_time_unix_nano"] = nanos_to_rfc3339(
+                    rec.get("observedTimeUnixNano")
+                )
+                sev_num = rec.get("severityNumber")
+                if sev_num is not None:
+                    sev_num = int(sev_num)
+                    row["severity_number"] = sev_num
+                    row["severity_text"] = rec.get("severityText") or SEVERITY_TEXT.get(
+                        sev_num, str(sev_num)
+                    )
+                elif rec.get("severityText"):
+                    row["severity_text"] = rec["severityText"]
+                row["body"] = convert_anyvalue(rec.get("body"))
+                row.update(flatten_attributes(rec.get("attributes")))
+                if rec.get("droppedAttributesCount"):
+                    row["log_record_dropped_attributes_count"] = rec["droppedAttributesCount"]
+                if rec.get("flags") is not None:
+                    row["flags"] = rec.get("flags")
+                if rec.get("traceId"):
+                    row["trace_id"] = rec["traceId"]
+                if rec.get("spanId"):
+                    row["span_id"] = rec["spanId"]
+                rows.append(row)
+    return rows

@@ -1,0 +1,144 @@
+"""OTLP metrics flattener (reference: src/otel/metrics.rs:612; data-point
+kinds at :440 — gauge/sum/histogram/exponential histogram/summary).
+
+One row per data point, carrying the metric name/description/unit plus
+kind-specific fields. Aggregation temporality and flags are enriched with
+their enum names.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from parseable_tpu.otel.otel_utils import (
+    flatten_attributes,
+    nanos_to_rfc3339,
+    scope_and_resource_fields,
+)
+
+AGG_TEMPORALITY = {
+    0: "AGGREGATION_TEMPORALITY_UNSPECIFIED",
+    1: "AGGREGATION_TEMPORALITY_DELTA",
+    2: "AGGREGATION_TEMPORALITY_CUMULATIVE",
+}
+
+
+def _point_common(dp: dict) -> dict[str, Any]:
+    row: dict[str, Any] = {}
+    row.update(flatten_attributes(dp.get("attributes")))
+    if dp.get("startTimeUnixNano"):
+        row["start_time_unix_nano"] = nanos_to_rfc3339(dp["startTimeUnixNano"])
+    row["time_unix_nano"] = nanos_to_rfc3339(dp.get("timeUnixNano"))
+    flags = dp.get("flags")
+    if flags is not None:
+        row["flags"] = int(flags)
+        row["data_point_flags_description"] = (
+            "DATA_POINT_FLAGS_NO_RECORDED_VALUE_MASK" if int(flags) & 1 else "DATA_POINT_FLAGS_DO_NOT_USE"
+        )
+    if dp.get("exemplars"):
+        row["exemplars"] = json.dumps(dp["exemplars"], default=str)
+    return row
+
+
+def _number_value(dp: dict, prefix: str) -> dict[str, Any]:
+    out = {}
+    if "asDouble" in dp:
+        out[f"{prefix}_value"] = float(dp["asDouble"])
+    elif "asInt" in dp:
+        out[f"{prefix}_value"] = float(int(dp["asInt"]))
+    return out
+
+
+def flatten_otel_metrics(payload: dict) -> list[dict[str, Any]]:
+    rows: list[dict[str, Any]] = []
+    for rm in payload.get("resourceMetrics", []):
+        resource = rm.get("resource")
+        for sm in rm.get("scopeMetrics", []):
+            scope = sm.get("scope")
+            base = scope_and_resource_fields(resource, scope)
+            if sm.get("schemaUrl"):
+                base["schema_url"] = sm["schemaUrl"]
+            for metric in sm.get("metrics", []):
+                mbase = dict(base)
+                mbase["metric_name"] = metric.get("name")
+                if metric.get("description"):
+                    mbase["metric_description"] = metric["description"]
+                if metric.get("unit"):
+                    mbase["metric_unit"] = metric["unit"]
+                if metric.get("metadata"):
+                    mbase.update(flatten_attributes(metric["metadata"], prefix="metric_metadata_"))
+
+                if "gauge" in metric:
+                    for dp in metric["gauge"].get("dataPoints", []):
+                        row = {**mbase, "metric_type": "gauge", **_point_common(dp)}
+                        row.update(_number_value(dp, "gauge"))
+                        rows.append(row)
+                elif "sum" in metric:
+                    s = metric["sum"]
+                    temp = int(s.get("aggregationTemporality", 0))
+                    for dp in s.get("dataPoints", []):
+                        row = {**mbase, "metric_type": "sum", **_point_common(dp)}
+                        row.update(_number_value(dp, "sum"))
+                        row["sum_is_monotonic"] = bool(s.get("isMonotonic", False))
+                        row["sum_aggregation_temporality"] = temp
+                        row["sum_aggregation_temporality_description"] = AGG_TEMPORALITY.get(temp)
+                        rows.append(row)
+                elif "histogram" in metric:
+                    h = metric["histogram"]
+                    temp = int(h.get("aggregationTemporality", 0))
+                    for dp in h.get("dataPoints", []):
+                        row = {**mbase, "metric_type": "histogram", **_point_common(dp)}
+                        row["histogram_count"] = int(dp.get("count", 0))
+                        if "sum" in dp:
+                            row["histogram_sum"] = float(dp["sum"])
+                        if "min" in dp:
+                            row["histogram_min"] = float(dp["min"])
+                        if "max" in dp:
+                            row["histogram_max"] = float(dp["max"])
+                        if dp.get("bucketCounts"):
+                            row["histogram_bucket_counts"] = json.dumps(
+                                [int(c) for c in dp["bucketCounts"]]
+                            )
+                        if dp.get("explicitBounds"):
+                            row["histogram_explicit_bounds"] = json.dumps(
+                                [float(b) for b in dp["explicitBounds"]]
+                            )
+                        row["histogram_aggregation_temporality"] = temp
+                        row["histogram_aggregation_temporality_description"] = AGG_TEMPORALITY.get(temp)
+                        rows.append(row)
+                elif "exponentialHistogram" in metric:
+                    h = metric["exponentialHistogram"]
+                    temp = int(h.get("aggregationTemporality", 0))
+                    for dp in h.get("dataPoints", []):
+                        row = {**mbase, "metric_type": "exponential_histogram", **_point_common(dp)}
+                        row["exp_histogram_count"] = int(dp.get("count", 0))
+                        if "sum" in dp:
+                            row["exp_histogram_sum"] = float(dp["sum"])
+                        row["exp_histogram_scale"] = int(dp.get("scale", 0))
+                        row["exp_histogram_zero_count"] = int(dp.get("zeroCount", 0))
+                        for side in ("positive", "negative"):
+                            b = dp.get(side)
+                            if b:
+                                row[f"exp_histogram_{side}_offset"] = int(b.get("offset", 0))
+                                row[f"exp_histogram_{side}_bucket_counts"] = json.dumps(
+                                    [int(c) for c in b.get("bucketCounts", [])]
+                                )
+                        row["exp_histogram_aggregation_temporality"] = temp
+                        row["exp_histogram_aggregation_temporality_description"] = AGG_TEMPORALITY.get(temp)
+                        rows.append(row)
+                elif "summary" in metric:
+                    for dp in metric["summary"].get("dataPoints", []):
+                        row = {**mbase, "metric_type": "summary", **_point_common(dp)}
+                        row["summary_count"] = int(dp.get("count", 0))
+                        if "sum" in dp:
+                            row["summary_sum"] = float(dp["sum"])
+                        if dp.get("quantileValues"):
+                            row["summary_quantile_values"] = json.dumps(
+                                [
+                                    {"quantile": float(q.get("quantile", 0)), "value": float(q.get("value", 0))}
+                                    for q in dp["quantileValues"]
+                                ]
+                            )
+                        rows.append(row)
+    return rows

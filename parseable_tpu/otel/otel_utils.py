@@ -1,0 +1,68 @@
+"""Shared OTLP JSON helpers (reference: src/otel/otel_utils.rs)."""
+
+from __future__ import annotations
+
+import json
+from datetime import UTC, datetime
+from typing import Any
+
+
+def convert_anyvalue(value: dict | None) -> Any:
+    """OTLP AnyValue -> python scalar (nested kv/array -> JSON text)."""
+    if not isinstance(value, dict):
+        return value
+    if "stringValue" in value:
+        return value["stringValue"]
+    if "intValue" in value:
+        v = value["intValue"]
+        return int(v) if isinstance(v, str) else v
+    if "doubleValue" in value:
+        return float(value["doubleValue"])
+    if "boolValue" in value:
+        return bool(value["boolValue"])
+    if "bytesValue" in value:
+        return value["bytesValue"]
+    if "arrayValue" in value:
+        vals = [convert_anyvalue(v) for v in value["arrayValue"].get("values", [])]
+        return json.dumps(vals, default=str)
+    if "kvlistValue" in value:
+        return json.dumps(
+            {kv.get("key"): convert_anyvalue(kv.get("value")) for kv in value["kvlistValue"].get("values", [])},
+            default=str,
+        )
+    return None
+
+
+def flatten_attributes(attrs: list[dict] | None, prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for kv in attrs or []:
+        key = kv.get("key", "")
+        out[f"{prefix}{key}"] = convert_anyvalue(kv.get("value"))
+    return out
+
+
+def nanos_to_rfc3339(nanos: Any) -> str | None:
+    if nanos in (None, "", 0, "0"):
+        return None
+    try:
+        n = int(nanos)
+    except (TypeError, ValueError):
+        return None
+    dt = datetime.fromtimestamp(n / 1e9, UTC)
+    return dt.isoformat(timespec="microseconds").replace("+00:00", "Z")
+
+
+def scope_and_resource_fields(resource: dict | None, scope: dict | None) -> dict[str, Any]:
+    """Common per-record enrichment: resource + scope attrs and names."""
+    out: dict[str, Any] = {}
+    if resource:
+        out.update(flatten_attributes(resource.get("attributes"), prefix="resource_"))
+        if "droppedAttributesCount" in resource:
+            out["resource_dropped_attributes_count"] = resource["droppedAttributesCount"]
+    if scope:
+        if scope.get("name"):
+            out["scope_name"] = scope["name"]
+        if scope.get("version"):
+            out["scope_version"] = scope["version"]
+        out.update(flatten_attributes(scope.get("attributes"), prefix="scope_"))
+    return out

@@ -1,0 +1,111 @@
+"""OTLP traces flattener (reference: src/otel/traces.rs:174).
+
+One row per span; span events and links flatten into JSON-text columns;
+span kind and status code enriched with their enum names.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from parseable_tpu.otel.otel_utils import (
+    flatten_attributes,
+    nanos_to_rfc3339,
+    scope_and_resource_fields,
+)
+
+SPAN_KIND = {
+    0: "SPAN_KIND_UNSPECIFIED",
+    1: "SPAN_KIND_INTERNAL",
+    2: "SPAN_KIND_SERVER",
+    3: "SPAN_KIND_CLIENT",
+    4: "SPAN_KIND_PRODUCER",
+    5: "SPAN_KIND_CONSUMER",
+}
+
+STATUS_CODE = {
+    0: "STATUS_CODE_UNSET",
+    1: "STATUS_CODE_OK",
+    2: "STATUS_CODE_ERROR",
+}
+
+
+def _events_json(events: list[dict]) -> str | None:
+    if not events:
+        return None
+    out = []
+    for e in events:
+        out.append(
+            {
+                "time_unix_nano": nanos_to_rfc3339(e.get("timeUnixNano")),
+                "name": e.get("name"),
+                "attributes": flatten_attributes(e.get("attributes")),
+                "dropped_attributes_count": e.get("droppedAttributesCount", 0),
+            }
+        )
+    return json.dumps(out, default=str)
+
+
+def _links_json(links: list[dict]) -> str | None:
+    if not links:
+        return None
+    out = []
+    for l in links:
+        out.append(
+            {
+                "trace_id": l.get("traceId"),
+                "span_id": l.get("spanId"),
+                "attributes": flatten_attributes(l.get("attributes")),
+                "dropped_attributes_count": l.get("droppedAttributesCount", 0),
+            }
+        )
+    return json.dumps(out, default=str)
+
+
+def flatten_otel_traces(payload: dict) -> list[dict[str, Any]]:
+    rows: list[dict[str, Any]] = []
+    for rs in payload.get("resourceSpans", []):
+        resource = rs.get("resource")
+        for ss in rs.get("scopeSpans", []):
+            scope = ss.get("scope")
+            base = scope_and_resource_fields(resource, scope)
+            if ss.get("schemaUrl"):
+                base["schema_url"] = ss["schemaUrl"]
+            for span in ss.get("spans", []):
+                row = dict(base)
+                row["span_trace_id"] = span.get("traceId")
+                row["span_span_id"] = span.get("spanId")
+                if span.get("parentSpanId"):
+                    row["span_parent_span_id"] = span["parentSpanId"]
+                if span.get("traceState"):
+                    row["span_trace_state"] = span["traceState"]
+                row["span_name"] = span.get("name")
+                kind = span.get("kind")
+                if kind is not None:
+                    row["span_kind"] = int(kind)
+                    row["span_kind_description"] = SPAN_KIND.get(int(kind), str(kind))
+                row["span_start_time_unix_nano"] = nanos_to_rfc3339(span.get("startTimeUnixNano"))
+                row["span_end_time_unix_nano"] = nanos_to_rfc3339(span.get("endTimeUnixNano"))
+                row.update(flatten_attributes(span.get("attributes"), prefix="span_"))
+                ev = _events_json(span.get("events", []))
+                if ev is not None:
+                    row["span_events"] = ev
+                ln = _links_json(span.get("links", []))
+                if ln is not None:
+                    row["span_links"] = ln
+                if span.get("droppedAttributesCount"):
+                    row["span_dropped_attributes_count"] = span["droppedAttributesCount"]
+                if span.get("droppedEventsCount"):
+                    row["span_dropped_events_count"] = span["droppedEventsCount"]
+                if span.get("droppedLinksCount"):
+                    row["span_dropped_links_count"] = span["droppedLinksCount"]
+                status = span.get("status") or {}
+                if status:
+                    code = int(status.get("code", 0))
+                    row["span_status_code"] = code
+                    row["span_status_description"] = STATUS_CODE.get(code, str(code))
+                    if status.get("message"):
+                        row["span_status_message"] = status["message"]
+                rows.append(row)
+    return rows

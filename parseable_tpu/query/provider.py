@@ -158,11 +158,29 @@ class StreamScan:
             return None
 
     def staging_tables(self) -> Iterator[pa.Table]:
-        """This node's staging data (arrows not yet converted + local parquet
-        not yet uploaded)."""
+        """Staging-window data: this node's unconverted arrows + unuploaded
+        parquet, and — on a dedicated querier — every live ingestor's staging
+        window fetched over the cluster data plane (reference:
+        airplane.rs:155-184 recent-data fan-in)."""
+        from parseable_tpu.config import Mode
+
         stream = self.p.streams.get(self.plan.stream)
         if stream is None:
             return
+        if self.p.options.mode == Mode.QUERY:
+            from parseable_tpu.server.cluster import fetch_staging_batches
+
+            remote = fetch_staging_batches(self.p, self.plan.stream)
+            if remote:
+                from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+                self.stats.staging_batches += len(remote)
+                schema = merge_schemas([b.schema for b in remote])
+                table = pa.Table.from_batches([adapt_batch(schema, b) for b in remote])
+                cols = self._columns_for_read(table.column_names)
+                if cols is not None:
+                    table = table.select(cols)
+                yield table
         batches = stream.staging_batches()
         if batches:
             self.stats.staging_batches += len(batches)

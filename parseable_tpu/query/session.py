@@ -63,10 +63,16 @@ class QuerySession:
         sql_text: str,
         start_time: str | None = None,
         end_time: str | None = None,
+        allowed_streams: set[str] | None = None,
     ) -> QueryResult:
+        """Run SQL. `allowed_streams` (None = unrestricted) is the caller's
+        RBAC scope, enforced on the *resolved* plan before any execution so
+        unauthorized streams neither run nor leak through error messages."""
         t0 = _time.monotonic()
         select = S.parse_sql(sql_text)
         lp = build_plan(select)
+        if allowed_streams is not None and lp.stream not in allowed_streams:
+            raise QueryError(f"unauthorized for stream {lp.stream!r}")
         self.resolve_stream(lp.stream)
         stream = self.p.streams.get(lp.stream)
         if stream is not None and stream.metadata.schema:

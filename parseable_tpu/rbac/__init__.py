@@ -1,1 +1,321 @@
+"""RBAC: users, roles, sessions, authorization.
 
+Parity target (reference: src/rbac/): the `Action` enum (~60 actions,
+role.rs:22-79), privilege role builders (admin/editor/writer/reader/
+ingestor, role.rs:92-190), in-memory user/role/session maps (map.rs:44-357)
+and `Users.authorize` (mod.rs:242-292). Passwords hash with scrypt (the
+reference uses argon2; both are memory-hard KDFs — argon2 isn't available
+in this environment's stdlib).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum, auto
+
+
+class Action(Enum):
+    # ingest / streams
+    INGEST = auto()
+    QUERY = auto()
+    CREATE_STREAM = auto()
+    DELETE_STREAM = auto()
+    LIST_STREAM = auto()
+    GET_SCHEMA = auto()
+    GET_STATS = auto()
+    GET_STREAM_INFO = auto()
+    PUT_RETENTION = auto()
+    GET_RETENTION = auto()
+    PUT_HOT_TIER = auto()
+    GET_HOT_TIER = auto()
+    DELETE_HOT_TIER = auto()
+    # users / roles
+    PUT_USER = auto()
+    LIST_USER = auto()
+    DELETE_USER = auto()
+    PUT_USER_ROLES = auto()
+    GET_USER_ROLES = auto()
+    PUT_ROLE = auto()
+    GET_ROLE = auto()
+    DELETE_ROLE = auto()
+    LIST_ROLE = auto()
+    # alerts / targets
+    PUT_ALERT = auto()
+    GET_ALERT = auto()
+    DELETE_ALERT = auto()
+    LIST_ALERT = auto()
+    PUT_TARGET = auto()
+    GET_TARGET = auto()
+    DELETE_TARGET = auto()
+    LIST_TARGET = auto()
+    # dashboards / filters / correlations
+    CREATE_DASHBOARD = auto()
+    GET_DASHBOARD = auto()
+    DELETE_DASHBOARD = auto()
+    LIST_DASHBOARD = auto()
+    CREATE_FILTER = auto()
+    GET_FILTER = auto()
+    DELETE_FILTER = auto()
+    LIST_FILTER = auto()
+    CREATE_CORRELATION = auto()
+    GET_CORRELATION = auto()
+    DELETE_CORRELATION = auto()
+    LIST_CORRELATION = auto()
+    # system
+    GET_ABOUT = auto()
+    METRICS = auto()
+    GET_ANALYTICS = auto()
+    LIST_CLUSTER = auto()
+    LIST_CLUSTER_METRICS = auto()
+    DELETE_NODE = auto()
+    GET_LIVENESS = auto()
+    LIVE_TAIL = auto()
+    QUERY_LLM = auto()
+    MANAGE_API_KEYS = auto()
+    ALL = auto()
+
+
+@dataclass
+class Permission:
+    action: Action
+    resource: str | None = None  # None = unit/global, "*" = all streams
+
+    def allows(self, action: Action, resource: str | None) -> bool:
+        if self.action not in (action, Action.ALL):
+            return False
+        if self.resource in (None, "*") or resource is None:
+            return True
+        return self.resource == resource
+
+
+_EDITOR_ACTIONS = [
+    Action.INGEST, Action.QUERY, Action.CREATE_STREAM, Action.DELETE_STREAM,
+    Action.LIST_STREAM, Action.GET_SCHEMA, Action.GET_STATS,
+    Action.GET_STREAM_INFO, Action.PUT_RETENTION, Action.GET_RETENTION,
+    Action.PUT_HOT_TIER, Action.GET_HOT_TIER, Action.DELETE_HOT_TIER,
+    Action.PUT_ALERT, Action.GET_ALERT, Action.DELETE_ALERT, Action.LIST_ALERT,
+    Action.PUT_TARGET, Action.GET_TARGET, Action.DELETE_TARGET, Action.LIST_TARGET,
+    Action.CREATE_DASHBOARD, Action.GET_DASHBOARD, Action.DELETE_DASHBOARD,
+    Action.LIST_DASHBOARD, Action.CREATE_FILTER, Action.GET_FILTER,
+    Action.DELETE_FILTER, Action.LIST_FILTER, Action.CREATE_CORRELATION,
+    Action.GET_CORRELATION, Action.DELETE_CORRELATION, Action.LIST_CORRELATION,
+    Action.GET_ABOUT, Action.LIVE_TAIL, Action.QUERY_LLM,
+]
+
+_WRITER_ACTIONS = [
+    Action.INGEST, Action.QUERY, Action.LIST_STREAM, Action.GET_SCHEMA,
+    Action.GET_STATS, Action.GET_STREAM_INFO, Action.GET_RETENTION,
+    Action.GET_ALERT, Action.LIST_ALERT, Action.GET_ABOUT, Action.LIVE_TAIL,
+]
+
+_READER_ACTIONS = [
+    Action.QUERY, Action.LIST_STREAM, Action.GET_SCHEMA, Action.GET_STATS,
+    Action.GET_STREAM_INFO, Action.GET_RETENTION, Action.GET_ALERT,
+    Action.LIST_ALERT, Action.GET_ABOUT, Action.LIVE_TAIL,
+]
+
+
+def role_privileges(privilege: str, resource: str | None = None) -> list[Permission]:
+    """Build a role's permission list (reference: RoleBuilder role.rs:92-190)."""
+    if privilege == "admin":
+        return [Permission(Action.ALL, "*")]
+    if privilege == "editor":
+        return [Permission(a, "*") for a in _EDITOR_ACTIONS]
+    if privilege == "writer":
+        return [Permission(a, resource or "*") for a in _WRITER_ACTIONS]
+    if privilege == "reader":
+        return [Permission(a, resource or "*") for a in _READER_ACTIONS]
+    if privilege == "ingestor":
+        return [Permission(Action.INGEST, resource or "*")]
+    raise ValueError(f"unknown privilege {privilege!r}")
+
+
+def hash_password(password: str, salt: bytes | None = None) -> str:
+    salt = salt or os.urandom(16)
+    digest = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
+    return base64.b64encode(salt).decode() + "$" + base64.b64encode(digest).decode()
+
+
+def verify_password(password: str, stored: str) -> bool:
+    try:
+        salt_b64, digest_b64 = stored.split("$", 1)
+        salt = base64.b64decode(salt_b64)
+        expected = base64.b64decode(digest_b64)
+    except ValueError:
+        return False
+    digest = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
+    return hmac.compare_digest(digest, expected)
+
+
+@dataclass
+class User:
+    username: str
+    password_hash: str | None = None  # None for oauth users
+    roles: set[str] = field(default_factory=set)
+    user_type: str = "native"  # native | oauth
+
+
+SESSION_EXPIRY_SECS = 7 * 24 * 3600
+
+
+@dataclass
+class Session:
+    key: str
+    username: str
+    expires_at: float
+
+
+class RbacStore:
+    """In-memory users/roles/sessions with metastore persistence hooks
+    (reference: global USERS/ROLES/SESSIONS maps, map.rs)."""
+
+    def __init__(self) -> None:
+        self.users: dict[str, User] = {}
+        self.roles: dict[str, list[Permission]] = {}
+        self.sessions: dict[str, Session] = {}
+        # verified-credential cache: scrypt costs ~tens of ms by design, far
+        # too much per request on the ingest hot path; cache a fast hash of
+        # (user, password) after the first successful KDF verification
+        self._cred_cache: dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    # ----- roles ------------------------------------------------------------
+    def put_role(self, name: str, perms: list[Permission]) -> None:
+        with self._lock:
+            self.roles[name] = perms
+
+    def delete_role(self, name: str) -> None:
+        with self._lock:
+            in_use = [u.username for u in self.users.values() if name in u.roles]
+            if in_use:
+                raise ValueError(f"role {name!r} in use by {in_use}")
+            self.roles.pop(name, None)
+
+    # ----- users ------------------------------------------------------------
+    def put_user(self, username: str, password: str | None = None, roles: set[str] | None = None) -> str:
+        """Create/replace a user; returns the generated password if none given."""
+        with self._lock:
+            pw = password or secrets.token_urlsafe(16)
+            self.users[username] = User(
+                username=username, password_hash=hash_password(pw), roles=roles or set()
+            )
+            self._cred_cache.pop(username, None)
+            return pw
+
+    def delete_user(self, username: str) -> None:
+        with self._lock:
+            self.users.pop(username, None)
+            self._cred_cache.pop(username, None)
+            self.sessions = {k: s for k, s in self.sessions.items() if s.username != username}
+
+    # ----- sessions ---------------------------------------------------------
+    def new_session(self, username: str) -> str:
+        key = secrets.token_urlsafe(32)
+        with self._lock:
+            self.sessions[key] = Session(key, username, time.time() + SESSION_EXPIRY_SECS)
+        return key
+
+    def session_user(self, key: str) -> str | None:
+        with self._lock:
+            s = self.sessions.get(key)
+            if s is None:
+                return None
+            if s.expires_at < time.time():
+                del self.sessions[key]
+                return None
+            return s.username
+
+    # ----- auth -------------------------------------------------------------
+    def authenticate(self, username: str, password: str) -> User | None:
+        with self._lock:
+            u = self.users.get(username)
+            cached = self._cred_cache.get(username)
+        if u is None or u.password_hash is None:
+            return None
+        fast = hashlib.sha256(f"{username}\x00{password}".encode()).digest()
+        if cached is not None:
+            return u if hmac.compare_digest(cached, fast) else None
+        if not verify_password(password, u.password_hash):
+            return None
+        with self._lock:
+            self._cred_cache[username] = fast
+        return u
+
+    def authorize(self, username: str, action: Action, resource: str | None = None) -> bool:
+        """(reference: Users.authorize mod.rs:242-292)"""
+        with self._lock:
+            u = self.users.get(username)
+            if u is None:
+                return False
+            for role_name in u.roles:
+                for perm in self.roles.get(role_name, []):
+                    if perm.allows(action, resource):
+                        return True
+        return False
+
+    def user_allowed_streams(self, username: str) -> set[str] | None:
+        """Streams the user may query; None means all
+        (reference: utils/mod.rs:158-230 user_auth_for_datasets)."""
+        with self._lock:
+            u = self.users.get(username)
+            if u is None:
+                return set()
+            allowed: set[str] = set()
+            for role_name in u.roles:
+                for perm in self.roles.get(role_name, []):
+                    if perm.action in (Action.QUERY, Action.ALL):
+                        if perm.resource in (None, "*"):
+                            return None
+                        allowed.add(perm.resource)
+        return allowed
+
+    # ----- persistence ------------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "users": [
+                {
+                    "username": u.username,
+                    "password_hash": u.password_hash,
+                    "roles": sorted(u.roles),
+                    "user_type": u.user_type,
+                }
+                for u in self.users.values()
+            ],
+            "roles": {
+                name: [
+                    {"action": p.action.name, "resource": p.resource} for p in perms
+                ]
+                for name, perms in self.roles.items()
+            },
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "RbacStore":
+        store = cls()
+        for name, perms in obj.get("roles", {}).items():
+            store.roles[name] = [
+                Permission(Action[p["action"]], p.get("resource")) for p in perms
+            ]
+        for u in obj.get("users", []):
+            store.users[u["username"]] = User(
+                username=u["username"],
+                password_hash=u.get("password_hash"),
+                roles=set(u.get("roles", [])),
+                user_type=u.get("user_type", "native"),
+            )
+        return store
+
+
+def bootstrap_admin(store: RbacStore, username: str, password: str) -> None:
+    """Root user from P_USERNAME/P_PASSWORD (reference: rbac/map.rs:105)."""
+    store.put_role("admin", role_privileges("admin"))
+    store.users[username] = User(
+        username=username, password_hash=hash_password(password), roles={"admin"}
+    )

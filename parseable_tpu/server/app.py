@@ -1,0 +1,889 @@
+"""L7 — HTTP API servers (modal: all / ingest / query).
+
+Parity target (reference: src/handlers/http/modal/{mod,server,ingest_server,
+query_server}.rs route tables + middleware.rs auth). One aiohttp application
+whose route set depends on the mode, with:
+
+- basic-auth + session-cookie auth, RBAC per route (middleware.rs:106-558)
+- `/api/v1/*` management plane compatible with the reference's paths
+- OTLP ingest at /v1/{logs,metrics,traces}
+- SSE livetail (the reference's Flight livetail, over HTTP here)
+- an intra-cluster data-plane endpoint serving staging batches as Arrow IPC
+  (the reference's querier->ingestor Flight do_get; SURVEY §5 maps DCN data
+  plane to HTTP+Arrow in this build)
+- background sync loops (arrows->parquet 60s, parquet->object store 30s,
+  retention daily; reference src/sync.rs) and graceful drain on shutdown.
+
+CPU-bound work (JSON parse/flatten/encode) runs on a worker thread pool —
+the analogue of the reference's rayon ingest pool (ingest.rs:60).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import UTC, datetime
+
+from aiohttp import web
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY, __version__
+from parseable_tpu.config import Mode, Options, StorageOptions, parse_cli
+from parseable_tpu.core import Parseable, StreamError, StreamNotFound, validate_stream_name
+from parseable_tpu.event.format import LogSource
+from parseable_tpu.event.json_format import EventError
+from parseable_tpu.livetail import LIVETAIL
+from parseable_tpu.query.session import QueryError, QuerySession
+from parseable_tpu.query.sql import SqlError
+from parseable_tpu.rbac import Action, RbacStore, bootstrap_admin, role_privileges
+from parseable_tpu.server.ingest_utils import IngestError, flatten_and_push_logs
+from parseable_tpu.storage import rfc3339_now
+from parseable_tpu.utils import metrics as prom
+from parseable_tpu.utils.timeutil import TimeParseError
+
+logger = logging.getLogger(__name__)
+
+STREAM_HEADER = "X-P-Stream"
+LOG_SOURCE_HEADER = "X-P-Log-Source"
+CUSTOM_FIELD_PREFIX = "x-p-meta-"
+UPDATE_STREAM_HEADER = "X-P-Update-Stream"
+TIME_PARTITION_HEADER = "X-P-Time-Partition"
+CUSTOM_PARTITION_HEADER = "X-P-Custom-Partition"
+STATIC_SCHEMA_HEADER = "X-P-Static-Schema-Flag"
+TELEMETRY_TYPE_HEADER = "X-P-Telemetry-Type"
+
+
+class ServerState:
+    """Wires Parseable + RBAC + sessions + workers for one server process."""
+
+    def __init__(self, p: Parseable):
+        self.p = p
+        self.rbac = self._load_rbac()
+        self.workers = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ingest")
+        self.started_at = time.time()
+        self.shutting_down = False
+        self._sync_stop = threading.Event()
+        self._sync_threads: list[threading.Thread] = []
+
+    # ----- rbac persistence -------------------------------------------------
+    def _load_rbac(self) -> RbacStore:
+        doc = self.p.metastore.get_document("users", "rbac") if self._meta_ok() else None
+        store = RbacStore.from_json(doc) if doc else RbacStore()
+        bootstrap_admin(store, self.p.options.username, self.p.options.password)
+        return store
+
+    def _meta_ok(self) -> bool:
+        try:
+            self.p.metastore.get_parseable_metadata()
+            return True
+        except Exception:
+            return False
+
+    def save_rbac(self) -> None:
+        self.p.metastore.put_document("users", "rbac", self.rbac.to_json())
+
+    # ----- background sync (reference: src/sync.rs) -------------------------
+    def start_sync_loops(self) -> None:
+        def loop(interval: int, fn, name: str):
+            def run():
+                while not self._sync_stop.wait(interval):
+                    try:
+                        fn()
+                    except Exception:
+                        logger.exception("%s tick failed", name)
+
+            t = threading.Thread(target=run, name=name, daemon=True)
+            t.start()
+            self._sync_threads.append(t)
+
+        if self.p.options.mode in (Mode.ALL, Mode.INGEST):
+            loop(self.p.options.local_sync_interval_secs, self.p.local_sync, "local-sync")
+            loop(self.p.options.upload_interval_secs, self.p.sync_all_streams, "object-sync")
+            from parseable_tpu.storage.retention import retention_tick
+
+            loop(3600, lambda: retention_tick(self.p), "retention")
+        if self.p.options.mode in (Mode.ALL, Mode.QUERY):
+            from parseable_tpu.alerts import alert_tick
+
+            loop(60, lambda: alert_tick(self), "alerts")
+
+    def stop(self) -> None:
+        self.shutting_down = True
+        self._sync_stop.set()
+        self.p.shutdown()
+        self.workers.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------- middleware
+
+
+def _unauthorized(reason: str = "Unauthorized") -> web.Response:
+    return web.json_response({"error": reason}, status=401)
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    state: ServerState = request.app["state"]
+    if request.path in ("/api/v1/liveness", "/api/v1/readiness") or request.method == "OPTIONS":
+        return await handler(request)
+    username = None
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Basic "):
+        import base64
+
+        try:
+            user, _, pw = base64.b64decode(auth[6:]).decode().partition(":")
+        except Exception:
+            return _unauthorized("invalid basic auth")
+        if state.rbac.authenticate(user, pw) is None:
+            return _unauthorized()
+        username = user
+    elif auth.startswith("Bearer "):
+        username = state.rbac.session_user(auth[7:])
+        if username is None:
+            return _unauthorized("invalid or expired token")
+    elif "session" in request.cookies:
+        username = state.rbac.session_user(request.cookies["session"])
+        if username is None:
+            return _unauthorized("invalid or expired session")
+    else:
+        return _unauthorized("missing credentials")
+    request["username"] = username
+    return await handler(request)
+
+
+def require(action: Action, resource_param: str | None = None):
+    """RBAC guard decorator (reference: RouteExt::authorize*)."""
+
+    def deco(fn):
+        async def wrapped(request: web.Request):
+            state: ServerState = request.app["state"]
+            resource = (
+                request.match_info.get(resource_param)
+                if resource_param
+                else request.headers.get(STREAM_HEADER)
+            )
+            if not state.rbac.authorize(request["username"], action, resource):
+                return web.json_response({"error": "Forbidden"}, status=403)
+            return await fn(request)
+
+        return wrapped
+
+    return deco
+
+
+# ------------------------------------------------------------------ handlers
+
+
+async def liveness(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    if state.shutting_down:
+        return web.Response(status=503)
+    return web.Response(status=200)
+
+
+async def readiness(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        state.p.storage.list_dirs("")
+        return web.Response(status=200)
+    except Exception:
+        return web.Response(status=503)
+
+
+async def about(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.json_response(
+        {
+            "version": __version__,
+            "uiVersion": "none",
+            "commit": "",
+            "deploymentId": state.p.node_id,
+            "mode": state.p.options.mode.to_str(),
+            "staging": str(state.p.options.local_staging_path),
+            "store": {"type": state.p.storage.name, "path": state.p.provider.get_endpoint()},
+            "queryEngine": state.p.options.query_engine,
+            "license": "AGPL-3.0",
+        }
+    )
+
+
+async def metrics_handler(request: web.Request) -> web.Response:
+    return web.Response(body=prom.render(), content_type="text/plain")
+
+
+async def login(request: web.Request) -> web.Response:
+    """GET /api/v1/login: exchange basic auth (already verified by the
+    middleware) for a session token — avoids per-request KDF costs
+    (reference: session cookie flow, http/oidc.rs for the OAuth variant)."""
+    state: ServerState = request.app["state"]
+    token = state.rbac.new_session(request["username"])
+    resp = web.json_response({"token": token})
+    resp.set_cookie("session", token, httponly=True, max_age=7 * 24 * 3600)
+    return resp
+
+
+def _log_source_of(request: web.Request) -> LogSource:
+    return LogSource.from_str(request.headers.get(LOG_SOURCE_HEADER, "json"))
+
+
+def _custom_fields(request: web.Request) -> dict[str, str]:
+    return {
+        k[len(CUSTOM_FIELD_PREFIX) :]: v
+        for k, v in request.headers.items()
+        if k.lower().startswith(CUSTOM_FIELD_PREFIX)
+    }
+
+
+@require(Action.INGEST)
+async def ingest(request: web.Request) -> web.Response:
+    """POST /api/v1/ingest (reference: ingest.rs:69)."""
+    state: ServerState = request.app["state"]
+    stream_name = request.headers.get(STREAM_HEADER)
+    if not stream_name:
+        return web.json_response({"error": f"missing {STREAM_HEADER} header"}, status=400)
+    log_source = _log_source_of(request)
+    if log_source in (LogSource.OTEL_LOGS, LogSource.OTEL_METRICS, LogSource.OTEL_TRACES):
+        return web.json_response(
+            {"error": "use /v1/logs, /v1/metrics or /v1/traces for OTel data"}, status=400
+        )
+    return await _do_ingest(request, stream_name, log_source)
+
+
+async def post_event(request: web.Request) -> web.Response:
+    """POST /api/v1/logstream/{name} (reference: ingest.rs:393)."""
+    state: ServerState = request.app["state"]
+    stream_name = request.match_info["name"]
+    if not state.rbac.authorize(request["username"], Action.INGEST, stream_name):
+        return web.json_response({"error": "Forbidden"}, status=403)
+    return await _do_ingest(request, stream_name, _log_source_of(request))
+
+
+async def otel_ingest(request: web.Request) -> web.Response:
+    """POST /v1/{logs,metrics,traces} (reference: ingest.rs:308-392)."""
+    state: ServerState = request.app["state"]
+    kind = request.match_info["kind"]
+    source = {
+        "logs": LogSource.OTEL_LOGS,
+        "metrics": LogSource.OTEL_METRICS,
+        "traces": LogSource.OTEL_TRACES,
+    }.get(kind)
+    if source is None:
+        return web.json_response({"error": f"unknown OTel signal {kind}"}, status=404)
+    stream_name = request.headers.get(STREAM_HEADER) or f"otel-{kind}"
+    if not state.rbac.authorize(request["username"], Action.INGEST, stream_name):
+        return web.json_response({"error": "Forbidden"}, status=403)
+    return await _do_ingest(request, stream_name, source, telemetry_type=kind)
+
+
+async def _do_ingest(
+    request: web.Request, stream_name: str, log_source: LogSource, telemetry_type: str = "logs"
+) -> web.Response:
+    state: ServerState = request.app["state"]
+    body = await request.read()
+    if len(body) > state.p.options.max_event_payload_bytes:
+        return web.json_response({"error": "payload too large"}, status=413)
+    try:
+        payload = json.loads(body)
+    except json.JSONDecodeError as e:
+        return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+    custom_fields = _custom_fields(request)
+
+    def work() -> int:
+        state.p.create_stream_if_not_exists(
+            stream_name, log_source=log_source, telemetry_type=telemetry_type
+        )
+        return flatten_and_push_logs(
+            state.p, stream_name, payload, log_source, custom_fields, origin_size=len(body)
+        )
+
+    try:
+        count = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except (IngestError, StreamError, EventError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response({"message": f"ingested {count} records"}, status=200)
+
+
+@require(Action.QUERY)
+async def query(request: web.Request) -> web.Response:
+    """POST /api/v1/query (reference: handlers/http/query.rs:157)."""
+    state: ServerState = request.app["state"]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    sql = body.get("query")
+    if not sql:
+        return web.json_response({"error": "missing 'query'"}, status=400)
+    start, end = body.get("startTime"), body.get("endTime")
+    send_fields = bool(body.get("fields", False))
+    # RBAC scope resolves against the parsed plan, pre-execution
+    allowed = state.rbac.user_allowed_streams(request["username"])
+
+    def work():
+        sess = QuerySession(state.p)
+        return sess.query(sql, start, end, allowed_streams=allowed)
+
+    try:
+        result = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except QueryError as e:
+        if "unauthorized" in str(e):
+            return web.json_response({"error": "Forbidden"}, status=403)
+        return web.json_response({"error": str(e)}, status=400)
+    except (SqlError, TimeParseError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except Exception as e:
+        logger.exception("query failed")
+        return web.json_response({"error": str(e)}, status=500)
+
+    rows = result.to_json_rows()
+    if send_fields:
+        return web.json_response({"fields": result.fields, "records": rows, "stats": result.stats})
+    return web.json_response(rows)
+
+
+@require(Action.QUERY)
+async def counts(request: web.Request) -> web.Response:
+    """POST /api/v1/counts — time-histogram fast path
+    (reference: query/mod.rs:483-744 CountsRequest::get_bin_density)."""
+    state: ServerState = request.app["state"]
+    body = await request.json()
+    stream = body.get("stream")
+    start, end = body.get("startTime", "1h"), body.get("endTime", "now")
+    num_bins = int(body.get("numBins", 10))
+    if not stream:
+        return web.json_response({"error": "missing 'stream'"}, status=400)
+
+    allowed = state.rbac.user_allowed_streams(request["username"])
+
+    def work():
+        from parseable_tpu.utils.timeutil import TimeRange, expected_time_bins
+
+        tr = TimeRange.parse_human_time(start, end)
+        bins = expected_time_bins(tr.start, tr.end, num_bins)
+        sess = QuerySession(state.p)
+        step_s = int((bins[0][1] - bins[0][0]).total_seconds()) if bins else 60
+        # bins must align to the query start, not the epoch: pass the origin
+        origin = bins[0][0].isoformat().replace("+00:00", "Z") if bins else None
+        bin_expr = (
+            f"date_bin(interval '{step_s}s', {DEFAULT_TIMESTAMP_KEY}, '{origin}')"
+            if origin
+            else f"date_bin(interval '{step_s}s', {DEFAULT_TIMESTAMP_KEY})"
+        )
+        res = sess.query(
+            f"SELECT {bin_expr} AS start_time, "
+            f"count(*) AS count FROM {stream} GROUP BY start_time ORDER BY start_time",
+            start,
+            end,
+            allowed_streams=allowed,
+        )
+        counts_by_start = {r["start_time"]: r["count"] for r in res.to_json_rows()}
+        out = []
+        for lo, hi in bins:
+            key = lo.replace(tzinfo=None).isoformat(timespec="milliseconds")
+            out.append(
+                {
+                    "startTime": lo.isoformat().replace("+00:00", "Z"),
+                    "endTime": hi.isoformat().replace("+00:00", "Z"),
+                    "count": counts_by_start.get(key, 0),
+                }
+            )
+        return out
+
+    try:
+        records = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except (SqlError, QueryError, TimeParseError, StreamNotFound) as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response({"fields": ["startTime", "endTime", "count"], "records": records})
+
+
+# ----- logstream management (reference: handlers/http/logstream.rs) --------
+
+
+@require(Action.LIST_STREAM)
+async def list_streams(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    state.p.load_streams_from_storage()
+    allowed = state.rbac.user_allowed_streams(request["username"])
+    names = state.p.streams.list_names()
+    if allowed is not None:
+        names = [n for n in names if n in allowed]
+    return web.json_response([{"name": n} for n in names])
+
+
+@require(Action.CREATE_STREAM, "name")
+async def put_stream(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    update = request.headers.get(UPDATE_STREAM_HEADER, "").lower() == "true"
+    time_partition = request.headers.get(TIME_PARTITION_HEADER)
+    custom_partition = request.headers.get(CUSTOM_PARTITION_HEADER)
+    static_schema_flag = request.headers.get(STATIC_SCHEMA_HEADER, "").lower() == "true"
+    telemetry_type = request.headers.get(TELEMETRY_TYPE_HEADER, "logs")
+    static_schema = None
+    body = await request.read()
+    if static_schema_flag and body:
+        from parseable_tpu.static_schema import convert_static_schema
+
+        try:
+            static_schema = convert_static_schema(json.loads(body), time_partition)
+        except (ValueError, json.JSONDecodeError) as e:
+            return web.json_response({"error": f"invalid static schema: {e}"}, status=400)
+    try:
+        validate_stream_name(name)
+        exists = state.p.streams.contains(name)
+        if exists and not update:
+            return web.json_response({"error": f"stream {name} already exists"}, status=400)
+        if exists and update:
+            # apply header-driven changes to the existing stream
+            # (reference: logstream_utils.rs update path)
+            stream = state.p.get_stream(name)
+            if custom_partition is not None:
+                stream.metadata.custom_partition = custom_partition or None
+            if time_partition is not None:
+                return web.json_response(
+                    {"error": "time partition cannot be changed after creation"}, status=400
+                )
+            fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
+            fmt.custom_partition = stream.metadata.custom_partition
+            state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+            return web.json_response({"message": f"updated stream {name}"})
+        state.p.create_stream_if_not_exists(
+            name,
+            time_partition=time_partition,
+            custom_partition=custom_partition,
+            static_schema=static_schema,
+            telemetry_type=telemetry_type,
+        )
+    except StreamError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response({"message": f"created stream {name}"})
+
+
+@require(Action.DELETE_STREAM, "name")
+async def delete_stream(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    if not state.p.streams.contains(name):
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    state.p.streams.delete(name)
+    state.p.metastore.delete_stream(name)
+    return web.json_response({"message": f"deleted stream {name}"})
+
+
+@require(Action.GET_SCHEMA, "name")
+async def get_schema(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    try:
+        stream = state.p.get_stream(name)
+    except StreamNotFound:
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    fields = [
+        {"name": f.name, "data_type": str(f.type), "nullable": f.nullable}
+        for f in stream.metadata.schema.values()
+    ]
+    return web.json_response({"fields": fields})
+
+
+@require(Action.GET_STREAM_INFO, "name")
+async def stream_info(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    try:
+        stream = state.p.get_stream(name)
+    except StreamNotFound:
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    m = stream.metadata
+    return web.json_response(
+        {
+            "created-at": m.created_at,
+            "first-event-at": m.first_event_at,
+            "time_partition": m.time_partition,
+            "custom_partition": m.custom_partition,
+            "static_schema_flag": m.static_schema_flag,
+            "stream_type": m.stream_type,
+            "log_source": [s.value for s in m.log_source],
+            "telemetry_type": m.telemetry_type,
+        }
+    )
+
+
+@require(Action.GET_STATS, "name")
+async def stream_stats(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    try:
+        fmts = state.p.metastore.get_all_stream_jsons(name)
+    except Exception:
+        fmts = []
+    if not fmts and not state.p.streams.contains(name):
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    events = sum(f.stats.events for f in fmts)
+    ingestion = sum(f.stats.ingestion for f in fmts)
+    storage = sum(f.stats.storage for f in fmts)
+    return web.json_response(
+        {
+            "stream": name,
+            "time": rfc3339_now(),
+            "ingestion": {"count": events, "size": f"{ingestion} Bytes", "format": "json"},
+            "storage": {"size": f"{storage} Bytes", "format": "parquet"},
+        }
+    )
+
+
+@require(Action.PUT_RETENTION, "name")
+async def put_retention(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    body = await request.json()
+    from parseable_tpu.storage.retention import validate_retention_config
+
+    try:
+        validate_retention_config(body)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    try:
+        stream = state.p.get_stream(name)
+    except StreamNotFound:
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    stream.metadata.retention = body
+    try:
+        fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
+        fmt.retention = body
+        state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+    except Exception:
+        logger.exception("failed persisting retention")
+    return web.json_response({"message": "updated retention"})
+
+
+@require(Action.GET_RETENTION, "name")
+async def get_retention(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        stream = state.p.get_stream(request.match_info["name"])
+    except StreamNotFound:
+        return web.json_response({"error": "stream not found"}, status=404)
+    return web.json_response(stream.metadata.retention or [])
+
+
+# ----- livetail (SSE) -------------------------------------------------------
+
+
+@require(Action.LIVE_TAIL, "name")
+async def livetail_sse(request: web.Request) -> web.StreamResponse:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    pipe = LIVETAIL.subscribe(name)
+    resp = web.StreamResponse(
+        headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+    )
+    await resp.prepare(request)
+    from parseable_tpu.utils.arrowutil import record_batches_to_json
+
+    try:
+        while not state.shutting_down:
+            try:
+                batch = await asyncio.get_running_loop().run_in_executor(
+                    None, pipe.q.get, True, 5.0
+                )
+            except Exception:
+                await resp.write(b": keepalive\n\n")
+                continue
+            for row in record_batches_to_json([batch]):
+                await resp.write(b"data: " + json.dumps(row, default=str).encode() + b"\n\n")
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        LIVETAIL.unsubscribe(pipe)
+    return resp
+
+
+# ----- users & roles --------------------------------------------------------
+
+
+@require(Action.PUT_USER)
+async def put_user(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    username = request.match_info["username"]
+    if username == state.p.options.username:
+        return web.json_response({"error": "cannot modify root user"}, status=400)
+    if username in state.rbac.users:
+        return web.json_response({"error": f"user {username} already exists"}, status=400)
+    body = {}
+    raw = await request.read()
+    if raw:
+        body = json.loads(raw)
+    roles = set(body.get("roles", []))
+    password = state.rbac.put_user(username, roles=roles)
+    state.save_rbac()
+    return web.json_response(password)
+
+
+@require(Action.LIST_USER)
+async def list_users(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.json_response(
+        [
+            {"id": u.username, "method": u.user_type, "roles": sorted(u.roles)}
+            for u in state.rbac.users.values()
+        ]
+    )
+
+
+@require(Action.DELETE_USER)
+async def delete_user(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    username = request.match_info["username"]
+    if username == state.p.options.username:
+        return web.json_response({"error": "cannot delete root user"}, status=400)
+    state.rbac.delete_user(username)
+    state.save_rbac()
+    return web.json_response({"message": f"deleted user {username}"})
+
+
+@require(Action.PUT_USER_ROLES)
+async def put_user_roles(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    username = request.match_info["username"]
+    roles = set(await request.json())
+    u = state.rbac.users.get(username)
+    if u is None:
+        return web.json_response({"error": "user not found"}, status=404)
+    missing = [r for r in roles if r not in state.rbac.roles]
+    if missing:
+        return web.json_response({"error": f"unknown roles {missing}"}, status=400)
+    u.roles = roles
+    state.save_rbac()
+    return web.json_response({"message": "updated roles"})
+
+
+@require(Action.PUT_ROLE)
+async def put_role(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    body = await request.json()
+    perms = []
+    try:
+        for item in body:
+            privilege = item.get("privilege")
+            resource = (item.get("resource") or {}).get("stream") if isinstance(item.get("resource"), dict) else item.get("resource")
+            perms.extend(role_privileges(privilege, resource))
+    except (ValueError, AttributeError, TypeError) as e:
+        return web.json_response({"error": f"invalid role body: {e}"}, status=400)
+    state.rbac.put_role(name, perms)
+    state.save_rbac()
+    return web.json_response({"message": f"updated role {name}"})
+
+
+@require(Action.LIST_ROLE)
+async def list_roles(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.json_response(sorted(state.rbac.roles))
+
+
+@require(Action.DELETE_ROLE)
+async def delete_role(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    try:
+        state.rbac.delete_role(request.match_info["name"])
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    state.save_rbac()
+    return web.json_response({"message": "deleted role"})
+
+
+# ----- generic metastore-backed CRUD (alerts/targets/dashboards/filters) ----
+
+
+def crud_routes(collection: str, put_action: Action, get_action: Action, delete_action: Action):
+    async def put_doc(request: web.Request):
+        state: ServerState = request.app["state"]
+        if not state.rbac.authorize(request["username"], put_action):
+            return web.json_response({"error": "Forbidden"}, status=403)
+        body = await request.json()
+        doc_id = request.match_info.get("id") or body.get("id") or uuid.uuid4().hex
+        body["id"] = doc_id
+        body.setdefault("created", rfc3339_now())
+        body["modified"] = rfc3339_now()
+        if collection == "alerts":
+            from parseable_tpu.alerts import validate_alert
+
+            try:
+                validate_alert(body)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+        state.p.metastore.put_document(collection, doc_id, body)
+        return web.json_response(body)
+
+    async def get_doc(request: web.Request):
+        state: ServerState = request.app["state"]
+        if not state.rbac.authorize(request["username"], get_action):
+            return web.json_response({"error": "Forbidden"}, status=403)
+        doc = state.p.metastore.get_document(collection, request.match_info["id"])
+        if doc is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(doc)
+
+    async def list_docs(request: web.Request):
+        state: ServerState = request.app["state"]
+        if not state.rbac.authorize(request["username"], get_action):
+            return web.json_response({"error": "Forbidden"}, status=403)
+        return web.json_response(state.p.metastore.list_documents(collection))
+
+    async def delete_doc(request: web.Request):
+        state: ServerState = request.app["state"]
+        if not state.rbac.authorize(request["username"], delete_action):
+            return web.json_response({"error": "Forbidden"}, status=403)
+        state.p.metastore.delete_document(collection, request.match_info["id"])
+        return web.json_response({"message": "deleted"})
+
+    return put_doc, get_doc, list_docs, delete_doc
+
+
+# ----- intra-cluster data plane --------------------------------------------
+
+
+@require(Action.QUERY, "name")
+async def internal_staging(request: web.Request) -> web.Response:
+    """GET /api/v1/internal/staging/{name}: this node's staging-window rows
+    as Arrow IPC — the reference's querier->ingestor Flight do_get
+    (airplane.rs:155-184) over HTTP. Guarded by stream-scoped QUERY
+    permission (the reference uses an intra-cluster token; queriers here
+    authenticate with the shared cluster credentials, which are admin)."""
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    stream = state.p.streams.get(name)
+    if stream is None:
+        return web.Response(status=204)
+
+    def work() -> bytes:
+        import io
+
+        import pyarrow as pa
+        import pyarrow.ipc as ipc
+
+        batches = stream.staging_batches()
+        if not batches:
+            return b""
+        sink = io.BytesIO()
+        from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+        schema = merge_schemas([b.schema for b in batches])
+        with ipc.new_stream(sink, schema) as w:
+            for b in batches:
+                w.write_batch(adapt_batch(schema, b))
+        return sink.getvalue()
+
+    data = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    if not data:
+        return web.Response(status=204)
+    return web.Response(body=data, content_type="application/vnd.apache.arrow.stream")
+
+
+@require(Action.LIST_CLUSTER)
+async def cluster_info(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    nodes = state.p.metastore.list_nodes()
+    return web.json_response(nodes)
+
+
+# -------------------------------------------------------------------- app
+
+
+def build_app(state: ServerState) -> web.Application:
+    app = web.Application(middlewares=[auth_middleware], client_max_size=64 * 1024 * 1024)
+    app["state"] = state
+    mode = state.p.options.mode
+    r = app.router
+
+    # health (all modes)
+    r.add_get("/api/v1/liveness", liveness)
+    r.add_get("/api/v1/readiness", readiness)
+    r.add_get("/api/v1/about", about)
+    r.add_get("/api/v1/metrics", metrics_handler)
+    r.add_get("/api/v1/login", login)
+
+    if mode in (Mode.ALL, Mode.INGEST):
+        r.add_post("/api/v1/ingest", ingest)
+        r.add_post("/api/v1/logstream/{name}", post_event)
+        r.add_post("/v1/{kind}", otel_ingest)
+        r.add_get("/api/v1/internal/staging/{name}", internal_staging)
+
+    if mode in (Mode.ALL, Mode.QUERY):
+        r.add_post("/api/v1/query", query)
+        r.add_post("/api/v1/counts", counts)
+        r.add_get("/api/v1/logstream/{name}/livetail", livetail_sse)
+
+    # stream management on every mode (ingestors accept sync'd definitions)
+    r.add_get("/api/v1/logstream", list_streams)
+    r.add_put("/api/v1/logstream/{name}", put_stream)
+    r.add_delete("/api/v1/logstream/{name}", delete_stream)
+    r.add_get("/api/v1/logstream/{name}/schema", get_schema)
+    r.add_get("/api/v1/logstream/{name}/info", stream_info)
+    r.add_get("/api/v1/logstream/{name}/stats", stream_stats)
+    r.add_put("/api/v1/logstream/{name}/retention", put_retention)
+    r.add_get("/api/v1/logstream/{name}/retention", get_retention)
+
+    # rbac
+    r.add_post("/api/v1/user/{username}", put_user)
+    r.add_get("/api/v1/user", list_users)
+    r.add_delete("/api/v1/user/{username}", delete_user)
+    r.add_put("/api/v1/user/{username}/role", put_user_roles)
+    r.add_put("/api/v1/role/{name}", put_role)
+    r.add_get("/api/v1/role", list_roles)
+    r.add_delete("/api/v1/role/{name}", delete_role)
+
+    # alerts / targets / dashboards / filters / correlations
+    for coll, base, acts in (
+        ("alerts", "/api/v1/alerts", (Action.PUT_ALERT, Action.GET_ALERT, Action.DELETE_ALERT)),
+        ("targets", "/api/v1/targets", (Action.PUT_TARGET, Action.GET_TARGET, Action.DELETE_TARGET)),
+        ("dashboards", "/api/v1/dashboards", (Action.CREATE_DASHBOARD, Action.GET_DASHBOARD, Action.DELETE_DASHBOARD)),
+        ("filters", "/api/v1/filters", (Action.CREATE_FILTER, Action.GET_FILTER, Action.DELETE_FILTER)),
+        ("correlations", "/api/v1/correlation", (Action.CREATE_CORRELATION, Action.GET_CORRELATION, Action.DELETE_CORRELATION)),
+    ):
+        put_doc, get_doc, list_docs, delete_doc = crud_routes(coll, *acts)
+        r.add_post(base, put_doc)
+        r.add_put(base + "/{id}", put_doc)
+        r.add_get(base, list_docs)
+        r.add_get(base + "/{id}", get_doc)
+        r.add_delete(base + "/{id}", delete_doc)
+
+    r.add_get("/api/v1/cluster/info", cluster_info)
+    return app
+
+
+def run_server(opts: Options | None = None, storage: StorageOptions | None = None) -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    p = Parseable(opts, storage)
+    state = ServerState(p)
+    host, _, port = p.options.address.rpartition(":")
+    p.register_node(p.options.address)
+    state.start_sync_loops()
+    app = build_app(state)
+
+    async def on_shutdown(app):
+        state.stop()
+
+    app.on_shutdown.append(on_shutdown)
+    logger.info(
+        "parseable-tpu %s starting in %s mode on %s (store: %s)",
+        __version__,
+        p.options.mode.value,
+        p.options.address,
+        p.provider.get_endpoint(),
+    )
+    web.run_app(app, host=host or "0.0.0.0", port=int(port or 8000), print=None)
+
+
+def main(argv: list[str] | None = None) -> None:
+    opts, storage = parse_cli(argv)
+    run_server(opts, storage)
+
+
+if __name__ == "__main__":
+    main()

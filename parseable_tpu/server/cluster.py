@@ -1,0 +1,100 @@
+"""Cluster coordination: node discovery + querier->ingestor staging fan-in.
+
+Parity target (reference: handlers/http/cluster/mod.rs + airplane.rs +
+utils/arrow/flight.rs): queriers discover ingestors through the object-store
+node registry (rendezvous metadata, SURVEY §5), probe liveness, and pull
+each live ingestor's staging-window rows as Arrow record batches before a
+query — the reference does this over Arrow Flight gRPC; this build's DCN
+data plane is HTTP + Arrow IPC (`/api/v1/internal/staging/{stream}`).
+
+Dead nodes are skipped after a liveness probe and remembered briefly
+(reference: check_liveness + removal from the round-robin map,
+cluster/mod.rs:1796-1850).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import logging
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from parseable_tpu.core import Parseable
+
+logger = logging.getLogger(__name__)
+
+LIVENESS_TIMEOUT = 2.0
+STAGING_TIMEOUT = 10.0
+DEAD_NODE_TTL = 30.0
+
+_dead_nodes: dict[str, float] = {}
+_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cluster")
+
+
+def _auth_header(p: Parseable) -> str:
+    cred = f"{p.options.username}:{p.options.password}".encode()
+    return "Basic " + base64.b64encode(cred).decode()
+
+
+def check_liveness(domain: str) -> bool:
+    cached = _dead_nodes.get(domain)
+    if cached is not None and time.monotonic() - cached < DEAD_NODE_TTL:
+        return False
+    try:
+        req = urllib.request.Request(f"{domain}/api/v1/liveness", method="GET")
+        with urllib.request.urlopen(req, timeout=LIVENESS_TIMEOUT) as resp:
+            ok = resp.status == 200
+    except (urllib.error.URLError, OSError):
+        ok = False
+    if not ok:
+        _dead_nodes[domain] = time.monotonic()
+    else:
+        _dead_nodes.pop(domain, None)
+    return ok
+
+
+def live_ingestors(p: Parseable) -> list[dict]:
+    nodes = [n for n in p.metastore.list_nodes("ingestor") if n.get("node_id") != p.node_id]
+    return [n for n in nodes if check_liveness(n["domain_name"])]
+
+
+def _fetch_one(p: Parseable, domain: str, stream: str) -> list[pa.RecordBatch]:
+    url = f"{domain}/api/v1/internal/staging/{stream}"
+    req = urllib.request.Request(url, headers={"Authorization": _auth_header(p)})
+    try:
+        with urllib.request.urlopen(req, timeout=STAGING_TIMEOUT) as resp:
+            if resp.status == 204:
+                return []
+            data = resp.read()
+    except (urllib.error.URLError, OSError) as e:
+        logger.warning("staging fan-in from %s failed: %s", domain, e)
+        _dead_nodes[domain] = time.monotonic()
+        return []
+    if not data:
+        return []
+    try:
+        return list(ipc.open_stream(io.BytesIO(data)))
+    except pa.ArrowInvalid as e:
+        logger.warning("bad staging payload from %s: %s", domain, e)
+        return []
+
+
+def fetch_staging_batches(p: Parseable, stream: str) -> list[pa.RecordBatch]:
+    """Pull the staging window of `stream` from every live ingestor
+    (reference: airplane.rs:155-184 fan-out, concurrently)."""
+    nodes = live_ingestors(p)
+    if not nodes:
+        return []
+    futures = [
+        _pool.submit(_fetch_one, p, n["domain_name"], stream) for n in nodes
+    ]
+    out: list[pa.RecordBatch] = []
+    for f in futures:
+        out.extend(f.result())
+    return out

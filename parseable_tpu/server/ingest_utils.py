@@ -1,0 +1,170 @@
+"""Ingest dispatch: flatten by log source and push into staging.
+
+Parity target (reference: handlers/http/modal/utils/ingest_utils.rs):
+`flatten_and_push_logs` dispatches on the `X-P-Log-Source` header —
+otel-logs/metrics/traces use the OTel flatteners, kinesis decodes Firehose
+records, plain JSON goes through generic (cross-product) flattening with the
+depth guard — then `push_logs` chunks records per custom-partition value and
+builds/processes events.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+from parseable_tpu.core import Parseable
+from parseable_tpu.event.format import LogSource
+from parseable_tpu.event.json_format import JsonEvent
+from parseable_tpu.livetail import LIVETAIL
+from parseable_tpu.otel import (
+    flatten_otel_logs,
+    flatten_otel_metrics,
+    flatten_otel_traces,
+)
+from parseable_tpu.utils.flatten import (
+    JsonFlattenError,
+    flatten,
+    generic_flattening,
+    has_more_than_max_allowed_levels,
+)
+
+
+class IngestError(ValueError):
+    pass
+
+
+def decode_kinesis(payload: dict) -> list[dict[str, Any]]:
+    """Kinesis Firehose message -> rows (reference: handlers/http/kinesis.rs).
+
+    {"requestId": ..., "timestamp": ..., "records": [{"data": base64-json}]}
+    """
+    rows = []
+    request_id = payload.get("requestId")
+    timestamp = payload.get("timestamp")
+    for rec in payload.get("records", []):
+        try:
+            data = base64.b64decode(rec.get("data", ""))
+            obj = json.loads(data) if data.strip() else {}
+        except (ValueError, json.JSONDecodeError) as e:
+            raise IngestError(f"invalid kinesis record data: {e}") from e
+        if not isinstance(obj, dict):
+            obj = {"message": obj}
+        obj.setdefault("requestId", request_id)
+        obj.setdefault("timestamp", timestamp)
+        rows.append(obj)
+    return rows
+
+
+def flatten_json_records(
+    payload: Any,
+    max_flatten_level: int,
+    time_partition: str | None,
+    time_partition_limit_days: int | None,
+    custom_partition: str | None,
+    max_chunk_age_hours: int,
+) -> list[dict[str, Any]]:
+    """Plain-JSON path: depth guard -> cross-product expansion -> flatten."""
+    if has_more_than_max_allowed_levels(payload, max_flatten_level):
+        raise IngestError(
+            f"JSON is deeper than the allowed {max_flatten_level} levels"
+        )
+    expanded = generic_flattening(payload)
+    rows: list[dict[str, Any]] = []
+    validation = time_partition is not None or custom_partition is not None
+    for item in expanded:
+        try:
+            flat = flatten(
+                item,
+                "_",
+                time_partition,
+                time_partition_limit_days,
+                custom_partition,
+                validation_required=validation,
+                max_chunk_age_hours=max_chunk_age_hours,
+            )
+        except JsonFlattenError as e:
+            raise IngestError(str(e)) from e
+        if isinstance(flat, list):
+            rows.extend(flat)
+        else:
+            rows.append(flat)
+    return rows
+
+
+def flatten_and_push_logs(
+    p: Parseable,
+    stream_name: str,
+    payload: Any,
+    log_source: LogSource,
+    custom_fields: dict[str, str] | None = None,
+    origin_size: int = 0,
+) -> int:
+    """Parse+flatten by source, then push into staging. Returns row count."""
+    stream = p.get_stream(stream_name)
+    meta = stream.metadata
+
+    if log_source == LogSource.OTEL_LOGS:
+        rows = flatten_otel_logs(payload)
+    elif log_source == LogSource.OTEL_METRICS:
+        rows = flatten_otel_metrics(payload)
+    elif log_source == LogSource.OTEL_TRACES:
+        rows = flatten_otel_traces(payload)
+    elif log_source == LogSource.KINESIS:
+        rows = decode_kinesis(payload)
+    else:
+        rows = flatten_json_records(
+            payload,
+            p.options.event_flatten_level,
+            meta.time_partition,
+            meta.time_partition_limit_days,
+            meta.custom_partition,
+            p.options.event_max_chunk_age,
+        )
+    if not rows:
+        return 0
+    field_count = len({k for r in rows for k in r})
+    if field_count > p.options.dataset_fields_allowed_limit:
+        raise IngestError(
+            f"fields ({field_count}) exceed dataset limit "
+            f"({p.options.dataset_fields_allowed_limit})"
+        )
+    return push_logs(p, stream_name, rows, log_source, custom_fields, origin_size)
+
+
+def push_logs(
+    p: Parseable,
+    stream_name: str,
+    rows: list[dict[str, Any]],
+    log_source: LogSource,
+    custom_fields: dict[str, str] | None = None,
+    origin_size: int = 0,
+) -> int:
+    """Chunk rows by custom-partition value and process each chunk
+    (reference: ingest_utils.rs:291)."""
+    stream = p.get_stream(stream_name)
+    meta = stream.metadata
+    chunks: list[list[dict]]
+    if meta.custom_partition:
+        first_key = meta.custom_partition.split(",")[0].strip()
+        grouped: dict[Any, list[dict]] = {}
+        for r in rows:
+            grouped.setdefault(r.get(first_key), []).append(r)
+        chunks = list(grouped.values())
+    elif meta.time_partition:
+        chunks = [[r] for r in rows]  # per-record parsed timestamps
+    else:
+        chunks = [rows]
+    total = 0
+    for chunk in chunks:
+        ev = JsonEvent(
+            chunk,
+            stream_name,
+            origin_size=origin_size if len(chunks) == 1 else 0,
+            log_source=log_source,
+            custom_fields=custom_fields or {},
+        ).into_event(meta, stream.metadata.stream_type)
+        ev.process(stream, livetail=LIVETAIL.process, commit_schema=p.commit_schema)
+        total += ev.rb.num_rows
+    return total

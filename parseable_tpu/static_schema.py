@@ -1,0 +1,55 @@
+"""Static schema: user-declared field list -> Arrow schema.
+
+Parity target (reference: src/static_schema.rs:59-260): a stream created
+with `X-P-Static-Schema-Flag: true` takes `{"fields": [{"name": ...,
+"data_type": ...}]}` and ingestion is validated against it (no inference).
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+_TYPES = {
+    "int": pa.int64(),
+    "int64": pa.int64(),
+    "double": pa.float64(),
+    "float": pa.float64(),
+    "float64": pa.float64(),
+    "boolean": pa.bool_(),
+    "bool": pa.bool_(),
+    "string": pa.string(),
+    "text": pa.string(),
+    "datetime": pa.timestamp("ms"),
+    "timestamp": pa.timestamp("ms"),
+    "date": pa.timestamp("ms"),
+}
+
+
+def convert_static_schema(body: dict, time_partition: str | None = None) -> pa.Schema:
+    fields_spec = body.get("fields")
+    if not isinstance(fields_spec, list) or not fields_spec:
+        raise ValueError("static schema needs a non-empty 'fields' list")
+    fields: list[pa.Field] = []
+    seen: set[str] = set()
+    for spec in fields_spec:
+        name = spec.get("name")
+        dtype = str(spec.get("data_type", "")).lower()
+        if not name:
+            raise ValueError("static schema field missing 'name'")
+        if name in seen:
+            raise ValueError(f"duplicate field {name!r} in static schema")
+        if name == DEFAULT_TIMESTAMP_KEY:
+            raise ValueError(f"{DEFAULT_TIMESTAMP_KEY} is reserved")
+        if dtype not in _TYPES:
+            raise ValueError(f"unsupported data type {dtype!r} for field {name!r}")
+        seen.add(name)
+        t = _TYPES[dtype]
+        if time_partition and name == time_partition:
+            t = pa.timestamp("ms")
+        fields.append(pa.field(name, t, nullable=True))
+    if time_partition and time_partition not in seen:
+        raise ValueError(f"time partition {time_partition!r} missing from static schema")
+    fields.append(pa.field(DEFAULT_TIMESTAMP_KEY, pa.timestamp("ms"), nullable=True))
+    return pa.schema(sorted(fields, key=lambda f: f.name))

@@ -1,0 +1,98 @@
+"""Retention: delete data older than N days (reference: storage/retention.rs).
+
+Config format matches the reference: a list of tasks
+`[{"description": ..., "action": "delete", "duration": "30d"}]`. A daily
+tick removes expired day-partitions, their manifests, and the corresponding
+snapshot entries.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from datetime import UTC, datetime, timedelta
+
+from parseable_tpu.core import Parseable
+from parseable_tpu.metastore import MetastoreError
+
+logger = logging.getLogger(__name__)
+
+_DURATION_RE = re.compile(r"^(\d+)d$")
+
+
+def parse_retention_duration(text: str) -> int:
+    m = _DURATION_RE.match(text.strip())
+    if not m:
+        raise ValueError(f"invalid retention duration {text!r}; expected e.g. '30d'")
+    return int(m.group(1))
+
+
+def validate_retention_config(config) -> None:
+    if not isinstance(config, list):
+        raise ValueError("retention config must be a list of tasks")
+    for task in config:
+        if task.get("action") != "delete":
+            raise ValueError(f"unsupported retention action {task.get('action')!r}")
+        parse_retention_duration(task.get("duration", ""))
+
+
+_last_run: dict[str, datetime] = {}
+
+
+def retention_tick(p: Parseable, now: datetime | None = None) -> None:
+    """Hourly tick; per-stream cleanup runs at most once a day
+    (reference schedules with clokwerk daily at 00:00; retention.rs:43)."""
+    now = now or datetime.now(UTC)
+    for name in p.streams.list_names():
+        last = _last_run.get(name)
+        if last is not None and now - last < timedelta(days=1):
+            continue
+        stream = p.streams.get(name)
+        if stream is None or not stream.metadata.retention:
+            continue
+        try:
+            for task in stream.metadata.retention:
+                if task.get("action") == "delete":
+                    days = parse_retention_duration(task["duration"])
+                    apply_retention(p, name, days, now)
+            _last_run[name] = now
+        except Exception:
+            logger.exception("retention failed for stream %s", name)
+
+
+def apply_retention(p: Parseable, stream_name: str, days: int, now: datetime | None = None) -> list[str]:
+    """Delete day-partitions older than `days`; returns removed date prefixes
+    (reference: retention.rs:211-259 delete + manifest cleanup)."""
+    now = now or datetime.now(UTC)
+    cutoff = (now - timedelta(days=days)).date()
+    removed: list[str] = []
+    try:
+        fmt = p.metastore.get_stream_json(stream_name, p._node_suffix)
+    except MetastoreError:
+        return removed
+
+    keep = []
+    for item in fmt.snapshot.manifest_list:
+        if item.time_upper_bound.date() < cutoff:
+            prefix = item.manifest_path[: -len("/manifest.json")]
+            manifest = p.metastore.get_manifest(prefix)
+            if manifest is not None:
+                for f in manifest.files:
+                    try:
+                        p.storage.delete_object(f.file_path)
+                    except Exception:
+                        logger.warning("failed deleting %s", f.file_path)
+            p.metastore.delete_manifest(prefix)
+            p.storage.delete_prefix(prefix)
+            fmt.stats.deleted_events += item.events_ingested
+            fmt.stats.deleted_storage += item.storage_size
+            fmt.stats.events = max(0, fmt.stats.events - item.events_ingested)
+            fmt.stats.storage = max(0, fmt.stats.storage - item.storage_size)
+            removed.append(prefix)
+        else:
+            keep.append(item)
+    if removed:
+        fmt.snapshot.manifest_list = keep
+        p.metastore.put_stream_json(stream_name, fmt, p._node_suffix)
+        logger.info("retention removed %d day-partitions from %s", len(removed), stream_name)
+    return removed

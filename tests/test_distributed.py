@@ -1,0 +1,118 @@
+"""Distributed mode: N ingestors + querier over one object store.
+
+Mirrors the reference's docker-compose-distributed-test topology (SURVEY §4)
+in-process: ingest-mode servers on real sockets, a query-mode instance
+reading the shared store, staging fan-in over the cluster data plane.
+"""
+
+import asyncio
+import base64
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from parseable_tpu.config import Mode, Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.query.session import QuerySession
+from parseable_tpu.server.app import ServerState, build_app
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+
+def make_parseable(tmp_path, node: str, mode: Mode) -> Parseable:
+    opts = Options()
+    opts.mode = mode
+    opts.local_staging_path = tmp_path / f"staging-{node}"
+    storage = StorageOptions(backend="local-store", root=tmp_path / "shared-store")
+    return Parseable(opts, storage)
+
+
+def test_two_ingestors_one_querier(tmp_path):
+    async def scenario():
+        import aiohttp
+
+        # two ingest nodes on real ports
+        ing_states = []
+        servers = []
+        for i in range(2):
+            p = make_parseable(tmp_path, f"ing{i}", Mode.INGEST)
+            state = ServerState(p)
+            server = TestServer(build_app(state))
+            await server.start_server()
+            p.register_node(f"127.0.0.1:{server.port}")
+            ing_states.append(state)
+            servers.append(server)
+
+        async with aiohttp.ClientSession() as http:
+            for i, server in enumerate(servers):
+                url = f"http://127.0.0.1:{server.port}/api/v1/ingest"
+                rows = [{"host": f"node{i}", "v": float(j)} for j in range(10)]
+                async with http.post(
+                    url, json=rows, headers={**AUTH, "X-P-Stream": "dist"}
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+
+        # node 0 converts+uploads (historical path); node 1 stays in staging
+        ing_states[0].p.local_sync(shutdown=True)
+        ing_states[0].p.sync_all_streams()
+
+        def run_query():
+            q = make_parseable(tmp_path, "query", Mode.QUERY)
+            sess = QuerySession(q, engine="cpu")
+            res = sess.query("SELECT host, count(*) c FROM dist GROUP BY host ORDER BY host")
+            return res.to_json_rows(), res.stats
+
+        rows, stats = await asyncio.get_running_loop().run_in_executor(None, run_query)
+        # both the uploaded parquet (node0) and the remote staging window
+        # (node1, fan-in over HTTP arrow) are visible
+        assert rows == [{"host": "node0", "c": 10}, {"host": "node1", "c": 10}]
+
+        # per-node stream jsons exist (ingestor.<id>.stream.json)
+        store_meta = ing_states[0].p.metastore
+        fmts = store_meta.get_all_stream_jsons("dist")
+        assert len(fmts) >= 2
+
+        for s in servers:
+            await s.close()
+        for st in ing_states:
+            st._sync_stop.set()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_querier_skips_dead_ingestors(tmp_path):
+    async def scenario():
+        p = make_parseable(tmp_path, "ing0", Mode.INGEST)
+        state = ServerState(p)
+        server = TestServer(build_app(state))
+        await server.start_server()
+        p.register_node(f"127.0.0.1:{server.port}")
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            url = f"http://127.0.0.1:{server.port}/api/v1/ingest"
+            async with http.post(
+                url, json=[{"a": 1.0}], headers={**AUTH, "X-P-Stream": "ghost"}
+            ) as resp:
+                assert resp.status == 200
+        # register a dead node too
+        p.metastore.put_node(
+            {
+                "node_id": "deadbeef",
+                "node_type": "ingestor",
+                "domain_name": "http://127.0.0.1:1",  # nothing listens here
+            }
+        )
+
+        def run_query():
+            q = make_parseable(tmp_path, "query", Mode.QUERY)
+            sess = QuerySession(q, engine="cpu")
+            return sess.query("SELECT count(*) c FROM ghost").to_json_rows()
+
+        rows = await asyncio.get_running_loop().run_in_executor(None, run_query)
+        assert rows[0]["c"] == 1  # live node's staging served; dead one skipped
+
+        await server.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())

@@ -1,0 +1,427 @@
+"""HTTP API tests: ingest -> query end-to-end over the real server.
+
+The reference covers this surface with docker-compose + the external quest
+harness (SURVEY §4); here aiohttp's test client drives the same flows
+in-process.
+"""
+
+import asyncio
+import base64
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from parseable_tpu.config import Mode, Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.server.app import ServerState, build_app
+
+
+def make_state(tmp_path, mode=Mode.ALL):
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    opts.mode = mode
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    return ServerState(p)
+
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def with_client(state, fn):
+    app = build_app(state)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_health_and_about(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        assert (await client.get("/api/v1/liveness")).status == 200
+        assert (await client.get("/api/v1/readiness")).status == 200
+        r = await client.get("/api/v1/about", headers=AUTH)
+        assert r.status == 200
+        body = await r.json()
+        assert body["mode"] == "All"
+
+    run(with_client(state, fn))
+
+
+def test_auth_required_and_rejected(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        assert (await client.get("/api/v1/logstream")).status == 401
+        bad = {"Authorization": "Basic " + base64.b64encode(b"admin:wrong").decode()}
+        assert (await client.get("/api/v1/logstream", headers=bad)).status == 401
+
+    run(with_client(state, fn))
+
+
+def test_ingest_query_roundtrip(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        records = [{"host": f"h{i%2}", "status": 200 if i % 3 else 500} for i in range(30)]
+        r = await client.post(
+            "/api/v1/ingest", json=records, headers={**AUTH, "X-P-Stream": "api"}
+        )
+        assert r.status == 200, await r.text()
+        state.p.local_sync(shutdown=True)
+        state.p.sync_all_streams()
+
+        r = await client.post(
+            "/api/v1/query",
+            json={"query": "SELECT host, count(*) c FROM api GROUP BY host ORDER BY host"},
+            headers=AUTH,
+        )
+        assert r.status == 200, await r.text()
+        rows = await r.json()
+        assert rows == [{"host": "h0", "c": 15}, {"host": "h1", "c": 15}]
+
+        # stats + schema + info + list
+        r = await client.get("/api/v1/logstream", headers=AUTH)
+        assert [s["name"] for s in await r.json()] == ["api"]
+        r = await client.get("/api/v1/logstream/api/schema", headers=AUTH)
+        names = [f["name"] for f in (await r.json())["fields"]]
+        assert "host" in names and "p_timestamp" in names
+        r = await client.get("/api/v1/logstream/api/stats", headers=AUTH)
+        stats = await r.json()
+        assert stats["ingestion"]["count"] == 30
+
+    run(with_client(state, fn))
+
+
+def test_ingest_missing_stream_header(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.post("/api/v1/ingest", json=[{"a": 1}], headers=AUTH)
+        assert r.status == 400
+
+    run(with_client(state, fn))
+
+
+def test_otel_logs_ingest(tmp_path):
+    state = make_state(tmp_path)
+    payload = {
+        "resourceLogs": [
+            {
+                "resource": {"attributes": [{"key": "service.name", "value": {"stringValue": "svc"}}]},
+                "scopeLogs": [
+                    {
+                        "scope": {"name": "lib"},
+                        "logRecords": [
+                            {
+                                "timeUnixNano": "1714521600000000000",
+                                "severityNumber": 9,
+                                "body": {"stringValue": "hello"},
+                                "attributes": [{"key": "k", "value": {"intValue": "7"}}],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+    async def fn(client):
+        r = await client.post("/v1/logs", json=payload, headers=AUTH)
+        assert r.status == 200, await r.text()
+        state.p.local_sync(shutdown=True)
+        r = await client.post(
+            "/api/v1/query",
+            json={"query": "SELECT body, severity_text, k FROM \"otel-logs\""},
+            headers=AUTH,
+        )
+        rows = await r.json()
+        assert rows[0]["body"] == "hello"
+        assert rows[0]["severity_text"] == "SEVERITY_NUMBER_INFO"
+        assert rows[0]["k"] == 7
+
+    run(with_client(state, fn))
+
+
+def test_rbac_user_lifecycle(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        # create a reader role + user
+        r = await client.put(
+            "/api/v1/role/readers",
+            json=[{"privilege": "reader", "resource": {"stream": "api"}}],
+            headers=AUTH,
+        )
+        assert r.status == 200
+        r = await client.post("/api/v1/user/alice", json={"roles": ["readers"]}, headers=AUTH)
+        assert r.status == 200
+        password = await r.json()
+        alice = {"Authorization": "Basic " + base64.b64encode(f"alice:{password}".encode()).decode()}
+        # alice can list streams but cannot ingest
+        assert (await client.get("/api/v1/logstream", headers=alice)).status == 200
+        r = await client.post(
+            "/api/v1/ingest", json=[{"a": 1}], headers={**alice, "X-P-Stream": "api"}
+        )
+        assert r.status == 403
+        # delete user -> auth fails
+        assert (await client.delete("/api/v1/user/alice", headers=AUTH)).status == 200
+        assert (await client.get("/api/v1/logstream", headers=alice)).status == 401
+
+    run(with_client(state, fn))
+
+
+def test_alert_crud_and_eval(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        # alert windows end at the truncated current minute (reference
+        # parse_human_time semantics), so events must be >1 minute old
+        from datetime import UTC, datetime, timedelta
+
+        import pyarrow as pa
+
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+        from parseable_tpu.event import Event
+
+        stream = state.p.create_stream_if_not_exists("errs")
+        old = datetime.now(UTC) - timedelta(minutes=2)
+        batch = pa.RecordBatch.from_pydict(
+            {
+                DEFAULT_TIMESTAMP_KEY: pa.array(
+                    [old.replace(tzinfo=None)] * 5, pa.timestamp("ms")
+                ),
+                "status": pa.array([500.0] * 5),
+            }
+        )
+        Event("errs", batch, parsed_timestamp=old, is_first_event=True).process(
+            stream, commit_schema=state.p.commit_schema
+        )
+        alert = {
+            "title": "too many errors",
+            "stream": "errs",
+            "threshold_config": {"agg": "count", "operator": ">", "value": 3},
+            "eval_frequency": 1,
+        }
+        r = await client.post("/api/v1/alerts", json=alert, headers=AUTH)
+        assert r.status == 200, await r.text()
+        created = await r.json()
+        # invalid alert rejected
+        r = await client.post("/api/v1/alerts", json={"title": "x"}, headers=AUTH)
+        assert r.status == 400
+        # evaluate
+        from parseable_tpu.alerts import alert_tick
+
+        alert_tick(state)
+        rec = state.p.metastore.get_document("alert_state", created["id"])
+        assert rec["state"] == "triggered"
+        assert rec["actual"] == 5
+
+    run(with_client(state, fn))
+
+
+def test_dashboards_crud(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/dashboards", json={"name": "ops", "tiles": []}, headers=AUTH
+        )
+        doc = await r.json()
+        r = await client.get(f"/api/v1/dashboards/{doc['id']}", headers=AUTH)
+        assert (await r.json())["name"] == "ops"
+        r = await client.get("/api/v1/dashboards", headers=AUTH)
+        assert len(await r.json()) == 1
+        assert (await client.delete(f"/api/v1/dashboards/{doc['id']}", headers=AUTH)).status == 200
+
+    run(with_client(state, fn))
+
+
+def test_retention_endpoint_and_apply(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/ingest", json=[{"a": 1}], headers={**AUTH, "X-P-Stream": "old"}
+        )
+        assert r.status == 200
+        r = await client.put(
+            "/api/v1/logstream/old/retention",
+            json=[{"description": "d", "action": "delete", "duration": "30d"}],
+            headers=AUTH,
+        )
+        assert r.status == 200, await r.text()
+        r = await client.put(
+            "/api/v1/logstream/old/retention",
+            json=[{"action": "nonsense", "duration": "30d"}],
+            headers=AUTH,
+        )
+        assert r.status == 400
+
+    run(with_client(state, fn))
+
+
+def test_internal_staging_endpoint(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        await client.post(
+            "/api/v1/ingest", json=[{"a": 1.5}], headers={**AUTH, "X-P-Stream": "live"}
+        )
+        r = await client.get("/api/v1/internal/staging/live", headers=AUTH)
+        assert r.status == 200
+        body = await r.read()
+        import io
+
+        import pyarrow.ipc as ipc
+
+        batches = list(ipc.open_stream(io.BytesIO(body)))
+        assert sum(b.num_rows for b in batches) == 1
+
+    run(with_client(state, fn))
+
+
+def test_session_login_and_bearer(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.get("/api/v1/login", headers=AUTH)
+        assert r.status == 200
+        token = (await r.json())["token"]
+        bearer = {"Authorization": f"Bearer {token}"}
+        assert (await client.get("/api/v1/logstream", headers=bearer)).status == 200
+        assert (
+            await client.get("/api/v1/logstream", headers={"Authorization": "Bearer nope"})
+        ).status == 401
+
+    run(with_client(state, fn))
+
+
+def test_put_user_conflict(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        assert (await client.post("/api/v1/user/carol", headers=AUTH)).status == 200
+        r = await client.post("/api/v1/user/carol", headers=AUTH)
+        assert r.status == 400  # no silent password reset
+
+    run(with_client(state, fn))
+
+
+def test_static_schema_rejects_extra_fields(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.put(
+            "/api/v1/logstream/strict",
+            json={"fields": [{"name": "a", "data_type": "int"}]},
+            headers={**AUTH, "X-P-Static-Schema-Flag": "true"},
+        )
+        assert r.status == 200, await r.text()
+        ok = await client.post(
+            "/api/v1/ingest", json=[{"a": 1}], headers={**AUTH, "X-P-Stream": "strict"}
+        )
+        assert ok.status == 200
+        bad = await client.post(
+            "/api/v1/ingest", json=[{"a": 1, "b": "x"}], headers={**AUTH, "X-P-Stream": "strict"}
+        )
+        assert bad.status == 400
+        body = await bad.json()
+        assert "static schema" in body["error"]
+        # schema unchanged
+        r = await client.get("/api/v1/logstream/strict/schema", headers=AUTH)
+        names = [f["name"] for f in (await r.json())["fields"]]
+        assert "b" not in names
+
+    run(with_client(state, fn))
+
+
+def test_update_stream_custom_partition(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        await client.post(
+            "/api/v1/ingest", json=[{"region": "us"}], headers={**AUTH, "X-P-Stream": "upd"}
+        )
+        r = await client.put(
+            "/api/v1/logstream/upd",
+            headers={**AUTH, "X-P-Update-Stream": "true", "X-P-Custom-Partition": "region"},
+        )
+        assert r.status == 200
+        assert (await r.json())["message"] == "updated stream upd"
+        assert state.p.get_stream("upd").metadata.custom_partition == "region"
+        # time partition change rejected
+        r = await client.put(
+            "/api/v1/logstream/upd",
+            headers={**AUTH, "X-P-Update-Stream": "true", "X-P-Time-Partition": "ts"},
+        )
+        assert r.status == 400
+
+    run(with_client(state, fn))
+
+
+def test_counts_bins_align_to_start(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        from datetime import UTC, datetime, timedelta
+
+        import pyarrow as pa
+
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+        from parseable_tpu.event import Event
+
+        stream = state.p.create_stream_if_not_exists("hist")
+        old = datetime.now(UTC) - timedelta(minutes=30)
+        batch = pa.RecordBatch.from_pydict(
+            {
+                DEFAULT_TIMESTAMP_KEY: pa.array(
+                    [(old + timedelta(minutes=i)).replace(tzinfo=None) for i in range(20)],
+                    pa.timestamp("ms"),
+                ),
+                "v": pa.array([1.0] * 20),
+            }
+        )
+        Event("hist", batch, parsed_timestamp=old, is_first_event=True).process(
+            stream, commit_schema=state.p.commit_schema
+        )
+        r = await client.post(
+            "/api/v1/counts",
+            json={"stream": "hist", "startTime": "1h", "endTime": "now", "numBins": 6},
+            headers=AUTH,
+        )
+        assert r.status == 200, await r.text()
+        records = (await r.json())["records"]
+        assert sum(rec["count"] for rec in records) == 20  # keys aligned
+
+    run(with_client(state, fn))
+
+
+def test_internal_staging_requires_query_permission(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        await client.post(
+            "/api/v1/ingest", json=[{"a": 1}], headers={**AUTH, "X-P-Stream": "secret"}
+        )
+        # ingest-only user cannot dump staging
+        await client.put(
+            "/api/v1/role/pusher",
+            json=[{"privilege": "ingestor", "resource": {"stream": "other"}}],
+            headers=AUTH,
+        )
+        r = await client.post("/api/v1/user/ing", json={"roles": ["pusher"]}, headers=AUTH)
+        pw = await r.json()
+        ing = {"Authorization": "Basic " + base64.b64encode(f"ing:{pw}".encode()).decode()}
+        r = await client.get("/api/v1/internal/staging/secret", headers=ing)
+        assert r.status == 403
+
+    run(with_client(state, fn))
