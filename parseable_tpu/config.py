@@ -180,6 +180,9 @@ class Options:
     device_block_rows: int = field(default_factory=lambda: _env_int("P_TPU_BLOCK_ROWS", 1 << 20))
 
     # --- misc -----------------------------------------------------------------
+    collect_dataset_stats: bool = field(
+        default_factory=lambda: _env_bool("P_COLLECT_DATASET_STATS", False)
+    )
     check_update: bool = field(default_factory=lambda: _env_bool("P_CHECK_UPDATE", True))
     send_analytics: bool = field(default_factory=lambda: _env_bool("P_SEND_ANONYMOUS_USAGE_DATA", False))
     cpu_threshold_pct: float = field(default_factory=lambda: _env_float("P_CPU_THRESHOLD", 90.0))

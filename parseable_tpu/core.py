@@ -85,6 +85,7 @@ class Parseable:
         ingestor_id = self.node_id if self.options.mode == Mode.INGEST else None
         self.streams = Streams(self.options, ingestor_id)
         self.uploader = UploadPool(self.storage, self.options.upload_concurrency)
+        self.hot_tier = None  # set by the server when hot tier is enabled
 
     # ------------------------------------------------------------------ node
 
@@ -270,6 +271,18 @@ class Parseable:
             entry = create_from_parquet_file(self.storage.absolute_url(key), f)
             manifest_files.append(entry)
             uploaded.append(key)
+            if self.options.collect_dataset_stats and stream.name not in (
+                "pstats",
+                "pmeta",
+            ):
+                try:
+                    import pyarrow.parquet as pq
+
+                    from parseable_tpu.storage.field_stats import ingest_field_stats
+
+                    ingest_field_stats(self, stream.name, pq.read_table(f))
+                except Exception:
+                    logger.exception("field stats failed for %s", f)
             f.unlink(missing_ok=True)
         if manifest_files:
             self.update_snapshot(stream, manifest_files)
@@ -342,9 +355,14 @@ class Parseable:
     # -------------------------------------------------------------- shutdown
 
     def shutdown(self) -> None:
-        """Flush staging, convert, upload, then stop (sync.rs:71-86)."""
-        self.local_sync(shutdown=True)
-        self.sync_all_streams()
+        """Flush staging, convert, upload, then stop (sync.rs:71-86).
+
+        Two passes: uploading can itself ingest (field stats -> pstats), so a
+        second flush+upload drains anything produced during the first.
+        """
+        for _ in range(2):
+            self.local_sync(shutdown=True)
+            self.sync_all_streams()
         self.uploader.shutdown()
 
 

@@ -278,14 +278,19 @@ def rename_per_record_type_mismatches(
 def get_schema_key(fields: list[str]) -> str:
     """Stable 64-bit hex key over sorted field names.
 
-    Reference uses xxh3 (event/mod.rs:148); any stable 64-bit hash works since
-    the key is only used to group staging files by schema shape.
+    Native xxHash64 (reference uses xxh3; event/mod.rs:148) with a blake2b
+    fallback — the key only groups staging files by schema shape, so any
+    stable 64-bit hash is interchangeable.
     """
-    h = hashlib.blake2b(digest_size=8)
-    for name in sorted(fields):
-        h.update(name.encode())
-        h.update(b"\x00")
-    return h.hexdigest()
+    payload = b"\x00".join(name.encode() for name in sorted(fields))
+    try:
+        from parseable_tpu.native import xxh64
+
+        return f"{xxh64(payload):016x}"
+    except Exception:
+        h = hashlib.blake2b(digest_size=8)
+        h.update(payload)
+        return h.hexdigest()
 
 
 @dataclass

@@ -1,0 +1,131 @@
+"""Known-format extraction: parse structured fields out of raw log lines.
+
+Parity target (reference: src/event/format/known_schema.rs:33-196 +
+resources/formats.json): streams may declare a log-source format; incoming
+raw lines are matched against that format's regexes and named capture groups
+become event fields. Unmatched lines pass through untouched (never reject).
+
+The format library below is our own curated set of common formats (the
+reference ships a packaged formats.json with the same mechanism).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any
+
+_IP = r"(?:\d{1,3}\.){3}\d{1,3}|[0-9a-fA-F:]+"
+
+
+@dataclass
+class Format:
+    name: str
+    patterns: list[re.Pattern]
+
+    def fields(self) -> set[str]:
+        out: set[str] = set()
+        for p in self.patterns:
+            out |= set(p.groupindex)
+        return out
+
+
+def _fmt(name: str, *patterns: str) -> Format:
+    return Format(name, [re.compile(p) for p in patterns])
+
+
+KNOWN_FORMATS: dict[str, Format] = {
+    f.name: f
+    for f in [
+        _fmt(
+            "access_log",  # apache/nginx common + combined
+            r'^(?P<client_ip>' + _IP + r')\s+(?P<ident>\S+)\s+(?P<auth_user>\S+)\s+'
+            r'\[(?P<timestamp>[^\]]+)\]\s+"(?P<method>[A-Z]+)\s+(?P<path>\S+)\s+'
+            r'(?P<protocol>[^"]+)"\s+(?P<status>\d{3})\s+(?P<body_bytes>\d+|-)'
+            r'(?:\s+"(?P<referrer>[^"]*)"\s+"(?P<user_agent>[^"]*)")?',
+        ),
+        _fmt(
+            "syslog",  # RFC3164 + RFC5424
+            r"^<(?P<priority>\d{1,3})>(?P<version>\d)\s+(?P<timestamp>\S+)\s+(?P<hostname>\S+)\s+(?P<app_name>\S+)\s+(?P<proc_id>\S+)\s+(?P<msg_id>\S+)\s+(?P<message>.*)$",
+            r"^(?:<(?P<priority>\d{1,3})>)?(?P<timestamp>[A-Z][a-z]{2}\s+\d{1,2}\s+\d{2}:\d{2}:\d{2})\s+(?P<hostname>\S+)\s+(?P<app_name>[\w\-/\.]+)(?:\[(?P<proc_id>\d+)\])?:\s*(?P<message>.*)$",
+        ),
+        _fmt(
+            "logfmt",
+            r"^(?P<logfmt>(?:[\w\.]+=(?:\"[^\"]*\"|\S+)\s*){2,})$",
+        ),
+        _fmt(
+            "python_logging",
+            r"^(?P<timestamp>\d{4}-\d{2}-\d{2}[ T]\d{2}:\d{2}:\d{2}(?:[.,]\d+)?)\s*[-:]?\s*(?P<level>DEBUG|INFO|WARNING|ERROR|CRITICAL)\s*[-:]\s*(?P<logger>[\w\.]+)?\s*[-:]?\s*(?P<message>.*)$",
+        ),
+        _fmt(
+            "java_log",
+            r"^(?P<timestamp>\d{4}-\d{2}-\d{2}[ T]\d{2}:\d{2}:\d{2}[.,]\d+)\s+(?P<level>TRACE|DEBUG|INFO|WARN|ERROR|FATAL)\s+(?:\[(?P<thread>[^\]]+)\]\s+)?(?P<logger>[\w\.$]+)\s*[-:]\s*(?P<message>.*)$",
+        ),
+        _fmt(
+            "klog",  # kubernetes component logs
+            r"^(?P<level_char>[IWEF])(?P<timestamp>\d{4}\s+\d{2}:\d{2}:\d{2}\.\d+)\s+(?P<thread>\d+)\s+(?P<source_file>[\w\._-]+):(?P<source_line>\d+)\]\s+(?P<message>.*)$",
+        ),
+        _fmt(
+            "go_log",
+            r"^(?P<timestamp>\d{4}/\d{2}/\d{2}\s+\d{2}:\d{2}:\d{2})\s+(?P<message>.*)$",
+        ),
+        _fmt(
+            "aws_alb",
+            r'^(?P<request_type>\S+)\s+(?P<timestamp>\S+)\s+(?P<elb>\S+)\s+'
+            r'(?P<client_port>(?:' + _IP + r'):\d+)\s+(?P<target_port>\S+)\s+'
+            r'(?P<request_processing_time>[\d\.-]+)\s+(?P<target_processing_time>[\d\.-]+)\s+'
+            r'(?P<response_processing_time>[\d\.-]+)\s+(?P<elb_status_code>\d+|-)\s+'
+            r'(?P<target_status_code>\d+|-)\s+(?P<received_bytes>\d+)\s+(?P<sent_bytes>\d+)\s+'
+            r'"(?P<request>[^"]*)"',
+        ),
+    ]
+}
+
+
+class KnownSchemaList:
+    """Per-stream format registry + line extraction."""
+
+    def __init__(self, formats: dict[str, Format] | None = None):
+        self.formats = formats if formats is not None else KNOWN_FORMATS
+
+    def extract(self, format_name: str, text: str) -> dict[str, Any] | None:
+        """Match `text` against the named format; fields dict or None."""
+        fmt = self.formats.get(format_name)
+        if fmt is None:
+            return None
+        for pattern in fmt.patterns:
+            m = pattern.match(text)
+            if m:
+                fields = {k: v for k, v in m.groupdict().items() if v is not None}
+                if "logfmt" in fields:
+                    fields = _parse_logfmt(fields["logfmt"])
+                return fields
+        return None
+
+    def check_or_extract(
+        self, record: dict[str, Any], format_name: str, extract_field: str = "message"
+    ) -> dict[str, Any]:
+        """Enrich a record in place style: if `extract_field` holds a raw
+        line matching the format, merge the extracted fields (existing keys
+        win; unmatched lines pass through — reference :93-155)."""
+        raw = record.get(extract_field)
+        if not isinstance(raw, str):
+            return record
+        fields = self.extract(format_name, raw)
+        if not fields:
+            return record
+        out = dict(fields)
+        out.update(record)  # record's own keys win
+        return out
+
+
+def _parse_logfmt(text: str) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for m in re.finditer(r'([\w\.]+)=(?:"([^"]*)"|(\S+))', text):
+        key = m.group(1)
+        val = m.group(2) if m.group(2) is not None else m.group(3)
+        out[key] = val
+    return out
+
+
+KNOWN_SCHEMA_LIST = KnownSchemaList()

@@ -27,6 +27,7 @@ from parseable_tpu.catalog import Manifest
 from parseable_tpu.storage import (
     ALERTS_ROOT_DIRECTORY,
     MANIFEST_FILE,
+    SETTINGS_ROOT_DIRECTORY,
     PARSEABLE_METADATA_FILE_NAME,
     PARSEABLE_ROOT_DIRECTORY,
     STREAM_ROOT_DIRECTORY,
@@ -249,6 +250,7 @@ class ObjectStoreMetastore(Metastore):
         "roles": f"{USERS_ROOT_DIR}/roles",
         "users": f"{USERS_ROOT_DIR}/users",
         "llmconfigs": ".llmconfigs",
+        "hottier": SETTINGS_ROOT_DIRECTORY,
         "chats": ".chats",
     }
 

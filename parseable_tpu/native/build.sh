@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native fastpath shared library (no external deps).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -fPIC -shared -std=c++17 fastpath.cpp -o libptpu_fastpath.so
+echo "built $(pwd)/libptpu_fastpath.so"

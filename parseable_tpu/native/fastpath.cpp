@@ -1,0 +1,214 @@
+// Native fastpath for parseable_tpu: xxHash64 + HyperLogLog.
+//
+// The reference keeps its whole runtime native (Rust); this build keeps the
+// TPU compute in JAX/XLA and moves the host-side hot helpers to C++:
+//
+//  - ptpu_xxh64:  64-bit xxHash (public algorithm, XXH64 variant) used for
+//    staging schema keys (reference: event/mod.rs:148 uses xxh3) and shard
+//    routing. Implemented from the published specification.
+//  - HLL sketch:  dense HyperLogLog with 2^P registers used by field stats
+//    (reference: storage/field_stats.rs:545-734 custom HLL) and the
+//    high-cardinality distinct-count fallback.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this environment).
+// Build: parseable_tpu/native/build.sh (g++ -O3 -shared).
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// ---------------------------------------------------------------- xxHash64
+// Constants and round structure follow the public XXH64 specification.
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+static inline uint64_t read64(const uint8_t* p) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    return v;
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint64_t xxh_round(uint64_t acc, uint64_t input) {
+    acc += input * P2;
+    acc = rotl64(acc, 31);
+    acc *= P1;
+    return acc;
+}
+
+static inline uint64_t xxh_merge_round(uint64_t acc, uint64_t val) {
+    acc ^= xxh_round(0, val);
+    acc = acc * P1 + P4;
+    return acc;
+}
+
+uint64_t ptpu_xxh64(const uint8_t* data, uint64_t len, uint64_t seed) {
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    uint64_t h;
+    if (len >= 32) {
+        uint64_t v1 = seed + P1 + P2;
+        uint64_t v2 = seed + P2;
+        uint64_t v3 = seed + 0;
+        uint64_t v4 = seed - P1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = xxh_round(v1, read64(p)); p += 8;
+            v2 = xxh_round(v2, read64(p)); p += 8;
+            v3 = xxh_round(v3, read64(p)); p += 8;
+            v4 = xxh_round(v4, read64(p)); p += 8;
+        } while (p <= limit);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+        h = xxh_merge_round(h, v1);
+        h = xxh_merge_round(h, v2);
+        h = xxh_merge_round(h, v3);
+        h = xxh_merge_round(h, v4);
+    } else {
+        h = seed + P5;
+    }
+    h += len;
+    while (p + 8 <= end) {
+        h ^= xxh_round(0, read64(p));
+        h = rotl64(h, 27) * P1 + P4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h ^= (uint64_t)read32(p) * P1;
+        h = rotl64(h, 23) * P2 + P3;
+        p += 4;
+    }
+    while (p < end) {
+        h ^= (*p) * P5;
+        h = rotl64(h, 11) * P1;
+        p++;
+    }
+    h ^= h >> 33;
+    h *= P2;
+    h ^= h >> 29;
+    h *= P3;
+    h ^= h >> 32;
+    return h;
+}
+
+// hash a batch of length-prefixed strings into out[i]
+void ptpu_xxh64_batch(const uint8_t* buf, const uint64_t* offsets, uint64_t n,
+                      uint64_t seed, uint64_t* out) {
+    for (uint64_t i = 0; i < n; i++) {
+        out[i] = ptpu_xxh64(buf + offsets[i], offsets[i + 1] - offsets[i], seed);
+    }
+}
+
+// ------------------------------------------------------------- HyperLogLog
+// Dense HLL, P bits of bucket index (2^P registers), standard bias-corrected
+// estimator with linear counting for the small range.
+
+struct Hll {
+    uint32_t p;
+    uint32_t m;
+    uint8_t* regs;
+};
+
+void* ptpu_hll_create(uint32_t p) {
+    if (p < 4 || p > 18) return nullptr;
+    Hll* h = new Hll;
+    h->p = p;
+    h->m = 1u << p;
+    h->regs = new uint8_t[h->m];
+    std::memset(h->regs, 0, h->m);
+    return h;
+}
+
+void ptpu_hll_free(void* ptr) {
+    Hll* h = (Hll*)ptr;
+    if (!h) return;
+    delete[] h->regs;
+    delete h;
+}
+
+static inline void hll_add_hash(Hll* h, uint64_t x) {
+    uint32_t idx = (uint32_t)(x >> (64 - h->p));
+    uint64_t rest = x << h->p;
+    uint8_t rank = rest == 0 ? (uint8_t)(64 - h->p + 1)
+                             : (uint8_t)(__builtin_clzll(rest) + 1);
+    if (rank > h->regs[idx]) h->regs[idx] = rank;
+}
+
+void ptpu_hll_add(void* ptr, const uint8_t* data, uint64_t len) {
+    hll_add_hash((Hll*)ptr, ptpu_xxh64(data, len, 0));
+}
+
+void ptpu_hll_add_batch(void* ptr, const uint8_t* buf, const uint64_t* offsets,
+                        uint64_t n) {
+    Hll* h = (Hll*)ptr;
+    for (uint64_t i = 0; i < n; i++) {
+        hll_add_hash(h, ptpu_xxh64(buf + offsets[i], offsets[i + 1] - offsets[i], 0));
+    }
+}
+
+void ptpu_hll_add_hashes(void* ptr, const uint64_t* hashes, uint64_t n) {
+    Hll* h = (Hll*)ptr;
+    for (uint64_t i = 0; i < n; i++) hll_add_hash(h, hashes[i]);
+}
+
+int ptpu_hll_merge(void* dst_ptr, const void* src_ptr) {
+    Hll* dst = (Hll*)dst_ptr;
+    const Hll* src = (const Hll*)src_ptr;
+    if (dst->p != src->p) return -1;
+    for (uint32_t i = 0; i < dst->m; i++) {
+        if (src->regs[i] > dst->regs[i]) dst->regs[i] = src->regs[i];
+    }
+    return 0;
+}
+
+double ptpu_hll_estimate(const void* ptr) {
+    const Hll* h = (const Hll*)ptr;
+    double m = (double)h->m;
+    double alpha;
+    switch (h->m) {
+        case 16: alpha = 0.673; break;
+        case 32: alpha = 0.697; break;
+        case 64: alpha = 0.709; break;
+        default: alpha = 0.7213 / (1.0 + 1.079 / m); break;
+    }
+    double sum = 0.0;
+    uint32_t zeros = 0;
+    for (uint32_t i = 0; i < h->m; i++) {
+        sum += std::ldexp(1.0, -(int)h->regs[i]);
+        if (h->regs[i] == 0) zeros++;
+    }
+    double e = alpha * m * m / sum;
+    if (e <= 2.5 * m && zeros > 0) {
+        e = m * std::log(m / (double)zeros);  // linear counting
+    }
+    return e;
+}
+
+// serialize registers for cross-process merge (field stats upload)
+uint64_t ptpu_hll_bytes(const void* ptr) { return ((const Hll*)ptr)->m; }
+
+void ptpu_hll_serialize(const void* ptr, uint8_t* out) {
+    const Hll* h = (const Hll*)ptr;
+    std::memcpy(out, h->regs, h->m);
+}
+
+int ptpu_hll_deserialize(void* ptr, const uint8_t* data, uint64_t len) {
+    Hll* h = (Hll*)ptr;
+    if (len != h->m) return -1;
+    std::memcpy(h->regs, data, h->m);
+    return 0;
+}
+
+}  // extern "C"

@@ -83,10 +83,15 @@ class QuerySession:
             api_bounds = TimeBounds(low=tr.start, high=tr.end)
             lp.time_bounds = lp.time_bounds.intersect(api_bounds)
 
+        hot_dir = (
+            self.p.hot_tier.local_dir_for_scan(lp.stream)
+            if getattr(self.p, "hot_tier", None) is not None
+            else self.p.options.hot_tier_storage_path
+        )
         scan = StreamScan(
             self.p,
             lp,
-            hot_tier_dir=self.p.options.hot_tier_storage_path,
+            hot_tier_dir=hot_dir,
             use_hot_stubs=self.engine == "tpu" and lp.is_aggregate,
         )
         result = self._execute(lp, scan)

@@ -68,6 +68,22 @@ class ServerState:
         self.shutting_down = False
         self._sync_stop = threading.Event()
         self._sync_threads: list[threading.Thread] = []
+        self._hot_tier = None
+
+    def hot_tier(self):
+        """Lazily-built hot tier manager, restored from persisted budgets."""
+        if self._hot_tier is None:
+            from parseable_tpu.storage.hottier import HotTierManager
+
+            self._hot_tier = HotTierManager(self.p)
+            self.p.hot_tier = self._hot_tier
+            try:
+                for doc in self.p.metastore.list_documents("hottier"):
+                    if doc.get("stream") and doc.get("size"):
+                        self._hot_tier.set_budget(doc["stream"], doc["size"])
+            except Exception:
+                logger.exception("failed restoring hot tier budgets")
+        return self._hot_tier
 
     # ----- rbac persistence -------------------------------------------------
     def _load_rbac(self) -> RbacStore:
@@ -110,6 +126,8 @@ class ServerState:
             from parseable_tpu.alerts import alert_tick
 
             loop(60, lambda: alert_tick(self), "alerts")
+            self.hot_tier()  # restore budgets
+            loop(60, lambda: self.hot_tier().tick(), "hot-tier")
 
     def stop(self) -> None:
         self.shutting_down = True
@@ -293,12 +311,20 @@ async def _do_ingest(
         return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
     custom_fields = _custom_fields(request)
 
+    log_source_name = request.headers.get(LOG_SOURCE_HEADER, "json")
+
     def work() -> int:
         state.p.create_stream_if_not_exists(
             stream_name, log_source=log_source, telemetry_type=telemetry_type
         )
         return flatten_and_push_logs(
-            state.p, stream_name, payload, log_source, custom_fields, origin_size=len(body)
+            state.p,
+            stream_name,
+            payload,
+            log_source,
+            custom_fields,
+            origin_size=len(body),
+            log_source_name=log_source_name,
         )
 
     try:
@@ -559,6 +585,50 @@ async def put_retention(request: web.Request) -> web.Response:
     except Exception:
         logger.exception("failed persisting retention")
     return web.json_response({"message": "updated retention"})
+
+
+@require(Action.PUT_HOT_TIER, "name")
+async def put_hot_tier(request: web.Request) -> web.Response:
+    """PUT /api/v1/logstream/{name}/hottier {"size": "10GiB"}
+    (reference: hottier.rs + logstream hot-tier endpoints)."""
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    try:
+        state.p.get_stream(name)
+    except StreamNotFound:
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    body = await request.json()
+    try:
+        state.hot_tier().set_budget(name, body.get("size", ""))
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    state.p.metastore.put_document("hottier", name, {"stream": name, "size": body.get("size")})
+    # reconcile eagerly so the tier warms without waiting for the tick
+    await asyncio.get_running_loop().run_in_executor(
+        state.workers, state.hot_tier().reconcile, name
+    )
+    return web.json_response({"message": f"hot tier enabled for {name}"})
+
+
+@require(Action.GET_HOT_TIER, "name")
+async def get_hot_tier(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    budget = state.hot_tier().get_budget(name)
+    if budget is None:
+        return web.json_response({"error": "hot tier not enabled"}, status=404)
+    return web.json_response(
+        {"size": budget, "used_size": state.hot_tier().used_bytes(name)}
+    )
+
+
+@require(Action.DELETE_HOT_TIER, "name")
+async def delete_hot_tier(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    state.hot_tier().disable(name)
+    state.p.metastore.delete_document("hottier", name)
+    return web.json_response({"message": f"hot tier disabled for {name}"})
 
 
 @require(Action.GET_RETENTION, "name")
@@ -828,6 +898,9 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/logstream/{name}/stats", stream_stats)
     r.add_put("/api/v1/logstream/{name}/retention", put_retention)
     r.add_get("/api/v1/logstream/{name}/retention", get_retention)
+    r.add_put("/api/v1/logstream/{name}/hottier", put_hot_tier)
+    r.add_get("/api/v1/logstream/{name}/hottier", get_hot_tier)
+    r.add_delete("/api/v1/logstream/{name}/hottier", delete_hot_tier)
 
     # rbac
     r.add_post("/api/v1/user/{username}", put_user)

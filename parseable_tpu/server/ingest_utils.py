@@ -100,8 +100,14 @@ def flatten_and_push_logs(
     log_source: LogSource,
     custom_fields: dict[str, str] | None = None,
     origin_size: int = 0,
+    log_source_name: str | None = None,
 ) -> int:
-    """Parse+flatten by source, then push into staging. Returns row count."""
+    """Parse+flatten by source, then push into staging. Returns row count.
+
+    `log_source_name` carries the raw X-P-Log-Source value: names matching a
+    known format (event/known_schema.py) get regex field extraction applied
+    to each record's raw line (reference: KNOWN_SCHEMA_LIST
+    extract_from_inline_log, ingest.rs:114-122)."""
     stream = p.get_stream(stream_name)
     meta = stream.metadata
 
@@ -122,6 +128,13 @@ def flatten_and_push_logs(
             meta.custom_partition,
             p.options.event_max_chunk_age,
         )
+        if log_source == LogSource.CUSTOM and log_source_name:
+            from parseable_tpu.event.known_schema import KNOWN_FORMATS, KNOWN_SCHEMA_LIST
+
+            if log_source_name in KNOWN_FORMATS:
+                rows = [
+                    KNOWN_SCHEMA_LIST.check_or_extract(r, log_source_name) for r in rows
+                ]
     if not rows:
         return 0
     field_count = len({k for r in rows for k in r})

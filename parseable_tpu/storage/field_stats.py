@@ -1,0 +1,98 @@
+"""Field statistics ("pstats"): per-field distinct/count stats on upload.
+
+Parity target (reference: src/storage/field_stats.rs:119-734): when a
+parquet file uploads, compute per-field stats — count, null count, distinct
+count (HyperLogLog, native C++ sketch from parseable_tpu.native), and the
+top distinct values with frequencies — and ingest them as rows into the
+internal `pstats` stream so they're queryable like any other data.
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import UTC, datetime
+from typing import Any
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from parseable_tpu import FIELD_STATS_STREAM_NAME
+from parseable_tpu.native import Hll
+
+logger = logging.getLogger(__name__)
+
+MAX_TOP_VALUES = 10
+# columns beyond this distinct share are treated as unbounded (no top-values)
+DISTINCT_SAMPLE_LIMIT = 100_000
+
+
+def compute_field_stats(stream_name: str, table: pa.Table) -> list[dict[str, Any]]:
+    """One stats row per field (reference: calculate_field_stats :119-544)."""
+    rows: list[dict[str, Any]] = []
+    collected_at = datetime.now(UTC).isoformat()
+    for name in table.column_names:
+        col = table.column(name)
+        count = len(col)
+        null_count = col.null_count
+        try:
+            distinct = _distinct_count(col)
+        except Exception:
+            logger.exception("distinct count failed for %s.%s", stream_name, name)
+            distinct = None
+        top = _top_values(col)
+        rows.append(
+            {
+                "stream": stream_name,
+                "field": name,
+                "count": count,
+                "null_count": null_count,
+                "distinct_count": distinct,
+                "top_values": top,
+                "collected_at": collected_at,
+            }
+        )
+    return rows
+
+
+def _distinct_count(col: pa.ChunkedArray) -> int:
+    n = len(col)
+    if n <= DISTINCT_SAMPLE_LIMIT:
+        return pc.count_distinct(col).as_py()
+    # large columns: HLL sketch over the values (native C++)
+    hll = Hll(14)
+    for chunk in col.chunks if isinstance(col, pa.ChunkedArray) else [col]:
+        hll.add_strings(chunk.to_pylist())
+    return int(hll.estimate())
+
+
+def _top_values(col: pa.ChunkedArray) -> list[dict[str, Any]]:
+    try:
+        vc = col.value_counts()
+        if len(vc) == 0:
+            return []
+        values = vc.field("values")
+        counts = vc.field("counts")
+        idx = pc.sort_indices(counts, sort_keys=[("", "descending")])[:MAX_TOP_VALUES]
+        out = []
+        for i in idx.to_pylist():
+            v = values[i].as_py()
+            out.append({"value": str(v) if v is not None else None, "count": counts[i].as_py()})
+        return out
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return []
+
+
+def ingest_field_stats(p, stream_name: str, table: pa.Table) -> None:
+    """Compute stats for an uploaded file and push them into `pstats`."""
+    import json as _json
+
+    from parseable_tpu.event.json_format import JsonEvent
+
+    rows = compute_field_stats(stream_name, table)
+    for r in rows:
+        r["top_values"] = _json.dumps(r["top_values"], default=str)
+    stats_stream = p.create_stream_if_not_exists(
+        FIELD_STATS_STREAM_NAME, stream_type="Internal"
+    )
+    ev = JsonEvent(rows, FIELD_STATS_STREAM_NAME).into_event(stats_stream.metadata)
+    ev.process(stats_stream, commit_schema=p.commit_schema)

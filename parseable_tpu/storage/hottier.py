@@ -1,0 +1,154 @@
+"""Disk hot tier: local NVMe cache of object-store parquet.
+
+Parity target (reference: src/hottier.rs): per-stream size budgets, a
+reconcile loop that downloads manifest files newest-first within the budget,
+oldest-date eviction when over, and a disk-usage guard. The scan provider
+(query/provider.py) reads hot-tier copies before hitting the object store —
+and on this build the *device* hot set (ops/hotset.py) sits one tier above,
+so the hierarchy is HBM -> NVMe -> object store.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import shutil
+import threading
+from pathlib import Path
+
+from parseable_tpu.core import Parseable
+from parseable_tpu.metastore import MetastoreError
+from parseable_tpu.utils.metrics import HOT_TIER_DOWNLOAD_BYTES, HOT_TIER_SIZE
+
+logger = logging.getLogger(__name__)
+
+_SIZE_RE = re.compile(r"^\s*([\d.]+)\s*(B|KB|MB|GB|TB|KiB|MiB|GiB|TiB)?\s*$", re.I)
+_UNITS = {
+    "b": 1,
+    "kb": 10**3, "mb": 10**6, "gb": 10**9, "tb": 10**12,
+    "kib": 2**10, "mib": 2**20, "gib": 2**30, "tib": 2**40,
+}
+MIN_HOT_TIER_BYTES = 10 * 2**20  # parity with reference's sanity floor
+
+
+def parse_human_size(text: str) -> int:
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise ValueError(f"invalid size {text!r}; expected e.g. '10GiB'")
+    value = float(m.group(1))
+    unit = (m.group(2) or "B").lower()
+    return int(value * _UNITS[unit])
+
+
+class HotTierManager:
+    """Per-stream hot-tier reconcile + eviction (reference: hottier.rs:100)."""
+
+    def __init__(self, p: Parseable, base_dir: Path | None = None):
+        self.p = p
+        self.base = Path(base_dir or p.options.hot_tier_storage_path or (p.options.staging_dir() / "hot-tier"))
+        self.base.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        # stream -> size budget bytes
+        self.budgets: dict[str, int] = {}
+
+    # ----- budgets ---------------------------------------------------------
+    def set_budget(self, stream: str, size: str | int) -> None:
+        size_bytes = parse_human_size(size) if isinstance(size, str) else int(size)
+        if size_bytes < MIN_HOT_TIER_BYTES:
+            raise ValueError(f"hot tier size must be >= {MIN_HOT_TIER_BYTES} bytes")
+        free = shutil.disk_usage(self.base).free
+        if size_bytes > free:
+            raise ValueError(f"hot tier size {size_bytes} exceeds free disk {free}")
+        with self._lock:
+            self.budgets[stream] = size_bytes
+
+    def get_budget(self, stream: str) -> int | None:
+        return self.budgets.get(stream)
+
+    def disable(self, stream: str) -> None:
+        with self._lock:
+            self.budgets.pop(stream, None)
+        shutil.rmtree(self.base / stream, ignore_errors=True)
+        HOT_TIER_SIZE.labels(stream).set(0)
+
+    def used_bytes(self, stream: str) -> int:
+        root = self.base / stream
+        if not root.exists():
+            return 0
+        return sum(f.stat().st_size for f in root.rglob("*") if f.is_file())
+
+    # ----- reconcile -------------------------------------------------------
+    def reconcile(self, stream: str) -> int:
+        """Download newest-first within budget; evict oldest when over
+        (reference: hottier.rs:281-432 + LRU-by-date :1422-1595).
+        Returns number of files downloaded."""
+        budget = self.budgets.get(stream)
+        if budget is None:
+            return 0
+        try:
+            fmts = self.p.metastore.get_all_stream_jsons(stream)
+        except MetastoreError:
+            return 0
+        items = []
+        for fmt in fmts:
+            items.extend(fmt.snapshot.manifest_list)
+        # newest manifests first
+        items.sort(key=lambda i: i.time_lower_bound, reverse=True)
+        downloaded = 0
+        used = self.used_bytes(stream)
+        wanted: set[Path] = set()
+        for item in items:
+            prefix = item.manifest_path[: -len("/manifest.json")]
+            manifest = self.p.metastore.get_manifest(prefix)
+            if manifest is None:
+                continue
+            for f in sorted(manifest.files, key=lambda x: x.file_path, reverse=True):
+                local = self.base / stream / f.file_path
+                wanted.add(local)
+                if local.exists():
+                    continue
+                if used + f.file_size > budget:
+                    continue  # out of budget: skip older files
+                try:
+                    self.p.storage.download_file(f.file_path, local)
+                except Exception:
+                    logger.warning("hot tier download failed for %s", f.file_path)
+                    continue
+                used += f.file_size
+                downloaded += 1
+                HOT_TIER_DOWNLOAD_BYTES.labels(stream).inc(f.file_size)
+        self._evict(stream, budget, wanted)
+        HOT_TIER_SIZE.labels(stream).set(self.used_bytes(stream))
+        return downloaded
+
+    def _evict(self, stream: str, budget: int, wanted: set[Path]) -> None:
+        root = self.base / stream
+        if not root.exists():
+            return
+        files = sorted(
+            (f for f in root.rglob("*.parquet") if f.is_file()),
+            key=lambda f: str(f),  # date=... lexicographic == chronological
+        )
+        # drop files no longer in any manifest (retention ran), then oldest
+        used = sum(f.stat().st_size for f in files)
+        for f in files:
+            if f not in wanted:
+                used -= f.stat().st_size
+                f.unlink(missing_ok=True)
+        files = [f for f in files if f.exists()]
+        i = 0
+        while used > budget and i < len(files):
+            used -= files[i].stat().st_size
+            files[i].unlink(missing_ok=True)
+            i += 1
+
+    def tick(self) -> None:
+        for stream in list(self.budgets):
+            try:
+                self.reconcile(stream)
+            except Exception:
+                logger.exception("hot tier reconcile failed for %s", stream)
+
+    def local_dir_for_scan(self, stream: str) -> Path | None:
+        """Directory the scan provider should probe for this stream."""
+        return (self.base / stream) if stream in self.budgets else None

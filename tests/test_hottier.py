@@ -1,0 +1,63 @@
+"""Disk hot tier: budgets, reconcile, eviction, scan integration."""
+
+import pytest
+
+from parseable_tpu.event.json_format import JsonEvent
+from parseable_tpu.query.session import QuerySession
+from parseable_tpu.storage.hottier import HotTierManager, parse_human_size
+
+
+def load_stream(p, name, n=500):
+    stream = p.create_stream_if_not_exists(name)
+    recs = [{"k": f"v{i % 7}", "x": float(i)} for i in range(n)]
+    ev = JsonEvent(recs, name).into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+    return stream
+
+
+def test_parse_human_size():
+    assert parse_human_size("10GiB") == 10 * 2**30
+    assert parse_human_size("500 MB") == 500 * 10**6
+    with pytest.raises(ValueError):
+        parse_human_size("lots")
+
+
+def test_reconcile_downloads_and_scan_uses_local(parseable, tmp_path):
+    p = parseable
+    load_stream(p, "tiered")
+    mgr = HotTierManager(p, tmp_path / "ht")
+    p.hot_tier = mgr
+    mgr.set_budget("tiered", 100 * 2**20)
+    n = mgr.reconcile("tiered")
+    assert n >= 1
+    assert mgr.used_bytes("tiered") > 0
+    # scan reads the hot-tier copy: bytes_scanned counts local reads too but
+    # the object store GET path is skipped (no NoSuchKey surprises either)
+    sess = QuerySession(p, engine="cpu")
+    res = sess.query("SELECT count(*) c FROM tiered")
+    assert res.to_json_rows()[0]["c"] == 500
+    # second reconcile is a no-op
+    assert mgr.reconcile("tiered") == 0
+
+
+def test_budget_eviction(parseable, tmp_path):
+    p = parseable
+    load_stream(p, "small", n=2000)
+    mgr = HotTierManager(p, tmp_path / "ht")
+    mgr.budgets["small"] = 1  # sub-minimum budget forced directly
+    mgr.reconcile("small")
+    assert mgr.used_bytes("small") <= 1 or mgr.used_bytes("small") == 0
+
+
+def test_disable_clears(parseable, tmp_path):
+    p = parseable
+    load_stream(p, "gone")
+    mgr = HotTierManager(p, tmp_path / "ht")
+    mgr.set_budget("gone", 100 * 2**20)
+    mgr.reconcile("gone")
+    assert mgr.used_bytes("gone") > 0
+    mgr.disable("gone")
+    assert mgr.used_bytes("gone") == 0
+    assert mgr.get_budget("gone") is None

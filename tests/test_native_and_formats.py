@@ -1,0 +1,116 @@
+"""Native fastpath (xxh64 + HLL), known-format extraction, field stats."""
+
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.event.known_schema import KNOWN_SCHEMA_LIST
+from parseable_tpu.native import Hll, native_available, xxh64
+from parseable_tpu.storage.field_stats import compute_field_stats
+
+
+def test_native_builds_and_loads():
+    assert native_available()
+
+
+def test_xxh64_spec_vectors():
+    # published XXH64 test vectors
+    assert xxh64(b"") == 0xEF46DB3751D8E999
+    assert xxh64(b"", seed=1) != xxh64(b"")
+    long = bytes(range(256)) * 10  # exercises the 32-byte lane path
+    assert xxh64(long) == xxh64(long)
+    assert xxh64(long) != xxh64(long[:-1])
+
+
+def test_hll_accuracy_and_merge():
+    h = Hll(14)
+    h.add_strings([f"user-{i}" for i in range(50_000)])
+    est = h.estimate()
+    assert abs(est - 50_000) / 50_000 < 0.02
+    h2 = Hll(14)
+    h2.add_strings([f"user-{i}" for i in range(25_000, 75_000)])
+    h.merge(h2)
+    est = h.estimate()
+    assert abs(est - 75_000) / 75_000 < 0.02
+
+
+def test_hll_serialize_roundtrip():
+    h = Hll(14)
+    h.add_strings([str(i) for i in range(1000)])
+    h2 = Hll.deserialize(h.serialize())
+    assert abs(h2.estimate() - h.estimate()) < 1e-9
+
+
+# ------------------------------------------------------------ known formats
+
+
+def test_access_log_extraction():
+    line = '192.168.1.10 - frank [10/Oct/2000:13:55:36 -0700] "GET /apache_pb.gif HTTP/1.0" 200 2326 "http://ref/" "Mozilla/4.08"'
+    fields = KNOWN_SCHEMA_LIST.extract("access_log", line)
+    assert fields["client_ip"] == "192.168.1.10"
+    assert fields["method"] == "GET"
+    assert fields["status"] == "200"
+    assert fields["user_agent"] == "Mozilla/4.08"
+
+
+def test_syslog_rfc3164_and_rfc5424():
+    f1 = KNOWN_SCHEMA_LIST.extract("syslog", "<34>Oct 11 22:14:15 mymachine su[230]: 'su root' failed")
+    assert f1["hostname"] == "mymachine" and f1["app_name"] == "su"
+    f2 = KNOWN_SCHEMA_LIST.extract(
+        "syslog", "<165>1 2003-10-11T22:14:15.003Z host.example app 1234 ID47 an event"
+    )
+    assert f2["version"] == "1" and f2["msg_id"] == "ID47"
+
+
+def test_logfmt_extraction():
+    f = KNOWN_SCHEMA_LIST.extract("logfmt", 'level=info msg="request done" status=200 dur=1.2ms')
+    assert f["level"] == "info" and f["msg"] == "request done" and f["status"] == "200"
+
+
+def test_unmatched_line_passes_through():
+    rec = {"message": "totally unstructured line"}
+    out = KNOWN_SCHEMA_LIST.check_or_extract(rec, "access_log")
+    assert out == rec
+
+
+def test_existing_keys_win_over_extracted():
+    rec = {"message": "<34>Oct 11 22:14:15 mymachine su: x", "hostname": "original"}
+    out = KNOWN_SCHEMA_LIST.check_or_extract(rec, "syslog")
+    assert out["hostname"] == "original"
+    assert out["app_name"] == "su"
+
+
+# -------------------------------------------------------------- field stats
+
+
+def test_compute_field_stats():
+    t = pa.table(
+        {
+            "host": pa.array(["a", "a", "b", None]),
+            "v": pa.array([1.0, 2.0, 2.0, 3.0]),
+        }
+    )
+    rows = compute_field_stats("s", t)
+    by_field = {r["field"]: r for r in rows}
+    assert by_field["host"]["count"] == 4
+    assert by_field["host"]["null_count"] == 1
+    assert by_field["host"]["distinct_count"] == 2  # nulls not counted
+    top = by_field["host"]["top_values"]
+    assert top[0] == {"value": "a", "count": 2}
+
+
+def test_field_stats_pipeline(parseable):
+    """pstats ingestion on upload when P_COLLECT_DATASET_STATS is on."""
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = parseable
+    p.options.collect_dataset_stats = True
+    stream = p.create_stream_if_not_exists("statsy")
+    ev = JsonEvent([{"k": "x"}, {"k": "y"}], "statsy").into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+    pstats = p.streams.get("pstats")
+    assert pstats is not None
+    batches = pstats.staging_batches()
+    rows = sum(b.num_rows for b in batches)
+    assert rows >= 2  # one row per field of 'statsy'
