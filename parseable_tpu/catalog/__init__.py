@@ -137,12 +137,16 @@ class Manifest:
     version: str = CURRENT_MANIFEST_VERSION
     files: list[ManifestFile] = field(default_factory=list)
 
-    def apply_change(self, change: ManifestFile) -> None:
+    def apply_change(self, change: ManifestFile) -> "ManifestFile | None":
+        """Insert or replace by file_path. Returns the replaced entry (if
+        any) so callers can adjust counters by delta instead of re-adding —
+        a re-upload after a failed unlink must not double-count stats."""
         for i, f in enumerate(self.files):
             if f.file_path == change.file_path:
                 self.files[i] = change
-                return
+                return f
         self.files.append(change)
+        return None
 
     def to_json(self) -> dict:
         return {"version": self.version, "files": [f.to_json() for f in self.files]}

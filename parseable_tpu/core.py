@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import re
+import threading
 from datetime import UTC, datetime, timedelta
 from pathlib import Path
 
@@ -86,6 +87,19 @@ class Parseable:
         self.streams = Streams(self.options, ingestor_id)
         self.uploader = UploadPool(self.storage, self.options.upload_concurrency)
         self.hot_tier = None  # set by the server when hot tier is enabled
+        self._json_locks: dict[str, threading.Lock] = {}
+        self._json_locks_guard = threading.Lock()
+
+    def stream_json_lock(self, name: str) -> threading.Lock:
+        """Serializes read-modify-write of a stream's `.stream.json`.
+
+        The object-sync thread (update_snapshot), the retention thread, and
+        HTTP handlers (put_retention / stream updates) all mutate the same
+        document; unsynchronized interleavings lose updates (e.g. retention
+        writing back a stale snapshot drops manifest items a concurrent sync
+        just added, making uploaded parquet unqueryable)."""
+        with self._json_locks_guard:
+            return self._json_locks.setdefault(name, threading.Lock())
 
     # ------------------------------------------------------------------ node
 
@@ -310,47 +324,55 @@ class Parseable:
     def update_snapshot(self, stream: Stream, entries: list) -> None:
         """Append manifest entries + refresh the stream snapshot
         (reference: catalog/mod.rs:108-497)."""
-        try:
-            fmt = self.metastore.get_stream_json(stream.name, self._node_suffix)
-        except MetastoreError:
-            fmt = ObjectStoreFormat(created_at=stream.metadata.created_at or rfc3339_now())
+        with self.stream_json_lock(stream.name):
+            try:
+                fmt = self.metastore.get_stream_json(stream.name, self._node_suffix)
+            except MetastoreError:
+                fmt = ObjectStoreFormat(created_at=stream.metadata.created_at or rfc3339_now())
 
-        for entry in entries:
-            lower, upper = self._file_time_bounds(entry)
-            day_lower = lower.replace(hour=0, minute=0, second=0, microsecond=0)
-            day_upper = day_lower + timedelta(days=1) - timedelta(milliseconds=1)
-            prefix = partition_path(stream.name, lower, lower)
-            manifest = self.metastore.get_manifest(prefix) or Manifest()
-            manifest.apply_change(entry)
-            self.metastore.put_manifest(prefix, manifest)
+            for entry in entries:
+                lower, upper = self._file_time_bounds(entry)
+                day_lower = lower.replace(hour=0, minute=0, second=0, microsecond=0)
+                day_upper = day_lower + timedelta(days=1) - timedelta(milliseconds=1)
+                prefix = partition_path(stream.name, lower, lower)
+                manifest = self.metastore.get_manifest(prefix) or Manifest()
+                replaced = manifest.apply_change(entry)
+                self.metastore.put_manifest(prefix, manifest)
 
-            manifest_path_full = f"{prefix}/manifest.json"
-            item = next(
-                (m for m in fmt.snapshot.manifest_list if m.manifest_path == manifest_path_full),
-                None,
-            )
-            if item is None:
-                item = ManifestItem(
-                    manifest_path=manifest_path_full,
-                    time_lower_bound=day_lower,
-                    time_upper_bound=day_upper,
+                # On replacement (retried upload of the same file_path) count
+                # only the delta vs the replaced entry — not the full amounts.
+                d_rows = entry.num_rows - (replaced.num_rows if replaced else 0)
+                d_ingest = entry.ingestion_size - (replaced.ingestion_size if replaced else 0)
+                d_size = entry.file_size - (replaced.file_size if replaced else 0)
+
+                manifest_path_full = f"{prefix}/manifest.json"
+                item = next(
+                    (m for m in fmt.snapshot.manifest_list if m.manifest_path == manifest_path_full),
+                    None,
                 )
-                fmt.snapshot.manifest_list.append(item)
-            item.events_ingested += entry.num_rows
-            item.ingestion_size += entry.ingestion_size
-            item.storage_size += entry.file_size
-            fmt.stats.events += entry.num_rows
-            fmt.stats.storage += entry.file_size
-            fmt.stats.lifetime_events += entry.num_rows
-            fmt.stats.lifetime_storage += entry.file_size
-            date = lower.date().isoformat()
-            EVENTS_STORAGE_SIZE_DATE.labels("data", stream.name, "json", date).inc(entry.file_size)
-            LIFETIME_EVENTS_STORAGE_SIZE.labels("data", stream.name, "json").inc(entry.file_size)
-            STORAGE_SIZE.labels("data", stream.name, "json").inc(entry.file_size)
+                if item is None:
+                    item = ManifestItem(
+                        manifest_path=manifest_path_full,
+                        time_lower_bound=day_lower,
+                        time_upper_bound=day_upper,
+                    )
+                    fmt.snapshot.manifest_list.append(item)
+                item.events_ingested += d_rows
+                item.ingestion_size += d_ingest
+                item.storage_size += d_size
+                fmt.stats.events += d_rows
+                fmt.stats.storage += d_size
+                fmt.stats.lifetime_events += d_rows
+                fmt.stats.lifetime_storage += d_size
+                date = lower.date().isoformat()
+                if d_size > 0:
+                    EVENTS_STORAGE_SIZE_DATE.labels("data", stream.name, "json", date).inc(d_size)
+                    LIFETIME_EVENTS_STORAGE_SIZE.labels("data", stream.name, "json").inc(d_size)
+                    STORAGE_SIZE.labels("data", stream.name, "json").inc(d_size)
 
-        if fmt.first_event_at is None and stream.metadata.first_event_at:
-            fmt.first_event_at = stream.metadata.first_event_at
-        self.metastore.put_stream_json(stream.name, fmt, self._node_suffix)
+            if fmt.first_event_at is None and stream.metadata.first_event_at:
+                fmt.first_event_at = stream.metadata.first_event_at
+            self.metastore.put_stream_json(stream.name, fmt, self._node_suffix)
 
     # -------------------------------------------------------------- shutdown
 

@@ -74,9 +74,13 @@ def extract_time_bounds(where: S.Expr | None, time_col: str = DEFAULT_TIMESTAMP_
                 dt = _literal_dt(e.right)
                 if dt is None:
                     return
-                if e.op in (">", ">="):
+                # bounds are [low, high) at millisecond resolution, so the
+                # strict ops need a 1 ms nudge to stay exclusive/inclusive.
+                if e.op == ">":
+                    bounds = bounds.intersect(TimeBounds(low=dt + timedelta(milliseconds=1)))
+                elif e.op == ">=":
                     bounds = bounds.intersect(TimeBounds(low=dt))
-                elif e.op in ("<",):
+                elif e.op == "<":
                     bounds = bounds.intersect(TimeBounds(high=dt))
                 elif e.op == "<=":
                     bounds = bounds.intersect(TimeBounds(high=dt + timedelta(milliseconds=1)))
@@ -86,9 +90,13 @@ def extract_time_bounds(where: S.Expr | None, time_col: str = DEFAULT_TIMESTAMP_
                 dt = _literal_dt(e.left)
                 if dt is None:
                     return
-                if e.op in ("<", "<="):
+                if e.op == "<":  # dt < ts  ==  ts > dt
+                    bounds = bounds.intersect(TimeBounds(low=dt + timedelta(milliseconds=1)))
+                elif e.op == "<=":
                     bounds = bounds.intersect(TimeBounds(low=dt))
-                elif e.op in (">", ">="):
+                elif e.op == ">":  # dt > ts  ==  ts < dt
+                    bounds = bounds.intersect(TimeBounds(high=dt))
+                elif e.op == ">=":
                     bounds = bounds.intersect(TimeBounds(high=dt + timedelta(milliseconds=1)))
 
     visit(where)

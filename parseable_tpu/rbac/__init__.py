@@ -106,7 +106,7 @@ _EDITOR_ACTIONS = [
     Action.LIST_DASHBOARD, Action.CREATE_FILTER, Action.GET_FILTER,
     Action.DELETE_FILTER, Action.LIST_FILTER, Action.CREATE_CORRELATION,
     Action.GET_CORRELATION, Action.DELETE_CORRELATION, Action.LIST_CORRELATION,
-    Action.GET_ABOUT, Action.LIVE_TAIL, Action.QUERY_LLM,
+    Action.GET_ABOUT, Action.LIVE_TAIL, Action.QUERY_LLM, Action.METRICS,
 ]
 
 _WRITER_ACTIONS = [

@@ -213,6 +213,7 @@ async def readiness(request: web.Request) -> web.Response:
         return web.Response(status=503)
 
 
+@require(Action.GET_ABOUT)
 async def about(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     return web.json_response(
@@ -230,7 +231,11 @@ async def about(request: web.Request) -> web.Response:
     )
 
 
+@require(Action.METRICS)
 async def metrics_handler(request: web.Request) -> web.Response:
+    """Reference authorizes /metrics and /about with Action::Metrics and
+    Action::GetAbout (server.rs:251,785) — without the guard any
+    single-stream INGEST user can read global volumes and stream names."""
     return web.Response(body=prom.render(), content_type="text/plain")
 
 
@@ -474,9 +479,10 @@ async def put_stream(request: web.Request) -> web.Response:
                 return web.json_response(
                     {"error": "time partition cannot be changed after creation"}, status=400
                 )
-            fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
-            fmt.custom_partition = stream.metadata.custom_partition
-            state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+            with state.p.stream_json_lock(name):
+                fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
+                fmt.custom_partition = stream.metadata.custom_partition
+                state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
             return web.json_response({"message": f"updated stream {name}"})
         state.p.create_stream_if_not_exists(
             name,
@@ -579,9 +585,10 @@ async def put_retention(request: web.Request) -> web.Response:
         return web.json_response({"error": f"stream {name} not found"}, status=404)
     stream.metadata.retention = body
     try:
-        fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
-        fmt.retention = body
-        state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+        with state.p.stream_json_lock(name):
+            fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
+            fmt.retention = body
+            state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
     except Exception:
         logger.exception("failed persisting retention")
     return web.json_response({"message": "updated retention"})

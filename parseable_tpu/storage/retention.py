@@ -66,33 +66,37 @@ def apply_retention(p: Parseable, stream_name: str, days: int, now: datetime | N
     now = now or datetime.now(UTC)
     cutoff = (now - timedelta(days=days)).date()
     removed: list[str] = []
-    try:
-        fmt = p.metastore.get_stream_json(stream_name, p._node_suffix)
-    except MetastoreError:
-        return removed
+    # Hold the stream-json lock across the whole read-modify-write so a
+    # concurrent update_snapshot (object-sync thread) can't be clobbered by
+    # our stale copy of the snapshot.
+    with p.stream_json_lock(stream_name):
+        try:
+            fmt = p.metastore.get_stream_json(stream_name, p._node_suffix)
+        except MetastoreError:
+            return removed
 
-    keep = []
-    for item in fmt.snapshot.manifest_list:
-        if item.time_upper_bound.date() < cutoff:
-            prefix = item.manifest_path[: -len("/manifest.json")]
-            manifest = p.metastore.get_manifest(prefix)
-            if manifest is not None:
-                for f in manifest.files:
-                    try:
-                        p.storage.delete_object(f.file_path)
-                    except Exception:
-                        logger.warning("failed deleting %s", f.file_path)
-            p.metastore.delete_manifest(prefix)
-            p.storage.delete_prefix(prefix)
-            fmt.stats.deleted_events += item.events_ingested
-            fmt.stats.deleted_storage += item.storage_size
-            fmt.stats.events = max(0, fmt.stats.events - item.events_ingested)
-            fmt.stats.storage = max(0, fmt.stats.storage - item.storage_size)
-            removed.append(prefix)
-        else:
-            keep.append(item)
-    if removed:
-        fmt.snapshot.manifest_list = keep
-        p.metastore.put_stream_json(stream_name, fmt, p._node_suffix)
-        logger.info("retention removed %d day-partitions from %s", len(removed), stream_name)
+        keep = []
+        for item in fmt.snapshot.manifest_list:
+            if item.time_upper_bound.date() < cutoff:
+                prefix = item.manifest_path[: -len("/manifest.json")]
+                manifest = p.metastore.get_manifest(prefix)
+                if manifest is not None:
+                    for f in manifest.files:
+                        try:
+                            p.storage.delete_object(f.file_path)
+                        except Exception:
+                            logger.warning("failed deleting %s", f.file_path)
+                p.metastore.delete_manifest(prefix)
+                p.storage.delete_prefix(prefix)
+                fmt.stats.deleted_events += item.events_ingested
+                fmt.stats.deleted_storage += item.storage_size
+                fmt.stats.events = max(0, fmt.stats.events - item.events_ingested)
+                fmt.stats.storage = max(0, fmt.stats.storage - item.storage_size)
+                removed.append(prefix)
+            else:
+                keep.append(item)
+        if removed:
+            fmt.snapshot.manifest_list = keep
+            p.metastore.put_stream_json(stream_name, fmt, p._node_suffix)
+            logger.info("retention removed %d day-partitions from %s", len(removed), stream_name)
     return removed

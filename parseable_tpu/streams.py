@@ -19,6 +19,7 @@ import os
 import re
 import socket
 import threading
+import uuid
 from dataclasses import dataclass, field
 from datetime import UTC, datetime
 from pathlib import Path
@@ -216,8 +217,14 @@ class Stream:
         # (reference sorts descending by p_timestamp; streams.rs:701-764)
         if DEFAULT_TIMESTAMP_KEY in table.column_names:
             table = table.sort_by([(DEFAULT_TIMESTAMP_KEY, "descending")])
+        # Unique id per conversion (reference appends a random ULID;
+        # streams.rs arrow_path_to_parquet): a deterministic name would let a
+        # second conversion of the same minute bucket (query-forced flush,
+        # retried upload) silently overwrite the first parquet — data loss —
+        # and collide in the object-store key and manifest file_path.
+        uid = uuid.uuid4().hex[:16]
         suffix = f".{part_index}" if part_index else ""
-        final = self.data_path / f"{group_key}{suffix}.data.parquet"
+        final = self.data_path / f"{group_key}{suffix}.{uid}.data.parquet"
         part = final.with_name(final.name + ".part.parquet")
         pq.write_table(
             table,

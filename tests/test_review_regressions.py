@@ -128,3 +128,90 @@ def test_unrepresentable_bounds_fall_back_cleanly():
     tpu = TpuQueryExecutor(lp2).execute(iter([t])).to_pylist()
     assert sorted(map(str, cpu)) == sorted(map(str, tpu))
     assert sum(r["c"] for r in cpu) == 100  # nothing dropped
+
+
+def test_parquet_conversion_names_are_unique(parseable):
+    """Two conversions of the same minute bucket must not overwrite each
+    other's parquet (advisor: deterministic names silently lost data)."""
+    from parseable_tpu.event.format import LogSource
+
+    stream = parseable.create_stream_if_not_exists("uniq", log_source=LogSource.JSON)
+    ts = datetime(2024, 5, 1, 10, 0, tzinfo=UTC)
+
+    def one_batch(v):
+        return pa.record_batch(
+            {
+                DEFAULT_TIMESTAMP_KEY: pa.array([ts], pa.timestamp("ms")),
+                "v": pa.array([float(v)]),
+            }
+        )
+
+    stream.push("k", one_batch(1), ts)
+    stream.flush(forced=True)
+    first = stream.convert_disk_files_to_parquet()
+    stream.push("k", one_batch(2), ts)
+    stream.flush(forced=True)
+    second = stream.convert_disk_files_to_parquet()
+    assert first and second
+    assert first[0].name != second[0].name
+    # both files exist — neither conversion clobbered the other
+    assert first[0].is_file() and second[0].is_file()
+    # and their object-store keys differ too
+    k1 = stream.stream_relative_path(first[0])
+    k2 = stream.stream_relative_path(second[0])
+    assert k1 != k2
+
+
+def test_strict_gt_excluded_from_manifest_count(parseable):
+    """`p_timestamp > T` must not count rows at exactly T via the manifest
+    fast path (advisor: inclusive low bound off-by-one)."""
+    from parseable_tpu.query.planner import extract_time_bounds
+    from parseable_tpu.query.sql import parse_sql
+
+    q = parse_sql("SELECT count(*) FROM t WHERE p_timestamp > '2024-05-01T10:00:00Z'")
+    b = extract_time_bounds(q.where)
+    assert b.low == datetime(2024, 5, 1, 10, 0, 0, 1000, tzinfo=UTC)
+    # reversed literal-first form: 'T' > p_timestamp == p_timestamp < T
+    q2 = parse_sql("SELECT count(*) FROM t WHERE '2024-05-01T10:00:00Z' > p_timestamp")
+    b2 = extract_time_bounds(q2.where)
+    assert b2.high == datetime(2024, 5, 1, 10, 0, tzinfo=UTC)
+
+
+def test_manifest_replacement_does_not_double_count():
+    """Re-applying a manifest entry for the same file_path returns the
+    replaced entry so snapshot stats can be delta-adjusted (advisor)."""
+    from parseable_tpu.catalog import Manifest, ManifestFile
+
+    m = Manifest()
+    e1 = ManifestFile(file_path="p/a.parquet", num_rows=10, file_size=100)
+    e2 = ManifestFile(file_path="p/a.parquet", num_rows=10, file_size=100)
+    assert m.apply_change(e1) is None
+    replaced = m.apply_change(e2)
+    assert replaced is e1
+    assert len(m.files) == 1
+
+
+def test_update_snapshot_replacement_stats(parseable):
+    """update_snapshot applied twice with the same file_path keeps stats at
+    one file's worth."""
+    from parseable_tpu.catalog import Column, ManifestFile, TypedStatistics
+    from parseable_tpu.event.format import LogSource
+
+    stream = parseable.create_stream_if_not_exists("dd", log_source=LogSource.JSON)
+    ts_ms = int(datetime(2024, 5, 1, 10, 0, tzinfo=UTC).timestamp() * 1000)
+    entry = ManifestFile(
+        file_path="dd/x.parquet",
+        num_rows=10,
+        file_size=100,
+        ingestion_size=100,
+        columns=[
+            Column(name=DEFAULT_TIMESTAMP_KEY, stats=TypedStatistics("Int", ts_ms, ts_ms))
+        ],
+    )
+    parseable.update_snapshot(stream, [entry])
+    parseable.update_snapshot(stream, [entry])
+    fmt = parseable.metastore.get_stream_json("dd", parseable._node_suffix)
+    assert fmt.stats.events == 10
+    assert fmt.stats.storage == 100
+    assert len(fmt.snapshot.manifest_list) == 1
+    assert fmt.snapshot.manifest_list[0].events_ingested == 10
