@@ -331,7 +331,9 @@ class PredicateCompiler:
             lut = next(luts)
             return jnp.logical_and(lut[dev[col.name]], dev[f"{col.name}__valid"])
         if isinstance(e, S.Literal) and isinstance(e.value, bool):
-            return jnp.full(enc.block_rows, e.value)
+            # size from the device array, not enc.block_rows: under
+            # shard_map this trace sees the per-device row shard
+            return jnp.full(dev["__ones"].shape[0], e.value)
         raise UnsupportedOnDevice(f"predicate not device-mappable: {type(e).__name__}")
 
     # ---------------------------------------------------------- shared bits
@@ -413,7 +415,7 @@ class PredicateCompiler:
             return jnp.logical_and(lut[dev[col.name]], valid)
         if col.kind in ("num", "bool"):
             lits = [self._literal_of(i) for i in e.items]
-            mask = jnp.zeros(enc.block_rows, dtype=bool)
+            mask = jnp.zeros_like(valid)
             for v in lits:
                 mask = jnp.logical_or(mask, dev[col.name] == float(v))
             if e.negated:
@@ -550,22 +552,86 @@ class PlanLayout:
 _PROGRAM_CACHE: dict[tuple, Callable] = {}
 
 
+# ------------------------------------------------------------------- the mesh
+# The reference scales queries by fanning results across querier/ingestor
+# nodes and merging JSON host-side (cluster/mod.rs:1785-1964,
+# stream_schema_provider.rs:566-585). Here the same reduction is a psum tree
+# over the chip mesh's `data` axis (parallel/mesh.py): row blocks shard
+# across devices, each device folds its shard with the same fused kernel,
+# and partials combine over ICI inside the jitted program.
+
+_MESH_CACHE: dict[str, Any] = {}
+
+
+def resolve_mesh(options: Options | None = None):
+    """Device mesh for distributed aggregation, or None (single chip).
+
+    `P_TPU_MESH`: "off" disables; "data:N" / "N" pins the data-axis size;
+    empty auto-shards over all visible devices when more than one exists.
+    The axis size is clamped to the largest power of two so it always
+    divides the power-of-two row blocks.
+    """
+    shape = (options.mesh_shape if options is not None else "").strip().lower()
+    if shape in _MESH_CACHE:
+        return _MESH_CACHE[shape]
+    mesh = None
+    try:
+        if shape != "off":
+            import jax
+
+            n_avail = jax.device_count()
+            want = None
+            if shape.startswith("data:"):
+                want = int(shape.split(":", 1)[1])
+            elif shape.isdigit():
+                want = int(shape)
+            elif n_avail > 1:
+                want = n_avail
+            if want and want > 1:
+                n = min(want, n_avail)
+                n = 1 << (n.bit_length() - 1)  # largest pow2 <= n
+                if n > 1:
+                    from parseable_tpu.parallel.mesh import make_mesh
+
+                    mesh = make_mesh(n)
+    except Exception:
+        logger.exception("mesh resolution failed; running single-chip")
+        mesh = None
+    _MESH_CACHE[shape] = mesh
+    return mesh
+
+
+def _mesh_shardings(mesh):
+    """(row-sharded, replicated) placement specs for a data-axis mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("data")), NamedSharding(mesh, P())
+
+
 def _expr_fingerprint(e: S.Expr | None) -> str:
     return repr(e)  # dataclass repr is structural and stable
 
 
-# device-resident all-true masks per block size; eagerly computing jnp.ones
-# per batch costs a full dispatch round trip on tunneled backends
-_ONES_CACHE: dict[int, Any] = {}
+# device-resident all-true masks per (block size, mesh); eagerly computing
+# jnp.ones per batch costs a full dispatch round trip on tunneled backends
+_ONES_CACHE: dict[tuple, Any] = {}
 
 
-def _device_ones(block_rows: int):
+def _device_ones(block_rows: int, mesh=None):
     import jax.numpy as jnp
 
-    ones = _ONES_CACHE.get(block_rows)
+    key = (block_rows, None if mesh is None else id(mesh))
+    ones = _ONES_CACHE.get(key)
     if ones is None:
-        ones = jnp.asarray(np.ones(block_rows, dtype=bool))
-        _ONES_CACHE[block_rows] = ones
+        ones = np.ones(block_rows, dtype=bool)
+        if mesh is not None:
+            import jax
+
+            row_s, _ = _mesh_shardings(mesh)
+            ones = jax.device_put(ones, row_s)
+        else:
+            ones = jnp.asarray(ones)
+        _ONES_CACHE[key] = ones
     return ones
 
 
@@ -575,6 +641,7 @@ class TpuQueryExecutor(QueryExecutor):
     def __init__(self, plan: LogicalPlan, options: Options | None = None):
         super().__init__(plan)
         self.options = options or Options()
+        self.mesh = resolve_mesh(self.options)
 
     # ------------------------------------------------------------------ main
 
@@ -671,7 +738,7 @@ class TpuQueryExecutor(QueryExecutor):
         enc = encode_table(table, needed, dict_columns=dict_cols)
         if enc is None:
             raise UnsupportedOnDevice("unencodable column in batch")
-        dev, nbytes = _transfer(enc)
+        dev, nbytes = _transfer(enc, self.mesh)
         if key is not None:
             _strip_host_values(enc)
             hotset.put(key, HotEntry(dev=dev, meta=enc, nbytes=nbytes))
@@ -727,7 +794,13 @@ class TpuQueryExecutor(QueryExecutor):
                 np.full((n_min, num_groups), np.float32(3.4e38)),
                 np.full((n_max, num_groups), np.float32(-3.4e38)),
             ]
-            return jnp.asarray(np.concatenate(parts, axis=0))
+            host = np.concatenate(parts, axis=0)
+            if self.mesh is not None:
+                import jax
+
+                _, rep_s = _mesh_shardings(self.mesh)
+                return jax.device_put(host, rep_s)
+            return jnp.asarray(host)
 
         def flush(acc_dev, num_groups: int) -> None:
             """ONE device->host readback, then fold into the sparse agg."""
@@ -806,6 +879,7 @@ class TpuQueryExecutor(QueryExecutor):
                     pending_sig[1],
                     pending_sig[2],
                     n_blocks=len(pending),
+                    dev_keys=tuple(sorted(pending[0][2].keys())),
                 )
                 acc = program(
                     acc,
@@ -868,8 +942,17 @@ class TpuQueryExecutor(QueryExecutor):
                 if pending and sig != pending_sig:
                     dispatch_pending()
                 pending_sig = sig
-                dev_luts = tuple(jnp.asarray(l) for l in luts)
-                dev_remaps = tuple(jnp.asarray(r) for r in remaps if r is not None)
+                if self.mesh is not None and enc.block_rows % self.mesh.size == 0:
+                    import jax
+
+                    _, rep_s = _mesh_shardings(self.mesh)
+                    dev_luts = tuple(jax.device_put(l, rep_s) for l in luts)
+                    dev_remaps = tuple(
+                        jax.device_put(r, rep_s) for r in remaps if r is not None
+                    )
+                else:
+                    dev_luts = tuple(jnp.asarray(l) for l in luts)
+                    dev_remaps = tuple(jnp.asarray(r) for r in remaps if r is not None)
                 row_mask = dev.get("__rowmask", dev["__ones"])
                 pending.append((table, enc, dev, dev_luts, dev_remaps, row_mask))
                 if len(pending) >= GROUP_N:
@@ -899,12 +982,22 @@ class TpuQueryExecutor(QueryExecutor):
         lut_shapes: tuple,
         remap_shapes: tuple,
         n_blocks: int = 1,
+        dev_keys: tuple = (),
     ) -> Callable:
         """One jitted dispatch: WHERE mask + dict remap + group ids + fused
         aggregate + fold into the device accumulator.
 
+        With a mesh active, the whole fold runs under `shard_map`: each
+        device computes the fused partial aggregate for its row shard and
+        the partials combine with psum/pmin/pmax over the `data` axis — the
+        reduction the reference does in querier-side merge loops
+        (cluster/mod.rs:1785-1964) happens on ICI inside one XLA program.
+
         Cached process-wide; the key covers everything baked into the trace.
         """
+        mesh = self.mesh
+        if mesh is not None and enc.block_rows % mesh.size:
+            mesh = None
         kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
         bounds_s = self._bounds_seconds()
         key = (
@@ -923,6 +1016,8 @@ class TpuQueryExecutor(QueryExecutor):
             remap_shapes,
             num_groups,
             n_blocks,
+            None if mesh is None else id(mesh),
+            dev_keys,
         )
         prog = _PROGRAM_CACHE.get(key)
         if prog is not None:
@@ -938,12 +1033,14 @@ class TpuQueryExecutor(QueryExecutor):
             KeySpec(ks.kind, ks.column, ks.expr, ks.bin_ms, ks.gdict, cap, orig)
             for ks, cap, orig in zip(layout.key_specs, layout.caps, layout.origins)
         ]
-        block_rows = enc.block_rows
         origin_units = CANON_TIME_ORIGIN_MS // CANON_TIME_UNIT_MS
 
         from parseable_tpu import DEFAULT_TIMESTAMP_KEY
 
         def fold_one(acc, dev: dict, luts: tuple, remaps: tuple, row_mask):
+            # row count as seen by this trace: the full block single-chip,
+            # or this device's shard under shard_map
+            local_rows = row_mask.shape[0]
             mask = compiler.trace(sel_where, enc, dev, list(luts))
             mask = jnp.logical_and(mask, row_mask)
             if bounds_s != (None, None) and DEFAULT_TIMESTAMP_KEY in enc.columns:
@@ -955,7 +1052,7 @@ class TpuQueryExecutor(QueryExecutor):
                     mask = jnp.logical_and(mask, ts < jnp.int32(hi))
                 mask = jnp.logical_and(mask, dev[f"{DEFAULT_TIMESTAMP_KEY}__valid"])
             if not key_specs:
-                ids = jnp.zeros(block_rows, dtype=jnp.int32)
+                ids = jnp.zeros(local_rows, dtype=jnp.int32)
             else:
                 ids = None
                 stride = 1
@@ -981,12 +1078,12 @@ class TpuQueryExecutor(QueryExecutor):
 
             def stack(names):
                 if not names:
-                    return jnp.zeros((0, block_rows), jnp.float32)
+                    return jnp.zeros((0, local_rows), jnp.float32)
                 return jnp.stack([dev[n].astype(jnp.float32) for n in names])
 
             def stack_valid(names):
                 if not names:
-                    return jnp.zeros((0, block_rows), bool)
+                    return jnp.zeros((0, local_rows), bool)
                 return jnp.stack([dev[f"{n}__valid"] for n in names])
 
             count, pac, sums, mins, maxs = kernels.fused_groupby_block(
@@ -1002,6 +1099,11 @@ class TpuQueryExecutor(QueryExecutor):
                 n_max,
             )
             adds = jnp.concatenate([count[None, :], pac, sums], axis=0)
+            if mesh is not None:
+                # the distributed reduce tree: partials ride ICI
+                adds = jax.lax.psum(adds, "data")
+                mins = jax.lax.pmin(mins, "data")
+                maxs = jax.lax.pmax(maxs, "data")
             a0 = adds.shape[0]
             new_acc = jnp.concatenate(
                 [
@@ -1020,10 +1122,27 @@ class TpuQueryExecutor(QueryExecutor):
                 acc = fold_one(acc, devs[i], luts_all[i], remaps_all[i], row_masks[i])
             return acc
 
+        if mesh is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            n_remaps = sum(1 for s in remap_shapes if s is not None)
+            dev_spec = {k: P("data") for k in dev_keys}
+            in_specs = (
+                P(),  # accumulator: replicated
+                tuple(dev_spec for _ in range(n_blocks)),
+                tuple(tuple(P() for _ in lut_shapes) for _ in range(n_blocks)),
+                tuple(tuple(P() for _ in range(n_remaps)) for _ in range(n_blocks)),
+                tuple(P("data") for _ in range(n_blocks)),
+            )
+            prog_body = shard_map(prog_fn, mesh=mesh, in_specs=in_specs, out_specs=P())
+        else:
+            prog_body = prog_fn
+
         # NOTE: no donate_argnums — buffer donation forces a synchronous
         # round trip on tunneled PJRT backends (measured 424ms vs 10ms per
         # call); the G-sized accumulator copy is far cheaper
-        prog = jax.jit(prog_fn)
+        prog = jax.jit(prog_body)
         _PROGRAM_CACHE[key] = prog
         return prog
 
@@ -1144,30 +1263,43 @@ class TpuQueryExecutor(QueryExecutor):
 # --------------------------------------------------------------- device util
 
 
-def _transfer(enc: EncodedBatch) -> tuple[dict, int]:
-    """Ship encoded columns to device.
+def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
+    """Ship encoded columns to device (row-sharded over the mesh `data`
+    axis when one is active).
 
     Null-free columns share ONE device `ones` mask instead of shipping a
     validity array each — transfer bytes are the scan budget.
     """
     import jax.numpy as jnp
 
+    if mesh is not None and enc.block_rows % mesh.size:
+        mesh = None  # block not shardable; keep it single-device
+    if mesh is not None:
+        import jax
+
+        row_s, _ = _mesh_shardings(mesh)
+
+        def put_row(a):
+            return jax.device_put(a, row_s)
+    else:
+        put_row = jnp.asarray
+
     dev: dict[str, Any] = {}
     nbytes = 0
-    ones = _device_ones(enc.block_rows)
+    ones = _device_ones(enc.block_rows, mesh)
     for name, col in enc.columns.items():
-        dev[name] = jnp.asarray(col.values)
+        dev[name] = put_row(col.values)
         nbytes += col.values.nbytes
         if col.all_valid:
             dev[f"{name}__valid"] = ones
         else:
-            dev[f"{name}__valid"] = jnp.asarray(col.valid)
+            dev[f"{name}__valid"] = put_row(col.valid)
             nbytes += col.valid.nbytes
     dev["__ones"] = ones
     if enc.num_rows != enc.block_rows:
         # padding mask must live with the block (host copy gets stripped
         # when the block enters the hot set)
-        dev["__rowmask"] = jnp.asarray(enc.row_mask)
+        dev["__rowmask"] = put_row(enc.row_mask)
         nbytes += enc.row_mask.nbytes
     DEVICE_BYTES_TO_DEVICE.labels("scan").inc(nbytes)
     return dev, nbytes

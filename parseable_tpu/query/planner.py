@@ -98,6 +98,8 @@ def extract_time_bounds(where: S.Expr | None, time_col: str = DEFAULT_TIMESTAMP_
                     bounds = bounds.intersect(TimeBounds(high=dt))
                 elif e.op == ">=":
                     bounds = bounds.intersect(TimeBounds(high=dt + timedelta(milliseconds=1)))
+                else:  # =
+                    bounds = bounds.intersect(TimeBounds(low=dt, high=dt + timedelta(milliseconds=1)))
 
     visit(where)
     return bounds

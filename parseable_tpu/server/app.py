@@ -479,10 +479,15 @@ async def put_stream(request: web.Request) -> web.Response:
                 return web.json_response(
                     {"error": "time partition cannot be changed after creation"}, status=400
                 )
-            with state.p.stream_json_lock(name):
-                fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
-                fmt.custom_partition = stream.metadata.custom_partition
-                state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+            def _persist() -> None:
+                # executor thread: the lock may be held by the sync/retention
+                # threads; never block the event loop waiting on it
+                with state.p.stream_json_lock(name):
+                    fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
+                    fmt.custom_partition = stream.metadata.custom_partition
+                    state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+
+            await asyncio.get_running_loop().run_in_executor(None, _persist)
             return web.json_response({"message": f"updated stream {name}"})
         state.p.create_stream_if_not_exists(
             name,
@@ -584,11 +589,14 @@ async def put_retention(request: web.Request) -> web.Response:
     except StreamNotFound:
         return web.json_response({"error": f"stream {name} not found"}, status=404)
     stream.metadata.retention = body
-    try:
+    def _persist() -> None:
         with state.p.stream_json_lock(name):
             fmt = state.p.metastore.get_stream_json(name, state.p._node_suffix)
             fmt.retention = body
             state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
+
+    try:
+        await asyncio.get_running_loop().run_in_executor(None, _persist)
     except Exception:
         logger.exception("failed persisting retention")
     return web.json_response({"message": "updated retention"})

@@ -66,9 +66,12 @@ def apply_retention(p: Parseable, stream_name: str, days: int, now: datetime | N
     now = now or datetime.now(UTC)
     cutoff = (now - timedelta(days=days)).date()
     removed: list[str] = []
-    # Hold the stream-json lock across the whole read-modify-write so a
-    # concurrent update_snapshot (object-sync thread) can't be clobbered by
-    # our stale copy of the snapshot.
+    expired: list = []
+    # Phase 1 — under the per-stream lock: read-modify-write ONLY the
+    # stream json (drop expired manifest items from the snapshot, adjust
+    # stats). Keeping the critical section to this one RMW means a slow
+    # object-store sweep can't block concurrent snapshot updates or the
+    # HTTP handlers that share the lock.
     with p.stream_json_lock(stream_name):
         try:
             fmt = p.metastore.get_stream_json(stream_name, p._node_suffix)
@@ -78,25 +81,32 @@ def apply_retention(p: Parseable, stream_name: str, days: int, now: datetime | N
         keep = []
         for item in fmt.snapshot.manifest_list:
             if item.time_upper_bound.date() < cutoff:
-                prefix = item.manifest_path[: -len("/manifest.json")]
-                manifest = p.metastore.get_manifest(prefix)
-                if manifest is not None:
-                    for f in manifest.files:
-                        try:
-                            p.storage.delete_object(f.file_path)
-                        except Exception:
-                            logger.warning("failed deleting %s", f.file_path)
-                p.metastore.delete_manifest(prefix)
-                p.storage.delete_prefix(prefix)
+                expired.append(item)
                 fmt.stats.deleted_events += item.events_ingested
                 fmt.stats.deleted_storage += item.storage_size
                 fmt.stats.events = max(0, fmt.stats.events - item.events_ingested)
                 fmt.stats.storage = max(0, fmt.stats.storage - item.storage_size)
-                removed.append(prefix)
             else:
                 keep.append(item)
-        if removed:
+        if expired:
             fmt.snapshot.manifest_list = keep
             p.metastore.put_stream_json(stream_name, fmt, p._node_suffix)
-            logger.info("retention removed %d day-partitions from %s", len(removed), stream_name)
+
+    # Phase 2 — outside the lock: delete data + manifests. Snapshot no
+    # longer references them, so a crash mid-sweep leaves only unreferenced
+    # (re-collectable) objects, never dangling manifest entries.
+    for item in expired:
+        prefix = item.manifest_path[: -len("/manifest.json")]
+        manifest = p.metastore.get_manifest(prefix)
+        if manifest is not None:
+            for f in manifest.files:
+                try:
+                    p.storage.delete_object(f.file_path)
+                except Exception:
+                    logger.warning("failed deleting %s", f.file_path)
+        p.metastore.delete_manifest(prefix)
+        p.storage.delete_prefix(prefix)
+        removed.append(prefix)
+    if removed:
+        logger.info("retention removed %d day-partitions from %s", len(removed), stream_name)
     return removed

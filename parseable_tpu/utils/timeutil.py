@@ -109,8 +109,9 @@ class TimeRange:
         else:
             start = parse_rfc3339(start_time)
             end = parse_rfc3339(end_time)
-        start = truncate_to_minute(start)
-        end = truncate_to_minute(end)
+        # No minute truncation (reference parses exact instants;
+        # time.rs:191): truncating `now` to the minute start would hide the
+        # current minute's rows — the freshest data — from every query.
         if start > end:
             raise TimeParseError("start time is after end time")
         return cls(start, end)

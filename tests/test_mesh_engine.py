@@ -1,0 +1,124 @@
+"""Distributed (mesh) query execution through the real engine.
+
+conftest.py pins JAX to a virtual 8-device CPU mesh, so `resolve_mesh`
+auto-activates and every TpuQueryExecutor in this suite runs the shard_map
+psum-tree path (parallel/mesh.py design; reference's querier-side merge
+loops at cluster/mod.rs:1785-1964 replaced by ICI collectives).
+"""
+
+from datetime import datetime, timedelta
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query import executor_tpu as ET
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.session import QuerySession
+from parseable_tpu.query.sql import parse_sql
+
+BASE = datetime(2024, 5, 1, 10, 0)
+
+
+def make_table(n=20000, seed=0):
+    rng = np.random.default_rng(seed)
+    ts = [BASE + timedelta(seconds=int(i)) for i in rng.integers(0, 3600, n)]
+    return pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "status": pa.array(rng.choice(["200", "404", "500"], n).tolist()),
+            "bytes": pa.array(rng.random(n) * 1000),
+            "host": pa.array(rng.choice(["a", "b", "c", "d"], n).tolist()),
+        }
+    )
+
+
+def assert_parity(cpu_rows, tpu_rows, sql=""):
+    key = lambda r: tuple(str(r[k]) for k in sorted(r) if not isinstance(r[k], float))
+    cpu_rows, tpu_rows = sorted(cpu_rows, key=key), sorted(tpu_rows, key=key)
+    assert len(cpu_rows) == len(tpu_rows), sql
+    for rc, rt in zip(cpu_rows, tpu_rows):
+        for k in rc:
+            a, b = rc[k], rt[k]
+            if isinstance(a, float):
+                assert a == pytest.approx(b, rel=1e-4, abs=1e-6), (sql, k)
+            else:
+                assert a == b, (sql, k)
+
+
+def test_mesh_is_active():
+    ex = ET.TpuQueryExecutor(build_plan(parse_sql("SELECT count(*) FROM t")))
+    assert ex.mesh is not None
+    assert ex.mesh.size == 8
+    assert ex.mesh.axis_names == ("data",)
+
+
+@pytest.mark.parametrize(
+    "sql",
+    [
+        "SELECT status, count(*) c, sum(bytes) s, min(bytes) mn, max(bytes) mx "
+        "FROM t WHERE host != 'd' GROUP BY status",
+        "SELECT date_bin(interval '5m', p_timestamp) b, host, count(*) c, avg(bytes) a "
+        "FROM t WHERE status = '500' GROUP BY b, host",
+        "SELECT count(*) c FROM t WHERE bytes > 500 AND host IN ('a', 'b')",
+        "SELECT host, count(*) c FROM t WHERE status LIKE '4%' GROUP BY host",
+        "SELECT count(*) c, sum(bytes) s FROM t",
+    ],
+)
+def test_mesh_groupby_parity(sql):
+    t = make_table()
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    ex = ET.TpuQueryExecutor(lp2)
+    assert ex.mesh is not None
+    cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+    tpu = ex.execute(iter([t])).to_pylist()
+    assert_parity(cpu, tpu, sql)
+
+
+def test_mesh_program_actually_compiles():
+    """The dispatched program must be a mesh program (psum tree), not a
+    silent single-chip or CPU fallback."""
+    t = make_table(seed=3)
+    sql = "SELECT host, count(*) c FROM t WHERE bytes >= 250 GROUP BY host"
+    before = {k for k in ET._PROGRAM_CACHE}
+    lp = build_plan(parse_sql(sql))
+    ex = ET.TpuQueryExecutor(lp)
+    ex.execute(iter([t]))
+    new_keys = [k for k in ET._PROGRAM_CACHE if k not in before]
+    assert new_keys, "no device program compiled — everything fell back to CPU"
+    assert any(k[-2] is not None for k in new_keys), "program compiled without the mesh"
+
+
+def test_mesh_multi_block_accumulation():
+    """Blocks folded across multiple dispatches still reduce correctly."""
+    tables = [make_table(6000, seed=s) for s in range(5)]
+    sql = "SELECT status, count(*) c, sum(bytes) s FROM t GROUP BY status"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter(tables)).to_pylist()
+    tpu = ET.TpuQueryExecutor(lp2).execute(iter(tables)).to_pylist()
+    assert_parity(cpu, tpu, sql)
+
+
+def test_mesh_session_end_to_end(parseable):
+    """VERDICT round-1 'done' criterion: a real SQL query through
+    QuerySession with mesh execution matching CPU results."""
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = parseable
+    stream = p.create_stream_if_not_exists("meshweb")
+    records = [
+        {"host": f"h{i % 3}", "status": 200 if i % 4 else 500, "bytes": float(i)}
+        for i in range(5000)
+    ]
+    ev = JsonEvent(records, "meshweb").into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    sql = "SELECT host, count(*) c, sum(bytes) s FROM meshweb GROUP BY host ORDER BY host"
+    cpu = QuerySession(p, engine="cpu").query(sql).to_json_rows()
+    tpu = QuerySession(p, engine="tpu").query(sql).to_json_rows()
+    assert_parity(cpu, tpu, sql)
+    assert sum(r["c"] for r in tpu) == 5000

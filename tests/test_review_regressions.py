@@ -215,3 +215,32 @@ def test_update_snapshot_replacement_stats(parseable):
     assert fmt.stats.storage == 100
     assert len(fmt.snapshot.manifest_list) == 1
     assert fmt.snapshot.manifest_list[0].events_ingested == 10
+
+
+def test_reversed_equality_time_bound():
+    """Literal-first equality on p_timestamp must bound the manifest fast
+    path (review finding: unbounded TimeBounds counted the whole stream)."""
+    from parseable_tpu.query.planner import extract_time_bounds
+    from parseable_tpu.query.sql import parse_sql
+
+    q = parse_sql("SELECT count(*) FROM t WHERE '2024-05-01T10:00:00Z' = p_timestamp")
+    b = extract_time_bounds(q.where)
+    assert b.low == datetime(2024, 5, 1, 10, 0, tzinfo=UTC)
+    assert b.high == datetime(2024, 5, 1, 10, 0, 0, 1000, tzinfo=UTC)
+
+
+def test_current_minute_staging_rows_visible(parseable):
+    """A filtered query with endTime=now must see rows ingested seconds ago
+    (verify finding: minute truncation hid the current minute's staging)."""
+    from parseable_tpu.event.json_format import JsonEvent
+    from parseable_tpu.query.session import QuerySession
+
+    p = parseable
+    stream = p.create_stream_if_not_exists("fresh")
+    ev = JsonEvent([{"a": 5}, {"a": 6}], "fresh").into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "select count(*) as c from fresh where a >= 0", start_time="1h", end_time="now"
+    )
+    assert r.to_json_rows() == [{"c": 2}]

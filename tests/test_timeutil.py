@@ -44,13 +44,15 @@ def test_parse_rfc3339():
 def test_parse_human_time_now():
     tr = TimeRange.parse_human_time("10m", "now")
     assert (tr.end - tr.start) == timedelta(minutes=10)
-    assert tr.start.second == 0 and tr.end.second == 0
+    # the end stays at the exact current instant (no minute truncation):
+    # truncating would hide the current minute's staging rows from queries
+    assert datetime.now(UTC) - tr.end < timedelta(seconds=5)
 
 
-def test_parse_human_time_rfc3339_truncates():
+def test_parse_human_time_rfc3339_exact():
     tr = TimeRange.parse_human_time("2022-06-11T23:00:59Z", "2022-06-11T23:30:59Z")
-    assert tr.start == datetime(2022, 6, 11, 23, 0, tzinfo=UTC)
-    assert tr.end == datetime(2022, 6, 11, 23, 30, tzinfo=UTC)
+    assert tr.start == datetime(2022, 6, 11, 23, 0, 59, tzinfo=UTC)
+    assert tr.end == datetime(2022, 6, 11, 23, 30, 59, tzinfo=UTC)
 
 
 def test_parse_human_time_start_after_end():
