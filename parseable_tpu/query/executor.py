@@ -29,6 +29,17 @@ class ExecError(ValueError):
     pass
 
 
+class QueryTimeout(ExecError):
+    """Cooperative SQL timeout (reference: QUERY_RUNTIME sql timeout,
+    src/query/mod.rs:92,152-165). Raised between scan blocks once the
+    plan's deadline passes."""
+
+
+class MemoryLimitExceeded(ExecError):
+    """Result materialization exceeded the query memory cap (reference:
+    85% memory pool / P_QUERY_MEMORY_LIMIT, src/query/mod.rs:216-226)."""
+
+
 # ------------------------------------------------------------- expression eval
 
 
@@ -446,8 +457,20 @@ class HashAggregator:
                     getattr(mine, attr)[si] = b if a is None else (a if b is None else fn(a, b))
                 mine.distincts[si] |= st.distincts[si]
 
-    def merge_raw(self, key: tuple, counts: list[int], sums: list[float], mins: list, maxs: list) -> None:
-        """Merge one group's partials produced by a device kernel."""
+    def merge_raw(
+        self,
+        key: tuple,
+        counts: list[int],
+        sums: list[float],
+        mins: list,
+        maxs: list,
+        distincts: dict[int, set] | None = None,
+    ) -> None:
+        """Merge one group's partials produced by a device kernel.
+
+        `distincts` maps spec index -> set of observed values (decoded from
+        the device presence bitmap), so device blocks and CPU-fallback
+        blocks merge exactly."""
         st = self.groups.get(key)
         if st is None:
             st = self._new_state()
@@ -459,6 +482,9 @@ class HashAggregator:
                 a = getattr(st, attr)[si]
                 b = vals[si]
                 getattr(st, attr)[si] = b if a is None else (a if b is None else fn(a, b))
+        if distincts:
+            for si, vals_set in distincts.items():
+                st.distincts[si] |= vals_set
 
     def finalize_value(self, st: GroupState, si: int) -> Any:
         spec = self.specs[si]
@@ -487,6 +513,17 @@ class QueryExecutor:
         self.plan = plan
 
     # -- shared pieces -------------------------------------------------------
+
+    def _check_deadline(self) -> None:
+        """Cooperative timeout, checked once per scan block."""
+        import time as _time
+
+        dl = getattr(self.plan, "deadline", None)
+        if dl is not None and _time.monotonic() > dl:
+            raise QueryTimeout("query exceeded its timeout and was cancelled")
+
+    def _memory_budget(self) -> int | None:
+        return getattr(self.plan, "memory_limit_bytes", None)
 
     def _where_mask(self, table: pa.Table) -> pa.Array | None:
         w = self.plan.select.where
@@ -525,34 +562,86 @@ class QueryExecutor:
         sel = self.plan.select
         out_parts: list[pa.Table] = []
         rows_needed = None
-        if sel.limit is not None and not sel.order_by and not sel.distinct:
+        if sel.limit is not None and not sel.distinct:
             rows_needed = sel.limit + (sel.offset or 0)
+        # top-K pushdown: with ORDER BY + LIMIT, periodically sort-compact
+        # the working set down to the K needed rows instead of materializing
+        # the whole scan (reference leans on DataFusion's sort-limit;
+        # `SELECT * ... LIMIT 100` over 100 GB must not OOM)
+        topk = rows_needed is not None and bool(sel.order_by)
+        compact_at = max(2 * (rows_needed or 0), 100_000)
+        budget = self._memory_budget()
+        held_bytes = 0
         total = 0
         for table in tables:
+            self._check_deadline()
             table = self._bounds_filter(table)
             mask = self._where_mask(table)
             if mask is not None:
                 table = table.filter(mask)
             if table.num_rows == 0:
                 continue
-            out_parts.append(self._project(table))
-            total += table.num_rows
-            if rows_needed is not None and total >= rows_needed:
+            part = self._project(table)
+            out_parts.append(part)
+            total += part.num_rows
+            held_bytes += part.nbytes
+            if rows_needed is not None and not sel.order_by and total >= rows_needed:
                 break
+            # compact on row count OR budget pressure — a tight memory cap
+            # must trigger top-K compaction, not fail a bounded query
+            if topk and (total >= compact_at or (budget is not None and held_bytes > budget)):
+                compacted = self._sorted(_unify_parts(out_parts)).slice(0, rows_needed)
+                out_parts = [compacted]
+                total = compacted.num_rows
+                held_bytes = compacted.nbytes
+            if budget is not None and held_bytes > budget:
+                raise MemoryLimitExceeded(
+                    f"query holds {held_bytes} bytes of results "
+                    f"(limit {budget}); add LIMIT/filters or raise P_QUERY_MEMORY_LIMIT"
+                )
         if not out_parts:
             return self._project(_empty_like(self.plan))
-        from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
-
-        schema = merge_schemas([t.schema for t in out_parts])
-        unified = []
-        for t in out_parts:
-            for b in t.to_batches():
-                unified.append(adapt_batch(schema, b))
-        result = pa.Table.from_batches(unified, schema=schema)
+        result = _unify_parts(out_parts)
         if sel.distinct:
             result = result.group_by(result.column_names).aggregate([])
         result = self._order_limit(result)
         return result
+
+    def execute_select_stream(self, tables: Iterator[pa.Table]) -> Iterator[pa.Table]:
+        """Stream filtered + projected blocks one at a time (reference:
+        chunked streaming responses, handlers/http/query.rs:325-407).
+
+        ORDER BY / DISTINCT / aggregates need the full result before the
+        first row can be emitted, so those yield the materialized table.
+        """
+        sel = self.plan.select
+        if self.plan.is_aggregate or sel.order_by or sel.distinct:
+            yield self.execute(tables)
+            return
+        to_skip = sel.offset or 0
+        remaining = sel.limit  # None = unbounded
+        for table in tables:
+            self._check_deadline()
+            table = self._bounds_filter(table)
+            mask = self._where_mask(table)
+            if mask is not None:
+                table = table.filter(mask)
+            if table.num_rows == 0:
+                continue
+            part = self._project(table)
+            if to_skip:
+                drop = min(to_skip, part.num_rows)
+                part = part.slice(drop)
+                to_skip -= drop
+                if part.num_rows == 0:
+                    continue
+            if remaining is not None:
+                part = part.slice(0, remaining)
+                remaining -= part.num_rows
+            if part.num_rows:
+                yield part
+            if remaining == 0:
+                return
 
     def _project(self, table: pa.Table) -> pa.Table:
         sel = self.plan.select
@@ -588,6 +677,7 @@ class QueryExecutor:
     def _execute_aggregate(self, tables: Iterator[pa.Table]) -> pa.Table:
         agg, rewritten, group_names = self.build_aggregator()
         for table in tables:
+            self._check_deadline()
             table = self._bounds_filter(table)
             mask = self._where_mask(table)
             agg.update(table, mask)
@@ -641,30 +731,46 @@ class QueryExecutor:
 
     # -- order / limit -------------------------------------------------------
 
+    def _sorted(self, table: pa.Table) -> pa.Table:
+        """ORDER BY sort (aux columns for expression keys, dropped after)."""
+        sel = self.plan.select
+        keys = []
+        aux_cols = 0
+        for o in sel.order_by:
+            name = S.expr_name(o.expr)
+            if isinstance(o.expr, S.Column) and o.expr.name in table.column_names:
+                keys.append((o.expr.name, "descending" if o.desc else "ascending"))
+            elif name in table.column_names:
+                keys.append((name, "descending" if o.desc else "ascending"))
+            else:
+                aux = f"__sort{aux_cols}"
+                aux_cols += 1
+                table = table.append_column(aux, _arr(evaluate(o.expr, table), table))
+                keys.append((aux, "descending" if o.desc else "ascending"))
+        table = table.sort_by(keys)
+        return table.select([c for c in table.column_names if not c.startswith("__sort")])
+
     def _order_limit(self, table: pa.Table) -> pa.Table:
         sel = self.plan.select
         if sel.order_by:
-            keys = []
-            aux_cols = 0
-            for o in sel.order_by:
-                name = S.expr_name(o.expr)
-                if isinstance(o.expr, S.Column) and o.expr.name in table.column_names:
-                    keys.append((o.expr.name, "descending" if o.desc else "ascending"))
-                elif name in table.column_names:
-                    keys.append((name, "descending" if o.desc else "ascending"))
-                else:
-                    aux = f"__sort{aux_cols}"
-                    aux_cols += 1
-                    table = table.append_column(aux, _arr(evaluate(o.expr, table), table))
-                    keys.append((aux, "descending" if o.desc else "ascending"))
-            table = table.sort_by(keys)
-            table = table.select([c for c in table.column_names if not c.startswith("__sort")])
+            table = self._sorted(table)
         off = sel.offset or 0
         if off:
             table = table.slice(off)
         if sel.limit is not None:
             table = table.slice(0, sel.limit)
         return table
+
+
+def _unify_parts(parts: list[pa.Table]) -> pa.Table:
+    from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+    schema = merge_schemas([t.schema for t in parts])
+    unified = []
+    for t in parts:
+        for b in t.to_batches():
+            unified.append(adapt_batch(schema, b))
+    return pa.Table.from_batches(unified, schema=schema)
 
 
 def _dedup(names: list[str], arrays: list) -> dict:

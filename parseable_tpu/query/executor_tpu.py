@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import logging
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dc_field
 from datetime import UTC, datetime
 from typing import Any, Callable, Iterator
 
@@ -545,6 +545,8 @@ class PlanLayout:
     min_cols: list[str]
     max_cols: list[str]
     stacked_cols: list[str]
+    distinct_cols: list[str] = dc_field(default_factory=list)
+    distinct_caps: tuple[int, ...] = ()
 
 
 # Jitted programs cached process-wide: two identical queries (or two
@@ -760,11 +762,10 @@ class TpuQueryExecutor(QueryExecutor):
         min_idx: list[int] = []
         max_idx: list[int] = []
         countcol_idx: list[int] = []
+        distinct_idx: list[int] = []
         for i, spec in enumerate(specs):
             if spec.func == "count_star":
                 continue
-            if spec.func == "count_distinct":
-                raise UnsupportedOnDevice("count_distinct runs on the CPU engine")
             if not isinstance(spec.arg, S.Column):
                 raise UnsupportedOnDevice(f"aggregate over expression: {S.expr_name(spec.arg)}")
             if spec.func in ("sum", "avg"):
@@ -775,16 +776,30 @@ class TpuQueryExecutor(QueryExecutor):
                 max_idx.append(i)
             elif spec.func == "count":
                 countcol_idx.append(i)
+            elif spec.func == "count_distinct":
+                distinct_idx.append(i)
             else:
                 raise UnsupportedOnDevice(f"aggregate {spec.func}")
         stacked_idx = sum_idx + min_idx + max_idx + countcol_idx
         n_sum, n_min, n_max = len(sum_idx), len(min_idx), len(max_idx)
         n_all = len(stacked_idx)
 
+        # count(distinct y): y dict-encodes like a group key; per block a
+        # segment_max ORs presence bits into a [G, Vcap] device bitmap
+        # (masked_distinct_bitmap design, ops/kernels.py). Exact — flush
+        # decodes present codes back to values and merges them into the
+        # same sets CPU-fallback blocks fill, so mixed paths stay correct.
+        dkeys = [
+            KeySpec("dict", specs[i].arg.name, specs[i].arg, gdict=GlobalDict())
+            for i in distinct_idx
+        ]
+
         compiler = PredicateCompiler()
         dict_cols = {ks.column for ks in key_specs if ks.kind == "dict"}
+        dict_cols |= {dk.column for dk in dkeys}
 
         acc = None  # device-resident packed accumulator (R, G) f32
+        dacc: list = []  # per-distinct [G * Vcap] f32 presence bitmaps
         acc_groups = 0
 
         def new_acc(num_groups: int):
@@ -802,8 +817,18 @@ class TpuQueryExecutor(QueryExecutor):
                 return jax.device_put(host, rep_s)
             return jnp.asarray(host)
 
+        def new_dacc(size: int):
+            host = np.zeros(size, np.float32)
+            if self.mesh is not None:
+                import jax
+
+                _, rep_s = _mesh_shardings(self.mesh)
+                return jax.device_put(host, rep_s)
+            return jnp.asarray(host)
+
         def flush(acc_dev, num_groups: int) -> None:
-            """ONE device->host readback, then fold into the sparse agg."""
+            """ONE device->host readback per accumulator, folded into the
+            sparse agg (distinct presence bitmaps decode alongside)."""
             arr = np.asarray(acc_dev, np.float64)
             state = DenseState(
                 capacities=tuple(ks.capacity for ks in key_specs),
@@ -814,7 +839,11 @@ class TpuQueryExecutor(QueryExecutor):
                 mins=arr[1 + n_all + n_sum : 1 + n_all + n_sum + n_min],
                 maxs=arr[1 + n_all + n_sum + n_min :],
             )
-            self._flush_state(state, key_specs, agg, specs)
+            dists = [
+                (si, dk, np.asarray(d).reshape(num_groups, dk.capacity))
+                for si, dk, d in zip(distinct_idx, dkeys, dacc)
+            ]
+            self._flush_state(state, key_specs, agg, specs, dists)
 
         # Coalesce scan tables into larger device blocks: dispatch latency is
         # the budget, so fewer/bigger blocks win (Options.device_block_rows).
@@ -858,7 +887,7 @@ class TpuQueryExecutor(QueryExecutor):
             pending.clear()
 
         def dispatch_pending() -> None:
-            nonlocal acc
+            nonlocal acc, dacc
             if not pending:
                 return
             enc0 = pending[0][1]
@@ -870,6 +899,8 @@ class TpuQueryExecutor(QueryExecutor):
                 min_cols=[specs[i].arg.name for i in min_idx],
                 max_cols=[specs[i].arg.name for i in max_idx],
                 stacked_cols=[specs[i].arg.name for i in stacked_idx],
+                distinct_cols=[dk.column for dk in dkeys],
+                distinct_caps=tuple(dk.capacity for dk in dkeys),
             )
             try:
                 program = self._get_program(
@@ -880,14 +911,18 @@ class TpuQueryExecutor(QueryExecutor):
                     pending_sig[2],
                     n_blocks=len(pending),
                     dev_keys=tuple(sorted(pending[0][2].keys())),
+                    dremap_shapes=pending_sig[3],
                 )
-                acc = program(
+                acc, dacc_out = program(
                     acc,
+                    tuple(dacc),
                     tuple(x[2] for x in pending),
                     tuple(x[3] for x in pending),
                     tuple(x[4] for x in pending),
                     tuple(x[5] for x in pending),
+                    tuple(x[6] for x in pending),
                 )
+                dacc = list(dacc_out)
                 pending.clear()
             except UnsupportedOnDevice as e:
                 logger.debug("pending blocks on CPU (%s)", e)
@@ -898,6 +933,7 @@ class TpuQueryExecutor(QueryExecutor):
 
         t_start = _t.monotonic()
         for table in blocks(tables):
+            self._check_deadline()
             try:
                 enc, dev = self._encoded_block(table, self.plan.needed_columns, dict_cols)
                 for i in stacked_idx:
@@ -915,29 +951,49 @@ class TpuQueryExecutor(QueryExecutor):
                 ]
                 if any(r is None and ks.kind == "dict" for r, ks in zip(remaps, key_specs)):
                     raise UnsupportedOnDevice("group key column missing from batch")
+                dremaps_np = []
+                for dk in dkeys:
+                    col = enc.columns.get(dk.column)
+                    if col is None or col.kind != "dict":
+                        raise UnsupportedOnDevice(f"distinct column {dk.column} not dict-encoded")
+                    dremaps_np.append(dk.gdict.absorb(col.dictionary))
 
                 layouts = [self._required_layout(ks, enc) for ks in key_specs]
                 caps = tuple(c for _, c in layouts)
                 origins = tuple(o for o, _ in layouts)
+                dlayouts = [self._required_layout(dk, enc) for dk in dkeys]
+                dcaps = tuple(c for _, c in dlayouts)
+                new_groups = 1
+                for c in caps:
+                    new_groups *= c
+                new_groups = max(new_groups, 1)
+                # presence bitmaps are device-resident [G, Vcap] f32 each —
+                # bound the footprint, else fall back (exact) to the CPU
+                if any(new_groups * c > (1 << 24) for c in dcaps):
+                    raise UnsupportedOnDevice(
+                        "distinct bitmap exceeds device budget (G*V too large)"
+                    )
                 current = tuple((ks.origin_rel or 0, ks.capacity) for ks in key_specs)
-                if acc is None or tuple(zip(origins, caps)) != current:
+                dcurrent = tuple(dk.capacity for dk in dkeys)
+                if acc is None or tuple(zip(origins, caps)) != current or dcaps != dcurrent:
                     dispatch_pending()  # under the old epoch's layout
                     if acc is not None:
                         flush(acc, acc_groups)
                     for ks, (o, c) in zip(key_specs, layouts):
                         ks.capacity = c
                         ks.origin_rel = o if ks.kind == "timebin" else None
-                    acc_groups = 1
-                    for c in caps:
-                        acc_groups *= c
-                    acc_groups = max(acc_groups, 1)
+                    for dk, c in zip(dkeys, dcaps):
+                        dk.capacity = c
+                    acc_groups = new_groups
                     acc = new_acc(acc_groups)
+                    dacc = [new_dacc(acc_groups * c) for c in dcaps]
 
                 kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
                 sig = (
                     (enc.block_rows, kinds, "__rowmask" in dev),
                     tuple(l.shape for l in luts),
                     tuple(r.shape if r is not None else None for r in remaps),
+                    tuple(r.shape for r in dremaps_np),
                 )
                 if pending and sig != pending_sig:
                     dispatch_pending()
@@ -946,15 +1002,14 @@ class TpuQueryExecutor(QueryExecutor):
                     import jax
 
                     _, rep_s = _mesh_shardings(self.mesh)
-                    dev_luts = tuple(jax.device_put(l, rep_s) for l in luts)
-                    dev_remaps = tuple(
-                        jax.device_put(r, rep_s) for r in remaps if r is not None
-                    )
+                    put_rep = lambda a: jax.device_put(a, rep_s)
                 else:
-                    dev_luts = tuple(jnp.asarray(l) for l in luts)
-                    dev_remaps = tuple(jnp.asarray(r) for r in remaps if r is not None)
+                    put_rep = jnp.asarray
+                dev_luts = tuple(put_rep(l) for l in luts)
+                dev_remaps = tuple(put_rep(r) for r in remaps if r is not None)
+                dev_dremaps = tuple(put_rep(r) for r in dremaps_np)
                 row_mask = dev.get("__rowmask", dev["__ones"])
-                pending.append((table, enc, dev, dev_luts, dev_remaps, row_mask))
+                pending.append((table, enc, dev, dev_luts, dev_remaps, dev_dremaps, row_mask))
                 if len(pending) >= GROUP_N:
                     dispatch_pending()
             except UnsupportedOnDevice as e:
@@ -983,6 +1038,7 @@ class TpuQueryExecutor(QueryExecutor):
         remap_shapes: tuple,
         n_blocks: int = 1,
         dev_keys: tuple = (),
+        dremap_shapes: tuple = (),
     ) -> Callable:
         """One jitted dispatch: WHERE mask + dict remap + group ids + fused
         aggregate + fold into the device accumulator.
@@ -1018,6 +1074,9 @@ class TpuQueryExecutor(QueryExecutor):
             n_blocks,
             None if mesh is None else id(mesh),
             dev_keys,
+            tuple(layout.distinct_cols),
+            layout.distinct_caps,
+            dremap_shapes,
         )
         prog = _PROGRAM_CACHE.get(key)
         if prog is not None:
@@ -1037,7 +1096,7 @@ class TpuQueryExecutor(QueryExecutor):
 
         from parseable_tpu import DEFAULT_TIMESTAMP_KEY
 
-        def fold_one(acc, dev: dict, luts: tuple, remaps: tuple, row_mask):
+        def fold_one(acc, dacc: tuple, dev: dict, luts: tuple, remaps: tuple, dremaps: tuple, row_mask):
             # row count as seen by this trace: the full block single-chip,
             # or this device's shard under shard_map
             local_rows = row_mask.shape[0]
@@ -1099,6 +1158,18 @@ class TpuQueryExecutor(QueryExecutor):
                 n_max,
             )
             adds = jnp.concatenate([count[None, :], pac, sums], axis=0)
+            # distinct presence: OR (max) each (group, value-code) bit
+            dacc_new = []
+            for di, (dcol, dcap) in enumerate(zip(layout.distinct_cols, layout.distinct_caps)):
+                codes = jnp.minimum(dremaps[di][dev[dcol]], dcap - 1)
+                dm = jnp.logical_and(mask, dev[f"{dcol}__valid"])
+                flat = ids * jnp.int32(dcap) + codes
+                upd = jax.ops.segment_max(
+                    dm.astype(jnp.float32), flat, num_segments=num_groups * dcap
+                )
+                if mesh is not None:
+                    upd = jax.lax.pmax(upd, "data")
+                dacc_new.append(jnp.maximum(dacc[di], upd))
             if mesh is not None:
                 # the distributed reduce tree: partials ride ICI
                 adds = jax.lax.psum(adds, "data")
@@ -1113,29 +1184,43 @@ class TpuQueryExecutor(QueryExecutor):
                 ],
                 axis=0,
             )
-            return new_acc
+            return new_acc, tuple(dacc_new)
 
-        def prog_fn(acc, devs: tuple, luts_all: tuple, remaps_all: tuple, row_masks: tuple):
+        def prog_fn(
+            acc,
+            dacc: tuple,
+            devs: tuple,
+            luts_all: tuple,
+            remaps_all: tuple,
+            dremaps_all: tuple,
+            row_masks: tuple,
+        ):
             # unrolled folds: N blocks per dispatch amortize round-trip
             # latency; XLA sees one big program and schedules it as a unit
             for i in range(n_blocks):
-                acc = fold_one(acc, devs[i], luts_all[i], remaps_all[i], row_masks[i])
-            return acc
+                acc, dacc = fold_one(
+                    acc, dacc, devs[i], luts_all[i], remaps_all[i], dremaps_all[i], row_masks[i]
+                )
+            return acc, dacc
 
         if mesh is not None:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
             n_remaps = sum(1 for s in remap_shapes if s is not None)
+            n_dremaps = len(dremap_shapes)
             dev_spec = {k: P("data") for k in dev_keys}
             in_specs = (
                 P(),  # accumulator: replicated
+                tuple(P() for _ in layout.distinct_caps),  # presence bitmaps
                 tuple(dev_spec for _ in range(n_blocks)),
                 tuple(tuple(P() for _ in lut_shapes) for _ in range(n_blocks)),
                 tuple(tuple(P() for _ in range(n_remaps)) for _ in range(n_blocks)),
+                tuple(tuple(P() for _ in range(n_dremaps)) for _ in range(n_blocks)),
                 tuple(P("data") for _ in range(n_blocks)),
             )
-            prog_body = shard_map(prog_fn, mesh=mesh, in_specs=in_specs, out_specs=P())
+            out_specs = (P(), tuple(P() for _ in layout.distinct_caps))
+            prog_body = shard_map(prog_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         else:
             prog_body = prog_fn
 
@@ -1205,6 +1290,7 @@ class TpuQueryExecutor(QueryExecutor):
         key_specs: list[KeySpec],
         agg: HashAggregator,
         specs: list[AggSpec],
+        dists: list[tuple] | None = None,  # (spec_idx, KeySpec, [G, Vcap] presence)
     ) -> None:
         """Dense accumulators -> sparse host aggregator, decoding group ids."""
         idxs = np.nonzero(state.count > 0)[0]
@@ -1235,6 +1321,8 @@ class TpuQueryExecutor(QueryExecutor):
             for si, spec in enumerate(specs):
                 if spec.func == "count_star":
                     counts.append(int(state.count[flat]))
+                elif spec.func == "count_distinct":
+                    counts.append(0)  # finalized from the merged value sets
                 else:
                     pos = stacked_order.index(si)
                     counts.append(int(state.per_agg_count[pos][flat]))
@@ -1252,7 +1340,13 @@ class TpuQueryExecutor(QueryExecutor):
                     maxs_l.append(None if v == -np.inf else float(v))
                 else:
                     maxs_l.append(None)
-            agg.merge_raw(tuple(key_parts), counts, sums_l, mins_l, maxs_l)
+            distincts = None
+            if dists:
+                distincts = {}
+                for si, dk, presence in dists:
+                    codes = np.nonzero(presence[flat][: len(dk.gdict)] > 0)[0]
+                    distincts[si] = {dk.gdict.values[c] for c in codes}
+            agg.merge_raw(tuple(key_parts), counts, sums_l, mins_l, maxs_l, distincts)
         state.count[:] = 0
         state.per_agg_count[:] = 0
         state.sums[:] = 0

@@ -269,6 +269,10 @@ class LogicalPlan:
     # scan's overall [min, max] event time (from manifests): lets the TPU
     # engine pre-size time-bin group capacities and flush exactly once
     scan_time_hint: tuple[datetime, datetime] | None = None
+    # safety rails (set by the session from Options; reference:
+    # query/mod.rs:92,152-165 timeout + :216-226 memory pool)
+    deadline: float | None = None  # time.monotonic() cutoff
+    memory_limit_bytes: int | None = None
 
     @property
     def count_star_only(self) -> bool:

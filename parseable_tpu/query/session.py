@@ -69,29 +69,12 @@ class QuerySession:
         RBAC scope, enforced on the *resolved* plan before any execution so
         unauthorized streams neither run nor leak through error messages."""
         t0 = _time.monotonic()
-        select = S.parse_sql(sql_text)
-        lp = build_plan(select)
-        if allowed_streams is not None and lp.stream not in allowed_streams:
-            raise QueryError(f"unauthorized for stream {lp.stream!r}")
-        self.resolve_stream(lp.stream)
-        stream = self.p.streams.get(lp.stream)
-        if stream is not None and stream.metadata.schema:
-            lp.schema_hint = pa.schema(list(stream.metadata.schema.values()))
+        lp = self._plan(sql_text, start_time, end_time, allowed_streams, t0)
 
-        if start_time and end_time:
-            tr = TimeRange.parse_human_time(start_time, end_time)
-            api_bounds = TimeBounds(low=tr.start, high=tr.end)
-            lp.time_bounds = lp.time_bounds.intersect(api_bounds)
-
-        hot_dir = (
-            self.p.hot_tier.local_dir_for_scan(lp.stream)
-            if getattr(self.p, "hot_tier", None) is not None
-            else self.p.options.hot_tier_storage_path
-        )
         scan = StreamScan(
             self.p,
             lp,
-            hot_tier_dir=hot_dir,
+            hot_tier_dir=self._hot_dir(lp.stream),
             use_hot_stubs=self.engine == "tpu" and lp.is_aggregate,
         )
         result = self._execute(lp, scan)
@@ -108,6 +91,65 @@ class QuerySession:
             }
         )
         return result
+
+    def _plan(
+        self,
+        sql_text: str,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+    ) -> LogicalPlan:
+        select = S.parse_sql(sql_text)
+        lp = build_plan(select)
+        if allowed_streams is not None and lp.stream not in allowed_streams:
+            raise QueryError(f"unauthorized for stream {lp.stream!r}")
+        self.resolve_stream(lp.stream)
+        stream = self.p.streams.get(lp.stream)
+        if stream is not None and stream.metadata.schema:
+            lp.schema_hint = pa.schema(list(stream.metadata.schema.values()))
+
+        if start_time and end_time:
+            tr = TimeRange.parse_human_time(start_time, end_time)
+            api_bounds = TimeBounds(low=tr.start, high=tr.end)
+            lp.time_bounds = lp.time_bounds.intersect(api_bounds)
+
+        # safety rails (reference: query/mod.rs:92,152-165 + :216-226)
+        timeout = self.p.options.query_timeout_secs
+        if timeout:
+            lp.deadline = t0 + timeout
+        lp.memory_limit_bytes = self.p.options.query_memory_limit_bytes
+        return lp
+
+    def query_stream(
+        self,
+        sql_text: str,
+        start_time: str | None = None,
+        end_time: str | None = None,
+        allowed_streams: set[str] | None = None,
+    ):
+        """Streaming variant (reference: handlers/http/query.rs:325-407):
+        returns an iterator of pyarrow Tables, emitted as the scan
+        progresses, so `SELECT *` over a huge range never materializes in
+        full. Row export is IO-bound, so it always runs the CPU engine —
+        the device path exists for aggregation."""
+        t0 = _time.monotonic()
+        lp = self._plan(sql_text, start_time, end_time, allowed_streams, t0)
+        # streaming exports are paced by the client (resp.write backpressure
+        # counts as wall time); the SQL timeout would truncate every large
+        # download, so it doesn't apply here — memory stays bounded by the
+        # per-block emission instead
+        lp.deadline = None
+        scan = StreamScan(self.p, lp, hot_tier_dir=self._hot_dir(lp.stream))
+        executor = QueryExecutor(lp)
+        return executor.execute_select_stream(scan.tables())
+
+    def _hot_dir(self, stream: str):
+        return (
+            self.p.hot_tier.local_dir_for_scan(stream)
+            if getattr(self.p, "hot_tier", None) is not None
+            else self.p.options.hot_tier_storage_path
+        )
 
     def _execute(self, lp: LogicalPlan, scan: StreamScan) -> QueryResult:
         # count(*) fast path off manifest row counts, only when every

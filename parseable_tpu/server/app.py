@@ -352,8 +352,14 @@ async def query(request: web.Request) -> web.Response:
         return web.json_response({"error": "missing 'query'"}, status=400)
     start, end = body.get("startTime"), body.get("endTime")
     send_fields = bool(body.get("fields", False))
+    streaming = bool(body.get("streaming", False))
     # RBAC scope resolves against the parsed plan, pre-execution
     allowed = state.rbac.user_allowed_streams(request["username"])
+
+    from parseable_tpu.query.executor import MemoryLimitExceeded, QueryTimeout
+
+    if streaming:
+        return await _query_streaming(request, state, sql, start, end, allowed, send_fields)
 
     def work():
         sess = QuerySession(state.p)
@@ -361,6 +367,10 @@ async def query(request: web.Request) -> web.Response:
 
     try:
         result = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except QueryTimeout as e:
+        return web.json_response({"error": str(e)}, status=504)
+    except MemoryLimitExceeded as e:
+        return web.json_response({"error": str(e)}, status=413)
     except QueryError as e:
         if "unauthorized" in str(e):
             return web.json_response({"error": "Forbidden"}, status=403)
@@ -375,6 +385,61 @@ async def query(request: web.Request) -> web.Response:
     if send_fields:
         return web.json_response({"fields": result.fields, "records": rows, "stats": result.stats})
     return web.json_response(rows)
+
+
+async def _query_streaming(request, state, sql, start, end, allowed, send_fields=False):
+    """Chunked NDJSON response (reference: query.rs:325-407): one line per
+    scanned block, emitted as the scan progresses — a `SELECT *` over a big
+    range streams without the server holding the full result."""
+    from parseable_tpu.query.session import QuerySession as QS
+    from parseable_tpu.utils.arrowutil import record_batches_to_json
+
+    loop = asyncio.get_running_loop()
+
+    def start_stream():
+        sess = QS(state.p)
+        it = sess.query_stream(sql, start, end, allowed_streams=allowed)
+        return iter(it)
+
+    try:
+        it = await loop.run_in_executor(state.workers, start_stream)
+    except QueryError as e:
+        if "unauthorized" in str(e):
+            return web.json_response({"error": "Forbidden"}, status=403)
+        return web.json_response({"error": str(e)}, status=400)
+    except (SqlError, TimeParseError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+
+    resp = web.StreamResponse(
+        headers={"Content-Type": "application/x-ndjson", "Transfer-Encoding": "chunked"}
+    )
+    await resp.prepare(request)
+    fields_sent = not send_fields
+    try:
+        try:
+            while True:
+                part = await loop.run_in_executor(state.workers, lambda: next(it, None))
+                if part is None:
+                    break
+                if not fields_sent:
+                    await resp.write(
+                        json.dumps({"fields": part.column_names}).encode() + b"\n"
+                    )
+                    fields_sent = True
+                rows = record_batches_to_json(part.to_batches())
+                await resp.write(json.dumps({"records": rows}).encode() + b"\n")
+            await resp.write_eof()
+        except Exception as e:
+            # headers are gone; surface the error in-band like the reference
+            # — unless the connection itself is dead (client disconnect)
+            try:
+                await resp.write(json.dumps({"error": str(e)}).encode() + b"\n")
+                await resp.write_eof()
+            except (ConnectionError, ConnectionResetError):
+                logger.debug("client disconnected mid-stream")
+    finally:
+        it.close()  # release open scan files promptly
+    return resp
 
 
 @require(Action.QUERY)

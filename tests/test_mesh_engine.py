@@ -122,3 +122,27 @@ def test_mesh_session_end_to_end(parseable):
     tpu = QuerySession(p, engine="tpu").query(sql).to_json_rows()
     assert_parity(cpu, tpu, sql)
     assert sum(r["c"] for r in tpu) == 5000
+
+
+def test_mesh_count_distinct_parity():
+    """count(distinct y) runs on the device bitmap path (segment_max OR over
+    [G, Vcap]) and matches the CPU engine's exact sets — including mixed
+    device/CPU-fallback block merges."""
+    tables = [make_table(6000, seed=s) for s in range(3)]
+    sql = "SELECT status, count(*) c, count(distinct host) d FROM t GROUP BY status"
+    before = set(ET._PROGRAM_CACHE)
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter(tables)).to_pylist()
+    tpu = ET.TpuQueryExecutor(lp2).execute(iter(tables)).to_pylist()
+    assert_parity(cpu, tpu, sql)
+    new_keys = [k for k in ET._PROGRAM_CACHE if k not in before]
+    assert new_keys, "distinct query fell back to CPU entirely"
+
+
+def test_count_distinct_no_groupby():
+    tables = [make_table(4000, seed=s) for s in range(2)]
+    sql = "SELECT count(distinct host) d, count(distinct status) e FROM t"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter(tables)).to_pylist()
+    tpu = ET.TpuQueryExecutor(lp2).execute(iter(tables)).to_pylist()
+    assert cpu == tpu == [{"d": 4, "e": 3}]

@@ -1,0 +1,133 @@
+"""Query safety rails: timeout, memory cap, top-K pushdown, streaming.
+
+Reference: dedicated runtime + SQL timeout (query/mod.rs:92,152-165),
+memory pool (:216-226), chunked streaming (handlers/http/query.rs:325-407).
+"""
+
+import time
+from datetime import datetime, timedelta
+
+import pyarrow as pa
+import pytest
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query.executor import (
+    MemoryLimitExceeded,
+    QueryExecutor,
+    QueryTimeout,
+)
+from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+BASE = datetime(2024, 5, 1, 10, 0)
+
+
+def make_table(n=5000, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ts = [BASE + timedelta(seconds=int(i)) for i in rng.integers(0, 3600, n)]
+    return pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "v": pa.array(rng.random(n) * 1000),
+            "host": pa.array(rng.choice(["a", "b", "c"], n).tolist()),
+        }
+    )
+
+
+def test_timeout_cuts_off_scan():
+    lp = build_plan(parse_sql("SELECT host, count(*) c FROM t GROUP BY host"))
+    lp.deadline = time.monotonic() - 1  # already expired
+
+    def slow_tables():
+        yield make_table()
+
+    with pytest.raises(QueryTimeout):
+        QueryExecutor(lp).execute(slow_tables())
+
+
+def test_timeout_cuts_off_tpu_scan():
+    lp = build_plan(parse_sql("SELECT host, count(*) c FROM t GROUP BY host"))
+    lp.deadline = time.monotonic() - 1
+    with pytest.raises(QueryTimeout):
+        TpuQueryExecutor(lp).execute(iter([make_table()]))
+
+
+def test_memory_limit_select():
+    lp = build_plan(parse_sql("SELECT * FROM t"))
+    lp.memory_limit_bytes = 10_000  # tiny
+    tables = [make_table(seed=s) for s in range(4)]
+    with pytest.raises(MemoryLimitExceeded):
+        QueryExecutor(lp).execute(iter(tables))
+
+
+def test_topk_pushdown_bounds_memory_and_matches_full_sort():
+    """ORDER BY + LIMIT over many blocks compacts the working set instead of
+    materializing everything — and still returns the globally correct K."""
+    sql = "SELECT v, host FROM t ORDER BY v DESC LIMIT 7"
+    tables = [make_table(seed=s) for s in range(6)]
+    lp = build_plan(parse_sql(sql))
+    # a memory cap far below the full concat proves compaction happened
+    lp.memory_limit_bytes = 500_000
+    got = QueryExecutor(lp).execute(iter(tables)).to_pylist()
+    all_rows = pa.concat_tables(
+        [t.select(["v", "host"]) for t in tables]
+    ).to_pylist()
+    want = sorted(all_rows, key=lambda r: -r["v"])[:7]
+    assert [r["v"] for r in got] == [r["v"] for r in want]
+
+
+def test_topk_with_offset():
+    sql = "SELECT v FROM t ORDER BY v LIMIT 5 OFFSET 3"
+    tables = [make_table(seed=s) for s in range(3)]
+    lp = build_plan(parse_sql(sql))
+    got = [r["v"] for r in QueryExecutor(lp).execute(iter(tables)).to_pylist()]
+    every = sorted(
+        v for t in tables for v in t.column("v").to_pylist()
+    )
+    assert got == every[3:8]
+
+
+def test_select_stream_yields_incrementally():
+    lp = build_plan(parse_sql("SELECT host, v FROM t WHERE v >= 0 LIMIT 9000"))
+    tables = [make_table(seed=s) for s in range(3)]
+    out = list(QueryExecutor(lp).execute_select_stream(iter(tables)))
+    assert len(out) >= 2  # streamed per block, not one materialized table
+    assert sum(t.num_rows for t in out) == 9000
+
+
+def test_select_stream_offset_and_order_fallback():
+    # ORDER BY forces materialization but still returns correct rows
+    lp = build_plan(parse_sql("SELECT v FROM t ORDER BY v LIMIT 4"))
+    tables = [make_table(seed=s) for s in range(2)]
+    out = list(QueryExecutor(lp).execute_select_stream(iter(tables)))
+    assert len(out) == 1
+    every = sorted(v for t in tables for v in t.column("v").to_pylist())
+    assert [r["v"] for r in out[0].to_pylist()] == every[:4]
+
+
+def test_session_applies_rails(parseable):
+    from parseable_tpu.event.json_format import JsonEvent
+    from parseable_tpu.query.session import QuerySession
+
+    p = parseable
+    p.options.query_timeout_secs = 300
+    stream = p.create_stream_if_not_exists("railed")
+    ev = JsonEvent([{"a": i} for i in range(50)], "railed").into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+
+    sess = QuerySession(p, engine="cpu")
+    res = sess.query("SELECT a FROM railed ORDER BY a DESC LIMIT 3")
+    assert [r["a"] for r in res.to_json_rows()] == [49.0, 48.0, 47.0]
+
+    # streaming variant
+    parts = list(sess.query_stream("SELECT a FROM railed LIMIT 10"))
+    assert sum(t.num_rows for t in parts) == 10
+
+    # timeout = 0-ish -> the query is cut off
+    p.options.query_timeout_secs = -1
+    with pytest.raises(QueryTimeout):
+        sess.query("SELECT a, count(*) FROM railed GROUP BY a")
+    p.options.query_timeout_secs = 300

@@ -425,3 +425,55 @@ def test_internal_staging_requires_query_permission(tmp_path):
         assert r.status == 403
 
     run(with_client(state, fn))
+
+
+def test_streaming_query(tmp_path):
+    """NDJSON streaming (reference: query.rs:325-407): rows arrive in
+    chunks, optional fields line first."""
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/ingest",
+            json=[{"a": i} for i in range(30)],
+            headers={**AUTH, "X-P-Stream": "s1"},
+        )
+        assert r.status == 200
+        r = await client.post(
+            "/api/v1/query",
+            json={
+                "query": "select a from s1 limit 10",
+                "startTime": "1h",
+                "endTime": "now",
+                "streaming": True,
+                "fields": True,
+            },
+            headers=AUTH,
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("application/x-ndjson")
+        lines = [json.loads(l) for l in (await r.text()).strip().splitlines()]
+        assert lines[0] == {"fields": ["a"]}
+        rows = [rec for l in lines[1:] for rec in l["records"]]
+        assert len(rows) == 10
+
+    run(with_client(state, fn))
+
+
+def test_query_timeout_maps_to_504(tmp_path):
+    state = make_state(tmp_path)
+    state.p.options.query_timeout_secs = -1  # instantly expired deadline
+
+    async def fn(client):
+        await client.post(
+            "/api/v1/ingest", json=[{"a": 1}], headers={**AUTH, "X-P-Stream": "s2"}
+        )
+        r = await client.post(
+            "/api/v1/query",
+            json={"query": "select a, count(*) from s2 group by a",
+                  "startTime": "1h", "endTime": "now"},
+            headers=AUTH,
+        )
+        assert r.status == 504
+
+    run(with_client(state, fn))
