@@ -211,6 +211,10 @@ class StorageOptions:
     endpoint_url: str | None = field(default_factory=lambda: _env("P_S3_URL"))
     access_key: str | None = field(default_factory=lambda: _env("P_S3_ACCESS_KEY"))
     secret_key: str | None = field(default_factory=lambda: _env("P_S3_SECRET_KEY"))
+    # azure (blob-store): account + its own key; container rides `bucket` —
+    # kept separate from the S3 credentials so stale env vars can't cross-wire
+    account: str | None = field(default_factory=lambda: _env("P_AZR_ACCOUNT"))
+    azure_access_key: str | None = field(default_factory=lambda: _env("P_AZR_ACCESS_KEY"))
 
 
 def generate_node_id() -> str:

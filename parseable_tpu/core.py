@@ -79,6 +79,13 @@ class Parseable:
             bucket=self.storage_options.bucket,
             region=self.storage_options.region,
             endpoint=self.storage_options.endpoint_url,
+            access_key=self.storage_options.access_key,
+            secret_key=self.storage_options.secret_key,
+            account=getattr(self.storage_options, "account", None),
+            azure_access_key=getattr(self.storage_options, "azure_access_key", None),
+            multipart_threshold=self.options.multipart_threshold_bytes,
+            download_chunk_bytes=self.options.hot_tier_download_chunk_bytes,
+            download_concurrency=self.options.hot_tier_download_concurrency,
         )
         self.storage = self.provider.construct_client()
         self.metastore = ObjectStoreMetastore(self.storage)

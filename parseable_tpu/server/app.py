@@ -438,7 +438,22 @@ async def _query_streaming(request, state, sql, start, end, allowed, send_fields
             except (ConnectionError, ConnectionResetError):
                 logger.debug("client disconnected mid-stream")
     finally:
-        it.close()  # release open scan files promptly
+        # close on a worker thread: if the handler was cancelled while a
+        # next(it) is still executing in the pool, closing from here would
+        # raise ValueError("generator already executing")
+        def _close_quietly():
+            import time as _tm
+
+            for _ in range(40):
+                try:
+                    it.close()
+                    return
+                except ValueError:
+                    _tm.sleep(0.05)
+                except Exception:
+                    return
+
+        state.workers.submit(_close_quietly)
     return resp
 
 

@@ -1,0 +1,223 @@
+"""Azure Blob Storage backend (reference: src/storage/azure_blob.rs).
+
+Self-contained SharedKey REST client over `requests` (no azure SDK in this
+image). Block blobs only — which is all a log store writes:
+
+- Put Blob for small objects; Put Block + Put Block List above the
+  multipart threshold (Azure's multipart analogue);
+- Get Blob with Range headers for the parallel chunked download path;
+- List Blobs (XML, prefix + delimiter) for listing and dir discovery.
+
+Endpoint override supports Azurite for tests.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import hashlib
+import hmac
+import xml.etree.ElementTree as ET
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Iterator
+from urllib.parse import quote
+
+from parseable_tpu.storage.object_storage import (
+    NoSuchKey,
+    ObjectMeta,
+    ObjectStorage,
+    ObjectStorageError,
+    _timed,
+)
+
+_API_VERSION = "2021-08-06"
+
+
+class AzureBlobStorage(ObjectStorage):
+    name = "blob_store"
+
+    def __init__(
+        self,
+        account: str,
+        container: str,
+        access_key: str,
+        endpoint: str | None = None,
+        multipart_threshold: int = 25 * 1024 * 1024,
+        download_chunk_bytes: int = 8 * 1024 * 1024,
+        download_concurrency: int = 16,
+    ):
+        import requests
+
+        self.account = account
+        self.container = container
+        self.key = base64.b64decode(access_key) if access_key else b""
+        self.endpoint = (endpoint or f"https://{account}.blob.core.windows.net").rstrip("/")
+        self.multipart_threshold = multipart_threshold
+        self.block_size = 25 * 1024 * 1024
+        self.download_chunk_bytes = max(1 << 20, download_chunk_bytes)
+        self.download_concurrency = max(1, download_concurrency)
+        self._session = requests.Session()
+
+    # ---------------------------------------------------------------- signing
+
+    def _auth_headers(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, str],
+        content_length: int,
+        extra: dict[str, str],
+    ) -> dict[str, str]:
+        now = _dt.datetime.now(_dt.UTC).strftime("%a, %d %b %Y %H:%M:%S GMT")
+        headers = {"x-ms-date": now, "x-ms-version": _API_VERSION, **extra}
+        canon_headers = "".join(
+            f"{k}:{headers[k]}\n" for k in sorted(h for h in headers if h.startswith("x-ms-"))
+        )
+        canon_resource = f"/{self.account}{path}"
+        for k in sorted(query):
+            canon_resource += f"\n{k}:{query[k]}"
+        string_to_sign = "\n".join(
+            [
+                method,
+                "",  # Content-Encoding
+                "",  # Content-Language
+                str(content_length) if content_length else "",
+                "",  # Content-MD5
+                extra.get("Content-Type", ""),
+                "",  # Date (we use x-ms-date)
+                "",  # If-Modified-Since
+                "",  # If-Match
+                "",  # If-None-Match
+                "",  # If-Unmodified-Since
+                extra.get("Range", ""),
+                canon_headers + canon_resource,
+            ]
+        )
+        sig = base64.b64encode(
+            hmac.new(self.key, string_to_sign.encode(), hashlib.sha256).digest()
+        ).decode()
+        headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+        return headers
+
+    def _request(
+        self,
+        method: str,
+        key: str = "",
+        query: dict[str, str] | None = None,
+        data: bytes | None = None,
+        extra: dict[str, str] | None = None,
+    ):
+        query = query or {}
+        extra = dict(extra or {})
+        path = f"/{self.container}" + (f"/{key}" if key else "")
+        if data is not None and method == "PUT" and "x-ms-blob-type" not in extra and "comp" not in query:
+            extra["x-ms-blob-type"] = "BlockBlob"
+        headers = self._auth_headers(method, path, query, len(data) if data else 0, extra)
+        if "Range" in extra:
+            headers["Range"] = extra["Range"]
+        url = self.endpoint + quote(path)
+        return self._session.request(
+            method, url, params=query, data=data, headers=headers, timeout=60
+        )
+
+    def _check(self, resp, key: str = ""):
+        if resp.status_code == 404:
+            raise NoSuchKey(key)
+        if resp.status_code >= 300:
+            raise ObjectStorageError(
+                f"azure {resp.request.method} {key!r} -> {resp.status_code}: {resp.text[:200]}"
+            )
+        return resp
+
+    # -------------------------------------------------------------- trait ops
+
+    def get_object(self, key: str) -> bytes:
+        with _timed(self.name, "GET"):
+            return self._check(self._request("GET", key), key).content
+
+    def put_object(self, key: str, data: bytes) -> None:
+        with _timed(self.name, "PUT"):
+            self._check(self._request("PUT", key, data=data), key)
+
+    def delete_object(self, key: str) -> None:
+        with _timed(self.name, "DELETE"):
+            resp = self._request("DELETE", key)
+            if resp.status_code not in (200, 202, 204, 404):
+                self._check(resp, key)
+
+    def head(self, key: str) -> ObjectMeta:
+        with _timed(self.name, "HEAD"):
+            resp = self._request("HEAD", key)
+            if resp.status_code == 404:
+                raise NoSuchKey(key)
+            self._check(resp, key)
+            return ObjectMeta(
+                key=key, size=int(resp.headers.get("Content-Length", 0)), last_modified=0.0
+            )
+
+    def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
+        with _timed(self.name, "LIST"):
+            marker = None
+            while True:
+                query = {"restype": "container", "comp": "list", "prefix": prefix}
+                if not recursive:
+                    query["delimiter"] = "/"
+                if marker:
+                    query["marker"] = marker
+                root = ET.fromstring(self._check(self._request("GET", query=query)).text)
+                for b in root.iter("Blob"):
+                    props = b.find("Properties")
+                    size = int(props.find("Content-Length").text) if props is not None else 0
+                    yield ObjectMeta(key=b.find("Name").text, size=size, last_modified=0.0)
+                nm = root.find("NextMarker")
+                marker = nm.text if nm is not None else None
+                if not marker:
+                    break
+
+    def list_dirs(self, prefix: str) -> list[str]:
+        with _timed(self.name, "LIST"):
+            p = prefix.rstrip("/") + "/" if prefix else ""
+            query = {"restype": "container", "comp": "list", "prefix": p, "delimiter": "/"}
+            root = ET.fromstring(self._check(self._request("GET", query=query)).text)
+            out = []
+            for bp in root.iter("BlobPrefix"):
+                out.append(bp.find("Name").text[len(p) :].rstrip("/"))
+            return sorted(out)
+
+    def upload_file(self, key: str, path: Path) -> None:
+        size = path.stat().st_size
+        if size <= self.multipart_threshold:
+            self.put_object(key, path.read_bytes())
+            return
+        with _timed(self.name, "PUT_BLOCKS"):
+            block_ids: list[str] = []
+            n_blocks = (size + self.block_size - 1) // self.block_size
+
+            def put_block(i: int) -> str:
+                bid = base64.b64encode(f"block-{i:08d}".encode()).decode()
+                with path.open("rb") as f:
+                    f.seek(i * self.block_size)
+                    chunk = f.read(self.block_size)
+                self._check(
+                    self._request("PUT", key, query={"comp": "block", "blockid": bid}, data=chunk),
+                    key,
+                )
+                return bid
+
+            with ThreadPoolExecutor(max_workers=min(8, n_blocks)) as pool:
+                block_ids = list(pool.map(put_block, range(n_blocks)))
+            body = "<BlockList>" + "".join(
+                f"<Latest>{b}</Latest>" for b in block_ids
+            ) + "</BlockList>"
+            self._check(
+                self._request("PUT", key, query={"comp": "blocklist"}, data=body.encode()),
+                key,
+            )
+
+    def get_range(self, key: str, start: int, end: int) -> bytes:
+        """Ranged read primitive for the shared parallel download."""
+        resp = self._check(
+            self._request("GET", key, extra={"Range": f"bytes={start}-{end}"}), key
+        )
+        return resp.content

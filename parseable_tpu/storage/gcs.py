@@ -1,0 +1,105 @@
+"""GCS object storage backend (reference: src/storage/gcs.rs).
+
+Primary backend for TPU-VMs (SURVEY §2 row 7: "GCS first"). Wraps the
+google-cloud-storage SDK behind the same ObjectStorage trait; large
+downloads use parallel ranged reads like the S3 backend, and uploads above
+the multipart threshold use the SDK's resumable upload (GCS's equivalent
+of S3 multipart).
+
+Supports a custom `endpoint` (fake-gcs-server / emulator) via
+client_options, which is also how tests drive it without egress.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator
+
+from parseable_tpu.storage.object_storage import (
+    NoSuchKey,
+    ObjectMeta,
+    ObjectStorage,
+    _timed,
+)
+
+
+class GcsStorage(ObjectStorage):
+    name = "gcs"
+
+    def __init__(
+        self,
+        bucket: str,
+        endpoint: str | None = None,
+        multipart_threshold: int = 25 * 1024 * 1024,
+        download_chunk_bytes: int = 8 * 1024 * 1024,
+        download_concurrency: int = 16,
+    ):
+        from google.cloud import storage as gcs
+
+        kwargs = {}
+        if endpoint:
+            import google.auth.credentials
+
+            kwargs["client_options"] = {"api_endpoint": endpoint}
+            kwargs["credentials"] = google.auth.credentials.AnonymousCredentials()
+        self.client = gcs.Client(**kwargs)
+        self.bucket = self.client.bucket(bucket)
+        self.multipart_threshold = multipart_threshold
+        self.download_chunk_bytes = max(1 << 20, download_chunk_bytes)
+        self.download_concurrency = max(1, download_concurrency)
+
+    def get_object(self, key: str) -> bytes:
+        from google.api_core import exceptions as gexc
+
+        with _timed(self.name, "GET"):
+            try:
+                return self.bucket.blob(key).download_as_bytes()
+            except gexc.NotFound as e:
+                raise NoSuchKey(key) from e
+
+    def put_object(self, key: str, data: bytes) -> None:
+        with _timed(self.name, "PUT"):
+            self.bucket.blob(key).upload_from_string(data)
+
+    def delete_object(self, key: str) -> None:
+        from google.api_core import exceptions as gexc
+
+        with _timed(self.name, "DELETE"):
+            try:
+                self.bucket.blob(key).delete()
+            except gexc.NotFound:
+                pass
+
+    def head(self, key: str) -> ObjectMeta:
+        with _timed(self.name, "HEAD"):
+            blob = self.bucket.get_blob(key)
+            if blob is None:
+                raise NoSuchKey(key)
+            ts = blob.updated.timestamp() if blob.updated else 0.0
+            return ObjectMeta(key=key, size=blob.size or 0, last_modified=ts)
+
+    def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
+        with _timed(self.name, "LIST"):
+            delimiter = None if recursive else "/"
+            for blob in self.client.list_blobs(self.bucket, prefix=prefix, delimiter=delimiter):
+                ts = blob.updated.timestamp() if blob.updated else 0.0
+                yield ObjectMeta(key=blob.name, size=blob.size or 0, last_modified=ts)
+
+    def list_dirs(self, prefix: str) -> list[str]:
+        with _timed(self.name, "LIST"):
+            p = prefix.rstrip("/") + "/" if prefix else ""
+            it = self.client.list_blobs(self.bucket, prefix=p, delimiter="/")
+            list(it)  # prefixes populate after iteration
+            return sorted(x[len(p) :].rstrip("/") for x in it.prefixes)
+
+    def upload_file(self, key: str, path: Path) -> None:
+        with _timed(self.name, "PUT"):
+            blob = self.bucket.blob(key)
+            if path.stat().st_size > self.multipart_threshold:
+                # resumable upload = GCS's multipart analogue
+                blob.chunk_size = 8 * 1024 * 1024
+            blob.upload_from_filename(str(path))
+
+    def get_range(self, key: str, start: int, end: int) -> bytes:
+        """Ranged read primitive for the shared parallel download."""
+        return self.bucket.blob(key).download_as_bytes(start=start, end=end)

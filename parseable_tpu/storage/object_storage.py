@@ -77,11 +77,47 @@ class ObjectStorage(ABC):
     def upload_file(self, key: str, path: Path) -> None:
         """Upload a local file (multipart when large)."""
 
-    @abstractmethod
-    def download_file(self, key: str, path: Path) -> None: ...
+    def get_range(self, key: str, start: int, end: int) -> bytes:
+        """Inclusive byte range [start, end]. Backends override with a real
+        ranged request; the default reads the whole object."""
+        return self.get_object(key)[start : end + 1]
 
-    @abstractmethod
-    def delete_prefix(self, prefix: str) -> None: ...
+    # tuning for the shared ranged download (overridden per backend config)
+    download_chunk_bytes: int = 8 * 1024 * 1024
+    download_concurrency: int = 16
+
+    def download_file(self, key: str, path: Path) -> None:
+        """Parallel ranged download shared by all remote backends
+        (reference: s3.rs:383-492; hot-tier chunk/concurrency knobs)."""
+        meta = self.head(key)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(path.name + ".tmp")
+        chunk = max(1 << 20, self.download_chunk_bytes)
+        if meta.size <= chunk:
+            tmp.write_bytes(self.get_object(key))
+        else:
+            with _timed(self.name, "GET_RANGED"):
+                ranges = [
+                    (o, min(o + chunk, meta.size) - 1) for o in range(0, meta.size, chunk)
+                ]
+                with tmp.open("wb") as f:
+                    f.truncate(meta.size)
+                    with ThreadPoolExecutor(
+                        max_workers=max(1, self.download_concurrency)
+                    ) as pool:
+                        for offset, data in zip(
+                            (r[0] for r in ranges),
+                            pool.map(lambda r: self.get_range(key, r[0], r[1]), ranges),
+                        ):
+                            f.seek(offset)
+                            f.write(data)
+        os.replace(tmp, path)
+
+    def delete_prefix(self, prefix: str) -> None:
+        """List-then-delete; backends with batch delete APIs override."""
+        with _timed(self.name, "DELETE_PREFIX"):
+            for meta in list(self.list_prefix(prefix)):
+                self.delete_object(meta.key)
 
     # -- helpers ------------------------------------------------------------
     def exists(self, key: str) -> bool:
@@ -224,54 +260,127 @@ class LocalFSProvider(ObjectStorageProvider):
 
 
 class GcsProvider(ObjectStorageProvider):
-    """GCS backend — primary target on TPU-VMs; requires google-cloud-storage.
+    """GCS backend — primary target on TPU-VMs (reference src/storage/gcs.rs).
 
-    Gated: raises StorageUnavailable when the SDK is absent (this build env
-    has no egress). Mirrors reference src/storage/gcs.rs.
+    Wraps google-cloud-storage (present in this image); a custom endpoint
+    targets fake-gcs-server/emulators.
     """
 
-    def __init__(self, bucket: str):
+    def __init__(self, bucket: str, endpoint: str | None = None, **tuning):
         self.bucket = bucket
+        self.endpoint = endpoint
+        self.tuning = tuning
 
     def construct_client(self) -> ObjectStorage:
         try:
-            import google.cloud.storage  # noqa: F401
+            from parseable_tpu.storage.gcs import GcsStorage
+
+            # the SDK import happens inside GcsStorage.__init__, so the
+            # construction itself must sit in the gated block
+            return GcsStorage(self.bucket, endpoint=self.endpoint, **self.tuning)
         except ImportError as e:
             raise StorageUnavailable(
                 "google-cloud-storage SDK not installed; use local-store"
             ) from e
-        raise StorageUnavailable("GCS backend not implemented in this build")
 
     def get_endpoint(self) -> str:
         return f"gs://{self.bucket}"
 
 
 class S3Provider(ObjectStorageProvider):
-    """S3 backend (reference src/storage/s3.rs). Gated like GCS."""
+    """S3-compatible backend — self-contained SigV4 client
+    (reference src/storage/s3.rs; works against AWS/MinIO/mock)."""
 
-    def __init__(self, bucket: str, region: str | None = None, endpoint: str | None = None):
+    def __init__(
+        self,
+        bucket: str,
+        region: str | None = None,
+        endpoint: str | None = None,
+        access_key: str | None = None,
+        secret_key: str | None = None,
+        **tuning,
+    ):
         self.bucket = bucket
         self.region = region
         self.endpoint = endpoint
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.tuning = tuning
 
     def construct_client(self) -> ObjectStorage:
-        try:
-            import boto3  # noqa: F401
-        except ImportError as e:
-            raise StorageUnavailable("boto3 not installed; use local-store") from e
-        raise StorageUnavailable("S3 backend not implemented in this build")
+        from parseable_tpu.storage.s3 import S3Storage
+
+        return S3Storage(
+            self.bucket,
+            region=self.region or "us-east-1",
+            endpoint=self.endpoint,
+            access_key=self.access_key,
+            secret_key=self.secret_key,
+            **self.tuning,
+        )
 
     def get_endpoint(self) -> str:
         return self.endpoint or f"s3://{self.bucket}"
 
 
+class AzureBlobProvider(ObjectStorageProvider):
+    """Azure Blob backend — SharedKey REST client
+    (reference src/storage/azure_blob.rs; Azurite-compatible)."""
+
+    def __init__(
+        self,
+        account: str,
+        container: str,
+        access_key: str,
+        endpoint: str | None = None,
+        **tuning,
+    ):
+        self.account = account
+        self.container = container
+        self.access_key = access_key
+        self.endpoint = endpoint
+        self.tuning = tuning
+
+    def construct_client(self) -> ObjectStorage:
+        from parseable_tpu.storage.azure_blob import AzureBlobStorage
+
+        return AzureBlobStorage(
+            self.account, self.container, self.access_key, endpoint=self.endpoint, **self.tuning
+        )
+
+    def get_endpoint(self) -> str:
+        return self.endpoint or f"https://{self.account}.blob.core.windows.net/{self.container}"
+
+
 def make_provider(backend: str, **kw) -> ObjectStorageProvider:
+    tuning = {
+        k: kw[k]
+        for k in ("multipart_threshold", "download_chunk_bytes", "download_concurrency")
+        if kw.get(k) is not None
+    }
     if backend in ("local-store", "localfs", "drive"):
         return LocalFSProvider(kw["root"])
     if backend in ("gcs-store", "gcs"):
-        return GcsProvider(kw["bucket"])
+        return GcsProvider(kw["bucket"], kw.get("endpoint"), **tuning)
     if backend in ("s3-store", "s3"):
-        return S3Provider(kw["bucket"], kw.get("region"), kw.get("endpoint"))
+        return S3Provider(
+            kw["bucket"],
+            kw.get("region"),
+            kw.get("endpoint"),
+            kw.get("access_key"),
+            kw.get("secret_key"),
+            **tuning,
+        )
+    if backend in ("blob-store", "azure", "blob"):
+        account = kw.get("account")
+        access_key = kw.get("azure_access_key")
+        if not account or not access_key:
+            raise ValueError(
+                "blob-store requires P_AZR_ACCOUNT and P_AZR_ACCESS_KEY"
+            )
+        return AzureBlobProvider(
+            account, kw["bucket"], access_key, kw.get("endpoint"), **tuning
+        )
     raise ValueError(f"unknown storage backend {backend!r}")
 
 

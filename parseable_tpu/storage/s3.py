@@ -1,0 +1,345 @@
+"""S3-compatible object storage backend (reference: src/storage/s3.rs).
+
+A self-contained SigV4 REST client over `requests` — no boto3 in this image.
+Implements the full trait surface the staging/hot-tier/catalog layers need:
+
+- basic ops: GET / PUT / HEAD / DELETE, ListObjectsV2 (+delimiter dirs),
+  batch DeleteObjects for prefixes;
+- `upload_file` switches to multipart above `multipart_threshold`
+  (reference: object_storage.rs:111-227 upload_multipart, s3.rs:716-813),
+  with concurrent part uploads and abort-on-failure;
+- `download_file` fetches large objects as parallel ranged GETs
+  (reference: s3.rs:383-492 parallel chunked download), honoring the
+  hot-tier chunk-size/concurrency knobs.
+
+Works against AWS and any S3-compatible endpoint (MinIO, the in-process
+mock in tests/s3_mock.py) via path-style addressing when an endpoint URL is
+configured.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import hmac
+import threading
+import xml.etree.ElementTree as ET
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Iterator
+from urllib.parse import quote
+
+from parseable_tpu.storage.object_storage import (
+    NoSuchKey,
+    ObjectMeta,
+    ObjectStorage,
+    ObjectStorageError,
+    _timed,
+)
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+# strip namespaces from ListBucketResult etc. so find() stays simple
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _uri_encode(s: str, encode_slash: bool) -> str:
+    safe = "-._~" if encode_slash else "-._~/"
+    return quote(s, safe=safe)
+
+
+class SigV4Signer:
+    """AWS Signature Version 4 (the published signing algorithm)."""
+
+    def __init__(self, access_key: str, secret_key: str, region: str, service: str = "s3"):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.service = service
+
+    def sign(
+        self,
+        method: str,
+        host: str,
+        path: str,
+        query: dict[str, str],
+        payload_sha256: str,
+        now: _dt.datetime | None = None,
+    ) -> dict[str, str]:
+        now = now or _dt.datetime.now(_dt.UTC)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        canonical_query = "&".join(
+            f"{_uri_encode(k, True)}={_uri_encode(v, True)}" for k, v in sorted(query.items())
+        )
+        headers = {
+            "host": host,
+            "x-amz-content-sha256": payload_sha256,
+            "x-amz-date": amz_date,
+        }
+        signed_headers = ";".join(sorted(headers))
+        canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+        canonical_request = "\n".join(
+            [
+                method,
+                _uri_encode(path, False),
+                canonical_query,
+                canonical_headers,
+                signed_headers,
+                payload_sha256,
+            ]
+        )
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        string_to_sign = "\n".join(
+            [
+                "AWS4-HMAC-SHA256",
+                amz_date,
+                scope,
+                hashlib.sha256(canonical_request.encode()).hexdigest(),
+            ]
+        )
+
+        def _hmac(key: bytes, msg: str) -> bytes:
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hmac(("AWS4" + self.secret_key).encode(), datestamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, self.service)
+        k = _hmac(k, "aws4_request")
+        signature = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+        auth = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        )
+        return {
+            "Authorization": auth,
+            "x-amz-date": amz_date,
+            "x-amz-content-sha256": payload_sha256,
+        }
+
+
+class S3Storage(ObjectStorage):
+    """SigV4 S3 client over requests (path-style for custom endpoints)."""
+
+    name = "s3"
+
+    def __init__(
+        self,
+        bucket: str,
+        region: str = "us-east-1",
+        endpoint: str | None = None,
+        access_key: str | None = None,
+        secret_key: str | None = None,
+        multipart_threshold: int = 25 * 1024 * 1024,
+        multipart_part_size: int = 25 * 1024 * 1024,
+        download_chunk_bytes: int = 8 * 1024 * 1024,
+        download_concurrency: int = 16,
+    ):
+        import os
+
+        import requests
+
+        self.bucket = bucket
+        self.region = region or "us-east-1"
+        self.endpoint = (endpoint or f"https://s3.{self.region}.amazonaws.com").rstrip("/")
+        self.signer = SigV4Signer(
+            access_key or os.environ.get("AWS_ACCESS_KEY_ID", ""),
+            secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
+            self.region,
+        )
+        self.multipart_threshold = multipart_threshold
+        self.multipart_part_size = max(5 * 1024 * 1024, multipart_part_size)
+        self.download_chunk_bytes = max(1 << 20, download_chunk_bytes)
+        self.download_concurrency = max(1, download_concurrency)
+        self._session = requests.Session()
+        self._session_lock = threading.Lock()
+        self._host = self.endpoint.split("://", 1)[1]
+
+    # ---------------------------------------------------------------- request
+
+    def _request(
+        self,
+        method: str,
+        key: str = "",
+        query: dict[str, str] | None = None,
+        data: bytes | None = None,
+        headers: dict[str, str] | None = None,
+        stream: bool = False,
+    ):
+        query = query or {}
+        path = f"/{self.bucket}" + (f"/{key}" if key else "")
+        payload = data or b""
+        sha = hashlib.sha256(payload).hexdigest() if payload else _EMPTY_SHA256
+        signed = self.signer.sign(method, self._host, path, query, sha)
+        if headers:
+            signed.update(headers)
+        url = self.endpoint + _uri_encode(path, False)
+        resp = self._session.request(
+            method, url, params=query, data=payload or None, headers=signed,
+            stream=stream, timeout=60,
+        )
+        return resp
+
+    def _check(self, resp, key: str = ""):
+        if resp.status_code == 404:
+            raise NoSuchKey(key)
+        if resp.status_code >= 300:
+            raise ObjectStorageError(
+                f"s3 {resp.request.method} {key!r} -> {resp.status_code}: {resp.text[:200]}"
+            )
+        return resp
+
+    # -------------------------------------------------------------- trait ops
+
+    def get_object(self, key: str) -> bytes:
+        with _timed(self.name, "GET"):
+            return self._check(self._request("GET", key), key).content
+
+    def put_object(self, key: str, data: bytes) -> None:
+        with _timed(self.name, "PUT"):
+            self._check(self._request("PUT", key, data=data), key)
+
+    def delete_object(self, key: str) -> None:
+        with _timed(self.name, "DELETE"):
+            resp = self._request("DELETE", key)
+            if resp.status_code not in (200, 204, 404):
+                self._check(resp, key)
+
+    def head(self, key: str) -> ObjectMeta:
+        with _timed(self.name, "HEAD"):
+            resp = self._request("HEAD", key)
+            if resp.status_code == 404:
+                raise NoSuchKey(key)
+            self._check(resp, key)
+            size = int(resp.headers.get("Content-Length", 0))
+            return ObjectMeta(key=key, size=size, last_modified=0.0)
+
+    def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
+        with _timed(self.name, "LIST"):
+            token = None
+            while True:
+                query = {"list-type": "2", "prefix": prefix}
+                if not recursive:
+                    query["delimiter"] = "/"
+                if token:
+                    query["continuation-token"] = token
+                root = ET.fromstring(self._check(self._request("GET", query=query)).text)
+                for c in root.iter(f"{_NS}Contents"):
+                    yield ObjectMeta(
+                        key=c.find(f"{_NS}Key").text,
+                        size=int(c.find(f"{_NS}Size").text),
+                        last_modified=0.0,
+                    )
+                trunc = root.find(f"{_NS}IsTruncated")
+                if trunc is None or trunc.text != "true":
+                    break
+                token_el = root.find(f"{_NS}NextContinuationToken")
+                token = token_el.text if token_el is not None else None
+                if not token:
+                    break
+
+    def list_dirs(self, prefix: str) -> list[str]:
+        with _timed(self.name, "LIST"):
+            p = prefix.rstrip("/") + "/" if prefix else ""
+            query = {"list-type": "2", "prefix": p, "delimiter": "/"}
+            root = ET.fromstring(self._check(self._request("GET", query=query)).text)
+            out = []
+            for cp in root.iter(f"{_NS}CommonPrefixes"):
+                full = cp.find(f"{_NS}Prefix").text
+                out.append(full[len(p) :].rstrip("/"))
+            return sorted(out)
+
+    # ------------------------------------------------------------- upload path
+
+    def upload_file(self, key: str, path: Path) -> None:
+        size = path.stat().st_size
+        if size <= self.multipart_threshold:
+            self.put_object(key, path.read_bytes())
+            return
+        self._upload_multipart(key, path, size)
+
+    def _upload_multipart(self, key: str, path: Path, size: int) -> None:
+        """Multipart upload with concurrent parts + abort on failure
+        (reference: object_storage.rs:111-227, s3.rs:716-813)."""
+        with _timed(self.name, "PUT_MULTIPART"):
+            resp = self._check(self._request("POST", key, query={"uploads": ""}), key)
+            upload_id = ET.fromstring(resp.text).find(f"{_NS}UploadId").text
+            part_size = self.multipart_part_size
+            n_parts = (size + part_size - 1) // part_size
+
+            def put_part(i: int) -> tuple[int, str]:
+                with path.open("rb") as f:
+                    f.seek(i * part_size)
+                    chunk = f.read(part_size)
+                r = self._check(
+                    self._request(
+                        "PUT", key,
+                        query={"partNumber": str(i + 1), "uploadId": upload_id},
+                        data=chunk,
+                    ),
+                    key,
+                )
+                return i + 1, r.headers.get("ETag", "")
+
+            try:
+                with ThreadPoolExecutor(max_workers=min(8, n_parts)) as pool:
+                    etags = sorted(pool.map(put_part, range(n_parts)))
+                body = "<CompleteMultipartUpload>" + "".join(
+                    f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+                    for n, e in etags
+                ) + "</CompleteMultipartUpload>"
+                resp = self._check(
+                    self._request(
+                        "POST", key, query={"uploadId": upload_id}, data=body.encode()
+                    ),
+                    key,
+                )
+                # S3 documents CompleteMultipartUpload returning HTTP 200
+                # whose BODY is an <Error> — treating it as success would
+                # let the staging layer delete a parquet that was never
+                # assembled. Inspect the payload.
+                text = resp.text or ""
+                if "<Error" in text and "CompleteMultipartUploadResult" not in text:
+                    raise ObjectStorageError(
+                        f"multipart completion failed for {key}: {text[:200]}"
+                    )
+            except Exception:
+                self._request("DELETE", key, query={"uploadId": upload_id})
+                raise
+
+    # ----------------------------------------------------------- download path
+
+    def get_range(self, key: str, start: int, end: int) -> bytes:
+        """Ranged GET — the primitive the shared parallel download
+        (ObjectStorage.download_file) fans out over (s3.rs:383-492)."""
+        resp = self._check(
+            self._request("GET", key, headers={"Range": f"bytes={start}-{end}"}), key
+        )
+        return resp.content
+
+    def delete_prefix(self, prefix: str) -> None:
+        """Batch DeleteObjects over a listed prefix."""
+        with _timed(self.name, "DELETE_PREFIX"):
+            keys = [m.key for m in self.list_prefix(prefix)]
+            for i in range(0, len(keys), 1000):
+                batch = keys[i : i + 1000]
+                body = "<Delete>" + "".join(
+                    f"<Object><Key>{k}</Key></Object>" for k in batch
+                ) + "</Delete>"
+                resp = self._request(
+                    "POST",
+                    query={"delete": ""},
+                    data=body.encode(),
+                    headers={"Content-MD5": _content_md5(body.encode())},
+                )
+                if resp.status_code >= 300:
+                    # fall back to per-key deletes (some S3-compatibles lack
+                    # batch delete)
+                    for k in batch:
+                        self.delete_object(k)
+
+
+def _content_md5(data: bytes) -> str:
+    import base64
+    import hashlib as _h
+
+    return base64.b64encode(_h.md5(data).digest()).decode()

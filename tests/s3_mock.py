@@ -1,0 +1,198 @@
+"""Minimal in-process S3-compatible server for exercising S3Storage.
+
+Plays the role MinIO plays in the reference's docker-compose test harness
+(SURVEY §4) without needing a container: object CRUD, ListObjectsV2 with
+prefix/delimiter/continuation, multipart upload, ranged GET, and batch
+DeleteObjects. Auth headers are accepted but not verified.
+"""
+
+from __future__ import annotations
+
+import threading
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class _State:
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+        self.uploads: dict[str, dict[int, bytes]] = {}
+        self.lock = threading.Lock()
+        self.upload_seq = 0
+
+
+def _xml(elem: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(elem)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State  # set by serve()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # -- helpers ------------------------------------------------------------
+
+    def _parts(self):
+        u = urlparse(self.path)
+        segs = unquote(u.path).lstrip("/").split("/", 1)
+        bucket = segs[0] if segs else ""
+        key = segs[1] if len(segs) > 1 else ""
+        q = {k: v[0] for k, v in parse_qs(u.query, keep_blank_values=True).items()}
+        return bucket, key, q
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def _send(
+        self,
+        code: int,
+        body: bytes = b"",
+        headers: dict | None = None,
+        content_length: int | None = None,
+    ):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header(
+            "Content-Length", str(len(body) if content_length is None else content_length)
+        )
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    # -- methods ------------------------------------------------------------
+
+    def do_PUT(self):
+        _, key, q = self._parts()
+        body = self._body()
+        st = self.state
+        with st.lock:
+            if "partNumber" in q and "uploadId" in q:
+                st.uploads.setdefault(q["uploadId"], {})[int(q["partNumber"])] = body
+                self._send(200, headers={"ETag": f'"part-{q["partNumber"]}"'})
+                return
+            st.objects[key] = body
+        self._send(200, headers={"ETag": '"mock"'})
+
+    def do_POST(self):
+        bucket, key, q = self._parts()
+        st = self.state
+        if "uploads" in q:
+            with st.lock:
+                st.upload_seq += 1
+                uid = f"upload-{st.upload_seq}"
+                st.uploads[uid] = {}
+            root = ET.Element("InitiateMultipartUploadResult", xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+            ET.SubElement(root, "UploadId").text = uid
+            self._send(200, _xml(root))
+            return
+        if "uploadId" in q:
+            self._body()
+            with st.lock:
+                parts = st.uploads.pop(q["uploadId"], {})
+                st.objects[key] = b"".join(parts[i] for i in sorted(parts))
+            root = ET.Element("CompleteMultipartUploadResult", xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+            ET.SubElement(root, "Key").text = key
+            self._send(200, _xml(root))
+            return
+        if "delete" in q:
+            body = self._body()
+            root_in = ET.fromstring(body)
+            deleted = ET.Element("DeleteResult", xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+            with st.lock:
+                for obj in root_in.iter("Object"):
+                    k = obj.find("Key").text
+                    st.objects.pop(k, None)
+                    d = ET.SubElement(deleted, "Deleted")
+                    ET.SubElement(d, "Key").text = k
+            self._send(200, _xml(deleted))
+            return
+        self._send(400)
+
+    def do_GET(self):
+        bucket, key, q = self._parts()
+        st = self.state
+        if not key and "list-type" in q:
+            self._list(q)
+            return
+        with st.lock:
+            data = st.objects.get(key)
+        if data is None:
+            self._send(404)
+            return
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            lo, hi = rng[len("bytes=") :].split("-")
+            lo, hi = int(lo), int(hi)
+            chunk = data[lo : hi + 1]
+            self._send(206, chunk, headers={"Content-Range": f"bytes {lo}-{hi}/{len(data)}"})
+            return
+        self._send(200, data)
+
+    def _list(self, q):
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter")
+        max_keys = int(q.get("max-keys", 1000))
+        start_after = q.get("continuation-token", "")
+        st = self.state
+        with st.lock:
+            keys = sorted(k for k in st.objects if k.startswith(prefix))
+        if start_after:
+            keys = [k for k in keys if k > start_after]
+        contents, common = [], []
+        for k in keys:
+            if delimiter:
+                rest = k[len(prefix) :]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    if cp not in common:
+                        common.append(cp)
+                    continue
+            contents.append(k)
+        truncated = len(contents) > max_keys
+        contents = contents[:max_keys]
+        root = ET.Element("ListBucketResult", xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+        ET.SubElement(root, "IsTruncated").text = "true" if truncated else "false"
+        if truncated and contents:
+            ET.SubElement(root, "NextContinuationToken").text = contents[-1]
+        with st.lock:
+            for k in contents:
+                c = ET.SubElement(root, "Contents")
+                ET.SubElement(c, "Key").text = k
+                ET.SubElement(c, "Size").text = str(len(st.objects.get(k, b"")))
+        for cp in common:
+            e = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(e, "Prefix").text = cp
+        self._send(200, _xml(root))
+
+    def do_HEAD(self):
+        _, key, _ = self._parts()
+        with self.state.lock:
+            data = self.state.objects.get(key)
+        if data is None:
+            self._send(404)
+        else:
+            self._send(200, b"", content_length=len(data))
+
+    def do_DELETE(self):
+        _, key, q = self._parts()
+        st = self.state
+        with st.lock:
+            if "uploadId" in q:
+                st.uploads.pop(q["uploadId"], None)
+            else:
+                st.objects.pop(key, None)
+        self._send(204)
+
+
+def serve() -> tuple[ThreadingHTTPServer, str, _State]:
+    """Start the mock on an ephemeral port; returns (server, endpoint, state)."""
+    state = _State()
+    handler = type("Handler", (_Handler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_port}", state

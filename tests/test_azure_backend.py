@@ -1,0 +1,170 @@
+"""Azure Blob backend against a minimal in-process Azurite-like mock."""
+
+import base64
+import threading
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import pytest
+
+from parseable_tpu.storage.azure_blob import AzureBlobStorage
+from parseable_tpu.storage.object_storage import NoSuchKey
+
+
+class _State:
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+        self.blocks: dict[str, dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State
+
+    def log_message(self, *a):
+        pass
+
+    def _parts(self):
+        u = urlparse(self.path)
+        segs = unquote(u.path).lstrip("/").split("/", 1)
+        key = segs[1] if len(segs) > 1 else ""
+        q = {k: v[0] for k, v in parse_qs(u.query, keep_blank_values=True).items()}
+        return key, q
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def _send(self, code, body=b"", headers=None, content_length=None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body) if content_length is None else content_length))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        key, q = self._parts()
+        body = self._body()
+        st = self.state
+        with st.lock:
+            if q.get("comp") == "block":
+                st.blocks.setdefault(key, {})[q["blockid"]] = body
+                self._send(201)
+                return
+            if q.get("comp") == "blocklist":
+                ids = [e.text for e in ET.fromstring(body).iter("Latest")]
+                st.blobs[key] = b"".join(st.blocks.get(key, {})[i] for i in ids)
+                st.blocks.pop(key, None)
+                self._send(201)
+                return
+            st.blobs[key] = body
+        self._send(201)
+
+    def do_GET(self):
+        key, q = self._parts()
+        st = self.state
+        if q.get("comp") == "list":
+            prefix = q.get("prefix", "")
+            delimiter = q.get("delimiter")
+            with st.lock:
+                keys = sorted(k for k in st.blobs if k.startswith(prefix))
+            root = ET.Element("EnumerationResults")
+            blobs_el = ET.SubElement(root, "Blobs")
+            seen_prefix = []
+            for k in keys:
+                if delimiter:
+                    rest = k[len(prefix):]
+                    if delimiter in rest:
+                        cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                        if cp not in seen_prefix:
+                            seen_prefix.append(cp)
+                            bp = ET.SubElement(blobs_el, "BlobPrefix")
+                            ET.SubElement(bp, "Name").text = cp
+                        continue
+                b = ET.SubElement(blobs_el, "Blob")
+                ET.SubElement(b, "Name").text = k
+                props = ET.SubElement(b, "Properties")
+                with st.lock:
+                    ET.SubElement(props, "Content-Length").text = str(len(st.blobs.get(k, b"")))
+            self._send(200, ET.tostring(root))
+            return
+        with st.lock:
+            data = st.blobs.get(key)
+        if data is None:
+            self._send(404)
+            return
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            lo, hi = (int(x) for x in rng[len("bytes="):].split("-"))
+            self._send(206, data[lo : hi + 1])
+            return
+        self._send(200, data)
+
+    def do_HEAD(self):
+        key, _ = self._parts()
+        with self.state.lock:
+            data = self.state.blobs.get(key)
+        if data is None:
+            self._send(404)
+        else:
+            self._send(200, b"", content_length=len(data))
+
+    def do_DELETE(self):
+        key, _ = self._parts()
+        with self.state.lock:
+            self.state.blobs.pop(key, None)
+        self._send(202)
+
+
+@pytest.fixture()
+def azure():
+    state = _State()
+    handler = type("H", (_Handler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    storage = AzureBlobStorage(
+        "acct",
+        "cont",
+        base64.b64encode(b"secret").decode(),
+        endpoint=f"http://127.0.0.1:{srv.server_port}",
+        multipart_threshold=1 << 16,
+    )
+    storage.block_size = 1 << 16
+    yield storage, state
+    srv.shutdown()
+
+
+def test_azure_crud(azure):
+    storage, _ = azure
+    storage.put_object("a/b.json", b"{}")
+    assert storage.get_object("a/b.json") == b"{}"
+    assert storage.head("a/b.json").size == 2
+    storage.delete_object("a/b.json")
+    with pytest.raises(NoSuchKey):
+        storage.get_object("a/b.json")
+
+
+def test_azure_list_and_dirs(azure):
+    storage, _ = azure
+    for k in ("x/d=1/a", "x/d=1/b", "x/d=2/c"):
+        storage.put_object(k, b"v")
+    assert [m.key for m in storage.list_prefix("x/")] == ["x/d=1/a", "x/d=1/b", "x/d=2/c"]
+    assert storage.list_dirs("x") == ["d=1", "d=2"]
+    storage.delete_prefix("x/d=1/")
+    assert [m.key for m in storage.list_prefix("x/")] == ["x/d=2/c"]
+
+
+def test_azure_block_upload_and_ranged_download(azure, tmp_path):
+    storage, state = azure
+    big = bytes(range(256)) * 1024  # 256 KiB > 64 KiB threshold
+    src = tmp_path / "big.bin"
+    src.write_bytes(big)
+    storage.upload_file("blobs/big.bin", src)
+    assert state.blobs["blobs/big.bin"] == big
+    storage.download_chunk_bytes = 1 << 17
+    dest = tmp_path / "out.bin"
+    storage.download_file("blobs/big.bin", dest)
+    assert dest.read_bytes() == big

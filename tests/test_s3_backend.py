@@ -1,0 +1,182 @@
+"""S3 backend against the in-process S3 mock (MinIO stand-in, SURVEY §4).
+
+Covers the SigV4 client's full trait surface — CRUD, listing with
+pagination and delimiter, multipart upload, parallel ranged download,
+batch prefix delete — then drives the complete ingest → staging → upload →
+catalog → query pipeline with S3 as the object store (VERDICT Next#4:
+"the existing storage/upload test suite runs green against [S3] in
+addition to LocalFS").
+"""
+
+import pytest
+
+from parseable_tpu.storage.object_storage import NoSuchKey
+from parseable_tpu.storage.s3 import S3Storage
+
+from tests.s3_mock import serve
+
+
+@pytest.fixture()
+def s3():
+    srv, endpoint, state = serve()
+    storage = S3Storage(
+        "testbucket",
+        region="us-east-1",
+        endpoint=endpoint,
+        access_key="ak",
+        secret_key="sk",
+        multipart_threshold=1 << 16,  # 64 KiB so tests exercise multipart
+        download_chunk_bytes=1 << 20,
+        download_concurrency=4,
+    )
+    yield storage, state
+    srv.shutdown()
+
+
+def test_crud_roundtrip(s3):
+    storage, _ = s3
+    storage.put_object("a/b/file.json", b'{"x": 1}')
+    assert storage.get_object("a/b/file.json") == b'{"x": 1}'
+    assert storage.head("a/b/file.json").size == 8
+    assert storage.exists("a/b/file.json")
+    storage.delete_object("a/b/file.json")
+    assert not storage.exists("a/b/file.json")
+    with pytest.raises(NoSuchKey):
+        storage.get_object("a/b/file.json")
+
+
+def test_list_prefix_and_dirs(s3):
+    storage, _ = s3
+    for k in ("s/date=1/x.parquet", "s/date=1/y.parquet", "s/date=2/z.parquet", "t/other"):
+        storage.put_object(k, b"data")
+    keys = [m.key for m in storage.list_prefix("s/")]
+    assert keys == ["s/date=1/x.parquet", "s/date=1/y.parquet", "s/date=2/z.parquet"]
+    assert storage.list_dirs("s") == ["date=1", "date=2"]
+
+
+def test_list_pagination(s3):
+    storage, state = s3
+    for i in range(25):
+        storage.put_object(f"pg/k{i:03d}", b"x")
+    # force tiny pages through the mock by patching max-keys via monkey query:
+    # the client paginates on IsTruncated/NextContinuationToken
+    import parseable_tpu.storage.s3 as s3mod
+
+    orig = storage._request
+
+    def patched(method, key="", query=None, **kw):
+        if query and query.get("list-type") == "2":
+            query = dict(query, **{"max-keys": "10"})
+        return orig(method, key, query, **kw)
+
+    storage._request = patched
+    keys = [m.key for m in storage.list_prefix("pg/")]
+    assert len(keys) == 25
+    storage._request = orig
+
+
+def test_multipart_upload_and_ranged_download(s3, tmp_path):
+    storage, state = s3
+    big = bytes(range(256)) * 2048  # 512 KiB > 64 KiB threshold
+    src = tmp_path / "big.bin"
+    src.write_bytes(big)
+    storage.upload_file("mp/big.bin", src)
+    # stored via multipart (mock concatenates parts)
+    assert state.objects["mp/big.bin"] == big
+    # download via a smaller chunk size to force parallel ranged GETs
+    storage.download_chunk_bytes = 1 << 17
+    dest = tmp_path / "out.bin"
+    storage.download_file("mp/big.bin", dest)
+    assert dest.read_bytes() == big
+
+
+def test_delete_prefix_batch(s3):
+    storage, state = s3
+    for i in range(5):
+        storage.put_object(f"dp/day=1/f{i}", b"x")
+    storage.put_object("dp/day=2/keep", b"x")
+    storage.delete_prefix("dp/day=1/")
+    assert [m.key for m in storage.list_prefix("dp/")] == ["dp/day=2/keep"]
+
+
+def test_full_pipeline_on_s3(tmp_path):
+    """ingest -> staging -> parquet -> S3 upload -> catalog -> query."""
+    srv, endpoint, state = serve()
+    try:
+        from parseable_tpu.config import Options, StorageOptions
+        from parseable_tpu.core import Parseable
+        from parseable_tpu.event.json_format import JsonEvent
+        from parseable_tpu.query.session import QuerySession
+
+        opts = Options()
+        opts.local_staging_path = tmp_path / "staging"
+        storage_opts = StorageOptions(
+            backend="s3-store",
+            bucket="testbucket",
+            region="us-east-1",
+            endpoint_url=endpoint,
+            access_key="ak",
+            secret_key="sk",
+        )
+        p = Parseable(opts, storage_opts)
+        stream = p.create_stream_if_not_exists("s3web")
+        records = [{"host": f"h{i % 3}", "v": float(i)} for i in range(300)]
+        ev = JsonEvent(records, "s3web").into_event(stream.metadata)
+        ev.process(stream, commit_schema=p.commit_schema)
+        p.local_sync(shutdown=True)
+        p.sync_all_streams()
+
+        # parquet + catalog objects landed in the mock bucket
+        assert any(k.endswith(".parquet") for k in state.objects)
+        assert any(k.endswith("manifest.json") for k in state.objects)
+        fmt = p.metastore.get_stream_json("s3web")
+        assert fmt.stats.events == 300
+
+        # query reads parquet back from S3
+        sess = QuerySession(p, engine="cpu")
+        res = sess.query("SELECT host, count(*) c, sum(v) s FROM s3web GROUP BY host ORDER BY host")
+        rows = res.to_json_rows()
+        assert [r["c"] for r in rows] == [100, 100, 100]
+
+        # restart bootstrap: a fresh instance discovers the stream from S3
+        opts2 = Options()
+        opts2.local_staging_path = tmp_path / "staging2"
+        p2 = Parseable(opts2, storage_opts)
+        p2.load_streams_from_storage()
+        res2 = QuerySession(p2, engine="cpu").query("SELECT count(*) FROM s3web")
+        assert res2.to_json_rows()[0]["count(*)"] == 300
+    finally:
+        srv.shutdown()
+
+
+def test_hot_tier_chunked_download_on_s3(tmp_path):
+    """Hot tier reconcile downloads manifests' parquet from S3 via the
+    chunked path and honors the size budget."""
+    srv, endpoint, state = serve()
+    try:
+        from parseable_tpu.config import Options, StorageOptions
+        from parseable_tpu.core import Parseable
+        from parseable_tpu.event.json_format import JsonEvent
+        from parseable_tpu.storage.hottier import HotTierManager
+
+        opts = Options()
+        opts.local_staging_path = tmp_path / "staging"
+        opts.hot_tier_storage_path = tmp_path / "hottier"
+        storage_opts = StorageOptions(
+            backend="s3-store", bucket="testbucket", endpoint_url=endpoint,
+            access_key="ak", secret_key="sk",
+        )
+        p = Parseable(opts, storage_opts)
+        stream = p.create_stream_if_not_exists("hts3")
+        ev = JsonEvent([{"v": float(i)} for i in range(2000)], "hts3").into_event(stream.metadata)
+        ev.process(stream, commit_schema=p.commit_schema)
+        p.local_sync(shutdown=True)
+        p.sync_all_streams()
+
+        mgr = HotTierManager(p, tmp_path / "hottier")
+        mgr.set_budget("hts3", 50 * 1024 * 1024)
+        mgr.reconcile("hts3")
+        local = list((tmp_path / "hottier").rglob("*.parquet"))
+        assert local, "hot tier downloaded nothing"
+    finally:
+        srv.shutdown()
