@@ -52,6 +52,9 @@ def evaluate(e: S.Expr, table: pa.Table) -> Any:
     if isinstance(e, S.Literal):
         return e.value
     if isinstance(e, S.Column):
+        # qualified refs resolve against join-output columns ("alias.col")
+        if e.table is not None and f"{e.table}.{e.name}" in table.column_names:
+            return table.column(f"{e.table}.{e.name}").combine_chunks()
         if e.name not in table.column_names:
             return pa.nulls(table.num_rows)
         return table.column(e.name).combine_chunks()
@@ -649,7 +652,14 @@ class QueryExecutor:
         arrays: list[pa.Array] = []
         for item in sel.items:
             if isinstance(item.expr, S.Star):
-                for name in table.column_names:
+                prefix = f"{item.expr.table}." if item.expr.table else None
+                cols = table.column_names
+                if prefix is not None:
+                    qualified = [n for n in cols if n.startswith(prefix)]
+                    # single-table scans have unqualified columns; `r.*`
+                    # over them means everything
+                    cols = qualified or cols
+                for name in cols:
                     names.append(name)
                     arrays.append(table.column(name).combine_chunks())
                 continue
@@ -700,14 +710,22 @@ class QueryExecutor:
                 cols[f"__agg{si}"].append(agg.finalize_value(st, si))
         interim = pa.table(cols) if cols else pa.table({"__dummy": [None] * len(agg.groups)})
 
-        # group exprs referenced post-agg resolve to the key columns
+        # group exprs referenced post-agg resolve to the key columns.
+        # Keyed by structural repr, not display name: `l.a` and `o.a` share
+        # the name "a" but are different group keys.
         remap: dict[str, str] = {}
         for i, g in enumerate(sel.group_by):
-            remap[S.expr_name(g)] = f"__g{i}"
+            remap[repr(g)] = f"__g{i}"
+            remap.setdefault(S.expr_name(g), f"__g{i}")
 
         def rewrite_groups(e: S.Expr) -> S.Expr:
-            nm = S.expr_name(e)
+            nm = repr(e)
             if nm in remap:
+                return S.Column(remap[nm])
+            nm = S.expr_name(e)
+            if nm in remap and not isinstance(e, S.Column):
+                return S.Column(remap[nm])
+            if isinstance(e, S.Column) and e.table is None and nm in remap:
                 return S.Column(remap[nm])
             if isinstance(e, S.BinaryOp):
                 return S.BinaryOp(e.op, rewrite_groups(e.left), rewrite_groups(e.right))

@@ -1,0 +1,259 @@
+"""Multi-stream execution: joins + subquery materialization.
+
+The reference gets arbitrary SQL (joins, subqueries) from DataFusion
+(src/query/mod.rs:212-276), which is what makes saved correlations
+(src/correlation.rs) executable. Here:
+
+- **Subqueries** (uncorrelated, the dialect's need) materialize first:
+  each inner SELECT runs as its own single-stream query; IN-subqueries
+  become literal IN-lists, scalar subqueries become literals.
+- **Joins** materialize each side through the normal single-stream scan
+  (staging + hot tier + manifest-pruned parquet, with the API time range
+  applied per stream), qualify columns as `alias.col`, and hash-join via
+  Arrow's C++ join kernel (pa.Table.join). Equality conditions drive the
+  hash join; residual ON conditions apply as a post-join filter.
+
+Joins run on the CPU engine: they're row-level merges feeding projections,
+not the dense aggregation shape the TPU path accelerates. An aggregation
+OVER a join still benefits — the joined table feeds the standard executor,
+which the session can point at either engine.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+import pyarrow as pa
+
+from parseable_tpu.query import sql as S
+from parseable_tpu.query.executor import ExecError, MemoryLimitExceeded, _arr, evaluate
+
+if TYPE_CHECKING:
+    from parseable_tpu.query.session import QuerySession
+
+logger = logging.getLogger(__name__)
+
+MAX_SUBQUERY_ROWS = 100_000
+
+
+class MultiStreamError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------- subqueries
+
+
+def resolve_subqueries(e: S.Expr | None, run_select) -> S.Expr | None:
+    """Replace Subquery nodes with materialized literals.
+
+    `run_select(select) -> pa.Table` executes an inner SELECT (the session
+    bounds nesting depth there, since nested subqueries re-enter through
+    it). IN-subqueries become literal lists (capped at MAX_SUBQUERY_ROWS);
+    scalar subqueries must yield exactly one column and at most one row.
+    """
+    if e is None:
+        return None
+
+    def rec(x):
+        return resolve_subqueries(x, run_select)
+
+    if isinstance(e, S.Subquery):
+        table = run_select(e.select)
+        if table.num_columns != 1:
+            raise MultiStreamError("scalar subquery must select exactly one column")
+        if table.num_rows > 1:
+            raise MultiStreamError("scalar subquery returned more than one row")
+        v = table.column(0).to_pylist()[0] if table.num_rows else None
+        return S.Literal(v)
+    if isinstance(e, S.InList):
+        if len(e.items) == 1 and isinstance(e.items[0], S.Subquery):
+            table = run_select(e.items[0].select)
+            if table.num_columns != 1:
+                raise MultiStreamError("IN subquery must select exactly one column")
+            if table.num_rows > MAX_SUBQUERY_ROWS:
+                raise MultiStreamError(
+                    f"IN subquery produced {table.num_rows} rows (max {MAX_SUBQUERY_ROWS})"
+                )
+            values = [v for v in table.column(0).to_pylist() if v is not None]
+            return S.InList(rec(e.expr), [S.Literal(v) for v in values], e.negated)
+        return S.InList(rec(e.expr), [rec(i) for i in e.items], e.negated)
+    if isinstance(e, S.BinaryOp):
+        return S.BinaryOp(e.op, rec(e.left), rec(e.right))
+    if isinstance(e, S.UnaryOp):
+        return S.UnaryOp(e.op, rec(e.operand))
+    if isinstance(e, S.Between):
+        return S.Between(rec(e.expr), rec(e.low), rec(e.high), e.negated)
+    if isinstance(e, S.IsNull):
+        return S.IsNull(rec(e.expr), e.negated)
+    if isinstance(e, S.FunctionCall):
+        return S.FunctionCall(e.name, [rec(a) for a in e.args], e.distinct)
+    if isinstance(e, S.Cast):
+        return S.Cast(rec(e.expr), e.type_name)
+    if isinstance(e, S.Case):
+        return S.Case(
+            [(rec(w), rec(t)) for w, t in e.whens],
+            rec(e.else_expr) if e.else_expr else None,
+        )
+    return e
+
+
+# ------------------------------------------------------------------- joins
+
+
+def _split_on(on: S.Expr | None, left_aliases: set[str], right_alias: str):
+    """Split an ON tree into equality key pairs (left_col, right_col) and a
+    residual expression applied post-join."""
+    eq_pairs: list[tuple[S.Column, S.Column]] = []
+    residual: list[S.Expr] = []
+
+    def side(col: S.Column) -> str | None:
+        if col.table is None:
+            return None
+        if col.table == right_alias:
+            return "right"
+        if col.table in left_aliases:
+            return "left"
+        return None
+
+    def walk(e: S.Expr) -> None:
+        if isinstance(e, S.BinaryOp) and e.op == "and":
+            walk(e.left)
+            walk(e.right)
+            return
+        if (
+            isinstance(e, S.BinaryOp)
+            and e.op == "="
+            and isinstance(e.left, S.Column)
+            and isinstance(e.right, S.Column)
+        ):
+            ls, rs = side(e.left), side(e.right)
+            if ls == "left" and rs == "right":
+                eq_pairs.append((e.left, e.right))
+                return
+            if ls == "right" and rs == "left":
+                eq_pairs.append((e.right, e.left))
+                return
+        residual.append(e)
+
+    if on is not None:
+        walk(on)
+    return eq_pairs, residual
+
+
+def _qualify(table: pa.Table, alias: str) -> pa.Table:
+    return table.rename_columns([f"{alias}.{c}" for c in table.column_names])
+
+
+def execute_join(
+    base: tuple[str, pa.Table],
+    joins: list[tuple[S.Join, pa.Table]],
+    memory_limit: int | None = None,
+) -> pa.Table:
+    """Fold joins left-to-right with Arrow's hash join."""
+    alias0, t0 = base
+    out = _qualify(t0, alias0)
+    left_aliases = {alias0}
+    for join, right_raw in joins:
+        ralias = join.alias or join.table
+        if ralias in left_aliases:
+            raise MultiStreamError(f"duplicate table alias {ralias!r}")
+        right = _qualify(right_raw, ralias)
+        if join.kind == "cross":
+            out = _cross_join(out, right)
+        else:
+            eq_pairs, residual = _split_on(join.on, left_aliases, ralias)
+            if not eq_pairs:
+                raise MultiStreamError(
+                    "JOIN ... ON needs at least one equality between the two sides"
+                )
+            left_keys = [f"{c.table}.{c.name}" for c, _ in eq_pairs]
+            right_keys = [f"{c.table}.{c.name}" for _, c in eq_pairs]
+            # keep the right key columns through the join (Arrow drops
+            # right_keys from the output): duplicate under temp names so
+            # LEFT-join null semantics survive, then restore.
+            tmp_names = [f"__rk{i}" for i in range(len(right_keys))]
+            for tmp, rk in zip(tmp_names, right_keys):
+                right = right.append_column(tmp, right.column(rk))
+            join_type = "left outer" if join.kind == "left" else "inner"
+            out = out.join(
+                right,
+                keys=left_keys,
+                right_keys=right_keys,
+                join_type=join_type,
+            )
+            for tmp, rk in zip(tmp_names, right_keys):
+                idx = out.column_names.index(tmp)
+                out = out.set_column(idx, rk, out.column(tmp))
+            if residual:
+                mask = None
+                import pyarrow.compute as pc
+
+                for r in residual:
+                    m = _arr(evaluate(r, out), out)
+                    mask = m if mask is None else pc.and_kleene(mask, m)
+                if mask is not None:
+                    if join.kind == "left":
+                        # rows with no match keep NULL right side; Kleene
+                        # nulls (unknown) must not drop them
+                        mask = pc.fill_null(mask, True)
+                    out = out.filter(mask)
+        left_aliases.add(ralias)
+        if memory_limit is not None and out.nbytes > memory_limit:
+            raise MemoryLimitExceeded(
+                f"join intermediate holds {out.nbytes} bytes (limit {memory_limit})"
+            )
+    return out
+
+
+def _cross_join(left: pa.Table, right: pa.Table) -> pa.Table:
+    if left.num_rows * right.num_rows > 5_000_000:
+        raise MultiStreamError("cross join too large")
+    import numpy as np
+
+    li = np.repeat(np.arange(left.num_rows), right.num_rows)
+    ri = np.tile(np.arange(right.num_rows), left.num_rows)
+    lt = left.take(pa.array(li))
+    rt = right.take(pa.array(ri))
+    cols = {n: lt.column(n) for n in lt.column_names}
+    cols.update({n: rt.column(n) for n in rt.column_names})
+    return pa.table(cols)
+
+
+def qualify_unqualified(e: S.Expr | None, owner_of: dict[str, str]) -> S.Expr | None:
+    """Attach table qualifiers to bare columns using schema ownership
+    (unambiguous columns only; ambiguous bare refs raise)."""
+    if e is None:
+        return None
+
+    def rec(x):
+        return qualify_unqualified(x, owner_of)
+
+    if isinstance(x := e, S.Column):
+        if x.table is None:
+            owner = owner_of.get(x.name)
+            if owner == "__ambiguous__":
+                raise MultiStreamError(f"ambiguous column {x.name!r}; qualify it")
+            if owner is not None:
+                return S.Column(x.name, table=owner)
+        return x
+    if isinstance(e, S.BinaryOp):
+        return S.BinaryOp(e.op, rec(e.left), rec(e.right))
+    if isinstance(e, S.UnaryOp):
+        return S.UnaryOp(e.op, rec(e.operand))
+    if isinstance(e, S.InList):
+        return S.InList(rec(e.expr), [rec(i) for i in e.items], e.negated)
+    if isinstance(e, S.Between):
+        return S.Between(rec(e.expr), rec(e.low), rec(e.high), e.negated)
+    if isinstance(e, S.IsNull):
+        return S.IsNull(rec(e.expr), e.negated)
+    if isinstance(e, S.FunctionCall):
+        return S.FunctionCall(e.name, [rec(a) for a in e.args], e.distinct)
+    if isinstance(e, S.Cast):
+        return S.Cast(rec(e.expr), e.type_name)
+    if isinstance(e, S.Case):
+        return S.Case(
+            [(rec(w), rec(t)) for w, t in e.whens],
+            rec(e.else_expr) if e.else_expr else None,
+        )
+    return e

@@ -220,6 +220,8 @@ def referenced_columns(e: S.Expr | None) -> set[str]:
         return cols
 
     def visit(x: S.Expr) -> None:
+        if isinstance(x, S.Subquery):
+            return  # inner select's columns belong to the inner stream
         if isinstance(x, S.Column):
             cols.add(x.name)
         elif isinstance(x, S.BinaryOp):

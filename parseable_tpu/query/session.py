@@ -33,6 +33,67 @@ class QueryError(ValueError):
     pass
 
 
+def collect_streams(select: S.Select) -> set[str]:
+    """Every stream a query touches: FROM, JOINs, and subqueries."""
+    out: set[str] = set()
+    if select.table:
+        out.add(select.table)
+    for j in select.joins:
+        out.add(j.table)
+
+    def walk(e: S.Expr | None) -> None:
+        if e is None:
+            return
+        if isinstance(e, S.Subquery):
+            out.update(collect_streams(e.select))
+            return
+        for attr in ("left", "right", "operand", "expr", "low", "high", "else_expr"):
+            v = getattr(e, attr, None)
+            if isinstance(v, S.Expr):
+                walk(v)
+        for lst_attr in ("items", "args"):
+            for v in getattr(e, lst_attr, []) or []:
+                if isinstance(v, S.Expr):
+                    walk(v)
+        for w, t in getattr(e, "whens", []) or []:
+            walk(w)
+            walk(t)
+
+    walk(select.where)
+    walk(select.having)
+    for i in select.items:
+        walk(i.expr)
+    return out
+
+
+def _qualified_refs(e: S.Expr | None) -> list[S.Column]:
+    """All Column nodes (qualified or not) in an expression tree."""
+    out: list[S.Column] = []
+    if e is None:
+        return out
+
+    def walk(x) -> None:
+        if isinstance(x, S.Column):
+            out.append(x)
+            return
+        if isinstance(x, S.Subquery):
+            return
+        for attr in ("left", "right", "operand", "expr", "low", "high", "else_expr"):
+            v = getattr(x, attr, None)
+            if isinstance(v, S.Expr):
+                walk(v)
+        for lst_attr in ("items", "args"):
+            for v in getattr(x, lst_attr, []) or []:
+                if isinstance(v, S.Expr):
+                    walk(v)
+        for w, t in getattr(x, "whens", []) or []:
+            walk(w)
+            walk(t)
+
+    walk(e)
+    return out
+
+
 @dataclass
 class QueryResult:
     table: pa.Table
@@ -69,7 +130,25 @@ class QuerySession:
         RBAC scope, enforced on the *resolved* plan before any execution so
         unauthorized streams neither run nor leak through error messages."""
         t0 = _time.monotonic()
-        lp = self._plan(sql_text, start_time, end_time, allowed_streams, t0)
+        select = S.parse_sql(sql_text)
+        return self._query_ast(select, start_time, end_time, allowed_streams, t0)
+
+    def _query_ast(
+        self,
+        select: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float | None = None,
+    ) -> QueryResult:
+        t0 = t0 if t0 is not None else _time.monotonic()
+        has_sub = any(
+            S.contains_subquery(x)
+            for x in [select.where, select.having, *(i.expr for i in select.items)]
+        )
+        if select.joins or has_sub:
+            return self._query_multi(select, start_time, end_time, allowed_streams, t0)
+        lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
 
         scan = StreamScan(
             self.p,
@@ -100,7 +179,18 @@ class QuerySession:
         allowed_streams: set[str] | None,
         t0: float,
     ) -> LogicalPlan:
-        select = S.parse_sql(sql_text)
+        return self._plan_ast(
+            S.parse_sql(sql_text), start_time, end_time, allowed_streams, t0
+        )
+
+    def _plan_ast(
+        self,
+        select: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+    ) -> LogicalPlan:
         lp = build_plan(select)
         if allowed_streams is not None and lp.stream not in allowed_streams:
             raise QueryError(f"unauthorized for stream {lp.stream!r}")
@@ -143,6 +233,166 @@ class QuerySession:
         scan = StreamScan(self.p, lp, hot_tier_dir=self._hot_dir(lp.stream))
         executor = QueryExecutor(lp)
         return executor.execute_select_stream(scan.tables())
+
+    # ------------------------------------------------------- multi-stream
+
+    def _query_multi(
+        self,
+        select: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+    ) -> QueryResult:
+        """Joins + subqueries (reference gets these from DataFusion;
+        query/multi.py documents the design). The API time range applies to
+        every stream scan; the WHERE tree applies post-join."""
+        import copy
+
+        from parseable_tpu.query import multi as M
+
+        sel = copy.deepcopy(select)
+
+        # bounded nesting: run_select re-enters this method for nested
+        # subqueries, so the depth lives on the session, not the recursion
+        depth = getattr(self, "_multi_depth", 0)
+        if depth > 4:
+            raise QueryError("subqueries nested too deeply")
+        self._multi_depth = depth + 1
+        try:
+            return self._query_multi_inner(
+                sel, start_time, end_time, allowed_streams, t0, M
+            )
+        finally:
+            self._multi_depth = depth
+
+    def _query_multi_inner(
+        self,
+        sel: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+        M,
+    ) -> QueryResult:
+        # RBAC over every referenced stream, before anything executes
+        streams = collect_streams(sel)
+        if allowed_streams is not None:
+            for name in streams:
+                if name not in allowed_streams:
+                    raise QueryError(f"unauthorized for stream {name!r}")
+
+        def run_select(sub: S.Select) -> pa.Table:
+            # share the outer query's t0 so all subqueries burn the SAME
+            # timeout window, not a fresh one each
+            return self._query_ast(sub, start_time, end_time, allowed_streams, t0).table
+
+        sel.where = M.resolve_subqueries(sel.where, run_select)
+        sel.having = M.resolve_subqueries(sel.having, run_select)
+        sel.items = [
+            S.SelectItem(M.resolve_subqueries(i.expr, run_select), i.alias)
+            for i in sel.items
+        ]
+
+        if not sel.joins:
+            # subqueries resolved; the remainder is a single-stream query
+            return self._query_ast(sel, start_time, end_time, allowed_streams, t0)
+
+        # --- materialize each side through the normal single-stream scan ---
+        refs = [(sel.table, sel.table_alias or sel.table)] + [
+            (j.table, j.alias or j.table) for j in sel.joins
+        ]
+        exprs = [sel.where, sel.having, *(i.expr for i in sel.items)]
+        exprs += [g for g in sel.group_by] + [o.expr for o in sel.order_by]
+        exprs += [j.on for j in sel.joins]
+        needed_all = set()
+        needed_by_alias: dict[str, set[str]] = {a: set() for _, a in refs}
+        star = any(isinstance(i.expr, S.Star) for i in sel.items)
+        for e in exprs:
+            for col in _qualified_refs(e):
+                if col.table is not None and col.table in needed_by_alias:
+                    needed_by_alias[col.table].add(col.name)
+                elif col.table is None:
+                    needed_all.add(col.name)
+
+        # ownership from the stream SCHEMAS, not materialized columns — an
+        # empty scan fabricates needed columns (_empty_like) and would make
+        # ambiguity detection data-dependent
+        owner_of: dict[str, str] = {}
+        sides: list[tuple[str, pa.Table]] = []
+        for name, alias in refs:
+            needed = None if star else (needed_by_alias[alias] | needed_all)
+            self.resolve_stream(name)
+            t = self._materialize_stream(name, needed, start_time, end_time, t0)
+            sides.append((alias, t))
+            stream = self.p.streams.get(name)
+            schema_cols = (
+                set(stream.metadata.schema.keys())
+                if stream is not None and stream.metadata.schema
+                else set(t.column_names)
+            )
+            for c in schema_cols:
+                owner_of[c] = "__ambiguous__" if c in owner_of else alias
+
+        # residual ON conditions evaluate against the alias-qualified join
+        # output — bare columns in them must be qualified first
+        sel.joins = [
+            S.Join(j.table, j.alias, j.kind, M.qualify_unqualified(j.on, owner_of))
+            for j in sel.joins
+        ]
+        joined = M.execute_join(
+            sides[0],
+            list(zip(sel.joins, [t for _, t in sides[1:]])),
+            memory_limit=self.p.options.query_memory_limit_bytes,
+        )
+
+        # bare columns resolve by schema ownership; then run the remaining
+        # SELECT over the joined table with the standard executor
+        sel.where = M.qualify_unqualified(sel.where, owner_of)
+        sel.having = M.qualify_unqualified(sel.having, owner_of)
+        sel.items = [
+            S.SelectItem(M.qualify_unqualified(i.expr, owner_of), i.alias) for i in sel.items
+        ]
+        sel.group_by = [M.qualify_unqualified(g, owner_of) for g in sel.group_by]
+        sel.order_by = [
+            S.OrderItem(M.qualify_unqualified(o.expr, owner_of), o.desc) for o in sel.order_by
+        ]
+        sel.joins = []
+        sel.table = "__joined"
+        lp = build_plan(sel)
+        lp.time_bounds = TimeBounds()  # already applied per stream scan
+        timeout = self.p.options.query_timeout_secs
+        if timeout:
+            lp.deadline = t0 + timeout
+        lp.memory_limit_bytes = self.p.options.query_memory_limit_bytes
+        executor = QueryExecutor(lp)
+        table = executor.execute(iter([joined]))
+        elapsed = _time.monotonic() - t0
+        QUERY_EXECUTE_TIME.labels(",".join(sorted(streams))).observe(elapsed)
+        return QueryResult(
+            table,
+            table.column_names,
+            {"elapsed_secs": round(elapsed, 6), "engine": "cpu", "joined_streams": sorted(streams)},
+        )
+
+    def _materialize_stream(
+        self,
+        name: str,
+        needed: set[str] | None,
+        start_time: str | None,
+        end_time: str | None,
+        t0: float,
+    ) -> pa.Table:
+        """One join side: full scan of a stream within the API time range,
+        column-pruned, bounded by the memory cap."""
+        from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+        sub = S.Select(items=[S.SelectItem(S.Star())], table=name)
+        lp = self._plan_ast(sub, start_time, end_time, None, t0)
+        if needed is not None:
+            lp.needed_columns = needed | {DEFAULT_TIMESTAMP_KEY}
+        scan = StreamScan(self.p, lp, hot_tier_dir=self._hot_dir(name))
+        return QueryExecutor(lp).execute(scan.tables())
 
     def _hot_dir(self, stream: str):
         return (

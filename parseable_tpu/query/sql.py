@@ -29,6 +29,10 @@ KEYWORDS = {
     "cast", "true", "false", "interval",
 }
 
+# Contextual words recognized only inside the FROM clause — log fields named
+# "left"/"on"/"join" must keep parsing as plain columns elsewhere.
+JOIN_WORDS = {"join", "inner", "left", "right", "full", "outer", "cross", "on"}
+
 
 @dataclass
 class Token:
@@ -146,7 +150,7 @@ class Column(Expr):
 
 @dataclass
 class Star(Expr):
-    pass
+    table: str | None = None  # `alias.*` keeps its qualifier
 
 
 @dataclass
@@ -208,6 +212,24 @@ class IntervalLit(Expr):
 
 
 @dataclass
+class Subquery(Expr):
+    """A nested SELECT used as a scalar or IN-list source. Resolved
+    (materialized) by the session before execution — the executors never
+    see one (reference: DataFusion subquery decorrelation; here the
+    observability dialect only needs uncorrelated subqueries)."""
+
+    select: "Select"
+
+
+@dataclass
+class Join:
+    table: str
+    alias: str | None
+    kind: str  # "inner" | "left" | "cross"
+    on: Expr | None
+
+
+@dataclass
 class SelectItem:
     expr: Expr
     alias: str | None = None
@@ -230,6 +252,32 @@ class Select:
     limit: int | None = None
     offset: int | None = None
     distinct: bool = False
+    table_alias: str | None = None
+    joins: list[Join] = field(default_factory=list)
+
+
+def contains_subquery(e: Expr | None) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, Subquery):
+        return True
+    if isinstance(e, BinaryOp):
+        return contains_subquery(e.left) or contains_subquery(e.right)
+    if isinstance(e, UnaryOp):
+        return contains_subquery(e.operand)
+    if isinstance(e, InList):
+        return contains_subquery(e.expr) or any(contains_subquery(i) for i in e.items)
+    if isinstance(e, Between):
+        return any(contains_subquery(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, IsNull):
+        return contains_subquery(e.expr)
+    if isinstance(e, FunctionCall):
+        return any(contains_subquery(a) for a in e.args)
+    if isinstance(e, Cast):
+        return contains_subquery(e.expr)
+    if isinstance(e, Case):
+        return any(contains_subquery(w) or contains_subquery(t) for w, t in e.whens) or contains_subquery(e.else_expr)
+    return False
 
 
 AGGREGATE_FUNCS = {"count", "sum", "min", "max", "avg", "approx_distinct", "count_distinct", "stddev", "var"}
@@ -288,6 +336,22 @@ class Parser:
             return t.value
         return None
 
+    def accept_word(self, *words: str) -> str | None:
+        """Contextual (non-reserved) word match, e.g. JOIN inside FROM."""
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() in words:
+            self.i += 1
+            return t.value.lower()
+        return None
+
+    def peek_word(self) -> str | None:
+        t = self.peek()
+        return t.value.lower() if t.kind == "ident" else None
+
+    def expect_word(self, word: str) -> None:
+        if not self.accept_word(word):
+            raise SqlError(f"expected {word.upper()} near position {self.peek().pos}")
+
     def expect_op(self, op: str) -> None:
         if not self.accept_op(op):
             raise SqlError(f"expected {op!r} near position {self.peek().pos}, got {self.peek().value!r}")
@@ -306,15 +370,45 @@ class Parser:
         items = [self.parse_select_item()]
         while self.accept_op(","):
             items.append(self.parse_select_item())
-        table = None
+        table = table_alias = None
+        joins: list[Join] = []
         if self.accept_kw("from"):
-            t = self.next()
-            if t.kind != "ident":
-                raise SqlError(f"expected table name at {t.pos}")
-            table = t.value
-            # optional alias
-            if self.peek().kind == "ident":
-                self.next()
+            table, table_alias = self.parse_table_ref()
+            while True:
+                kind = None
+                nxt = self.peek_word()
+                after = (
+                    self.tokens[self.i + 1]
+                    if self.i + 1 < len(self.tokens)
+                    else self.tokens[-1]
+                )
+                after_word = after.value.lower() if after.kind == "ident" else None
+                if nxt == "cross" and after_word == "join":
+                    self.next()
+                    self.next()
+                    kind = "cross"
+                elif nxt == "inner" and after_word == "join":
+                    self.next()
+                    self.next()
+                    kind = "inner"
+                elif nxt == "left":
+                    self.next()
+                    self.accept_word("outer")
+                    self.expect_word("join")
+                    kind = "left"
+                elif nxt == "join":
+                    self.next()
+                    kind = "inner"
+                elif nxt in ("right", "full") and after_word in ("join", "outer"):
+                    raise SqlError("RIGHT/FULL joins are not supported; rewrite as LEFT")
+                if kind is None:
+                    break
+                jt, ja = self.parse_table_ref()
+                on = None
+                if kind != "cross":
+                    self.expect_word("on")
+                    on = self.parse_expr()
+                joins.append(Join(jt, ja, kind, on))
         where = None
         if self.accept_kw("where"):
             where = self.parse_expr()
@@ -354,7 +448,23 @@ class Parser:
             limit=limit,
             offset=offset,
             distinct=distinct,
+            table_alias=table_alias,
+            joins=joins,
         )
+
+    def parse_table_ref(self) -> tuple[str, str | None]:
+        t = self.next()
+        if t.kind != "ident":
+            raise SqlError(f"expected table name at {t.pos}")
+        alias = None
+        if self.accept_kw("as"):
+            a = self.next()
+            if a.kind != "ident":
+                raise SqlError(f"expected alias at {a.pos}")
+            alias = a.value
+        elif self.peek().kind == "ident" and self.peek().value.lower() not in JOIN_WORDS:
+            alias = self.next().value
+        return t.value, alias
 
     def parse_select_item(self) -> SelectItem:
         if self.accept_op("*"):
@@ -405,6 +515,11 @@ class Parser:
         negated = bool(self.accept_kw("not"))
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                self.next()
+                sub = self.parse_select_body()
+                self.expect_op(")")
+                return InList(left, [Subquery(sub)], negated)
             items = [self.parse_expr()]
             while self.accept_op(","):
                 items.append(self.parse_expr())
@@ -498,6 +613,11 @@ class Parser:
                 raise SqlError(f"unexpected DISTINCT at {t.pos}")
         if t.kind == "op" and t.value == "(":
             self.next()
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                self.next()
+                sub = self.parse_select_body()
+                self.expect_op(")")
+                return Subquery(sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e
@@ -512,7 +632,7 @@ class Parser:
             if self.accept_op("."):
                 col = self.next()
                 if col.kind == "op" and col.value == "*":
-                    return Star()
+                    return Star(table=name)
                 if col.kind != "ident":
                     raise SqlError(f"expected column after '.' at {col.pos}")
                 return Column(col.value, table=name)

@@ -865,6 +865,36 @@ async def delete_role(request: web.Request) -> web.Response:
 # ----- generic metastore-backed CRUD (alerts/targets/dashboards/filters) ----
 
 
+def _validate_correlation(state: "ServerState", body: dict, username: str) -> None:
+    """Correlation config sanity (reference: correlation.rs:280 validate):
+    exactly two table configs over existing, authorized streams, and join
+    conditions naming fields from those tables."""
+    tables = body.get("tableConfigs") or []
+    if len(tables) != 2:
+        raise ValueError("correlation needs exactly two tableConfigs")
+    allowed = state.rbac.user_allowed_streams(username)
+    names = []
+    for tc in tables:
+        name = tc.get("tableName")
+        if not name:
+            raise ValueError("tableConfig missing tableName")
+        if state.p.streams.get(name) is None:
+            # fresh querier: the stream may exist in storage but not be
+            # loaded yet (same fallback as QuerySession.resolve_stream)
+            state.p.load_streams_from_storage()
+        if state.p.streams.get(name) is None:
+            raise ValueError(f"stream {name!r} does not exist")
+        if allowed is not None and name not in allowed:
+            raise ValueError(f"unauthorized for stream {name!r}")
+        names.append(name)
+    conds = (body.get("joinConfig") or {}).get("joinConditions") or []
+    if not conds:
+        raise ValueError("joinConfig.joinConditions must not be empty")
+    for c in conds:
+        if c.get("tableName") not in names or not c.get("field"):
+            raise ValueError("joinCondition must name a configured table and field")
+
+
 def crud_routes(collection: str, put_action: Action, get_action: Action, delete_action: Action):
     async def put_doc(request: web.Request):
         state: ServerState = request.app["state"]
@@ -880,6 +910,13 @@ def crud_routes(collection: str, put_action: Action, get_action: Action, delete_
 
             try:
                 validate_alert(body)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+        if collection == "correlations":
+            # reference validates correlation configs against live streams
+            # (correlation.rs:280); executable here via the JOIN SQL surface
+            try:
+                _validate_correlation(state, body, request["username"])
             except ValueError as e:
                 return web.json_response({"error": str(e)}, status=400)
         state.p.metastore.put_document(collection, doc_id, body)

@@ -1,0 +1,195 @@
+"""Multi-stream SQL: joins + subqueries (reference gets these from
+DataFusion, src/query/mod.rs:212-276; here query/multi.py + the parser)."""
+
+import pytest
+
+from parseable_tpu.query.session import QueryError, QuerySession
+from parseable_tpu.query.sql import SqlError, parse_sql
+
+
+@pytest.fixture()
+def joined(parseable):
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = parseable
+    s1 = p.create_stream_if_not_exists("reqs")
+    ev = JsonEvent(
+        [{"trace": f"t{i % 5}", "path": f"/p{i % 3}", "ms": float(i)} for i in range(50)],
+        "reqs",
+    ).into_event(s1.metadata)
+    ev.process(s1, commit_schema=p.commit_schema)
+    s2 = p.create_stream_if_not_exists("errs")
+    ev = JsonEvent(
+        [{"trace": f"t{i}", "code": 500.0 + i} for i in range(3)], "errs"
+    ).into_event(s2.metadata)
+    ev.process(s2, commit_schema=p.commit_schema)
+    return p
+
+
+def test_parse_join_shapes():
+    sel = parse_sql("SELECT a.x FROM s1 a JOIN s2 b ON a.k = b.k")
+    assert sel.table == "s1" and sel.table_alias == "a"
+    assert len(sel.joins) == 1 and sel.joins[0].kind == "inner"
+    sel2 = parse_sql("SELECT * FROM s1 LEFT OUTER JOIN s2 ON s1.k = s2.k")
+    assert sel2.joins[0].kind == "left"
+    with pytest.raises(SqlError):
+        parse_sql("SELECT * FROM s1 RIGHT JOIN s2 ON s1.k = s2.k")
+
+
+def test_inner_join(joined):
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query(
+        "SELECT r.trace, count(*) c FROM reqs r JOIN errs e ON r.trace = e.trace "
+        "GROUP BY r.trace ORDER BY r.trace"
+    )
+    assert r.to_json_rows() == [
+        {"trace": "t0", "c": 10},
+        {"trace": "t1", "c": 10},
+        {"trace": "t2", "c": 10},
+    ]
+
+
+def test_left_join_keeps_unmatched(joined):
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = joined
+    s = p.create_stream_if_not_exists("lonely")
+    ev = JsonEvent([{"trace": "zz", "v": 1.0}], "lonely").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "SELECT l.trace, e.code FROM lonely l LEFT JOIN errs e ON l.trace = e.trace"
+    )
+    rows = r.to_json_rows()
+    assert rows == [{"trace": "zz", "code": None}]
+
+
+def test_join_with_residual_condition(joined):
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query(
+        "SELECT count(*) c FROM reqs r JOIN errs e ON r.trace = e.trace AND r.ms > 20"
+    )
+    # traces t0/t1/t2 rows with ms>20: ms in 21..49 -> i%5 in {0,1,2}: 21,22,25,26,27,30,31,32,35,36,37,40,41,42,45,46,47
+    assert r.to_json_rows()[0]["c"] == 17
+
+
+def test_in_subquery(joined):
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query("SELECT count(*) c FROM reqs WHERE trace IN (SELECT trace FROM errs)")
+    assert r.to_json_rows() == [{"c": 30}]
+    r2 = sess.query(
+        "SELECT count(*) c FROM reqs WHERE trace NOT IN (SELECT trace FROM errs)"
+    )
+    assert r2.to_json_rows() == [{"c": 20}]
+
+
+def test_scalar_subquery(joined):
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query("SELECT count(*) c FROM reqs WHERE ms > (SELECT avg(ms) FROM reqs)")
+    assert r.to_json_rows() == [{"c": 25}]
+
+
+def test_join_rbac_checks_all_streams(joined):
+    sess = QuerySession(joined, engine="cpu")
+    with pytest.raises(QueryError, match="unauthorized"):
+        sess.query(
+            "SELECT count(*) FROM reqs r JOIN errs e ON r.trace = e.trace",
+            allowed_streams={"reqs"},  # errs missing
+        )
+    with pytest.raises(QueryError, match="unauthorized"):
+        sess.query(
+            "SELECT count(*) FROM reqs WHERE trace IN (SELECT trace FROM errs)",
+            allowed_streams={"reqs"},
+        )
+
+
+def test_ambiguous_bare_column_rejected(joined):
+    sess = QuerySession(joined, engine="cpu")
+    with pytest.raises(ValueError, match="ambiguous"):
+        sess.query("SELECT trace FROM reqs r JOIN errs e ON r.trace = e.trace")
+
+
+def test_three_way_join(joined):
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = joined
+    s = p.create_stream_if_not_exists("owners")
+    ev = JsonEvent(
+        [{"trace": "t0", "team": "core"}, {"trace": "t1", "team": "infra"}], "owners"
+    ).into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "SELECT o.team, count(*) c FROM reqs r "
+        "JOIN errs e ON r.trace = e.trace "
+        "JOIN owners o ON e.trace = o.trace "
+        "GROUP BY o.team ORDER BY o.team"
+    )
+    assert r.to_json_rows() == [{"team": "core", "c": 10}, {"team": "infra", "c": 10}]
+
+
+def test_unqualified_residual_on_condition(joined):
+    """Bare columns inside the ON residual must resolve by ownership, not
+    silently null out (review finding)."""
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query(
+        "SELECT count(*) c FROM reqs r JOIN errs e ON r.trace = e.trace AND ms > 20"
+    )
+    assert r.to_json_rows()[0]["c"] == 17
+
+
+def test_same_named_group_columns_keep_both_values(joined):
+    """GROUP BY l.x, o.x with the same bare name must not collapse to one
+    side's values (review finding)."""
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = joined
+    s = p.create_stream_if_not_exists("codes2")
+    ev = JsonEvent(
+        [{"trace": "t0", "code": 1.0}, {"trace": "t1", "code": 2.0}], "codes2"
+    ).into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "SELECT e.code, c2.code, count(*) c FROM errs e "
+        "JOIN codes2 c2 ON e.trace = c2.trace GROUP BY e.code, c2.code ORDER BY c2.code"
+    )
+    rows = r.to_json_rows()
+    assert [row["code"] for row in rows] == [500.0, 501.0]
+    assert [row["code_1"] for row in rows] == [1.0, 2.0]
+
+
+def test_qualified_star(joined):
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query("SELECT e.* FROM reqs r JOIN errs e ON r.trace = e.trace LIMIT 1")
+    cols = set(r.table.column_names)
+    assert all(c.startswith("e.") for c in cols), cols
+    # single-table alias star still yields everything
+    r2 = sess.query("SELECT r.* FROM reqs r LIMIT 1")
+    assert "trace" in r2.table.column_names
+
+
+def test_join_words_usable_as_column_names(parseable):
+    """Fields named 'left'/'on'/'join' keep working as columns (review
+    finding: new keywords must be contextual)."""
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = parseable
+    s = p.create_stream_if_not_exists("kwcols")
+    ev = JsonEvent([{"left": 1.0, "join": 2.0, "inner": 3.0}], "kwcols").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query("SELECT left, join, inner FROM kwcols")
+    assert r.to_json_rows() == [{"left": 1.0, "join": 2.0, "inner": 3.0}]
+
+
+def test_empty_side_does_not_create_false_ambiguity(joined):
+    """A side with zero rows in range must not fabricate the other side's
+    columns into ambiguity (review finding)."""
+    sess = QuerySession(joined, engine="cpu")
+    r = sess.query(
+        "SELECT r.path, code FROM reqs r JOIN errs e ON r.trace = e.trace "
+        "AND r.ms > 99999 LIMIT 5"
+    )
+    # no rows match, but 'code' (only in errs) resolves fine
+    assert r.to_json_rows() == []
