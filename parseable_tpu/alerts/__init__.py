@@ -3,21 +3,27 @@
 Parity target (reference: src/alerts/ — 5,858 LoC over 8 files):
 - alert config CRUD lives in the metastore ("alerts"/"targets" collections,
   wired in server/app.py);
-- `evaluate_alert` builds an aggregate SQL from the alert's query +
-  threshold condition and runs it over the rolling window
-  (alerts_utils.rs:58-165), feeding a triggered/resolved state machine
-  (alert_structs.rs:766-910);
-- targets (webhook / slack / alertmanager) receive notifications with a
-  retry policy (target.rs). This environment has no egress, so deliveries
-  log + record to the metastore ("alert_state" collection) — the transport
-  call is isolated in `_deliver` for real deployments.
+- AND/OR condition groups compile to SQL WHERE fragments
+  (alerts_utils.rs:390-671 `get_filter_string`), layered under the
+  aggregate + rolling-window query (alerts_utils.rs:58-165);
+- a triggered/resolved state machine with MTTR accounting
+  (alert_structs.rs:766-910): time-to-resolve accumulates per incident and
+  the running mean is stored with the alert state;
+- targets (webhook / slack / alertmanager payload shapes, target.rs) with
+  a bounded retry policy and, while an alert stays triggered, repeat
+  notifications on the target's repeat interval;
+- state transitions fan out to SSE subscribers (reference: src/sse/
+  Broadcaster) via the thread-safe `ALERT_EVENTS` hub.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from dataclasses import dataclass
 from datetime import UTC, datetime
+from typing import Any
 
 from parseable_tpu.storage import rfc3339_now
 
@@ -34,9 +40,24 @@ OPERATORS = {
 
 AGGREGATES = {"count", "sum", "avg", "min", "max"}
 
+# condition operators the reference's WhereConfigOperator supports
+# (alerts_utils.rs:390-671)
+_CONDITION_OPS = {
+    "=", "!=", "<", "<=", ">", ">=",
+    "is null", "is not null",
+    "contains", "does not contain",
+    "begins with", "does not begin with",
+    "ends with", "does not end with",
+}
+
+TARGET_TYPES = {"webhook", "slack", "alertmanager", "other"}
+
+
+# ----------------------------------------------------------- validation
+
 
 def validate_alert(config: dict) -> None:
-    """Minimal structural validation of an AlertRequest-shaped document
+    """Structural validation of an AlertRequest-shaped document
     (reference: alert_structs.rs:280-503)."""
     if not config.get("title"):
         raise ValueError("alert needs a title")
@@ -51,6 +72,105 @@ def validate_alert(config: dict) -> None:
     if cond.get("operator", ">") not in OPERATORS:
         raise ValueError(f"unknown operator {cond.get('operator')!r}")
     float(cond.get("value", 0))
+    groups = config.get("conditions")
+    if groups:
+        _validate_condition_group(groups)
+
+
+def _validate_condition_group(group: dict) -> None:
+    op = (group.get("operator") or "and").lower()
+    if op not in ("and", "or"):
+        raise ValueError(f"condition group operator must be and/or, got {op!r}")
+    entries = group.get("condition_config") or group.get("conditionConfig") or []
+    if not entries:
+        raise ValueError("condition group needs condition_config entries")
+    for c in entries:
+        if "condition_config" in c or "conditionConfig" in c:
+            _validate_condition_group(c)  # nested group
+            continue
+        if not c.get("column"):
+            raise ValueError("condition needs a column")
+        cop = (c.get("operator") or "=").lower()
+        if cop not in _CONDITION_OPS:
+            raise ValueError(f"unknown condition operator {c.get('operator')!r}")
+        if cop not in ("is null", "is not null") and "value" not in c:
+            raise ValueError(f"condition on {c['column']!r} needs a value")
+
+
+def validate_target(config: dict) -> None:
+    """Target shape (reference: target.rs TargetVerifier)."""
+    ttype = (config.get("type") or "").lower()
+    if ttype not in TARGET_TYPES:
+        raise ValueError(f"target type must be one of {sorted(TARGET_TYPES)}")
+    if not config.get("endpoint"):
+        raise ValueError("target needs an endpoint")
+    rep = config.get("repeat") or {}
+    if rep.get("interval"):
+        from parseable_tpu.utils.timeutil import parse_duration
+
+        parse_duration(str(rep["interval"]))
+
+
+# ------------------------------------------------- condition -> SQL compile
+
+
+def _sql_quote(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
+def _like_escape(v: str) -> str:
+    """Escape a value for embedding inside a LIKE '...' literal: quotes
+    double (SQL string escape) and wildcards backslash-escape."""
+    return (
+        str(v).replace("'", "''").replace("%", r"\%").replace("_", r"\_")
+    )
+
+
+def compile_condition(c: dict) -> str:
+    """One leaf condition -> SQL (reference: match arm per
+    WhereConfigOperator, alerts_utils.rs:390-671)."""
+    col = c["column"]
+    op = (c.get("operator") or "=").lower()
+    v = c.get("value")
+    if op == "is null":
+        return f"{col} IS NULL"
+    if op == "is not null":
+        return f"{col} IS NOT NULL"
+    if op == "contains":
+        return f"{col} LIKE '%{_like_escape(v)}%'"
+    if op == "does not contain":
+        return f"{col} NOT LIKE '%{_like_escape(v)}%'"
+    if op == "begins with":
+        return f"{col} LIKE '{_like_escape(v)}%'"
+    if op == "does not begin with":
+        return f"{col} NOT LIKE '{_like_escape(v)}%'"
+    if op == "ends with":
+        return f"{col} LIKE '%{_like_escape(v)}'"
+    if op == "does not end with":
+        return f"{col} NOT LIKE '%{_like_escape(v)}'"
+    return f"{col} {op} {_sql_quote(v)}"
+
+
+def compile_condition_group(group: dict) -> str:
+    """AND/OR tree -> parenthesized SQL WHERE fragment."""
+    op = (group.get("operator") or "and").upper()
+    entries = group.get("condition_config") or group.get("conditionConfig") or []
+    parts = []
+    for c in entries:
+        if "condition_config" in c or "conditionConfig" in c:
+            parts.append(compile_condition_group(c))
+        else:
+            parts.append(compile_condition(c))
+    joined = f" {op} ".join(parts)
+    return f"({joined})" if len(parts) > 1 else joined
+
+
+# ------------------------------------------------------------- evaluation
 
 
 @dataclass
@@ -62,12 +182,18 @@ class AlertOutcome:
 
 
 def build_alert_sql(config: dict) -> tuple[str, str]:
-    """(sql, window) for the alert (reference: condition->SQL compile,
-    alerts_utils.rs:390-671)."""
+    """(sql, window) for the alert (reference: alerts_utils.rs:58-165).
+
+    WHERE comes from (in priority order): the raw `query`, the AND/OR
+    condition tree (`conditions`), or the legacy flat `where` string. The
+    window comes from eval_config.rollingWindow.evalStart."""
     cond = config.get("threshold_config") or config.get("thresholdConfig") or {}
     agg = cond.get("agg", "count").lower()
     column = cond.get("column", "*")
     where = config.get("where") or cond.get("where")
+    groups = config.get("conditions")
+    if groups:
+        where = compile_condition_group(groups)
     if config.get("query"):
         sql = config["query"]
     else:
@@ -82,14 +208,17 @@ def build_alert_sql(config: dict) -> tuple[str, str]:
 
 
 def evaluate_alert(parseable, config: dict) -> AlertOutcome:
-    """Run one alert evaluation (reference: alerts_utils.rs:58-165)."""
+    """Run one alert evaluation (reference: alerts_utils.rs:58-165).
+
+    The alert loop only runs on query-capable nodes (all/query modes), so
+    evaluation is always local; non-query callers can route the same SQL
+    through cluster.send_query_request's querier round-robin."""
     from parseable_tpu.query.session import QuerySession
 
     alert_id = config.get("id", "unknown")
     sql, window = build_alert_sql(config)
     sess = QuerySession(parseable)
-    res = sess.query(sql, window, "now")
-    rows = res.to_json_rows()
+    rows = sess.query(sql, window, "now").to_json_rows()
     actual = None
     if rows:
         first = rows[0]
@@ -106,21 +235,185 @@ def evaluate_alert(parseable, config: dict) -> AlertOutcome:
     return AlertOutcome(alert_id, state, actual, msg)
 
 
-def _deliver(target: dict, outcome: AlertOutcome) -> None:
-    """Notification transport (webhook/slack/alertmanager). No egress in
-    this environment: log only. Deployments implement the POST here."""
-    logger.info(
-        "notify target=%s type=%s: %s", target.get("id"), target.get("type"), outcome.message
-    )
+# ----------------------------------------------------- SSE broadcaster hub
+
+
+class AlertEventHub:
+    """Thread-safe fan-out of alert state events to SSE subscribers
+    (reference: src/sse/mod.rs Broadcaster). The eval loop runs on a sync
+    thread; subscribers drain bounded queues from the event loop."""
+
+    def __init__(self, maxsize: int = 100):
+        self._subs: dict[int, queue.Queue] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+        self.maxsize = maxsize
+
+    def subscribe(self) -> tuple[int, queue.Queue]:
+        with self._lock:
+            sid = self._next
+            self._next += 1
+            q: queue.Queue = queue.Queue(self.maxsize)
+            self._subs[sid] = q
+            return sid, q
+
+    def unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    def publish(self, event: dict) -> None:
+        with self._lock:
+            subs = list(self._subs.values())
+        for q in subs:
+            try:
+                q.put_nowait(event)
+            except queue.Full:
+                pass  # slow consumer: drop (backpressure like livetail)
+
+
+ALERT_EVENTS = AlertEventHub()
+
+# bounded notification transport (reference: target.rs spawns per-target
+# tasks); DELIVERY_WALL_BUDGET caps how long one alert's deliveries can
+# hold up the eval loop
+from concurrent.futures import ThreadPoolExecutor as _TPE  # noqa: E402
+
+_DELIVERY_POOL = _TPE(max_workers=4, thread_name_prefix="alert-notify")
+DELIVERY_WALL_BUDGET = 15.0
+
+
+# -------------------------------------------------------- target delivery
+
+
+def _payload_for(target: dict, config: dict, outcome: AlertOutcome) -> dict:
+    """Per-transport payload shape (reference: target.rs)."""
+    ttype = (target.get("type") or "webhook").lower()
+    if ttype == "slack":
+        return {"text": outcome.message}
+    if ttype == "alertmanager":
+        return [
+            {
+                "labels": {
+                    "alertname": config.get("title", outcome.alert_id),
+                    "severity": config.get("severity", "medium"),
+                    "stream": config.get("stream", ""),
+                },
+                "annotations": {"message": outcome.message},
+                "status": "firing" if outcome.state == "triggered" else "resolved",
+            }
+        ]
+    return {
+        "id": outcome.alert_id,
+        "title": config.get("title"),
+        "state": outcome.state,
+        "actual": outcome.actual,
+        "message": outcome.message,
+        "severity": config.get("severity", "medium"),
+    }
+
+
+def _deliver(target: dict, config: dict, outcome: AlertOutcome, retries: int = 3) -> bool:
+    """POST the notification with bounded retries (reference: target.rs
+    retry loop). Returns True when delivered. The endpoint may be any
+    HTTP(S) URL; failures log and count — alert state is already durable."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    endpoint = target.get("endpoint")
+    if not endpoint:
+        logger.info("notify (no endpoint) target=%s: %s", target.get("id"), outcome.message)
+        return False
+    payload = _json.dumps(_payload_for(target, config, outcome)).encode()
+    headers = {"Content-Type": "application/json", **(target.get("headers") or {})}
+    timeout = float(target.get("timeout", 10))
+    for attempt in range(max(1, retries)):
+        try:
+            req = urllib.request.Request(endpoint, data=payload, method="POST")
+            for k, v in headers.items():
+                req.add_header(k, v)
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                if resp.status < 300:
+                    return True
+        except (urllib.error.URLError, OSError) as e:
+            logger.warning(
+                "target %s delivery attempt %d failed: %s", target.get("id"), attempt + 1, e
+            )
+        _time.sleep(min(2**attempt, 8) * 0.05)
+    return False
+
+
+# ------------------------------------------------------------- state machine
+
+
+def _update_state_machine(prev: dict, outcome: AlertOutcome, now_iso: str) -> dict:
+    """Triggered/resolved transitions with MTTR accounting
+    (reference: alert_structs.rs:766-910)."""
+    from parseable_tpu.utils.timeutil import parse_rfc3339
+
+    record = {
+        "id": outcome.alert_id,
+        "state": outcome.state,
+        "actual": outcome.actual,
+        "message": outcome.message,
+        "last_eval": now_iso,
+        "since": prev.get("since") if prev.get("state") == outcome.state else now_iso,
+        "incidents": prev.get("incidents", 0),
+        "total_resolve_secs": prev.get("total_resolve_secs", 0.0),
+        "mttr_secs": prev.get("mttr_secs"),
+        "triggered_at": prev.get("triggered_at"),
+    }
+    prev_state = prev.get("state")
+    if prev_state != "triggered" and outcome.state == "triggered":
+        record["triggered_at"] = now_iso
+        record["incidents"] = record["incidents"] + 1
+    elif prev_state == "triggered" and outcome.state == "resolved":
+        t_at = prev.get("triggered_at")
+        if t_at:
+            try:
+                dt = (parse_rfc3339(now_iso) - parse_rfc3339(t_at)).total_seconds()
+                record["total_resolve_secs"] = record["total_resolve_secs"] + max(0.0, dt)
+                record["mttr_secs"] = record["total_resolve_secs"] / max(1, record["incidents"])
+            except ValueError:
+                pass
+        record["triggered_at"] = None
+    return record
+
+
+def _should_repeat(target: dict, state_doc: dict, now: datetime) -> bool:
+    """While triggered, resend on the target's repeat interval
+    (reference: target.rs repeat/timeout loop)."""
+    from parseable_tpu.utils.timeutil import parse_duration, parse_rfc3339
+
+    rep = target.get("repeat") or {}
+    interval = rep.get("interval")
+    if not interval:
+        return False
+    times = rep.get("times")  # None/0 = unlimited
+    sent = state_doc.get("notify_count", {}).get(str(target.get("id")), 0)
+    if times and sent >= int(times):
+        return False
+    last = state_doc.get("last_notified", {}).get(str(target.get("id")))
+    if not last:
+        return True
+    try:
+        return (now - parse_rfc3339(last)).total_seconds() >= parse_duration(
+            str(interval)
+        ).total_seconds()
+    except ValueError:
+        return False
 
 
 def alert_tick(state) -> None:
     """Per-minute evaluation loop body (reference: sync.rs:371-435 runtime).
 
-    Respects per-alert eval frequency; transitions write to the metastore's
-    alert_state collection and bump the state-transition metric.
+    Respects per-alert eval frequency; transitions write the metastore's
+    alert_state collection, bump metrics, publish to SSE subscribers, and
+    notify targets (with repeats while triggered).
     """
     from parseable_tpu.utils.metrics import ALERTS_STATES
+    from parseable_tpu.utils.timeutil import parse_rfc3339
 
     p = state.p
     try:
@@ -137,8 +430,6 @@ def alert_tick(state) -> None:
         last = prev.get("last_eval")
         if last:
             try:
-                from parseable_tpu.utils.timeutil import parse_rfc3339
-
                 if (now - parse_rfc3339(last)).total_seconds() < freq_mins * 60 - 1:
                     continue
             except ValueError:
@@ -148,20 +439,52 @@ def alert_tick(state) -> None:
         except Exception as e:
             logger.warning("alert %s evaluation failed: %s", alert_id, e)
             continue
-        prev_state = prev.get("state")
-        record = {
-            "id": alert_id,
-            "state": outcome.state,
-            "actual": outcome.actual,
-            "message": outcome.message,
-            "last_eval": rfc3339_now(),
-            "since": prev.get("since") if prev_state == outcome.state else rfc3339_now(),
-        }
-        p.metastore.put_document("alert_state", alert_id, record)
-        if prev_state != outcome.state:
+        record = _update_state_machine(prev, outcome, rfc3339_now())
+        record["notify_count"] = prev.get("notify_count", {})
+        record["last_notified"] = prev.get("last_notified", {})
+
+        transitioned = prev.get("state") != outcome.state
+        if transitioned:
             ALERTS_STATES.labels(config.get("title", alert_id), outcome.state).inc()
             logger.info("%s", outcome.message)
-            for target_id in config.get("targets", []):
-                target = p.metastore.get_document("targets", target_id)
-                if target:
-                    _deliver(target, outcome)
+            ALERT_EVENTS.publish(
+                {
+                    "id": alert_id,
+                    "title": config.get("title"),
+                    "state": outcome.state,
+                    "actual": outcome.actual,
+                    "message": outcome.message,
+                    "at": record["last_eval"],
+                }
+            )
+        to_fire = []
+        for target_id in config.get("targets", []):
+            target = p.metastore.get_document("targets", target_id)
+            if not target:
+                continue
+            fire = transitioned or (
+                outcome.state == "triggered" and _should_repeat(target, record, now)
+            )
+            if not fire:
+                continue
+            if transitioned:
+                record["notify_count"][str(target_id)] = 0
+            to_fire.append((target_id, target))
+        # deliveries run concurrently with a hard per-alert wall budget —
+        # one blackholed endpoint must not stall the whole eval loop;
+        # undelivered targets simply retry on the next repeat/transition
+        if to_fire:
+            futures = {
+                tid: _DELIVERY_POOL.submit(_deliver, target, config, outcome)
+                for tid, target in to_fire
+            }
+            import concurrent.futures as _cf
+
+            done, _ = _cf.wait(futures.values(), timeout=DELIVERY_WALL_BUDGET)
+            for tid, fut in futures.items():
+                if fut in done and fut.result():
+                    record["notify_count"][str(tid)] = (
+                        record["notify_count"].get(str(tid), 0) + 1
+                    )
+                    record["last_notified"][str(tid)] = rfc3339_now()
+        p.metastore.put_document("alert_state", alert_id, record)

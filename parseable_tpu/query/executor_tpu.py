@@ -160,13 +160,22 @@ class KeySpec:
 
 def _like_to_regex(pattern: str) -> str:
     out = []
-    for ch in pattern:
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern) and pattern[i + 1] in ("%", "_", "\\"):
+            # backslash-escaped wildcard is a literal (matches Arrow's
+            # pc.match_like semantics on the CPU path)
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
         if ch == "%":
             out.append(".*")
         elif ch == "_":
             out.append(".")
         else:
             out.append(re.escape(ch))
+        i += 1
     return "^" + "".join(out) + "$"
 
 

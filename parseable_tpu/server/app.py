@@ -102,6 +102,14 @@ class ServerState:
     def save_rbac(self) -> None:
         self.p.metastore.put_document("users", "rbac", self.rbac.to_json())
 
+    def reload_rbac(self) -> None:
+        """Refresh users/roles from the metastore (cluster sync), keeping
+        live sessions and the verified-credential cache where the password
+        is unchanged."""
+        fresh = self._load_rbac()
+        fresh.sessions = self.rbac.sessions
+        self.rbac = fresh
+
     # ----- background sync (reference: src/sync.rs) -------------------------
     def start_sync_loops(self) -> None:
         def loop(interval: int, fn, name: str):
@@ -568,6 +576,7 @@ async def put_stream(request: web.Request) -> web.Response:
                     state.p.metastore.put_stream_json(name, fmt, state.p._node_suffix)
 
             await asyncio.get_running_loop().run_in_executor(None, _persist)
+            fanout_to_ingestors(state, "PUT", f"/api/v1/logstream/{name}", headers=_xp_headers(request))
             return web.json_response({"message": f"updated stream {name}"})
         state.p.create_stream_if_not_exists(
             name,
@@ -578,7 +587,12 @@ async def put_stream(request: web.Request) -> web.Response:
         )
     except StreamError as e:
         return web.json_response({"error": str(e)}, status=400)
+    fanout_to_ingestors(state, "PUT", f"/api/v1/logstream/{name}", headers=_xp_headers(request))
     return web.json_response({"message": f"created stream {name}"})
+
+
+def _xp_headers(request: web.Request) -> dict[str, str]:
+    return {k: v for k, v in request.headers.items() if k.lower().startswith("x-p-")}
 
 
 @require(Action.DELETE_STREAM, "name")
@@ -589,6 +603,7 @@ async def delete_stream(request: web.Request) -> web.Response:
         return web.json_response({"error": f"stream {name} not found"}, status=404)
     state.p.streams.delete(name)
     state.p.metastore.delete_stream(name)
+    fanout_to_ingestors(state, "DELETE", f"/api/v1/logstream/{name}")
     return web.json_response({"message": f"deleted stream {name}"})
 
 
@@ -679,6 +694,7 @@ async def put_retention(request: web.Request) -> web.Response:
         await asyncio.get_running_loop().run_in_executor(None, _persist)
     except Exception:
         logger.exception("failed persisting retention")
+    fanout_to_ingestors(state, "PUT", f"/api/v1/logstream/{name}/retention", json_body=body)
     return web.json_response({"message": "updated retention"})
 
 
@@ -786,6 +802,7 @@ async def put_user(request: web.Request) -> web.Response:
     roles = set(body.get("roles", []))
     password = state.rbac.put_user(username, roles=roles)
     state.save_rbac()
+    fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response(password)
 
 
@@ -808,6 +825,7 @@ async def delete_user(request: web.Request) -> web.Response:
         return web.json_response({"error": "cannot delete root user"}, status=400)
     state.rbac.delete_user(username)
     state.save_rbac()
+    fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": f"deleted user {username}"})
 
 
@@ -824,6 +842,7 @@ async def put_user_roles(request: web.Request) -> web.Response:
         return web.json_response({"error": f"unknown roles {missing}"}, status=400)
     u.roles = roles
     state.save_rbac()
+    fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": "updated roles"})
 
 
@@ -842,6 +861,7 @@ async def put_role(request: web.Request) -> web.Response:
         return web.json_response({"error": f"invalid role body: {e}"}, status=400)
     state.rbac.put_role(name, perms)
     state.save_rbac()
+    fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": f"updated role {name}"})
 
 
@@ -859,6 +879,7 @@ async def delete_role(request: web.Request) -> web.Response:
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
     state.save_rbac()
+    fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": "deleted role"})
 
 
@@ -910,6 +931,13 @@ def crud_routes(collection: str, put_action: Action, get_action: Action, delete_
 
             try:
                 validate_alert(body)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+        if collection == "targets":
+            from parseable_tpu.alerts import validate_target
+
+            try:
+                validate_target(body)
             except ValueError as e:
                 return web.json_response({"error": str(e)}, status=400)
         if collection == "correlations":
@@ -987,11 +1015,129 @@ async def internal_staging(request: web.Request) -> web.Response:
     return web.Response(body=data, content_type="application/vnd.apache.arrow.stream")
 
 
+@require(Action.GET_ALERT)
+async def alert_state_handler(request: web.Request) -> web.Response:
+    """GET /api/v1/alerts/{id}/state — current state incl. MTTR fields."""
+    state: ServerState = request.app["state"]
+    doc = state.p.metastore.get_document("alert_state", request.match_info["id"])
+    if doc is None:
+        return web.json_response({"error": "no state yet"}, status=404)
+    return web.json_response(doc)
+
+
+@require(Action.GET_ALERT)
+async def alerts_sse(request: web.Request) -> web.StreamResponse:
+    """GET /api/v1/alerts/sse — alert state transitions as server-sent
+    events (reference: src/sse/mod.rs Broadcaster push)."""
+    import queue as _q
+
+    from parseable_tpu.alerts import ALERT_EVENTS
+
+    state: ServerState = request.app["state"]
+    sid, events = ALERT_EVENTS.subscribe()
+    resp = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        }
+    )
+    await resp.prepare(request)
+    # poll with get_nowait + sleep: holding a worker thread in a blocking
+    # get() would let a handful of idle SSE clients starve the shared pool
+    idle = 0.0
+    try:
+        while not state.shutting_down:
+            try:
+                event = events.get_nowait()
+            except _q.Empty:
+                await asyncio.sleep(0.5)
+                idle += 0.5
+                if idle >= 15:
+                    await resp.write(b": keepalive\n\n")
+                    idle = 0.0
+                continue
+            idle = 0.0
+            await resp.write(f"data: {json.dumps(event)}\n\n".encode())
+    except (ConnectionError, ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        ALERT_EVENTS.unsubscribe(sid)
+    return resp
+
+
 @require(Action.LIST_CLUSTER)
 async def cluster_info(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     nodes = state.p.metastore.list_nodes()
     return web.json_response(nodes)
+
+
+def fanout_to_ingestors(
+    state: "ServerState",
+    method: str,
+    path: str,
+    json_body=None,
+    headers=None,
+    kinds: tuple[str, ...] = ("ingestor",),
+) -> None:
+    """Propagate a querier-side mutation to live peers
+    (reference: cluster/mod.rs:391-840 sync_*_with_ingestors). Fire-and-
+    forget on the worker pool — the metastore holds the durable state; the
+    fan-out refreshes peer caches / per-node stream jsons. RBAC changes go
+    to ALL peer kinds (other queriers also cache users/roles)."""
+    from parseable_tpu.config import Mode as _Mode
+
+    if state.p.options.mode != _Mode.QUERY:
+        return
+    from parseable_tpu.server import cluster as C
+
+    state.workers.submit(
+        C.sync_with_ingestors, state.p, method, path, json_body, headers, kinds
+    )
+
+
+async def internal_rbac_reload(request: web.Request) -> web.Response:
+    """POST /api/v1/internal/rbac/reload: drop the in-memory RBAC cache and
+    reload from the metastore (cache-invalidation flavor of the reference's
+    user/role/password sync)."""
+    state: ServerState = request.app["state"]
+    if not state.rbac.authorize(request["username"], Action.PUT_USER):
+        return web.json_response({"error": "Forbidden"}, status=403)
+    state.reload_rbac()
+    return web.json_response({"message": "rbac reloaded"})
+
+
+@require(Action.LIST_CLUSTER_METRICS)
+async def cluster_metrics(request: web.Request) -> web.Response:
+    """GET /api/v1/cluster/metrics: scrape every node's /metrics into a
+    per-node rollup (reference: cluster/mod.rs:1147-1320)."""
+    state: ServerState = request.app["state"]
+    from parseable_tpu.server import cluster as C
+
+    data = await asyncio.get_running_loop().run_in_executor(
+        state.workers, C.collect_node_metrics, state.p
+    )
+    return web.json_response(data)
+
+
+@require(Action.DELETE_NODE)
+async def remove_node_handler(request: web.Request) -> web.Response:
+    """DELETE /api/v1/cluster/{node_id}: deregister a dead node
+    (reference: cluster/mod.rs:1185; live nodes are refused)."""
+    state: ServerState = request.app["state"]
+    node_id = request.match_info["node_id"]
+    from parseable_tpu.server import cluster as C
+
+    try:
+        removed = await asyncio.get_running_loop().run_in_executor(
+            state.workers, C.remove_node, state.p, node_id
+        )
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    if not removed:
+        return web.json_response({"error": f"unknown node {node_id}"}, status=404)
+    return web.json_response({"message": f"removed node {node_id}"})
 
 
 # -------------------------------------------------------------------- app
@@ -1043,6 +1189,11 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/role", list_roles)
     r.add_delete("/api/v1/role/{name}", delete_role)
 
+    # alert-state SSE + state reads must register before the generic
+    # /alerts/{id} routes (aiohttp matches in registration order)
+    r.add_get("/api/v1/alerts/sse", alerts_sse)
+    r.add_get("/api/v1/alerts/{id}/state", alert_state_handler)
+
     # alerts / targets / dashboards / filters / correlations
     for coll, base, acts in (
         ("alerts", "/api/v1/alerts", (Action.PUT_ALERT, Action.GET_ALERT, Action.DELETE_ALERT)),
@@ -1059,6 +1210,9 @@ def build_app(state: ServerState) -> web.Application:
         r.add_delete(base + "/{id}", delete_doc)
 
     r.add_get("/api/v1/cluster/info", cluster_info)
+    r.add_get("/api/v1/cluster/metrics", cluster_metrics)
+    r.add_delete("/api/v1/cluster/{node_id}", remove_node_handler)
+    r.add_post("/api/v1/internal/rbac/reload", internal_rbac_reload)
     return app
 
 

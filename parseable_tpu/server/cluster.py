@@ -98,3 +98,158 @@ def fetch_staging_batches(p: Parseable, stream: str) -> list[pa.RecordBatch]:
     for f in futures:
         out.extend(f.result())
     return out
+
+
+# ---------------------------------------------------------- management plane
+# (reference: cluster/mod.rs:391-840 stream/user/role sync to ingestors,
+#  :841-925 stats aggregation, :1147-1320 cluster metrics, :1185 removal,
+#  :1785-1964 querier round-robin)
+
+
+def _http(p: Parseable, method: str, url: str, body: bytes | None = None, headers=None, timeout=10.0):
+    req = urllib.request.Request(url, data=body, method=method)
+    req.add_header("Authorization", _auth_header(p))
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    if body is not None and "Content-Type" not in (headers or {}):
+        req.add_header("Content-Type", "application/json")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def live_peers(p: Parseable, kinds: tuple[str, ...]) -> list[dict]:
+    """Live nodes of the given kinds, excluding this node."""
+    nodes = [
+        n
+        for kind in kinds
+        for n in p.metastore.list_nodes(kind)
+        if n.get("node_id") != p.node_id
+    ]
+    return [n for n in nodes if check_liveness(n["domain_name"])]
+
+
+def sync_with_ingestors(
+    p: Parseable,
+    method: str,
+    path: str,
+    json_body: dict | list | None = None,
+    headers: dict | None = None,
+    kinds: tuple[str, ...] = ("ingestor",),
+) -> list[str]:
+    """Fan a control-plane mutation (stream create/update/delete, retention,
+    RBAC cache reload) to every live ingestor. Returns domains that failed —
+    the metastore is the source of truth, so failures mean a stale ingestor
+    cache, not lost state (reference re-sends whole objects:
+    cluster/mod.rs:391-840; here most mutations are already durable in the
+    metastore and the fan-out is cache invalidation + per-node stream-json
+    updates)."""
+    import json as _json
+
+    body = _json.dumps(json_body).encode() if json_body is not None else None
+    failed: list[str] = []
+
+    def one(domain: str) -> None:
+        try:
+            with _http(p, method, f"{domain}{path}", body, headers) as resp:
+                if resp.status >= 300:
+                    failed.append(domain)
+        except (urllib.error.URLError, OSError) as e:
+            logger.warning("ingestor sync %s %s to %s failed: %s", method, path, domain, e)
+            failed.append(domain)
+
+    nodes = live_peers(p, kinds)
+    list(_pool.map(one, [n["domain_name"] for n in nodes]))
+    return failed
+
+
+_rr_index = 0
+
+
+def get_available_querier(p: Parseable) -> dict | None:
+    """Liveness-checked round-robin over registered queriers
+    (reference: cluster/mod.rs:1785-1964 get_available_querier)."""
+    global _rr_index
+    queriers = [
+        n
+        for kind in ("querier", "all")
+        for n in p.metastore.list_nodes(kind)
+        if n.get("node_id") != p.node_id
+    ]
+    if not queriers:
+        return None
+    for i in range(len(queriers)):
+        cand = queriers[(_rr_index + i) % len(queriers)]
+        if check_liveness(cand["domain_name"]):
+            _rr_index = (_rr_index + i + 1) % len(queriers)
+            return cand
+    return None
+
+
+def send_query_request(
+    p: Parseable, sql: str, start_time: str, end_time: str
+) -> list[dict]:
+    """Route a query to a live querier (reference: send_query_request
+    :1973; used by alert evaluation on non-query nodes)."""
+    import json as _json
+
+    q = get_available_querier(p)
+    if q is None:
+        raise RuntimeError("no live querier available")
+    body = {"query": sql, "startTime": start_time, "endTime": end_time}
+    with _http(
+        p, "POST", f"{q['domain_name']}/api/v1/query", _json.dumps(body).encode(), timeout=60.0
+    ) as resp:
+        return _json.loads(resp.read())
+
+
+def collect_node_metrics(p: Parseable) -> list[dict]:
+    """Scrape every live node's /metrics into parsed samples
+    (reference: fetch_cluster_metrics cluster/mod.rs:1147-1320)."""
+    out = []
+    for kind in ("ingestor", "querier", "all"):
+        for n in p.metastore.list_nodes(kind):
+            domain = n["domain_name"]
+            alive = n.get("node_id") == p.node_id or check_liveness(domain)
+            entry = {
+                "node_id": n.get("node_id"),
+                "node_type": kind,
+                "domain_name": domain,
+                "reachable": alive,
+                "metrics": {},
+            }
+            if alive:
+                try:
+                    with _http(p, "GET", f"{domain}/api/v1/metrics", timeout=5.0) as resp:
+                        entry["metrics"] = parse_prometheus(resp.read().decode())
+                except (urllib.error.URLError, OSError) as e:
+                    logger.warning("metrics scrape of %s failed: %s", domain, e)
+                    entry["reachable"] = False
+            out.append(entry)
+    return out
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Sum samples per metric family (enough for the cluster rollup)."""
+    totals: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(" ", 1)
+            name = name_part.split("{", 1)[0]
+            totals[name] = totals.get(name, 0.0) + float(value)
+        except ValueError:
+            continue
+    return totals
+
+
+def remove_node(p: Parseable, node_id: str) -> bool:
+    """Deregister a DEAD node (reference: cluster/mod.rs:1185 remove_node —
+    live nodes are refused)."""
+    for kind in ("ingestor", "querier", "all"):
+        for n in p.metastore.list_nodes(kind):
+            if n.get("node_id") == node_id:
+                if check_liveness(n["domain_name"]):
+                    raise ValueError(f"node {node_id} is live; stop it first")
+                p.metastore.delete_node(node_id)
+                return True
+    return False

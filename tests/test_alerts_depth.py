@@ -1,0 +1,246 @@
+"""Alert engine depth (reference: src/alerts/): condition-tree SQL compile,
+MTTR state machine, target transports with retry/repeat, SSE push."""
+
+import json
+import threading
+from datetime import UTC, datetime, timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from parseable_tpu.alerts import (
+    ALERT_EVENTS,
+    AlertOutcome,
+    _deliver,
+    _should_repeat,
+    _update_state_machine,
+    build_alert_sql,
+    compile_condition_group,
+    validate_alert,
+    validate_target,
+)
+
+
+def test_condition_tree_compiles_to_sql():
+    group = {
+        "operator": "and",
+        "condition_config": [
+            {"column": "status", "operator": ">=", "value": 500},
+            {
+                "operator": "or",
+                "condition_config": [
+                    {"column": "host", "operator": "begins with", "value": "edge-"},
+                    {"column": "msg", "operator": "contains", "value": "oom"},
+                ],
+            },
+            {"column": "trace", "operator": "is not null"},
+        ],
+    }
+    sql = compile_condition_group(group)
+    assert sql == (
+        "(status >= 500 AND (host LIKE 'edge-%' OR msg LIKE '%oom%') "
+        "AND trace IS NOT NULL)"
+    )
+
+
+def test_condition_value_quoting():
+    # SQL injection via value must be escaped
+    g = {"operator": "and", "condition_config": [
+        {"column": "a", "operator": "=", "value": "x' OR '1'='1"},
+    ]}
+    assert compile_condition_group(g) == "a = 'x'' OR ''1''=''1'"
+
+
+def test_build_alert_sql_with_conditions():
+    config = {
+        "title": "errs",
+        "stream": "web",
+        "threshold_config": {"agg": "count", "operator": ">", "value": 10},
+        "conditions": {
+            "operator": "and",
+            "condition_config": [{"column": "status", "operator": ">=", "value": 500}],
+        },
+        "eval_config": {"rollingWindow": {"evalStart": "10m"}},
+    }
+    validate_alert(config)
+    sql, window = build_alert_sql(config)
+    assert sql == "SELECT count(*) AS value FROM web WHERE status >= 500"
+    assert window == "10m"
+
+
+def test_validate_rejects_bad_conditions():
+    base = {
+        "title": "t", "stream": "s",
+        "threshold_config": {"agg": "count", "operator": ">", "value": 1},
+    }
+    with pytest.raises(ValueError, match="operator"):
+        validate_alert({**base, "conditions": {"operator": "xor", "condition_config": [
+            {"column": "a", "operator": "=", "value": 1}]}})
+    with pytest.raises(ValueError, match="column"):
+        validate_alert({**base, "conditions": {"operator": "and", "condition_config": [
+            {"operator": "=", "value": 1}]}})
+
+
+def test_mttr_state_machine():
+    t0 = datetime(2024, 5, 1, 10, 0, tzinfo=UTC)
+    iso = lambda dt: dt.isoformat().replace("+00:00", "Z")
+    fire = AlertOutcome("a1", "triggered", 12.0, "fire")
+    calm = AlertOutcome("a1", "resolved", 1.0, "calm")
+
+    rec = _update_state_machine({}, fire, iso(t0))
+    assert rec["incidents"] == 1 and rec["triggered_at"] == iso(t0)
+    # resolves 5 minutes later -> MTTR 300s
+    rec = _update_state_machine(rec, calm, iso(t0 + timedelta(minutes=5)))
+    assert rec["mttr_secs"] == pytest.approx(300.0)
+    assert rec["triggered_at"] is None
+    # second incident takes 1 minute -> mean of 300 and 60
+    rec = _update_state_machine(rec, fire, iso(t0 + timedelta(minutes=10)))
+    assert rec["incidents"] == 2
+    rec = _update_state_machine(rec, calm, iso(t0 + timedelta(minutes=11)))
+    assert rec["mttr_secs"] == pytest.approx((300 + 60) / 2)
+
+
+def test_target_validation():
+    validate_target({"type": "webhook", "endpoint": "http://x/hook"})
+    with pytest.raises(ValueError):
+        validate_target({"type": "carrier-pigeon", "endpoint": "http://x"})
+    with pytest.raises(ValueError):
+        validate_target({"type": "webhook"})
+    with pytest.raises(ValueError):
+        validate_target({"type": "webhook", "endpoint": "http://x", "repeat": {"interval": "bogus"}})
+
+
+class _Receiver(BaseHTTPRequestHandler):
+    received: list = []
+    fail_first = 0
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        cls = type(self)
+        if cls.fail_first > 0:
+            cls.fail_first -= 1
+            self.send_response(500)
+            self.end_headers()
+            return
+        cls.received.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+
+
+@pytest.fixture()
+def receiver():
+    handler = type("R", (_Receiver,), {"received": [], "fail_first": 0})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}", handler
+    srv.shutdown()
+
+
+def test_webhook_delivery_with_retry(receiver):
+    url, handler = receiver
+    handler.fail_first = 2  # first two attempts 500, third succeeds
+    outcome = AlertOutcome("a1", "triggered", 42.0, "boom")
+    ok = _deliver({"id": "t1", "type": "webhook", "endpoint": url}, {"title": "T"}, outcome)
+    assert ok
+    assert handler.received[0]["state"] == "triggered"
+    assert handler.received[0]["actual"] == 42.0
+
+
+def test_slack_and_alertmanager_payloads(receiver):
+    url, handler = receiver
+    outcome = AlertOutcome("a1", "triggered", 42.0, "boom")
+    _deliver({"id": "s", "type": "slack", "endpoint": url}, {"title": "T"}, outcome)
+    _deliver({"id": "am", "type": "alertmanager", "endpoint": url}, {"title": "T"}, outcome)
+    slack, am = handler.received
+    assert slack == {"text": "boom"}
+    assert am[0]["labels"]["alertname"] == "T"
+    assert am[0]["status"] == "firing"
+
+
+def test_repeat_policy():
+    target = {"id": "t1", "repeat": {"interval": "5m", "times": 2}}
+    now = datetime(2024, 5, 1, 10, 0, tzinfo=UTC)
+    iso = lambda dt: dt.isoformat().replace("+00:00", "Z")
+    state = {"notify_count": {"t1": 1}, "last_notified": {"t1": iso(now - timedelta(minutes=6))}}
+    assert _should_repeat(target, state, now)
+    state["last_notified"]["t1"] = iso(now - timedelta(minutes=2))
+    assert not _should_repeat(target, state, now)  # interval not elapsed
+    state["notify_count"]["t1"] = 2
+    state["last_notified"]["t1"] = iso(now - timedelta(minutes=30))
+    assert not _should_repeat(target, state, now)  # times exhausted
+    assert not _should_repeat({"id": "t2"}, state, now)  # no repeat config
+
+
+def test_end_to_end_alert_with_webhook(receiver, tmp_path):
+    """Full loop: ingest -> alert eval -> state machine -> webhook."""
+    url, handler = receiver
+    import pyarrow as pa
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.alerts import alert_tick
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.event import Event
+    from parseable_tpu.server.app import ServerState
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    state = ServerState(p)
+    stream = p.create_stream_if_not_exists("errs")
+    old = datetime.now(UTC) - timedelta(minutes=2)
+    batch = pa.RecordBatch.from_pydict(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array([old.replace(tzinfo=None)] * 5, pa.timestamp("ms")),
+            "status": pa.array([500.0] * 5),
+        }
+    )
+    Event("errs", batch, parsed_timestamp=old, is_first_event=True).process(
+        stream, commit_schema=p.commit_schema
+    )
+    p.metastore.put_document("targets", "hook", {"id": "hook", "type": "webhook", "endpoint": url})
+    p.metastore.put_document(
+        "alerts",
+        "a1",
+        {
+            "id": "a1",
+            "title": "too many 500s",
+            "stream": "errs",
+            "threshold_config": {"agg": "count", "operator": ">", "value": 3},
+            "conditions": {
+                "operator": "and",
+                "condition_config": [{"column": "status", "operator": ">=", "value": 500}],
+            },
+            "targets": ["hook"],
+            "eval_frequency": 1,
+        },
+    )
+    sid, events = ALERT_EVENTS.subscribe()
+    try:
+        alert_tick(state)
+    finally:
+        ALERT_EVENTS.unsubscribe(sid)
+    st = p.metastore.get_document("alert_state", "a1")
+    assert st["state"] == "triggered"
+    assert st["incidents"] == 1
+    assert handler.received and handler.received[0]["state"] == "triggered"
+    assert events.get_nowait()["state"] == "triggered"
+
+
+def test_like_escape_quotes_and_tpu_regex_parity():
+    """Values with quotes/wildcards compile to valid SQL and the TPU LIKE
+    regex honors backslash-escaped wildcards (review findings)."""
+    from parseable_tpu.alerts import compile_condition
+    from parseable_tpu.query.executor_tpu import _like_to_regex
+    import re
+
+    c = {"column": "user", "operator": "contains", "value": "O'Brien"}
+    assert compile_condition(c) == "user LIKE '%O''Brien%'"
+    # TPU regex for LIKE '%100\%%' must match '100%' literally
+    rx = re.compile(_like_to_regex(r"%100\%%"))
+    assert rx.search("a 100% b")
+    assert not rx.search("a 100x b")

@@ -1,0 +1,183 @@
+"""Cluster management plane (reference: handlers/http/cluster/mod.rs):
+stream/user/role sync querier->ingestors, stats aggregation, node removal,
+cluster metrics rollup, querier round-robin LB."""
+
+import asyncio
+import base64
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from parseable_tpu.config import Mode, Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.server.app import ServerState, build_app
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+
+def make_parseable(tmp_path, node: str, mode: Mode) -> Parseable:
+    opts = Options()
+    opts.mode = mode
+    opts.local_staging_path = tmp_path / f"staging-{node}"
+    storage = StorageOptions(backend="local-store", root=tmp_path / "shared-store")
+    return Parseable(opts, storage)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def _wait_for(cond, timeout=5.0):
+    for _ in range(int(timeout / 0.1)):
+        if cond():
+            return True
+        await asyncio.sleep(0.1)
+    return cond()
+
+
+def test_querier_syncs_streams_and_rbac_to_ingestors(tmp_path):
+    async def scenario():
+        # one ingestor on a real port
+        ing = make_parseable(tmp_path, "ing", Mode.INGEST)
+        ing_state = ServerState(ing)
+        ing_server = TestServer(build_app(ing_state))
+        await ing_server.start_server()
+        ing.register_node(f"127.0.0.1:{ing_server.port}")
+
+        # querier with its own HTTP surface
+        q = make_parseable(tmp_path, "query", Mode.QUERY)
+        q_state = ServerState(q)
+        q_client = TestClient(TestServer(build_app(q_state)))
+        await q_client.start_server()
+
+        # create a stream on the querier -> appears on the ingestor
+        r = await q_client.put("/api/v1/logstream/synced", headers=AUTH)
+        assert r.status == 200, await r.text()
+        assert await _wait_for(lambda: ing.streams.contains("synced"))
+
+        # create a user on the querier -> ingestor RBAC reloads from the
+        # metastore and the new user can ingest
+        r = await q_client.post(
+            "/api/v1/user/carol", json={"roles": []}, headers=AUTH
+        )
+        assert r.status == 200
+        password = await r.json()
+        r = await q_client.put("/api/v1/role/writers", json=[
+            {"privilege": "writer", "resource": "synced"}
+        ], headers=AUTH)
+        assert r.status == 200, await r.text()
+        r = await q_client.put(
+            "/api/v1/user/carol/role", json=["writers"], headers=AUTH
+        )
+        assert r.status == 200
+
+        carol = {
+            "Authorization": "Basic "
+            + base64.b64encode(f"carol:{password}".encode()).decode()
+        }
+        ok = await _wait_for(lambda: "carol" in ing_state.rbac.users)
+        assert ok, "ingestor did not reload RBAC"
+        assert "writers" in ing_state.rbac.users["carol"].roles
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            url = f"http://127.0.0.1:{ing_server.port}/api/v1/ingest"
+            async with http.post(
+                url, json=[{"a": 1}], headers={**carol, "X-P-Stream": "synced"}
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+
+        # retention sync: set on querier, ingestor metadata follows
+        r = await q_client.put(
+            "/api/v1/logstream/synced/retention",
+            json=[{"action": "delete", "duration": "30d"}],
+            headers=AUTH,
+        )
+        assert r.status == 200
+        assert await _wait_for(
+            lambda: ing.streams.get("synced").metadata.retention is not None
+        )
+
+        await q_client.close()
+        await ing_server.close()
+
+    run(scenario())
+
+
+def test_cluster_metrics_and_node_removal(tmp_path):
+    async def scenario():
+        ing = make_parseable(tmp_path, "ing", Mode.INGEST)
+        ing_state = ServerState(ing)
+        ing_server = TestServer(build_app(ing_state))
+        await ing_server.start_server()
+        ing.register_node(f"127.0.0.1:{ing_server.port}")
+
+        q = make_parseable(tmp_path, "query", Mode.QUERY)
+        q_state = ServerState(q)
+        q.register_node("127.0.0.1:59998")  # not actually listening
+        q_client = TestClient(TestServer(build_app(q_state)))
+        await q_client.start_server()
+
+        # metrics rollup sees the live ingestor
+        r = await q_client.get("/api/v1/cluster/metrics", headers=AUTH)
+        assert r.status == 200
+        nodes = await r.json()
+        by_id = {n["node_id"]: n for n in nodes}
+        assert by_id[ing.node_id]["reachable"] is True
+        assert "parseable_events_ingested" in by_id[ing.node_id]["metrics"]
+
+        # removing a live node is refused
+        r = await q_client.delete(f"/api/v1/cluster/{ing.node_id}", headers=AUTH)
+        assert r.status == 400
+
+        # stop it, then removal succeeds
+        await ing_server.close()
+        from parseable_tpu.server import cluster as C
+
+        C._dead_nodes.clear()
+        r = await q_client.delete(f"/api/v1/cluster/{ing.node_id}", headers=AUTH)
+        assert r.status == 200, await r.text()
+        assert all(
+            n.get("node_id") != ing.node_id for n in q.metastore.list_nodes("ingestor")
+        )
+
+        # unknown node -> 404
+        r = await q_client.delete("/api/v1/cluster/nope", headers=AUTH)
+        assert r.status == 404
+        await q_client.close()
+
+    run(scenario())
+
+
+def test_querier_round_robin(tmp_path):
+    async def scenario():
+        from parseable_tpu.server import cluster as C
+
+        C._dead_nodes.clear()
+        states = []
+        servers = []
+        for i in range(2):
+            qp = make_parseable(tmp_path, f"q{i}", Mode.QUERY)
+            st = ServerState(qp)
+            srv = TestServer(build_app(st))
+            await srv.start_server()
+            qp.register_node(f"127.0.0.1:{srv.port}")
+            states.append(st)
+            servers.append(srv)
+
+        # an ingest-mode node routes queries through the LB
+        ing = make_parseable(tmp_path, "ing", Mode.INGEST)
+
+        def pick_two():
+            a = C.get_available_querier(ing)
+            b = C.get_available_querier(ing)
+            return a, b
+
+        a, b = await asyncio.get_running_loop().run_in_executor(None, pick_two)
+        assert a is not None and b is not None
+        assert a["node_id"] != b["node_id"], "round robin did not rotate"
+
+        for srv in servers:
+            await srv.close()
+
+    run(scenario())
