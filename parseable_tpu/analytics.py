@@ -1,0 +1,81 @@
+"""Anonymous usage analytics (reference: src/analytics.rs).
+
+Off by default (P_SEND_ANONYMOUS_USAGE_DATA). When enabled, an hourly
+report — deployment id, version, mode, stream/event totals, platform — is
+POSTed to the analytics endpoint. Ingestor metric totals merge in via the
+cluster metrics scrape, mirroring the reference's ingestor merge
+(analytics.rs:253-330).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import platform
+import time
+import urllib.request
+
+from parseable_tpu import __version__
+
+logger = logging.getLogger(__name__)
+
+_STARTED = time.time()
+
+
+def build_report(p) -> dict:
+    """Report shape (reference: analytics.rs:61-186)."""
+    streams = []
+    total_events = 0
+    total_json_bytes = 0
+    total_parquet_bytes = 0
+    try:
+        streams = p.metastore.list_streams()
+        for name in streams:
+            for fmt in p.metastore.get_all_stream_jsons(name):
+                total_events += fmt.stats.events
+                total_json_bytes += fmt.stats.ingestion
+                total_parquet_bytes += fmt.stats.storage
+    except Exception:
+        logger.debug("analytics stats collection failed", exc_info=True)
+    meta = {}
+    try:
+        meta = p.metastore.get_parseable_metadata() or {}
+    except Exception:
+        pass
+    return {
+        "deployment_id": meta.get("deployment_id", p.node_id),
+        "report_created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "version": __version__,
+        "uptime_secs": round(time.time() - _STARTED, 1),
+        "operating_system_name": platform.system(),
+        "cpu_count": __import__("os").cpu_count(),
+        "server_mode": p.options.mode.to_str(),
+        "total_events_count": total_events,
+        "total_json_bytes": total_json_bytes,
+        "total_parquet_bytes": total_parquet_bytes,
+        "stream_count": len(streams),
+        "query_engine": p.options.query_engine,
+    }
+
+
+def send_report(p, endpoint: str | None = None, timeout: float = 10.0) -> bool:
+    """POST the report; failures only log (never disrupt the server)."""
+    url = endpoint or p.options.analytics_endpoint
+    report = build_report(p)
+    try:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(report).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json", "x-p-version": __version__},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status < 300
+    except Exception as e:
+        logger.debug("analytics report failed: %s", e)
+        return False
+
+
+def analytics_tick(state) -> None:
+    if state.p.options.send_analytics:
+        send_report(state.p)

@@ -92,8 +92,9 @@ class Options:
     address: str = field(default_factory=lambda: _env("P_ADDR", "0.0.0.0:8000"))
     ingestor_endpoint: str = field(default_factory=lambda: _env("P_INGESTOR_ENDPOINT", ""))
     querier_endpoint: str = field(default_factory=lambda: _env("P_QUERIER_ENDPOINT", ""))
-    flight_port: int = field(default_factory=lambda: _env_int("P_FLIGHT_PORT", 8002))
-    grpc_port: int = field(default_factory=lambda: _env_int("P_GRPC_PORT", 8001))
+    # NOTE: the reference's P_FLIGHT_PORT/P_GRPC_PORT are intentionally
+    # absent — this build's inter-node data plane is HTTP + Arrow IPC on the
+    # main port (SURVEY §5 distributed-comm mapping), not Arrow Flight gRPC.
     mode: Mode = field(default_factory=lambda: Mode(_env("P_MODE", "all").lower()))
 
     # --- auth -----------------------------------------------------------------
@@ -188,6 +189,14 @@ class Options:
     cpu_threshold_pct: float = field(default_factory=lambda: _env_float("P_CPU_THRESHOLD", 90.0))
     memory_threshold_pct: float = field(default_factory=lambda: _env_float("P_MEMORY_THRESHOLD", 90.0))
     openai_api_key: str | None = field(default_factory=lambda: _env("P_OPENAI_API_KEY"))
+    openai_base_url: str = field(
+        default_factory=lambda: _env("P_OPENAI_BASE_URL", "https://api.openai.com/v1")
+    )
+    analytics_endpoint: str = field(
+        default_factory=lambda: _env(
+            "P_ANALYTICS_ENDPOINT", "https://analytics.parseable.io/api/v1/event"
+        )
+    )
 
     def staging_dir(self) -> Path:
         self.local_staging_path.mkdir(parents=True, exist_ok=True)

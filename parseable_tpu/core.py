@@ -131,11 +131,19 @@ class Parseable:
         node_type = {Mode.INGEST: "ingestor", Mode.QUERY: "querier"}.get(
             self.options.mode, "all"
         )
+        # advertised-endpoint overrides (reference: cli.rs endpoint
+        # resolution): behind NAT/LB the bind address isn't reachable by
+        # peers, so P_INGESTOR_ENDPOINT / P_QUERIER_ENDPOINT win
+        if node_type == "ingestor" and self.options.ingestor_endpoint:
+            address = self.options.ingestor_endpoint
+        elif node_type in ("querier", "all") and self.options.querier_endpoint:
+            address = self.options.querier_endpoint
+        domain = address if address.startswith(("http://", "https://")) else f"http://{address}"
         self.metastore.put_node(
             {
                 "node_id": self.node_id,
                 "node_type": node_type,
-                "domain_name": f"http://{address}",
+                "domain_name": domain,
                 "mode": self.options.mode.to_str(),
                 "registered_at": rfc3339_now(),
             }

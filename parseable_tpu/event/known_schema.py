@@ -5,14 +5,21 @@ resources/formats.json): streams may declare a log-source format; incoming
 raw lines are matched against that format's regexes and named capture groups
 become event fields. Unmatched lines pass through untouched (never reject).
 
-The format library below is our own curated set of common formats (the
-reference ships a packaged formats.json with the same mechanism).
+Two format sources merge here:
+- the PACKAGED corpus `parseable_tpu/resources/formats.json` — ported
+  verbatim from the reference's resources/formats.json (declared
+  format-compatibility, as SURVEY §2 row 22 prescribes), with Rust-style
+  `(?<name>)` groups translated to Python `(?P<name>)` at load;
+- a small curated set below for formats where our hand-written patterns
+  are stricter; the packaged corpus wins on name conflicts.
 """
 
 from __future__ import annotations
 
+import json
 import re
 from dataclasses import dataclass
+from pathlib import Path
 from typing import Any
 
 _IP = r"(?:\d{1,3}\.){3}\d{1,3}|[0-9a-fA-F:]+"
@@ -34,7 +41,37 @@ def _fmt(name: str, *patterns: str) -> Format:
     return Format(name, [re.compile(p) for p in patterns])
 
 
-KNOWN_FORMATS: dict[str, Format] = {
+_PACKAGED_FORMATS_PATH = Path(__file__).resolve().parent.parent / "resources" / "formats.json"
+
+
+def _rust_to_python_regex(pattern: str) -> str:
+    """`(?<name>...)` -> `(?P<name>...)` (leave lookbehinds `(?<=`/`(?<!`)."""
+    return re.sub(r"\(\?<(?![=!])", "(?P<", pattern)
+
+
+def load_packaged_formats(path: Path = _PACKAGED_FORMATS_PATH) -> dict[str, Format]:
+    """The reference's full format corpus (53 formats). Patterns that
+    Python's `re` cannot compile are skipped individually (never fatal)."""
+    if not path.is_file():
+        return {}
+    out: dict[str, Format] = {}
+    for entry in json.loads(path.read_text()):
+        name = entry.get("name")
+        patterns: list[re.Pattern] = []
+        for spec in entry.get("regex", []):
+            raw = spec.get("pattern")
+            if not raw:
+                continue
+            try:
+                patterns.append(re.compile(_rust_to_python_regex(raw)))
+            except re.error:
+                continue
+        if name and patterns:
+            out[name] = Format(name, patterns)
+    return out
+
+
+_CURATED_FORMATS: dict[str, Format] = {
     f.name: f
     for f in [
         _fmt(
@@ -79,6 +116,14 @@ KNOWN_FORMATS: dict[str, Format] = {
             r'"(?P<request>[^"]*)"',
         ),
     ]
+}
+
+# packaged corpus wins on name conflicts (it is the compatibility surface);
+# drop the shadowed curated entries so only live patterns remain visible
+_PACKAGED = load_packaged_formats()
+KNOWN_FORMATS: dict[str, Format] = {
+    **{k: v for k, v in _CURATED_FORMATS.items() if k not in _PACKAGED},
+    **_PACKAGED,
 }
 
 

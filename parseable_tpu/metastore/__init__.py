@@ -186,11 +186,19 @@ class ObjectStoreMetastore(Metastore):
         self.storage.put_object(key, json.dumps(doc, default=str).encode())
 
     # -- streams ------------------------------------------------------------
+    @staticmethod
+    def _migrate(obj: dict) -> dict:
+        from parseable_tpu.migration import migrate_stream_json
+
+        return migrate_stream_json(obj)
+
     def get_stream_json(self, stream: str, node_id: str | None = None) -> ObjectStoreFormat:
         obj = self._get_json(stream_json_path(stream, node_id))
         if obj is None:
             raise MetastoreError(f"stream {stream} not found")
-        return ObjectStoreFormat.from_json(obj)
+        # reads always upgrade older layouts (migration/__init__.py), so
+        # data written by any earlier deployment version stays loadable
+        return ObjectStoreFormat.from_json(self._migrate(obj))
 
     def get_all_stream_jsons(self, stream: str) -> list[ObjectStoreFormat]:
         """All nodes' stream jsons — queriers merge these at scan time
@@ -201,8 +209,27 @@ class ObjectStoreMetastore(Metastore):
             if meta.key.endswith("stream.json"):
                 obj = self._get_json(meta.key)
                 if obj is not None:
-                    out.append(ObjectStoreFormat.from_json(obj))
+                    out.append(ObjectStoreFormat.from_json(self._migrate(obj)))
         return out
+
+    def list_stream_json_raw(self, stream: str):
+        """(node_id, raw dict) for every stream.json — the boot migration
+        pass rewrites these in place."""
+        prefix = f"{stream}/{STREAM_ROOT_DIRECTORY}"
+        for meta in self.storage.list_prefix(prefix):
+            name = meta.key.rsplit("/", 1)[-1]
+            if not name.endswith("stream.json"):
+                continue
+            obj = self._get_json(meta.key)
+            if obj is None:
+                continue
+            node_id = None
+            if name.startswith("ingestor."):
+                node_id = name[len("ingestor.") : -len(".stream.json")]
+            yield node_id, obj
+
+    def put_stream_json_raw(self, stream: str, obj: dict, node_id: str | None = None) -> None:
+        self._put_json(stream_json_path(stream, node_id), obj)
 
     def put_stream_json(self, stream: str, fmt: ObjectStoreFormat, node_id: str | None = None) -> None:
         self._put_json(stream_json_path(stream, node_id), fmt.to_json())

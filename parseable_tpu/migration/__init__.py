@@ -1,0 +1,215 @@
+"""Versioned metadata migrations + deployment reconcile.
+
+Parity targets:
+- stream-json migration v1 -> v7 (reference:
+  src/migration/stream_metadata_migration.rs): older stream.json layouts —
+  flat stats, scalar log_source, objectstore-format/camelCase key drift —
+  load and upgrade to the current ObjectStoreFormat shape, so data written
+  by any earlier deployment stays queryable.
+- parseable metadata migration v1 -> v4 (reference:
+  src/migration/metadata_migration.rs): .parseable.json upgrades in place.
+- `resolve_parseable_metadata` (reference: src/storage/store_metadata.rs):
+  staging-vs-remote reconciliation at boot decides whether this process is
+  a brand-new deployment, a new node joining an existing one, or a stale
+  staging dir pointed at the wrong store (hard error rather than silent
+  cross-deployment writes).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from parseable_tpu.storage import (
+    CURRENT_OBJECT_STORE_VERSION,
+    rfc3339_now,
+)
+
+logger = logging.getLogger(__name__)
+
+CURRENT_METADATA_VERSION = "v4"
+
+
+class MigrationError(Exception):
+    pass
+
+
+# ------------------------------------------------------------- stream json
+
+
+def migrate_stream_json(obj: dict) -> dict:
+    """Upgrade any historical stream.json shape to the current one.
+
+    Handled drift (mirroring v1->v7 in stream_metadata_migration.rs):
+    - v1 flat `stats` {events, ingestion, storage} -> current/lifetime/
+      deleted triplet (lifetime seeded from current; deleted zero);
+    - `objectstore-format` missing or under `object_store_format`;
+    - scalar `log_source` string -> [{log_source_format, fields}];
+    - camelCase keys (createdAt, firstEventAt, staticSchemaFlag,
+      timePartition, customPartition, streamType) -> current names;
+    - missing snapshot -> empty manifest list.
+    Idempotent: current-format documents pass through unchanged.
+    """
+    out = dict(obj)
+    version = str(out.get("version", "v1"))
+
+    # key drift ---------------------------------------------------------
+    renames = {
+        "createdAt": "created-at",
+        "firstEventAt": "first-event-at",
+        "staticSchemaFlag": "static_schema_flag",
+        "timePartition": "time_partition",
+        "timePartitionLimit": "time_partition_limit",
+        "customPartition": "custom_partition",
+        "streamType": "stream_type",
+        "object_store_format": "objectstore-format",
+    }
+    for old, new in renames.items():
+        if old in out and new not in out:
+            out[new] = out.pop(old)
+
+    # stats -------------------------------------------------------------
+    stats = out.get("stats") or {}
+    if stats and "current_stats" not in stats:
+        flat = {
+            "events": stats.get("events", 0),
+            "ingestion": stats.get("ingestion", 0),
+            "storage": stats.get("storage", 0),
+        }
+        out["stats"] = {
+            "current_stats": flat,
+            "lifetime_stats": dict(flat),
+            "deleted_stats": {"events": 0, "ingestion": 0, "storage": 0},
+        }
+
+    # log source --------------------------------------------------------
+    ls = out.get("log_source")
+    if isinstance(ls, str):
+        out["log_source"] = [{"log_source_format": ls, "fields": []}]
+    elif ls is None:
+        out["log_source"] = []
+
+    # snapshot ----------------------------------------------------------
+    if "snapshot" not in out or out["snapshot"] is None:
+        out["snapshot"] = {"version": "v2", "manifest_list": []}
+
+    if "created-at" not in out:
+        out["created-at"] = rfc3339_now()
+    out["version"] = CURRENT_OBJECT_STORE_VERSION
+    out.setdefault("objectstore-format", CURRENT_OBJECT_STORE_VERSION)
+    if version != CURRENT_OBJECT_STORE_VERSION:
+        logger.info("migrated stream.json %s -> %s", version, CURRENT_OBJECT_STORE_VERSION)
+    return out
+
+
+# --------------------------------------------------------- parseable json
+
+
+def migrate_parseable_metadata(obj: dict) -> dict:
+    """Upgrade .parseable.json to the current shape
+    (reference: metadata_migration.rs v1->v4: version bump, staging/server
+    mode fields, user block moved out to RBAC)."""
+    out = dict(obj)
+    version = str(out.get("version", "v1"))
+    renames = {"deployment_id": "deployment_id", "deploymentId": "deployment_id"}
+    for old, new in renames.items():
+        if old in out and new not in out:
+            out[new] = out.pop(old)
+    out.pop("users", None)  # pre-v3 embedded users; RBAC owns them now
+    out.pop("streams", None)  # pre-v2 embedded stream list
+    out.setdefault("server_mode", out.pop("mode", "All"))
+    out["version"] = CURRENT_METADATA_VERSION
+    if version != CURRENT_METADATA_VERSION:
+        logger.info(
+            "migrated .parseable.json %s -> %s", version, CURRENT_METADATA_VERSION
+        )
+    return out
+
+
+# ------------------------------------------------------------- reconcile
+
+
+def resolve_parseable_metadata(p) -> dict:
+    """Staging-vs-remote deployment reconciliation at boot
+    (reference: store_metadata.rs resolve_parseable_metadata).
+
+    Outcomes:
+    - neither side has metadata  -> NEW deployment: mint an id, write both;
+    - remote only                -> new node joining: adopt remote, copy to
+      staging;
+    - staging only               -> the store was wiped or this staging dir
+      points at the wrong store: hard error (silent re-create would corrupt
+      a different deployment's catalog);
+    - both, same deployment id   -> ok; run metadata migration and update;
+    - both, different ids        -> hard error.
+    """
+    staging_path = p.options.staging_dir() / ".parseable.json"
+    staging_doc = None
+    if staging_path.is_file():
+        try:
+            staging_doc = json.loads(staging_path.read_text())
+        except ValueError:
+            logger.warning("unreadable staging .parseable.json; ignoring")
+    remote_doc = p.metastore.get_parseable_metadata()
+
+    if remote_doc is None and staging_doc is None:
+        doc = {
+            "version": CURRENT_METADATA_VERSION,
+            "deployment_id": p.node_id,
+            "server_mode": p.options.mode.to_str(),
+            "created-at": rfc3339_now(),
+        }
+        p.metastore.put_parseable_metadata(doc)
+        staging_path.parent.mkdir(parents=True, exist_ok=True)
+        staging_path.write_text(json.dumps(doc))
+        logger.info("new deployment %s", doc["deployment_id"])
+        return doc
+
+    if remote_doc is not None and staging_doc is None:
+        doc = migrate_parseable_metadata(remote_doc)
+        staging_path.parent.mkdir(parents=True, exist_ok=True)
+        staging_path.write_text(json.dumps(doc))
+        logger.info("joined existing deployment %s", doc.get("deployment_id"))
+        return doc
+
+    if remote_doc is None and staging_doc is not None:
+        raise MigrationError(
+            "staging has deployment metadata but the object store has none — "
+            "the store was wiped or P_FS_DIR/bucket points at the wrong "
+            "location; refusing to silently re-create the deployment"
+        )
+
+    # both present
+    staged = migrate_parseable_metadata(staging_doc)
+    remote = migrate_parseable_metadata(remote_doc)
+    sid = staged.get("deployment_id")
+    rid = remote.get("deployment_id")
+    if sid and rid and sid != rid:
+        raise MigrationError(
+            f"staging belongs to deployment {sid} but the store is deployment "
+            f"{rid}; refusing to mix deployments"
+        )
+    p.metastore.put_parseable_metadata(remote)
+    staging_path.write_text(json.dumps(remote))
+    return remote
+
+
+def run_migrations(p) -> int:
+    """Boot-time pass (reference: migration/mod.rs:117-520): migrate every
+    stream.json in place. Returns how many documents were upgraded."""
+    upgraded = 0
+    try:
+        names = p.metastore.list_streams()
+    except Exception:
+        return 0
+    for name in names:
+        try:
+            for node_id, raw in p.metastore.list_stream_json_raw(name):
+                migrated = migrate_stream_json(raw)
+                if migrated != raw:
+                    p.metastore.put_stream_json_raw(name, migrated, node_id)
+                    upgraded += 1
+        except Exception:
+            logger.exception("migration failed for stream %s", name)
+    return upgraded

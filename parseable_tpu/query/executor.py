@@ -621,6 +621,9 @@ class QueryExecutor:
         if self.plan.is_aggregate or sel.order_by or sel.distinct:
             yield self.execute(tables)
             return
+        # chunk emissions at the execution batch size (reference: DF batch
+        # size, cli.rs:448-454) so response writes stay uniformly sized
+        batch_rows = getattr(self.plan, "execution_batch_size", None) or 1 << 30
         to_skip = sel.offset or 0
         remaining = sel.limit  # None = unbounded
         for table in tables:
@@ -641,8 +644,10 @@ class QueryExecutor:
             if remaining is not None:
                 part = part.slice(0, remaining)
                 remaining -= part.num_rows
-            if part.num_rows:
-                yield part
+            for off in range(0, part.num_rows, batch_rows):
+                chunk = part.slice(off, batch_rows)
+                if chunk.num_rows:
+                    yield chunk
             if remaining == 0:
                 return
 

@@ -275,6 +275,7 @@ class LogicalPlan:
     # query/mod.rs:92,152-165 timeout + :216-226 memory pool)
     deadline: float | None = None  # time.monotonic() cutoff
     memory_limit_bytes: int | None = None
+    execution_batch_size: int | None = None  # streaming emission chunk rows
 
     @property
     def count_star_only(self) -> bool:

@@ -209,6 +209,7 @@ class QuerySession:
         if timeout:
             lp.deadline = t0 + timeout
         lp.memory_limit_bytes = self.p.options.query_memory_limit_bytes
+        lp.execution_batch_size = self.p.options.execution_batch_size
         return lp
 
     def query_stream(

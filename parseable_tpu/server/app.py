@@ -69,6 +69,12 @@ class ServerState:
         self._sync_stop = threading.Event()
         self._sync_threads: list[threading.Thread] = []
         self._hot_tier = None
+        # 503-on-pressure for ingest (reference: resource_check.rs:41-137)
+        from parseable_tpu.utils.resources import ResourceMonitor
+
+        self.resources = ResourceMonitor(
+            p.options.cpu_threshold_pct, p.options.memory_threshold_pct
+        )
 
     def hot_tier(self):
         """Lazily-built hot tier manager, restored from persisted budgets."""
@@ -130,16 +136,22 @@ class ServerState:
             from parseable_tpu.storage.retention import retention_tick
 
             loop(3600, lambda: retention_tick(self.p), "retention")
+            self.resources.start()
         if self.p.options.mode in (Mode.ALL, Mode.QUERY):
             from parseable_tpu.alerts import alert_tick
 
             loop(60, lambda: alert_tick(self), "alerts")
             self.hot_tier()  # restore budgets
             loop(60, lambda: self.hot_tier().tick(), "hot-tier")
+        if self.p.options.send_analytics:
+            from parseable_tpu.analytics import analytics_tick
+
+            loop(3600, lambda: analytics_tick(self), "analytics")
 
     def stop(self) -> None:
         self.shutting_down = True
         self._sync_stop.set()
+        self.resources.stop()
         self.p.shutdown()
         self.workers.shutdown(wait=False)
 
@@ -151,11 +163,24 @@ def _unauthorized(reason: str = "Unauthorized") -> web.Response:
     return web.json_response({"error": reason}, status=401)
 
 
+_INGEST_PATHS = ("/api/v1/ingest", "/v1/")
+
+
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     state: ServerState = request.app["state"]
     if request.path in ("/api/v1/liveness", "/api/v1/readiness") or request.method == "OPTIONS":
         return await handler(request)
+    # shed ingest under resource pressure (reference: resource_check.rs:120)
+    if state.resources.overloaded and request.method == "POST":
+        path = request.path
+        if path.startswith(_INGEST_PATHS) or (
+            path.startswith("/api/v1/logstream/") and path.count("/") == 4
+        ):
+            return web.json_response(
+                {"error": f"node overloaded ({state.resources.reason}); retry later"},
+                status=503,
+            )
     username = None
     auth = request.headers.get("Authorization", "")
     if auth.startswith("Basic "):
@@ -1066,6 +1091,71 @@ async def alerts_sse(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+@require(Action.QUERY_LLM)
+async def llm_sql(request: web.Request) -> web.Response:
+    """POST /api/v1/llm — natural language -> SQL via an OpenAI-compatible
+    completion API (reference: handlers/http/llm.rs:92-147). The prompt
+    embeds the stream's schema; requires P_OPENAI_API_KEY."""
+    state: ServerState = request.app["state"]
+    api_key = state.p.options.openai_api_key
+    if not api_key:
+        return web.json_response(
+            {"error": "LLM is not configured (set P_OPENAI_API_KEY)"}, status=400
+        )
+    body = await request.json()
+    prompt = body.get("prompt")
+    stream_name = body.get("stream")
+    if not prompt or not stream_name:
+        return web.json_response({"error": "need 'prompt' and 'stream'"}, status=400)
+    try:
+        stream = state.p.get_stream(stream_name)
+    except StreamNotFound:
+        return web.json_response({"error": f"stream {stream_name} not found"}, status=404)
+    schema_desc = ", ".join(
+        f"{f.name} {f.type}" for f in stream.metadata.schema.values()
+    )
+
+    def work():
+        import urllib.request
+
+        full_prompt = (
+            f"I have a table named {stream_name} with columns: {schema_desc}. "
+            f"Write a SQL query (no explanation, just SQL) for: {prompt}"
+        )
+        payload = json.dumps(
+            {
+                "model": body.get("model", "gpt-4o-mini"),
+                "messages": [{"role": "user", "content": full_prompt}],
+                "temperature": 0,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"{state.p.options.openai_base_url.rstrip('/')}/chat/completions",
+            data=payload,
+            method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {api_key}",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        text = out["choices"][0]["message"]["content"]
+        # strip a markdown code fence if the model added one
+        if "```" in text:
+            text = text.split("```")[1]
+            if text.startswith("sql"):
+                text = text[3:]
+        return text.strip()
+
+    try:
+        sql = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except Exception as e:
+        logger.warning("llm proxy failed: %s", e)
+        return web.json_response({"error": f"LLM request failed: {e}"}, status=502)
+    return web.json_response({"sql": sql})
+
+
 @require(Action.LIST_CLUSTER)
 async def cluster_info(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
@@ -1209,6 +1299,7 @@ def build_app(state: ServerState) -> web.Application:
         r.add_get(base + "/{id}", get_doc)
         r.add_delete(base + "/{id}", delete_doc)
 
+    r.add_post("/api/v1/llm", llm_sql)
     r.add_get("/api/v1/cluster/info", cluster_info)
     r.add_get("/api/v1/cluster/metrics", cluster_metrics)
     r.add_delete("/api/v1/cluster/{node_id}", remove_node_handler)
@@ -1219,9 +1310,21 @@ def build_app(state: ServerState) -> web.Application:
 def run_server(opts: Options | None = None, storage: StorageOptions | None = None) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     p = Parseable(opts, storage)
+    # deployment reconcile + metadata migrations before anything registers
+    # (reference: main.rs:73-79 resolve_parseable_metadata + migration runs)
+    from parseable_tpu.migration import resolve_parseable_metadata, run_migrations
+
+    resolve_parseable_metadata(p)
+    upgraded = run_migrations(p)
+    if upgraded:
+        logger.info("migrated %d stream metadata documents", upgraded)
     state = ServerState(p)
     host, _, port = p.options.address.rpartition(":")
     p.register_node(p.options.address)
+    if p.options.check_update:
+        from parseable_tpu.utils.update import check_for_update
+
+        state.workers.submit(check_for_update, p.options)
     state.start_sync_loops()
     app = build_app(state)
 

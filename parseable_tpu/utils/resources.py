@@ -1,0 +1,70 @@
+"""Resource-pressure monitor: shed ingest load before the node falls over.
+
+Parity target (reference: src/handlers/http/resource_check.rs:41-137):
+a background poll samples CPU and memory utilization; while either is over
+its threshold (P_CPU_THRESHOLD / P_MEMORY_THRESHOLD, percent), ingest
+endpoints answer 503 so the load balancer retries another node.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+POLL_INTERVAL_SECS = 15.0
+
+
+class ResourceMonitor:
+    def __init__(self, cpu_threshold_pct: float, memory_threshold_pct: float):
+        self.cpu_threshold = cpu_threshold_pct
+        self.mem_threshold = memory_threshold_pct
+        self._over = False
+        self._reason = ""
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # separated for tests
+    def sample(self) -> tuple[float, float]:
+        import psutil
+
+        return psutil.cpu_percent(interval=None), psutil.virtual_memory().percent
+
+    def check_once(self) -> None:
+        try:
+            cpu, mem = self.sample()
+        except Exception:
+            logger.exception("resource sample failed")
+            return
+        over = []
+        if self.cpu_threshold and cpu >= self.cpu_threshold:
+            over.append(f"cpu {cpu:.0f}% >= {self.cpu_threshold:.0f}%")
+        if self.mem_threshold and mem >= self.mem_threshold:
+            over.append(f"memory {mem:.0f}% >= {self.mem_threshold:.0f}%")
+        was = self._over
+        self._over = bool(over)
+        self._reason = "; ".join(over)
+        if self._over and not was:
+            logger.warning("resource pressure: %s — shedding ingest", self._reason)
+        elif was and not self._over:
+            logger.info("resource pressure cleared")
+
+    @property
+    def overloaded(self) -> bool:
+        return self._over
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    def start(self) -> None:
+        def run():
+            while not self._stop.wait(POLL_INTERVAL_SECS):
+                self.check_once()
+
+        self._thread = threading.Thread(target=run, name="resource-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

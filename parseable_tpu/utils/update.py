@@ -1,0 +1,39 @@
+"""Startup version check (reference: src/utils/update.rs).
+
+Gated by P_CHECK_UPDATE; network failures never affect startup (best-effort
+GET with a short timeout). The endpoint is GitHub's latest-release API, the
+same source the reference polls.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+
+from parseable_tpu import __version__
+
+logger = logging.getLogger(__name__)
+
+RELEASES_URL = "https://api.github.com/repos/parseablehq/parseable/releases/latest"
+
+
+def latest_version(url: str = RELEASES_URL, timeout: float = 5.0) -> str | None:
+    try:
+        req = urllib.request.Request(url, headers={"User-Agent": "parseable-tpu"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read()).get("tag_name")
+    except Exception as e:
+        logger.debug("update check failed: %s", e)
+        return None
+
+
+def check_for_update(options, url: str = RELEASES_URL) -> str | None:
+    """Log (and return) the newer version tag when one exists."""
+    if not options.check_update:
+        return None
+    tag = latest_version(url)
+    if tag and tag.lstrip("v") != __version__:
+        logger.info("a newer release is available: %s (running %s)", tag, __version__)
+        return tag
+    return None

@@ -1,0 +1,131 @@
+"""Metadata migrations + deployment reconcile (reference:
+src/migration/mod.rs:117-520, storage/store_metadata.rs)."""
+
+import json
+
+import pytest
+
+from parseable_tpu.config import Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.migration import (
+    MigrationError,
+    migrate_parseable_metadata,
+    migrate_stream_json,
+    resolve_parseable_metadata,
+    run_migrations,
+)
+
+
+def make_p(tmp_path, staging="staging"):
+    opts = Options()
+    opts.local_staging_path = tmp_path / staging
+    return Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+
+
+V1_STREAM_JSON = {
+    # the oldest layout: flat stats, scalar log_source, camelCase keys
+    "version": "v1",
+    "createdAt": "2022-01-01T00:00:00.000Z",
+    "firstEventAt": "2022-01-01T00:01:00.000Z",
+    "stats": {"events": 42, "ingestion": 1000, "storage": 500},
+    "log_source": "json",
+    "streamType": "UserDefined",
+    "staticSchemaFlag": True,
+    "timePartition": "ts",
+}
+
+
+def test_stream_json_v1_upgrades():
+    out = migrate_stream_json(V1_STREAM_JSON)
+    assert out["version"] == "v7"
+    assert out["stats"]["current_stats"]["events"] == 42
+    assert out["stats"]["lifetime_stats"]["events"] == 42
+    assert out["stats"]["deleted_stats"]["events"] == 0
+    assert out["log_source"] == [{"log_source_format": "json", "fields": []}]
+    assert out["created-at"] == "2022-01-01T00:00:00.000Z"
+    assert out["first-event-at"] == "2022-01-01T00:01:00.000Z"
+    assert out["static_schema_flag"] is True
+    assert out["time_partition"] == "ts"
+    assert out["snapshot"] == {"version": "v2", "manifest_list": []}
+
+
+def test_stream_json_migration_idempotent():
+    once = migrate_stream_json(V1_STREAM_JSON)
+    twice = migrate_stream_json(once)
+    assert once == twice
+
+
+def test_old_fixture_loads_through_metastore(tmp_path):
+    """A stream.json written in the old format loads + upgrades on read AND
+    gets rewritten by the boot migration pass."""
+    p = make_p(tmp_path)
+    p.storage.put_object(
+        "legacy/.stream/.stream.json", json.dumps(V1_STREAM_JSON).encode()
+    )
+    fmt = p.metastore.get_stream_json("legacy")
+    assert fmt.stats.events == 42
+    assert fmt.stats.lifetime_events == 42
+    assert fmt.log_source == [{"log_source_format": "json", "fields": []}]
+
+    upgraded = run_migrations(p)
+    assert upgraded == 1
+    raw = json.loads(p.storage.get_object("legacy/.stream/.stream.json"))
+    assert raw["version"] == "v7"
+    assert run_migrations(p) == 0  # second pass: nothing left to do
+
+
+def test_parseable_metadata_migration():
+    old = {"version": "v1", "deploymentId": "d1", "mode": "All", "users": [{"u": 1}]}
+    out = migrate_parseable_metadata(old)
+    assert out["version"] == "v4"
+    assert out["deployment_id"] == "d1"
+    assert out["server_mode"] == "All"
+    assert "users" not in out
+
+
+def test_reconcile_new_deployment(tmp_path):
+    p = make_p(tmp_path)
+    doc = resolve_parseable_metadata(p)
+    assert doc["deployment_id"] == p.node_id
+    # both sides written
+    assert p.metastore.get_parseable_metadata()["deployment_id"] == p.node_id
+    staged = json.loads((p.options.staging_dir() / ".parseable.json").read_text())
+    assert staged["deployment_id"] == p.node_id
+
+
+def test_reconcile_join_existing(tmp_path):
+    p1 = make_p(tmp_path, staging="staging1")
+    resolve_parseable_metadata(p1)
+    # second node, fresh staging, same store
+    p2 = make_p(tmp_path, staging="staging2")
+    doc = resolve_parseable_metadata(p2)
+    assert doc["deployment_id"] == p1.node_id  # adopted, not re-minted
+    staged = json.loads((p2.options.staging_dir() / ".parseable.json").read_text())
+    assert staged["deployment_id"] == p1.node_id
+
+
+def test_reconcile_wiped_store_errors(tmp_path):
+    p = make_p(tmp_path)
+    resolve_parseable_metadata(p)
+    # wipe the remote metadata only
+    p.storage.delete_object(".parseable.json")
+    with pytest.raises(MigrationError, match="wiped|refusing"):
+        resolve_parseable_metadata(p)
+
+
+def test_reconcile_mismatched_deployment_errors(tmp_path):
+    p = make_p(tmp_path)
+    resolve_parseable_metadata(p)
+    # another deployment's metadata lands in the store
+    p.metastore.put_parseable_metadata(
+        {"version": "v4", "deployment_id": "someone-else", "server_mode": "All"}
+    )
+    with pytest.raises(MigrationError, match="mix"):
+        resolve_parseable_metadata(p)
+
+
+def test_reconcile_same_deployment_ok(tmp_path):
+    p = make_p(tmp_path)
+    first = resolve_parseable_metadata(p)
+    second = resolve_parseable_metadata(p)
+    assert second["deployment_id"] == first["deployment_id"]

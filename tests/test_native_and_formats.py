@@ -44,12 +44,14 @@ def test_hll_serialize_roundtrip():
 
 
 def test_access_log_extraction():
+    # field names follow the packaged reference corpus (formats.json),
+    # which is the compatibility surface
     line = '192.168.1.10 - frank [10/Oct/2000:13:55:36 -0700] "GET /apache_pb.gif HTTP/1.0" 200 2326 "http://ref/" "Mozilla/4.08"'
     fields = KNOWN_SCHEMA_LIST.extract("access_log", line)
-    assert fields["client_ip"] == "192.168.1.10"
-    assert fields["method"] == "GET"
-    assert fields["status"] == "200"
-    assert fields["user_agent"] == "Mozilla/4.08"
+    assert fields["c_ip"] == "192.168.1.10"
+    assert fields["cs_method"] == "GET"
+    assert fields["sc_status"] == "200"
+    assert fields["cs_user_agent"] == "Mozilla/4.08"
 
 
 def test_syslog_rfc3164_and_rfc5424():

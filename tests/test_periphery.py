@@ -1,0 +1,208 @@
+"""Periphery: resource monitor 503s, packaged format corpus, LLM proxy,
+analytics report, execution batch size — every Options knob has a reader
+(VERDICT Next#10)."""
+
+import asyncio
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ------------------------------------------------------- resource monitor
+
+
+def test_resource_monitor_thresholds():
+    from parseable_tpu.utils.resources import ResourceMonitor
+
+    mon = ResourceMonitor(50.0, 50.0)
+    mon.sample = lambda: (80.0, 10.0)
+    mon.check_once()
+    assert mon.overloaded and "cpu" in mon.reason
+    mon.sample = lambda: (10.0, 10.0)
+    mon.check_once()
+    assert not mon.overloaded
+
+
+def test_ingest_shed_503(tmp_path):
+    from tests.test_server import make_state, with_client
+
+    state = make_state(tmp_path)
+    state.resources.sample = lambda: (99.0, 99.0)
+    state.resources.check_once()
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/ingest", json=[{"a": 1}], headers={**AUTH, "X-P-Stream": "s"}
+        )
+        assert r.status == 503
+        # queries keep working under pressure
+        r = await client.get("/api/v1/logstream", headers=AUTH)
+        assert r.status == 200
+
+    run(with_client(state, fn))
+
+
+# ------------------------------------------------------- format corpus
+
+
+def test_packaged_corpus_loaded():
+    from parseable_tpu.event.known_schema import KNOWN_FORMATS, load_packaged_formats
+
+    packaged = load_packaged_formats()
+    assert len(packaged) >= 50  # reference ships 53; >=50 must compile
+    # formats from the reference corpus that the curated set never had
+    for name in ("zookeeper_log", "postgresql_log", "redis_log"):
+        assert name in KNOWN_FORMATS, name
+
+
+def test_packaged_format_extracts():
+    from parseable_tpu.event.known_schema import KNOWN_SCHEMA_LIST
+
+    fields = KNOWN_SCHEMA_LIST.extract(
+        "syslog", "<34>1 2024-03-12T10:00:00Z host app 123 MSGID - hi"
+    )
+    assert fields and fields["hostname"] == "host"
+
+
+# --------------------------------------------------------------- llm proxy
+
+
+class _OpenAIMock(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n))
+        assert "columns" in req["messages"][0]["content"]
+        body = json.dumps(
+            {"choices": [{"message": {"content": "```sql\nSELECT count(*) FROM web\n```"}}]}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_llm_proxy(tmp_path):
+    from tests.test_server import make_state, with_client
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _OpenAIMock)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    state = make_state(tmp_path)
+    state.p.options.openai_api_key = "sk-test"
+    state.p.options.openai_base_url = f"http://127.0.0.1:{srv.server_port}/v1"
+    state.p.create_stream_if_not_exists("web")
+    from parseable_tpu.event.json_format import JsonEvent
+
+    ev = JsonEvent([{"a": 1}], "web").into_event(state.p.get_stream("web").metadata)
+    ev.process(state.p.get_stream("web"), commit_schema=state.p.commit_schema)
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/llm", json={"prompt": "count rows", "stream": "web"}, headers=AUTH
+        )
+        assert r.status == 200, await r.text()
+        assert (await r.json())["sql"] == "SELECT count(*) FROM web"
+        # unconfigured key -> 400
+        state.p.options.openai_api_key = None
+        r = await client.post(
+            "/api/v1/llm", json={"prompt": "x", "stream": "web"}, headers=AUTH
+        )
+        assert r.status == 400
+
+    try:
+        run(with_client(state, fn))
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------- analytics
+
+
+def test_analytics_report(tmp_path):
+    from parseable_tpu.analytics import build_report, send_report
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.event.json_format import JsonEvent
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    s = p.create_stream_if_not_exists("an")
+    ev = JsonEvent([{"a": i} for i in range(7)], "an").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    report = build_report(p)
+    assert report["total_events_count"] == 7
+    assert report["stream_count"] == 1
+    assert report["server_mode"].lower() == "all"
+
+    received = []
+
+    class _Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        assert send_report(p, endpoint=f"http://127.0.0.1:{srv.server_port}/api/v1/event")
+        assert received[0]["total_events_count"] == 7
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------- execution batch size
+
+
+def test_streaming_respects_execution_batch_size(parseable):
+    from parseable_tpu.event.json_format import JsonEvent
+    from parseable_tpu.query.session import QuerySession
+
+    p = parseable
+    p.options.execution_batch_size = 7
+    s = p.create_stream_if_not_exists("chunked")
+    ev = JsonEvent([{"a": i} for i in range(30)], "chunked").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    parts = list(QuerySession(p, engine="cpu").query_stream("SELECT a FROM chunked"))
+    assert all(t.num_rows <= 7 for t in parts)
+    assert sum(t.num_rows for t in parts) == 30
+
+
+def test_every_option_has_a_reader():
+    """Each Options field must be read somewhere outside config.py
+    (VERDICT: dead knobs promise capabilities that don't exist)."""
+    import dataclasses
+    import pathlib
+    import re as _re
+
+    from parseable_tpu.config import Options
+
+    src = ""
+    for f in pathlib.Path("parseable_tpu").rglob("*.py"):
+        if f.name != "config.py":
+            src += f.read_text()
+    dead = []
+    for fld in dataclasses.fields(Options):
+        if not _re.search(rf"\b{fld.name}\b", src):
+            dead.append(fld.name)
+    assert not dead, f"dead Options knobs: {dead}"
