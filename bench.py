@@ -1,14 +1,20 @@
-"""Benchmark: time-bucketed GROUP BY aggregation, TPU engine vs CPU baseline.
+"""Benchmarks: the BASELINE.md configs, TPU engine vs CPU baseline.
 
-Reproduces BASELINE.md config 2 (time-bucketed GROUP BY (p_timestamp, status)
-COUNT over a flog-style JSON log stream) through the full stack: staging ->
-parquet -> catalog -> manifest-pruned scan -> engine.
+Runs through the full stack (staging -> parquet -> catalog -> manifest-
+pruned scan -> engine) over one synthesized flog/OTel-style stream:
 
-Prints ONE json line:
-    {"metric": ..., "value": rows/sec on TPU, "unit": "rows/s",
-     "vs_baseline": speedup over the CPU pyarrow engine}
+- config 2: time-bucketed GROUP BY (p_timestamp, status) aggregation;
+- config 3: LIKE substring filter on the message column (the dictionary-
+  LUT predicate path's showcase);
+- config 4 (north star): top-K + multi-column GROUP BY, reported COLD
+  (first scan: parquet read + encode + transfer overlapped via the
+  prefetcher) and WARM (device hot set resident);
+- config 5: the distributed psum-tree path, validated on a virtual
+  8-device CPU mesh in a subprocess (the bench host has one real chip).
 
-Env knobs: BENCH_ROWS (default 2_000_000), BENCH_REPEATS (default 3).
+Prints one JSON line per config; the LAST line is the headline north-star
+metric the driver records. Env knobs: BENCH_ROWS (default 32_000_000),
+BENCH_REPEATS (default 3).
 """
 
 from __future__ import annotations
@@ -16,6 +22,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -38,6 +45,14 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
     hosts = np.array([f"10.0.{i}.{j}" for i in range(4) for j in range(8)])
     methods = np.array(["GET", "GET", "GET", "POST", "PUT", "DELETE"])
     paths = np.array([f"/api/v1/resource{i}" for i in range(64)])
+    # OTel-ish message bodies: low-cardinality template set so blocks
+    # dictionary-encode (config 3 exercises the LUT regex path)
+    messages = np.array(
+        [f"request completed in {d}ms" for d in range(0, 400, 25)]
+        + [f"error: upstream timeout after {d}ms" for d in range(0, 400, 50)]
+        + [f"slow query warning threshold {d}" for d in range(0, 200, 25)]
+        + ["connection reset by peer", "error: permission denied", "cache miss"]
+    )
     written = 0
     minute = 0
     while written < total_rows:
@@ -52,6 +67,7 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
                 "host": pa.array(hosts[rng.integers(0, len(hosts), n)]),
                 "method": pa.array(methods[rng.integers(0, len(methods), n)]),
                 "path": pa.array(paths[rng.integers(0, len(paths), n)]),
+                "message": pa.array(messages[rng.integers(0, len(messages), n)]),
                 "status": pa.array(statuses[rng.integers(0, len(statuses), n)].astype(np.float64)),
                 "bytes": pa.array(rng.integers(100, 50_000, n).astype(np.float64)),
                 "latency_ms": pa.array((rng.random(n) * 500).astype(np.float64)),
@@ -61,7 +77,7 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
             ev = Event(
                 stream_name=stream_name,
                 rb=batch,
-                origin_size=batch.num_rows * 120,
+                origin_size=batch.num_rows * 150,
                 is_first_event=written == 0,
                 parsed_timestamp=base + timedelta(minutes=minute),
             )
@@ -72,29 +88,158 @@ def build_dataset(p, stream_name: str, total_rows: int) -> None:
     p.sync_all_streams()
 
 
-QUERY = (
-    "SELECT date_bin(interval '1 minute', p_timestamp) AS t, status, count(*) AS c, "
-    "sum(bytes) AS b, avg(latency_ms) AS l FROM {stream} GROUP BY t, status"
-)
+CONFIGS = {
+    # BASELINE config 2: time-bucketed GROUP BY aggregation
+    "groupby": (
+        "SELECT date_bin(interval '1 minute', p_timestamp) AS t, status, count(*) AS c, "
+        "sum(bytes) AS b, avg(latency_ms) AS l FROM {stream} GROUP BY t, status"
+    ),
+    # BASELINE config 3: substring/LIKE filter (dictionary-LUT predicates)
+    "regex_filter": (
+        "SELECT status, count(*) AS c, avg(latency_ms) AS l FROM {stream} "
+        "WHERE message LIKE '%error%' GROUP BY status"
+    ),
+    # BASELINE config 4: top-K + multi-column GROUP BY (north star)
+    "topk_multicol": (
+        "SELECT path, host, count(*) AS c, sum(bytes) AS s FROM {stream} "
+        "GROUP BY path, host ORDER BY s DESC LIMIT 10"
+    ),
+}
 
 
-def run_engine(p, stream: str, engine: str, repeats: int) -> tuple[float, int, list]:
+def run_query(p, stream: str, engine: str, sql: str) -> tuple[float, int, list]:
     from parseable_tpu.query.session import QuerySession
 
     sess = QuerySession(p, engine=engine)
-    best = float("inf")
-    rows_scanned = 0
-    result_rows = []
+    t0 = time.perf_counter()
+    res = sess.query(sql.format(stream=stream))
+    dt = time.perf_counter() - t0
+    rows = sorted(
+        (tuple(r.values()) for r in res.to_json_rows()),
+        key=lambda t: tuple(str(v) for v in t),
+    )
+    return dt, res.stats["rows_scanned"], rows
+
+
+def rows_match(a: list, b: list) -> bool:
+    """Exact on keys/counts; 1e-4 relative on floats (device sums are f32
+    per block; BENCH parity tolerance matches the test suite's)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if abs(va - vb) > 1e-4 * max(1.0, abs(va)):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def best_of(p, stream, engine, sql, repeats) -> tuple[float, int, list]:
+    best, rows_scanned, result = float("inf"), 0, []
     for _ in range(repeats):
-        t0 = time.perf_counter()
-        res = sess.query(QUERY.format(stream=stream))
-        dt = time.perf_counter() - t0
-        best = min(best, dt)
-        rows_scanned = res.stats["rows_scanned"]
-        result_rows = sorted(
-            (str(r.get("t")), r.get("status"), r.get("c")) for r in res.to_json_rows()
+        dt, scanned, rows = run_query(p, stream, engine, sql)
+        if dt < best:
+            best = dt
+        rows_scanned = max(rows_scanned, scanned)
+        result = rows
+    return best, rows_scanned, result
+
+
+def clear_hot_state() -> None:
+    """Force the next TPU run cold: drop device-resident blocks."""
+    from parseable_tpu.ops.hotset import get_hotset
+
+    hs = get_hotset()
+    try:
+        hs.clear()
+    except AttributeError:
+        for key in list(getattr(hs, "entries", {})):
+            hs.evict(key)
+
+
+def emit(name: str, tpu_rps: float, speedup: float, extra: dict | None = None) -> None:
+    line = {
+        "metric": name,
+        "value": round(tpu_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(speedup, 3),
+    }
+    if extra:
+        line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def bench_distributed_subprocess(total_rows: int) -> None:
+    """Config 5: the shard_map psum path on a virtual 8-device CPU mesh.
+
+    Runs in a subprocess because this process's JAX is bound to the real
+    chip; the virtual mesh validates the distributed path end-to-end and
+    reports its (CPU-device) throughput for the record."""
+    script = r"""
+import os, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS","") + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+from datetime import datetime, timedelta
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query.sql import parse_sql
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query import executor_tpu as ET
+
+n = %d
+rng = np.random.default_rng(0)
+base = datetime(2024, 5, 1)
+ts = [base + timedelta(seconds=int(i)) for i in rng.integers(0, 3600, n)]
+t = pa.table({
+    DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+    "status": pa.array(rng.choice(["200","404","500"], n).tolist()),
+    "bytes": pa.array(rng.random(n) * 1000),
+})
+sql = "SELECT status, count(*) c, sum(bytes) s FROM t GROUP BY status"
+lp = build_plan(parse_sql(sql))
+ex = ET.TpuQueryExecutor(lp)
+assert ex.mesh is not None and ex.mesh.size == 8
+ex.execute(iter([t]))  # warm/compile
+t0 = time.perf_counter()
+out = ex.execute(iter([t]))
+dt = time.perf_counter() - t0
+assert ET.MESH_PROGRAMS_BUILT > 0, "mesh program missing"
+assert sum(r["c"] for r in out.to_pylist()) == n
+print(json.dumps({"ok": True, "rows_per_sec": n / dt, "devices": 8}))
+""" % min(total_rows, 2_000_000)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    return best, rows_scanned, result_rows
+        last = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+        data = json.loads(last)
+        print(
+            f"# distributed (virtual 8-dev mesh): ok={data.get('ok')} "
+            f"{data.get('rows_per_sec', 0):,.0f} rows/s",
+            file=sys.stderr,
+        )
+        emit(
+            "distributed_mesh_groupby_rows_per_sec",
+            float(data.get("rows_per_sec", 0.0)),
+            1.0,
+            {"devices": 8, "note": "virtual CPU mesh validation (1 real chip on host)"},
+        )
+    except Exception as e:
+        print(f"# distributed bench failed: {e}", file=sys.stderr)
+        if "out" in dir():
+            print(out.stderr[-2000:], file=sys.stderr)
 
 
 def main() -> None:
@@ -119,32 +264,46 @@ def main() -> None:
 
         print(f"# devices: {jax.devices()}", file=sys.stderr)
 
-        # warm both engines (first TPU call pays XLA compile)
-        run_engine(p, "bench", "cpu", 1)
-        run_engine(p, "bench", "tpu", 1)
+        results = {}
+        for name, sql in CONFIGS.items():
+            cpu_t, rows, cpu_rows = best_of(p, "bench", "cpu", sql, max(1, repeats - 1))
 
-        cpu_t, rows, cpu_rows = run_engine(p, "bench", "cpu", repeats)
-        tpu_t, _, tpu_rows = run_engine(p, "bench", "tpu", repeats)
+            # compile first (one-time XLA cost), THEN measure cold: the cold
+            # number is the data path (parquet read + encode + transfer +
+            # compute, overlapped by the prefetcher), not compilation
+            run_query(p, "bench", "tpu", sql)
+            clear_hot_state()
+            cold_t, _, tpu_rows_cold = run_query(p, "bench", "tpu", sql)
+            warm_t, _, tpu_rows = best_of(p, "bench", "tpu", sql, repeats)
 
-        if cpu_rows != tpu_rows:
-            print("# WARNING: engine results differ!", file=sys.stderr)
-            print(f"#   cpu: {cpu_rows[:3]}... tpu: {tpu_rows[:3]}...", file=sys.stderr)
-
-        tpu_rps = rows / tpu_t
-        cpu_rps = rows / cpu_t
-        print(
-            f"# cpu: {cpu_t:.3f}s ({cpu_rps:,.0f} rows/s)  tpu: {tpu_t:.3f}s ({tpu_rps:,.0f} rows/s)",
-            file=sys.stderr,
-        )
-        print(
-            json.dumps(
-                {
-                    "metric": "groupby_scan_rows_per_sec_tpu",
-                    "value": round(tpu_rps, 1),
-                    "unit": "rows/s",
-                    "vs_baseline": round(cpu_t / tpu_t, 3),
-                }
+            if not rows_match(cpu_rows, tpu_rows):
+                print(f"# WARNING: {name} results differ!", file=sys.stderr)
+                print(f"#   cpu: {cpu_rows[:2]} tpu: {tpu_rows[:2]}", file=sys.stderr)
+            results[name] = (cpu_t, cold_t, warm_t, rows)
+            print(
+                f"# {name}: cpu {cpu_t:.3f}s | tpu cold {cold_t:.3f}s "
+                f"({rows/cold_t:,.0f} r/s, {cpu_t/cold_t:.1f}x) | tpu warm {warm_t:.3f}s "
+                f"({rows/warm_t:,.0f} r/s, {cpu_t/warm_t:.1f}x)",
+                file=sys.stderr,
             )
+
+        bench_distributed_subprocess(total_rows)
+
+        for name in ("groupby", "regex_filter"):
+            cpu_t, cold_t, warm_t, rows = results[name]
+            emit(
+                f"{name}_scan_rows_per_sec_tpu",
+                rows / warm_t,
+                cpu_t / warm_t,
+                {"cold_rows_per_sec": round(rows / cold_t, 1), "cold_vs_baseline": round(cpu_t / cold_t, 3)},
+            )
+        # north star LAST: top-K + multi-column GROUP BY (config 4)
+        cpu_t, cold_t, warm_t, rows = results["topk_multicol"]
+        emit(
+            "topk_multicol_groupby_rows_per_sec_tpu",
+            rows / warm_t,
+            cpu_t / warm_t,
+            {"cold_rows_per_sec": round(rows / cold_t, 1), "cold_vs_baseline": round(cpu_t / cold_t, 3)},
         )
     finally:
         shutil.rmtree(workdir, ignore_errors=True)

@@ -1,0 +1,106 @@
+"""API keys ("keystones"): long-lived programmatic credentials.
+
+Parity target (reference: src/apikeys.rs + handlers/http/apikeys.rs):
+- POST   /api/v1/apikeys          create {name, ttl_days?} -> plaintext key
+  (shown ONCE; only its hash persists in the metastore "keystones"
+  collection, like the reference);
+- GET    /api/v1/apikeys          list metadata (no secrets);
+- DELETE /api/v1/apikeys/{id}     revoke;
+- auth middleware accepts `X-P-API-Key: <key>` and resolves it to the
+  owning user's permissions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from datetime import UTC, datetime, timedelta
+
+from parseable_tpu.storage import rfc3339_now
+
+COLLECTION = "apikeys"  # persisted under .keystones (metastore registry)
+KEY_PREFIX = "psbl_"
+
+
+def _hash(key: str) -> str:
+    return hashlib.sha256(key.encode()).hexdigest()
+
+
+def create_key(metastore, username: str, name: str, ttl_days: int | None = None) -> dict:
+    """Mint a key for `username`. Returns the doc INCLUDING the plaintext
+    key — the only time it is ever visible."""
+    key = KEY_PREFIX + secrets.token_urlsafe(32)
+    key_id = secrets.token_hex(8)
+    expires = (
+        (datetime.now(UTC) + timedelta(days=ttl_days)).isoformat().replace("+00:00", "Z")
+        if ttl_days
+        else None
+    )
+    doc = {
+        "id": key_id,
+        "name": name,
+        "user": username,
+        "key_hash": _hash(key),
+        "created": rfc3339_now(),
+        "expires": expires,
+    }
+    metastore.put_document(COLLECTION, key_id, doc)
+    return {**doc, "key": key}
+
+
+def list_keys(metastore) -> list[dict]:
+    out = []
+    for doc in metastore.list_documents(COLLECTION):
+        out.append({k: v for k, v in doc.items() if k != "key_hash"})
+    return out
+
+
+def revoke_key(metastore, key_id: str) -> bool:
+    if metastore.get_document(COLLECTION, key_id) is None:
+        return False
+    metastore.delete_document(COLLECTION, key_id)
+    _RESOLVE_CACHE.clear()  # revocation must bite immediately on this node
+    return True
+
+
+_RESOLVE_CACHE: dict[str, tuple[float, str | None]] = {}
+_RESOLVE_TTL_SECS = 30.0
+
+
+def resolve_key_cached(metastore, key: str) -> str | None:
+    """resolve_key with a short TTL cache: listing the keystone collection
+    costs object-store round trips, far too much per request. Revocation
+    takes effect within the TTL."""
+    import time as _t
+
+    h = _hash(key)
+    hit = _RESOLVE_CACHE.get(h)
+    now = _t.monotonic()
+    if hit is not None and now - hit[0] < _RESOLVE_TTL_SECS:
+        return hit[1]
+    user = resolve_key(metastore, key)
+    _RESOLVE_CACHE[h] = (now, user)
+    if len(_RESOLVE_CACHE) > 10_000:  # bound pathological key spraying
+        _RESOLVE_CACHE.clear()
+    return user
+
+
+def resolve_key(metastore, key: str) -> str | None:
+    """Plaintext key -> owning username (None if unknown/expired)."""
+    if not key.startswith(KEY_PREFIX):
+        return None
+    h = _hash(key)
+    for doc in metastore.list_documents(COLLECTION):
+        if doc.get("key_hash") != h:
+            continue
+        exp = doc.get("expires")
+        if exp:
+            from parseable_tpu.utils.timeutil import parse_rfc3339
+
+            try:
+                if parse_rfc3339(exp) < datetime.now(UTC):
+                    return None
+            except ValueError:
+                return None
+        return doc.get("user")
+    return None

@@ -188,6 +188,11 @@ class Options:
     send_analytics: bool = field(default_factory=lambda: _env_bool("P_SEND_ANONYMOUS_USAGE_DATA", False))
     cpu_threshold_pct: float = field(default_factory=lambda: _env_float("P_CPU_THRESHOLD", 90.0))
     memory_threshold_pct: float = field(default_factory=lambda: _env_float("P_MEMORY_THRESHOLD", 90.0))
+    # --- OIDC (reference: src/oidc.rs P_OIDC_* options) ----------------------
+    oidc_issuer: str | None = field(default_factory=lambda: _env("P_OIDC_ISSUER"))
+    oidc_client_id: str | None = field(default_factory=lambda: _env("P_OIDC_CLIENT_ID"))
+    oidc_client_secret: str | None = field(default_factory=lambda: _env("P_OIDC_CLIENT_SECRET"))
+
     openai_api_key: str | None = field(default_factory=lambda: _env("P_OPENAI_API_KEY"))
     openai_base_url: str = field(
         default_factory=lambda: _env("P_OPENAI_BASE_URL", "https://api.openai.com/v1")

@@ -562,6 +562,11 @@ class PlanLayout:
 # executors in one query lifetime) reuse the compiled XLA executable.
 _PROGRAM_CACHE: dict[tuple, Callable] = {}
 
+# how many programs were built with a mesh (shard_map psum path) — the
+# stable signal tests/bench use to assert distributed execution happened
+# (cache-key positions are an implementation detail)
+MESH_PROGRAMS_BUILT = 0
+
 
 # ------------------------------------------------------------------- the mesh
 # The reference scales queries by fanning results across querier/ingestor
@@ -1237,6 +1242,9 @@ class TpuQueryExecutor(QueryExecutor):
         # round trip on tunneled PJRT backends (measured 424ms vs 10ms per
         # call); the G-sized accumulator copy is far cheaper
         prog = jax.jit(prog_body)
+        if mesh is not None:
+            global MESH_PROGRAMS_BUILT
+            MESH_PROGRAMS_BUILT += 1
         _PROGRAM_CACHE[key] = prog
         return prog
 

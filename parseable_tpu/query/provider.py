@@ -16,6 +16,7 @@ adapted to the merged stream schema so mixed-schema files union cleanly.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass
 from datetime import UTC, datetime, timedelta
 from pathlib import Path
@@ -40,6 +41,62 @@ class ScanStats:
     bytes_scanned: int = 0
     rows_scanned: int = 0
     staging_batches: int = 0
+
+
+def prefetch_iter(source, depth: int = 2):
+    """Run `source` on a background thread, keeping `depth` items ready.
+
+    Overlaps parquet read/decode with device compute (SURVEY hard-parts:
+    "keep host->device transfer off the critical path"). Exceptions
+    propagate to the consumer. When the consumer stops early (LIMIT,
+    timeout, generator close), the worker notices the closed flag on its
+    next bounded put and exits — no leaked thread or buffered tables.
+    """
+    import queue as _q
+
+    q: _q.Queue = _q.Queue(maxsize=max(1, depth))
+    _END = object()
+    closed = threading.Event()
+
+    def worker():
+        try:
+            for item in source:
+                while not closed.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except _q.Full:
+                        continue
+                if closed.is_set():
+                    return
+        except BaseException as e:  # propagate into the consumer
+            if not closed.is_set():
+                q.put((_END, e))
+            return
+        if not closed.is_set():
+            q.put((_END, None))
+
+    t = threading.Thread(target=worker, name="scan-prefetch", daemon=True)
+    t.start()
+
+    def gen():
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _END:
+                    if item[1] is not None:
+                        raise item[1]
+                    return
+                yield item
+        finally:
+            closed.set()
+            while not q.empty():  # drop buffered tables promptly
+                try:
+                    q.get_nowait()
+                except _q.Empty:
+                    break
+
+    return gen()
 
 
 class StreamScan:

@@ -414,13 +414,18 @@ class QuerySession:
 
         if self.engine == "tpu":
             from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+            from parseable_tpu.query.provider import prefetch_iter
 
             self._set_scan_time_hint(lp, scan)
             executor: QueryExecutor = TpuQueryExecutor(lp, self.p.options)
             executor.source_loader = scan.read_source
+            # overlap parquet read/decode with device compute; depth 3 keeps
+            # the tunnel transfer (the cold-path floor) continuously fed
+            tables = prefetch_iter(scan.tables(), depth=3)
         else:
             executor = QueryExecutor(lp)
-        table = executor.execute(scan.tables())
+            tables = scan.tables()
+        table = executor.execute(tables)
         return QueryResult(table, table.column_names)
 
     @staticmethod

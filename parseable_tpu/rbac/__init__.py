@@ -209,6 +209,24 @@ class RbacStore:
             self._cred_cache.pop(username, None)
             return pw
 
+    def put_oauth_user(self, username: str, roles: set[str] | None = None) -> None:
+        """Create/refresh an OIDC-authenticated user (reference: user.rs
+        OAuth users): no password hash; roles re-sync from the IdP's group
+        claim on every login."""
+        with self._lock:
+            existing = self.users.get(username)
+            if existing is not None and existing.user_type == "oauth":
+                existing.roles = set(roles or set())
+                return
+            if existing is not None:
+                raise ValueError(f"native user {username!r} already exists")
+            self.users[username] = User(
+                username=username,
+                password_hash=None,
+                roles=set(roles or set()),
+                user_type="oauth",
+            )
+
     def delete_user(self, username: str) -> None:
         with self._lock:
             self.users.pop(username, None)

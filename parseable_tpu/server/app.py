@@ -169,7 +169,11 @@ _INGEST_PATHS = ("/api/v1/ingest", "/v1/")
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     state: ServerState = request.app["state"]
-    if request.path in ("/api/v1/liveness", "/api/v1/readiness") or request.method == "OPTIONS":
+    if (
+        request.path in ("/api/v1/liveness", "/api/v1/readiness")
+        or request.path.startswith("/api/v1/o/")  # OIDC login flow
+        or request.method == "OPTIONS"
+    ):
         return await handler(request)
     # shed ingest under resource pressure (reference: resource_check.rs:120)
     if state.resources.overloaded and request.method == "POST":
@@ -197,6 +201,16 @@ async def auth_middleware(request: web.Request, handler):
         username = state.rbac.session_user(auth[7:])
         if username is None:
             return _unauthorized("invalid or expired token")
+    elif "X-P-API-Key" in request.headers:
+        from parseable_tpu.apikeys import resolve_key_cached
+
+        # off the event loop: resolution lists the metastore collection
+        # (object-store I/O) on a miss; hits come from the TTL cache
+        username = await asyncio.get_running_loop().run_in_executor(
+            state.workers, resolve_key_cached, state.p.metastore, request.headers["X-P-API-Key"]
+        )
+        if username is None or username not in state.rbac.users:
+            return _unauthorized("invalid or expired API key")
     elif "session" in request.cookies:
         username = state.rbac.session_user(request.cookies["session"])
         if username is None:
@@ -1091,6 +1105,47 @@ async def alerts_sse(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+@require(Action.MANAGE_API_KEYS)
+async def create_api_key(request: web.Request) -> web.Response:
+    """POST /api/v1/apikeys (reference: handlers/http/apikeys.rs). The
+    plaintext key appears only in this response."""
+    from parseable_tpu.apikeys import create_key
+
+    state: ServerState = request.app["state"]
+    body = await request.json()
+    name = body.get("name")
+    if not name:
+        return web.json_response({"error": "key needs a name"}, status=400)
+    ttl = body.get("ttl_days")
+    if ttl is not None:
+        try:
+            ttl = int(ttl)
+        except (TypeError, ValueError):
+            return web.json_response({"error": "ttl_days must be an integer"}, status=400)
+        if ttl <= 0:
+            return web.json_response({"error": "ttl_days must be positive"}, status=400)
+    doc = create_key(state.p.metastore, request["username"], name, ttl)
+    return web.json_response(doc)
+
+
+@require(Action.MANAGE_API_KEYS)
+async def list_api_keys(request: web.Request) -> web.Response:
+    from parseable_tpu.apikeys import list_keys
+
+    state: ServerState = request.app["state"]
+    return web.json_response(list_keys(state.p.metastore))
+
+
+@require(Action.MANAGE_API_KEYS)
+async def delete_api_key(request: web.Request) -> web.Response:
+    from parseable_tpu.apikeys import revoke_key
+
+    state: ServerState = request.app["state"]
+    if not revoke_key(state.p.metastore, request.match_info["id"]):
+        return web.json_response({"error": "unknown key"}, status=404)
+    return web.json_response({"message": "revoked"})
+
+
 @require(Action.QUERY_LLM)
 async def llm_sql(request: web.Request) -> web.Response:
     """POST /api/v1/llm — natural language -> SQL via an OpenAI-compatible
@@ -1300,6 +1355,14 @@ def build_app(state: ServerState) -> web.Application:
         r.add_delete(base + "/{id}", delete_doc)
 
     r.add_post("/api/v1/llm", llm_sql)
+    r.add_post("/api/v1/apikeys", create_api_key)
+    r.add_get("/api/v1/apikeys", list_api_keys)
+    r.add_delete("/api/v1/apikeys/{id}", delete_api_key)
+    from parseable_tpu.server import extras as _extras
+    from parseable_tpu.server import oidc as _oidc
+
+    _extras.register(r)
+    _oidc.register(r)
     r.add_get("/api/v1/cluster/info", cluster_info)
     r.add_get("/api/v1/cluster/metrics", cluster_metrics)
     r.add_delete("/api/v1/cluster/{node_id}", remove_node_handler)

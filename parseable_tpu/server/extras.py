@@ -1,0 +1,276 @@
+"""Secondary API surfaces: demo data, log context, Prism BFF.
+
+Parity targets:
+- demo data (reference: handlers/http/demo_data.rs:34-139): POST
+  /api/v1/demodata ingests a packaged sample workload so a fresh install
+  has something to query (the reference shells out to
+  resources/ingest_demo_data.sh; here the generator is in-process);
+- log context (reference: handlers/http/query_context.rs): rows around an
+  anchor timestamp with before/after counts and cursor pagination — the
+  console's "show surrounding lines" feature;
+- Prism BFF (reference: src/prism/{home,logstream}): aggregated bundles
+  the UI renders as its home screen and per-dataset drilldown.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from datetime import UTC, datetime, timedelta
+
+from aiohttp import web
+
+from parseable_tpu.core import StreamNotFound
+from parseable_tpu.rbac import Action
+
+logger = logging.getLogger(__name__)
+
+DEMO_STREAM = "demodata"
+
+
+# ----------------------------------------------------------------- demo data
+
+
+def generate_demo_events(count: int = 1000, seed: int | None = None) -> list[dict]:
+    """Sample access-log events mirroring resources/ingest_demo_data.sh."""
+    rng = random.Random(seed)
+    methods = ["GET", "GET", "GET", "POST", "PUT", "DELETE"]
+    statuses = [200, 200, 200, 200, 201, 301, 400, 404, 500, 503]
+    paths = ["/", "/login", "/api/orders", "/api/users", "/health", "/metrics", "/checkout"]
+    agents = ["curl/8.0", "Mozilla/5.0", "python-requests/2.31", "Go-http-client/2.0"]
+    out = []
+    for _ in range(count):
+        out.append(
+            {
+                "host": f"192.168.{rng.randint(0, 4)}.{rng.randint(1, 250)}",
+                "method": rng.choice(methods),
+                "path": rng.choice(paths),
+                "status": rng.choice(statuses),
+                "bytes": rng.randint(100, 60_000),
+                "latency_ms": round(rng.random() * 800, 2),
+                "user_agent": rng.choice(agents),
+                "referrer": rng.choice(["-", "https://example.com", "https://google.com"]),
+            }
+        )
+    return out
+
+
+def _require(state, request, action: Action, resource: str | None = None):
+    if not state.rbac.authorize(request["username"], action, resource):
+        raise web.HTTPForbidden(reason="Forbidden")
+
+
+async def demo_data(request: web.Request) -> web.Response:
+    """POST /api/v1/demodata [?count=N] — ingest a sample workload."""
+    import asyncio
+
+    state = request.app["state"]
+    _require(state, request, Action.INGEST, DEMO_STREAM)
+    count = min(100_000, int(request.query.get("count", "1000")))
+
+    def work():
+        from parseable_tpu.event.json_format import JsonEvent
+
+        stream = state.p.create_stream_if_not_exists(DEMO_STREAM)
+        ev = JsonEvent(generate_demo_events(count), DEMO_STREAM).into_event(stream.metadata)
+        ev.process(stream, commit_schema=state.p.commit_schema)
+
+    await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    return web.json_response({"message": f"ingested {count} demo events", "stream": DEMO_STREAM})
+
+
+# --------------------------------------------------------------- log context
+
+
+async def query_context(request: web.Request) -> web.Response:
+    """POST /api/v1/queryContext — rows around an anchor instant
+    (reference: query_context.rs anchor count :874 + window rows :922,
+    cursor pagination :96-106).
+
+    Body: {stream, anchor (rfc3339 ms), rows_before, rows_after,
+           before_cursor?, after_cursor?}
+    The cursors are the outermost timestamps already served; passing them
+    back pages further out from the anchor.
+    """
+    import asyncio
+
+    state = request.app["state"]
+    body = await request.json()
+    stream = body.get("stream")
+    anchor = body.get("anchor")
+    if not stream or not anchor:
+        return web.json_response({"error": "need 'stream' and 'anchor'"}, status=400)
+    _require(state, request, Action.QUERY, stream)
+    n_before = min(1000, int(body.get("rows_before", 10)))
+    n_after = min(1000, int(body.get("rows_after", 10)))
+    before_cursor = body.get("before_cursor") or anchor
+    after_cursor = body.get("after_cursor") or anchor
+
+    def work():
+        from parseable_tpu.query.session import QuerySession
+        from parseable_tpu.utils.timeutil import parse_rfc3339
+
+        anchor_dt = parse_rfc3339(anchor)
+        lo = (anchor_dt - timedelta(hours=12)).isoformat().replace("+00:00", "Z")
+        hi = (anchor_dt + timedelta(hours=12)).isoformat().replace("+00:00", "Z")
+        sess = QuerySession(state.p)
+        before = sess.query(
+            f"SELECT * FROM {stream} WHERE p_timestamp <= '{before_cursor}' "
+            f"ORDER BY p_timestamp DESC LIMIT {n_before}",
+            lo,
+            hi,
+        ).to_json_rows()
+        after = sess.query(
+            f"SELECT * FROM {stream} WHERE p_timestamp > '{after_cursor}' "
+            f"ORDER BY p_timestamp LIMIT {n_after}",
+            lo,
+            hi,
+        ).to_json_rows()
+        before.reverse()  # chronological
+        return before, after
+
+    try:
+        before, after = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except Exception as e:
+        return web.json_response({"error": str(e)}, status=400)
+    resp = {
+        "anchor": anchor,
+        "before": before,
+        "after": after,
+        "before_cursor": before[0].get("p_timestamp") if before else None,
+        "after_cursor": after[-1].get("p_timestamp") if after else None,
+    }
+    return web.json_response(resp)
+
+
+# ------------------------------------------------------------------- prism
+
+
+async def prism_home(request: web.Request) -> web.Response:
+    """GET /api/v1/prism/home — the UI home bundle
+    (reference: prism/home/mod.rs:107-269): datasets with stats, plus an
+    alert-state summary."""
+    import asyncio
+
+    state = request.app["state"]
+    _require(state, request, Action.LIST_STREAM)
+    allowed = state.rbac.user_allowed_streams(request["username"])
+
+    def work():
+        datasets = []
+        for name in state.p.metastore.list_streams():
+            if allowed is not None and name not in allowed:
+                continue
+            events = storage = 0
+            telemetry = "logs"
+            for fmt in state.p.metastore.get_all_stream_jsons(name):
+                events += fmt.stats.events
+                storage += fmt.stats.storage
+                telemetry = fmt.telemetry_type
+            datasets.append(
+                {"title": name, "events": events, "storage_bytes": storage, "telemetry_type": telemetry}
+            )
+        alert_summary = {"triggered": 0, "resolved": 0, "total": 0}
+        alert_titles = []
+        for a in state.p.metastore.list_documents("alerts"):
+            alert_summary["total"] += 1
+            st = state.p.metastore.get_document("alert_state", a.get("id", "")) or {}
+            if st.get("state") == "triggered":
+                alert_summary["triggered"] += 1
+                alert_titles.append(a.get("title"))
+            elif st.get("state") == "resolved":
+                alert_summary["resolved"] += 1
+        return {
+            "datasets": sorted(datasets, key=lambda d: -d["events"]),
+            "alerts_summary": alert_summary,
+            "triggered_alerts": alert_titles,
+        }
+
+    out = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    return web.json_response(out)
+
+
+async def prism_home_search(request: web.Request) -> web.Response:
+    """GET /api/v1/prism/home/search?key=q — title search over datasets,
+    alerts, dashboards, filters (reference: home/mod.rs:270+)."""
+    import asyncio
+
+    state = request.app["state"]
+    _require(state, request, Action.LIST_STREAM)
+    key = request.query.get("key", "").lower()
+    allowed = state.rbac.user_allowed_streams(request["username"])
+
+    def work():
+        out = []
+        for name in state.p.metastore.list_streams():
+            if allowed is not None and name not in allowed:
+                continue
+            if key in name.lower():
+                out.append({"title": name, "resource": "stream"})
+        for coll, label in (("alerts", "alert"), ("dashboards", "dashboard"), ("filters", "filter")):
+            for doc in state.p.metastore.list_documents(coll):
+                title = str(doc.get("title") or doc.get("name") or "")
+                if key in title.lower():
+                    out.append({"title": title, "resource": label, "id": doc.get("id")})
+        return out
+
+    return web.json_response(await asyncio.get_running_loop().run_in_executor(state.workers, work))
+
+
+async def prism_logstream(request: web.Request) -> web.Response:
+    """GET /api/v1/prism/logstream/{name} — info + stats + retention +
+    schema in one bundle (reference: prism/logstream/mod.rs:54-250)."""
+    import asyncio
+
+    state = request.app["state"]
+    name = request.match_info["name"]
+    _require(state, request, Action.GET_STREAM_INFO, name)
+
+    def work():
+        try:
+            stream = state.p.get_stream(name)
+        except StreamNotFound:
+            return None
+        m = stream.metadata
+        events = ingestion = storage = 0
+        for fmt in state.p.metastore.get_all_stream_jsons(name):
+            events += fmt.stats.events
+            ingestion += fmt.stats.ingestion
+            storage += fmt.stats.storage
+        return {
+            "info": {
+                "created-at": m.created_at,
+                "first-event-at": m.first_event_at,
+                "stream_type": m.stream_type,
+                "telemetry_type": m.telemetry_type,
+                "time_partition": m.time_partition,
+                "custom_partition": m.custom_partition,
+                "static_schema_flag": m.static_schema_flag,
+            },
+            "schema": [
+                {"name": f.name, "data_type": str(f.type)} for f in m.schema.values()
+            ],
+            "stats": {
+                "events": events,
+                "ingestion_bytes": ingestion,
+                "storage_bytes": storage,
+            },
+            "retention": m.retention or [],
+            "hot_tier": {
+                "enabled": getattr(state.p, "hot_tier", None) is not None
+                and state.p.hot_tier.get_budget(name) is not None,
+            },
+        }
+
+    out = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    if out is None:
+        return web.json_response({"error": f"stream {name} not found"}, status=404)
+    return web.json_response(out)
+
+
+def register(router) -> None:
+    router.add_post("/api/v1/demodata", demo_data)
+    router.add_post("/api/v1/queryContext", query_context)
+    router.add_get("/api/v1/prism/home", prism_home)
+    router.add_get("/api/v1/prism/home/search", prism_home_search)
+    router.add_get("/api/v1/prism/logstream/{name}", prism_logstream)

@@ -1,0 +1,166 @@
+"""OIDC login flow (reference: src/oauth/, src/oidc.rs,
+handlers/http/oidc.rs:76-496).
+
+Authorization-code flow against any OIDC provider:
+
+- GET /api/v1/o/login?redirect=...  -> 302 to the IdP's authorize endpoint
+  (discovered from {issuer}/.well-known/openid-configuration, cached),
+  with a random anti-CSRF `state` remembered for 10 minutes;
+- GET /api/v1/o/code?code=&state=   -> exchanges the code at the token
+  endpoint, then validates the access token by calling the IdP's
+  *userinfo* endpoint (server-to-server, so no local JWT signature
+  verification is needed — the IdP is the validator);
+- the userinfo claims map onto an `oauth`-type user: username from
+  preferred_username/email/sub, roles from the `groups` claim filtered to
+  role names that exist locally (reference: group -> role sync);
+- a session cookie is set and the browser is redirected back.
+
+Enabled only when P_OIDC_ISSUER / P_OIDC_CLIENT_ID / P_OIDC_CLIENT_SECRET
+are configured.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+import time
+import urllib.parse
+import urllib.request
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+STATE_TTL_SECS = 600
+
+_discovery_cache: dict[str, dict] = {}
+_pending_states: dict[str, tuple[float, str]] = {}  # state -> (expiry, redirect)
+
+
+def enabled(options) -> bool:
+    return bool(options.oidc_issuer and options.oidc_client_id and options.oidc_client_secret)
+
+
+def discover(issuer: str) -> dict:
+    doc = _discovery_cache.get(issuer)
+    if doc is None:
+        url = issuer.rstrip("/") + "/.well-known/openid-configuration"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        _discovery_cache[issuer] = doc
+    return doc
+
+
+def _prune_states(now: float) -> None:
+    for s, (exp, _) in list(_pending_states.items()):
+        if exp < now:
+            _pending_states.pop(s, None)
+
+
+async def oidc_login(request: web.Request) -> web.Response:
+    """GET /api/v1/o/login — kick off the code flow."""
+    state_obj = request.app["state"]
+    opts = state_obj.p.options
+    if not enabled(opts):
+        return web.json_response({"error": "OIDC is not configured"}, status=400)
+    import asyncio
+
+    doc = await asyncio.get_running_loop().run_in_executor(
+        state_obj.workers, discover, opts.oidc_issuer
+    )
+    now = time.monotonic()
+    _prune_states(now)
+    state = secrets.token_urlsafe(24)
+    # only same-origin relative paths: replaying an absolute URL after
+    # authentication would make this an open redirect (phishing vector)
+    redirect = request.query.get("redirect", "/")
+    if not redirect.startswith("/") or redirect.startswith("//"):
+        redirect = "/"
+    _pending_states[state] = (now + STATE_TTL_SECS, redirect)
+    callback = str(request.url.with_path("/api/v1/o/code").with_query({}))
+    q = urllib.parse.urlencode(
+        {
+            "response_type": "code",
+            "client_id": opts.oidc_client_id,
+            "redirect_uri": callback,
+            "scope": "openid profile email groups",
+            "state": state,
+        }
+    )
+    raise web.HTTPFound(f"{doc['authorization_endpoint']}?{q}")
+
+
+async def oidc_callback(request: web.Request) -> web.Response:
+    """GET /api/v1/o/code — exchange + validate + establish a session."""
+    state_obj = request.app["state"]
+    opts = state_obj.p.options
+    if not enabled(opts):
+        return web.json_response({"error": "OIDC is not configured"}, status=400)
+    code = request.query.get("code")
+    state = request.query.get("state")
+    if not code or not state:
+        return web.json_response({"error": "missing code/state"}, status=400)
+    pending = _pending_states.pop(state, None)
+    if pending is None or pending[0] < time.monotonic():
+        return web.json_response({"error": "unknown or expired state"}, status=400)
+    redirect_to = pending[1]
+
+    import asyncio
+
+    def work():
+        doc = discover(opts.oidc_issuer)
+        callback = str(request.url.with_path("/api/v1/o/code").with_query({}))
+        body = urllib.parse.urlencode(
+            {
+                "grant_type": "authorization_code",
+                "code": code,
+                "redirect_uri": callback,
+                "client_id": opts.oidc_client_id,
+                "client_secret": opts.oidc_client_secret,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            doc["token_endpoint"],
+            data=body,
+            headers={"Content-Type": "application/x-www-form-urlencoded"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            tokens = json.loads(resp.read())
+        access = tokens.get("access_token")
+        if not access:
+            raise ValueError("token endpoint returned no access_token")
+        ureq = urllib.request.Request(
+            doc["userinfo_endpoint"], headers={"Authorization": f"Bearer {access}"}
+        )
+        with urllib.request.urlopen(ureq, timeout=15) as resp:
+            return json.loads(resp.read())
+
+    try:
+        claims = await asyncio.get_running_loop().run_in_executor(state_obj.workers, work)
+    except Exception as e:
+        logger.warning("oidc exchange failed: %s", e)
+        return web.json_response({"error": f"OIDC exchange failed: {e}"}, status=502)
+
+    username = claims.get("preferred_username") or claims.get("email") or claims.get("sub")
+    if not username:
+        return web.json_response({"error": "userinfo has no usable identity"}, status=502)
+    groups = claims.get("groups") or []
+    # group -> role: only groups that name existing roles grant anything
+    roles = {g for g in groups if g in state_obj.rbac.roles}
+    try:
+        state_obj.rbac.put_oauth_user(username, roles)
+    except ValueError as e:
+        # IdP identity collides with an existing native user
+        return web.json_response({"error": str(e)}, status=409)
+    state_obj.save_rbac()
+    token = state_obj.rbac.new_session(username)
+    resp = web.HTTPFound(redirect_to)
+    resp.set_cookie("session", token, httponly=True, max_age=7 * 24 * 3600)
+    return resp
+
+
+def register(router) -> None:
+    router.add_get("/api/v1/o/login", oidc_login)
+    router.add_get("/api/v1/o/code", oidc_callback)

@@ -1,0 +1,246 @@
+"""API keys, demo data, log context, Prism BFF (reference: apikeys.rs,
+demo_data.rs, query_context.rs, src/prism/)."""
+
+import asyncio
+import base64
+from datetime import UTC, datetime, timedelta
+
+from tests.test_server import AUTH, make_state, run, with_client
+
+
+def test_api_keys_lifecycle(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        # create
+        r = await client.post("/api/v1/apikeys", json={"name": "ci"}, headers=AUTH)
+        assert r.status == 200, await r.text()
+        doc = await r.json()
+        key = doc["key"]
+        assert key.startswith("psbl_")
+
+        # list never exposes secrets
+        r = await client.get("/api/v1/apikeys", headers=AUTH)
+        listed = await r.json()
+        assert listed[0]["name"] == "ci"
+        assert "key" not in listed[0] and "key_hash" not in listed[0]
+
+        # the key authenticates as its owner
+        r = await client.get("/api/v1/logstream", headers={"X-P-API-Key": key})
+        assert r.status == 200
+        r = await client.get("/api/v1/logstream", headers={"X-P-API-Key": "psbl_bogus"})
+        assert r.status == 401
+
+        # revoke -> key stops working
+        r = await client.delete(f"/api/v1/apikeys/{doc['id']}", headers=AUTH)
+        assert r.status == 200
+        r = await client.get("/api/v1/logstream", headers={"X-P-API-Key": key})
+        assert r.status == 401
+
+    run(with_client(state, fn))
+
+
+def test_api_key_expiry(tmp_path):
+    from parseable_tpu.apikeys import create_key, resolve_key
+
+    state = make_state(tmp_path)
+    doc = create_key(state.p.metastore, "admin", "old", ttl_days=1)
+    # force expiry into the past
+    stored = state.p.metastore.get_document("apikeys", doc["id"])
+    stored["expires"] = (
+        (datetime.now(UTC) - timedelta(days=1)).isoformat().replace("+00:00", "Z")
+    )
+    state.p.metastore.put_document("apikeys", doc["id"], stored)
+    assert resolve_key(state.p.metastore, doc["key"]) is None
+
+
+def test_demo_data_and_prism(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.post("/api/v1/demodata?count=200", headers=AUTH)
+        assert r.status == 200, await r.text()
+
+        # prism home sees the demo stream
+        state.p.local_sync(shutdown=True)
+        state.p.sync_all_streams()
+        r = await client.get("/api/v1/prism/home", headers=AUTH)
+        assert r.status == 200
+        home = await r.json()
+        ds = {d["title"]: d for d in home["datasets"]}
+        assert ds["demodata"]["events"] == 200
+        assert "alerts_summary" in home
+
+        # search
+        r = await client.get("/api/v1/prism/home/search?key=demo", headers=AUTH)
+        results = await r.json()
+        assert any(x["title"] == "demodata" for x in results)
+
+        # per-stream bundle
+        r = await client.get("/api/v1/prism/logstream/demodata", headers=AUTH)
+        bundle = await r.json()
+        assert bundle["stats"]["events"] == 200
+        assert any(f["name"] == "status" for f in bundle["schema"])
+        assert bundle["info"]["stream_type"] == "UserDefined"
+
+    run(with_client(state, fn))
+
+
+def test_query_context(tmp_path):
+    import pyarrow as pa
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.event import Event
+
+    state = make_state(tmp_path)
+    stream = state.p.create_stream_if_not_exists("ctx")
+    base = datetime.now(UTC) - timedelta(minutes=30)
+    ts = [base + timedelta(seconds=i) for i in range(100)]
+    batch = pa.RecordBatch.from_pydict(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(
+                [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
+            ),
+            "n": pa.array([float(i) for i in range(100)]),
+        }
+    )
+    Event("ctx", batch, parsed_timestamp=base, is_first_event=True).process(
+        stream, commit_schema=state.p.commit_schema
+    )
+    # backdated rows sit outside the staging window (reference semantics:
+    # stream_schema_provider.rs:849-871) — convert+upload so the scan
+    # reads them from parquet like any historical query
+    state.p.local_sync(shutdown=True)
+    state.p.sync_all_streams()
+
+    anchor = (base + timedelta(seconds=50)).isoformat().replace("+00:00", "Z")
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/queryContext",
+            json={"stream": "ctx", "anchor": anchor, "rows_before": 5, "rows_after": 5},
+            headers=AUTH,
+        )
+        assert r.status == 200, await r.text()
+        ctx = await r.json()
+        before_ns = [row["n"] for row in ctx["before"]]
+        after_ns = [row["n"] for row in ctx["after"]]
+        assert before_ns == [46.0, 47.0, 48.0, 49.0, 50.0]
+        assert after_ns == [51.0, 52.0, 53.0, 54.0, 55.0]
+
+        # page outward with the cursors
+        r = await client.post(
+            "/api/v1/queryContext",
+            json={
+                "stream": "ctx",
+                "anchor": anchor,
+                "rows_before": 5,
+                "rows_after": 5,
+                "after_cursor": ctx["after_cursor"],
+                "before_cursor": ctx["before_cursor"],
+            },
+            headers=AUTH,
+        )
+        ctx2 = await r.json()
+        assert [row["n"] for row in ctx2["after"]] == [56.0, 57.0, 58.0, 59.0, 60.0]
+
+    run(with_client(state, fn))
+
+
+def test_oidc_flow(tmp_path):
+    """Full authorization-code flow against a mock IdP (reference:
+    handlers/http/oidc.rs:76-496)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    class IdP(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            if u.path == "/.well-known/openid-configuration":
+                base = f"http://127.0.0.1:{self.server.server_port}"
+                body = _json.dumps(
+                    {
+                        "authorization_endpoint": f"{base}/authorize",
+                        "token_endpoint": f"{base}/token",
+                        "userinfo_endpoint": f"{base}/userinfo",
+                    }
+                ).encode()
+            elif u.path == "/userinfo":
+                assert self.headers["Authorization"] == "Bearer at-123"
+                body = _json.dumps(
+                    {"sub": "u1", "preferred_username": "dana", "groups": ["analysts", "nope"]}
+                ).encode()
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            q = parse_qs(self.rfile.read(n).decode())
+            assert q["grant_type"] == ["authorization_code"]
+            assert q["code"] == ["code-xyz"]
+            body = _json.dumps({"access_token": "at-123", "token_type": "Bearer"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), IdP)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    state = make_state(tmp_path)
+    state.p.options.oidc_issuer = f"http://127.0.0.1:{srv.server_port}"
+    state.p.options.oidc_client_id = "cid"
+    state.p.options.oidc_client_secret = "cs"
+    # the IdP group that maps to a local role
+    from parseable_tpu.rbac import role_privileges
+
+    state.rbac.put_role("analysts", role_privileges("reader"))
+
+    async def fn(client):
+        # login: redirected to the IdP authorize endpoint with a state param
+        r = await client.get("/api/v1/o/login", allow_redirects=False)
+        assert r.status == 302, await r.text()
+        loc = r.headers["Location"]
+        assert loc.startswith(f"http://127.0.0.1:{srv.server_port}/authorize")
+        from urllib.parse import parse_qs as pq, urlparse as up
+
+        st = pq(up(loc).query)["state"][0]
+
+        # callback with the code -> session cookie + oauth user with
+        # group-mapped roles
+        r = await client.get(
+            f"/api/v1/o/code?code=code-xyz&state={st}", allow_redirects=False
+        )
+        assert r.status == 302, await r.text()
+        cookie = r.cookies.get("session")
+        assert cookie is not None
+        assert state.rbac.users["dana"].user_type == "oauth"
+        assert state.rbac.users["dana"].roles == {"analysts"}  # 'nope' dropped
+
+        # the session works for API calls
+        r = await client.get(
+            "/api/v1/logstream", headers={"Authorization": f"Bearer {cookie.value}"}
+        )
+        assert r.status == 200
+
+        # replaying the state fails (anti-CSRF)
+        r = await client.get(
+            f"/api/v1/o/code?code=code-xyz&state={st}", allow_redirects=False
+        )
+        assert r.status == 400
+
+    try:
+        run(with_client(state, fn))
+    finally:
+        srv.shutdown()

@@ -83,12 +83,13 @@ def test_mesh_program_actually_compiles():
     t = make_table(seed=3)
     sql = "SELECT host, count(*) c FROM t WHERE bytes >= 250 GROUP BY host"
     before = {k for k in ET._PROGRAM_CACHE}
+    before_mesh = ET.MESH_PROGRAMS_BUILT
     lp = build_plan(parse_sql(sql))
     ex = ET.TpuQueryExecutor(lp)
     ex.execute(iter([t]))
     new_keys = [k for k in ET._PROGRAM_CACHE if k not in before]
     assert new_keys, "no device program compiled — everything fell back to CPU"
-    assert any(k[-2] is not None for k in new_keys), "program compiled without the mesh"
+    assert ET.MESH_PROGRAMS_BUILT > before_mesh, "program compiled without the mesh"
 
 
 def test_mesh_multi_block_accumulation():
