@@ -279,6 +279,7 @@ class ObjectStoreMetastore(Metastore):
         "llmconfigs": ".llmconfigs",
         "hottier": SETTINGS_ROOT_DIRECTORY,
         "chats": ".chats",
+        "tenants": ".tenants",
     }
 
     def _collection_prefix(self, collection: str) -> str:

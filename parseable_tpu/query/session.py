@@ -130,8 +130,11 @@ class QuerySession:
         RBAC scope, enforced on the *resolved* plan before any execution so
         unauthorized streams neither run nor leak through error messages."""
         t0 = _time.monotonic()
-        select = S.parse_sql(sql_text)
-        return self._query_ast(select, start_time, end_time, allowed_streams, t0)
+        from parseable_tpu.utils.telemetry import TRACER
+
+        with TRACER.span("query", engine=self.engine):
+            select = S.parse_sql(sql_text)
+            return self._query_ast(select, start_time, end_time, allowed_streams, t0)
 
     def _query_ast(
         self,

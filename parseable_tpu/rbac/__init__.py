@@ -79,6 +79,7 @@ class Action(Enum):
     LIVE_TAIL = auto()
     QUERY_LLM = auto()
     MANAGE_API_KEYS = auto()
+    MANAGE_TENANTS = auto()
     ALL = auto()
 
 

@@ -75,6 +75,9 @@ class ServerState:
         self.resources = ResourceMonitor(
             p.options.cpu_threshold_pct, p.options.memory_threshold_pct
         )
+        from parseable_tpu.tenants import TenantRegistry
+
+        self.tenants = TenantRegistry(p.metastore)
 
     def hot_tier(self):
         """Lazily-built hot tier manager, restored from persisted budgets."""
@@ -361,6 +364,19 @@ async def _do_ingest(
         payload = json.loads(body)
     except json.JSONDecodeError as e:
         return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+
+    # tenant suspension/quota (reference: tenants/mod.rs:31-160; header
+    # extraction utils/mod.rs:123) — the lookup hits the metastore, so it
+    # runs on the worker pool, never the event loop
+    tenant = request.headers.get("X-P-Tenant")
+    if tenant:
+        approx_rows = len(payload) if isinstance(payload, list) else 1
+        rejection = await asyncio.get_running_loop().run_in_executor(
+            state.workers, state.tenants.check_ingest, tenant, approx_rows
+        )
+        if rejection is not None:
+            status, reason = rejection
+            return web.json_response({"error": reason}, status=status)
     custom_fields = _custom_fields(request)
 
     log_source_name = request.headers.get(LOG_SOURCE_HEADER, "json")
@@ -1211,6 +1227,33 @@ async def llm_sql(request: web.Request) -> web.Response:
     return web.json_response({"sql": sql})
 
 
+@require(Action.MANAGE_TENANTS)
+async def put_tenant(request: web.Request) -> web.Response:
+    """PUT /api/v1/tenants/{id} — suspension flag + daily event quota
+    (reference: tenants/mod.rs:31-160)."""
+    state: ServerState = request.app["state"]
+    body = await request.json() if request.can_read_body else {}
+    try:
+        doc = state.tenants.put(request.match_info["id"], body or {})
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(doc)
+
+
+@require(Action.MANAGE_TENANTS)
+async def list_tenants(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.json_response(state.tenants.list())
+
+
+@require(Action.MANAGE_TENANTS)
+async def delete_tenant(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    if not state.tenants.delete(request.match_info["id"]):
+        return web.json_response({"error": "unknown tenant"}, status=404)
+    return web.json_response({"message": "deleted"})
+
+
 @require(Action.LIST_CLUSTER)
 async def cluster_info(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
@@ -1355,6 +1398,9 @@ def build_app(state: ServerState) -> web.Application:
         r.add_delete(base + "/{id}", delete_doc)
 
     r.add_post("/api/v1/llm", llm_sql)
+    r.add_put("/api/v1/tenants/{id}", put_tenant)
+    r.add_get("/api/v1/tenants", list_tenants)
+    r.add_delete("/api/v1/tenants/{id}", delete_tenant)
     r.add_post("/api/v1/apikeys", create_api_key)
     r.add_get("/api/v1/apikeys", list_api_keys)
     r.add_delete("/api/v1/apikeys/{id}", delete_api_key)

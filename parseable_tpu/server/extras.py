@@ -66,7 +66,13 @@ async def demo_data(request: web.Request) -> web.Response:
 
     state = request.app["state"]
     _require(state, request, Action.INGEST, DEMO_STREAM)
-    count = min(100_000, int(request.query.get("count", "1000")))
+    try:
+        count = int(request.query.get("count", "1000"))
+    except ValueError:
+        return web.json_response({"error": "count must be an integer"}, status=400)
+    if count <= 0:
+        return web.json_response({"error": "count must be positive"}, status=400)
+    count = min(100_000, count)
 
     def work():
         from parseable_tpu.event.json_format import JsonEvent
@@ -100,17 +106,39 @@ async def query_context(request: web.Request) -> web.Response:
     anchor = body.get("anchor")
     if not stream or not anchor:
         return web.json_response({"error": "need 'stream' and 'anchor'"}, status=400)
+    from parseable_tpu.core import StreamError, validate_stream_name
+
+    try:
+        validate_stream_name(str(stream), internal_ok=True)
+    except StreamError as e:
+        return web.json_response({"error": str(e)}, status=400)
     _require(state, request, Action.QUERY, stream)
-    n_before = min(1000, int(body.get("rows_before", 10)))
-    n_after = min(1000, int(body.get("rows_after", 10)))
-    before_cursor = body.get("before_cursor") or anchor
-    after_cursor = body.get("after_cursor") or anchor
+    try:
+        n_before = min(1000, int(body.get("rows_before", 10)))
+        n_after = min(1000, int(body.get("rows_after", 10)))
+    except (TypeError, ValueError):
+        return web.json_response({"error": "rows_before/rows_after must be integers"}, status=400)
+
+    from parseable_tpu.utils.timeutil import TimeParseError, parse_rfc3339
+
+    def _ts(value, name):
+        """Cursors/anchor are attacker-controlled and get spliced into SQL:
+        parse as timestamps and re-serialize, never pass through raw."""
+        try:
+            dt = parse_rfc3339(str(value))
+        except (TimeParseError, ValueError) as e:
+            raise web.HTTPBadRequest(reason=f"{name} must be an RFC3339 timestamp: {e}")
+        return dt.isoformat().replace("+00:00", "Z")
+
+    anchor_iso = _ts(anchor, "anchor")
+    before_cursor = _ts(body.get("before_cursor") or anchor, "before_cursor")
+    after_cursor = _ts(body.get("after_cursor") or anchor, "after_cursor")
+    allowed = state.rbac.user_allowed_streams(request["username"])
 
     def work():
         from parseable_tpu.query.session import QuerySession
-        from parseable_tpu.utils.timeutil import parse_rfc3339
 
-        anchor_dt = parse_rfc3339(anchor)
+        anchor_dt = parse_rfc3339(anchor_iso)
         lo = (anchor_dt - timedelta(hours=12)).isoformat().replace("+00:00", "Z")
         hi = (anchor_dt + timedelta(hours=12)).isoformat().replace("+00:00", "Z")
         sess = QuerySession(state.p)
@@ -119,12 +147,14 @@ async def query_context(request: web.Request) -> web.Response:
             f"ORDER BY p_timestamp DESC LIMIT {n_before}",
             lo,
             hi,
+            allowed_streams=allowed,
         ).to_json_rows()
         after = sess.query(
             f"SELECT * FROM {stream} WHERE p_timestamp > '{after_cursor}' "
             f"ORDER BY p_timestamp LIMIT {n_after}",
             lo,
             hi,
+            allowed_streams=allowed,
         ).to_json_rows()
         before.reverse()  # chronological
         return before, after
@@ -134,7 +164,7 @@ async def query_context(request: web.Request) -> web.Response:
     except Exception as e:
         return web.json_response({"error": str(e)}, status=400)
     resp = {
-        "anchor": anchor,
+        "anchor": anchor_iso,
         "before": before,
         "after": after,
         "before_cursor": before[0].get("p_timestamp") if before else None,

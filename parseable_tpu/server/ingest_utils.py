@@ -108,6 +108,23 @@ def flatten_and_push_logs(
     known format (event/known_schema.py) get regex field extraction applied
     to each record's raw line (reference: KNOWN_SCHEMA_LIST
     extract_from_inline_log, ingest.rs:114-122)."""
+    from parseable_tpu.utils.telemetry import TRACER
+
+    with TRACER.span("ingest", stream=stream_name, source=log_source.value):
+        return _flatten_and_push(
+            p, stream_name, payload, log_source, custom_fields, origin_size, log_source_name
+        )
+
+
+def _flatten_and_push(
+    p: Parseable,
+    stream_name: str,
+    payload: Any,
+    log_source: LogSource,
+    custom_fields: dict[str, str] | None = None,
+    origin_size: int = 0,
+    log_source_name: str | None = None,
+) -> int:
     stream = p.get_stream(stream_name)
     meta = stream.metadata
 

@@ -75,7 +75,12 @@ async def oidc_login(request: web.Request) -> web.Response:
     # only same-origin relative paths: replaying an absolute URL after
     # authentication would make this an open redirect (phishing vector)
     redirect = request.query.get("redirect", "/")
-    if not redirect.startswith("/") or redirect.startswith("//"):
+    # "\\" bypasses the "//" check (browsers normalize \ -> /): reject both
+    if (
+        "\\" in redirect
+        or not redirect.startswith("/")
+        or redirect.startswith("//")
+    ):
         redirect = "/"
     _pending_states[state] = (now + STATE_TTL_SECS, redirect)
     callback = str(request.url.with_path("/api/v1/o/code").with_query({}))

@@ -1,0 +1,104 @@
+"""Multi-tenancy: tenant metadata, suspension, ingest quotas.
+
+Parity target (reference: src/tenants/mod.rs:31-160 TENANT_METADATA +
+utils/mod.rs:123 x-p-tenant extraction): tenants are identified by the
+`X-P-Tenant` header; each has a metadata record (metastore "tenants"
+collection) carrying a suspension flag and an optional daily ingest-event
+quota. A suspended or over-quota tenant's ingest answers 429/403 while
+queries keep serving.
+
+Scope note (matching the reference's own partial tenancy): the stream
+registry is tenant-keyed (streams.py) and enforcement happens at the API
+boundary; per-tenant object-store path prefixes are not implemented in the
+reference's OSS tree either.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from datetime import UTC, datetime
+
+logger = logging.getLogger(__name__)
+
+COLLECTION = "tenants"
+TENANT_HEADER = "X-P-Tenant"
+
+
+class TenantRegistry:
+    """In-memory view of tenant metadata + per-day ingest counters."""
+
+    def __init__(self, metastore):
+        self.metastore = metastore
+        self._lock = threading.Lock()
+        # (tenant, date) -> events ingested today (process-local, like the
+        # reference's in-memory TENANT_METADATA map)
+        self._today_events: dict[tuple[str, str], int] = {}
+
+    # -- metadata -----------------------------------------------------------
+
+    def get(self, tenant_id: str) -> dict | None:
+        return self.metastore.get_document(COLLECTION, tenant_id)
+
+    def put(self, tenant_id: str, doc: dict) -> dict:
+        quota = doc.get("daily_event_quota")
+        if quota is not None:
+            try:
+                quota = int(quota)
+            except (TypeError, ValueError):
+                raise ValueError("daily_event_quota must be an integer") from None
+            if quota <= 0:
+                raise ValueError("daily_event_quota must be positive")
+        doc = {
+            "id": tenant_id,
+            "suspended": bool(doc.get("suspended", False)),
+            "daily_event_quota": quota,
+            "description": doc.get("description", ""),
+        }
+        self.metastore.put_document(COLLECTION, tenant_id, doc)
+        return doc
+
+    def delete(self, tenant_id: str) -> bool:
+        if self.get(tenant_id) is None:
+            return False
+        self.metastore.delete_document(COLLECTION, tenant_id)
+        return True
+
+    def list(self) -> list[dict]:
+        return self.metastore.list_documents(COLLECTION)
+
+    # -- enforcement --------------------------------------------------------
+
+    def check_ingest(self, tenant_id: str | None, rows: int) -> tuple[int, str] | None:
+        """None = allowed; else (http_status, reason). Unregistered tenants
+        are allowed (registration is opt-in control, as in the reference)."""
+        if not tenant_id:
+            return None
+        doc = self.get(tenant_id)
+        if doc is None:
+            return None
+        if doc.get("suspended"):
+            return 403, f"tenant {tenant_id!r} is suspended"
+        quota = doc.get("daily_event_quota")
+        try:
+            quota = int(quota) if quota else None
+        except (TypeError, ValueError):
+            logger.warning("tenant %s has a malformed quota %r; ignoring", tenant_id, quota)
+            quota = None
+        if quota:
+            today = datetime.now(UTC).date().isoformat()
+            with self._lock:
+                key = (tenant_id, today)
+                used = self._today_events.get(key, 0)
+                if used + rows > quota:
+                    return 429, (
+                        f"tenant {tenant_id!r} exceeded its daily event quota "
+                        f"({used}/{quota})"
+                    )
+                self._today_events[key] = used + rows
+                # drop stale days
+                if len(self._today_events) > 10_000:
+                    self._today_events = {
+                        k: v for k, v in self._today_events.items() if k[1] == today
+                    }
+        return None

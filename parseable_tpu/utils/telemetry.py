@@ -1,0 +1,123 @@
+"""Self-telemetry: the server's own spans exported over OTLP/HTTP.
+
+Parity target (reference: src/telemetry.rs:55-149 init_tracing -> OTLP
+exporter): when P_OTLP_ENDPOINT is set, spans recorded around the hot
+paths (ingest, query, sync) batch in memory and POST to
+{endpoint}/v1/traces as OTLP JSON. Without an endpoint the tracer is a
+zero-cost no-op. No external SDK — the OTLP/HTTP JSON shape is small and
+this process's needs are a handful of span kinds.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+
+logger = logging.getLogger(__name__)
+
+MAX_BUFFER = 2048
+EXPORT_BATCH = 256
+
+
+class Tracer:
+    def __init__(self, endpoint: str | None = None, service_name: str = "parseable-tpu"):
+        self.endpoint = endpoint or os.environ.get("P_OTLP_ENDPOINT") or None
+        self.service_name = service_name
+        self._spans: list[dict] = []
+        self._lock = threading.Lock()
+        self._flush_inflight = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.endpoint is not None
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Record one span; no-op (zero allocation) when disabled."""
+        if not self.enabled:
+            yield
+            return
+        start_ns = time.time_ns()
+        err = None
+        try:
+            yield
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            end_ns = time.time_ns()
+            span = {
+                # one trace per top-level operation — a process-wide id
+                # would collapse everything into a single unbounded trace
+                "traceId": f"{random.getrandbits(128):032x}",
+                "spanId": f"{random.getrandbits(64):016x}",
+                "name": name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(start_ns),
+                "endTimeUnixNano": str(end_ns),
+                "attributes": [
+                    {"key": k, "value": {"stringValue": str(v)}} for k, v in attrs.items()
+                ],
+                "status": {"code": 2 if err else 1},
+            }
+            with self._lock:
+                self._spans.append(span)
+                if len(self._spans) > MAX_BUFFER:
+                    del self._spans[: len(self._spans) - MAX_BUFFER]
+                should_flush = len(self._spans) >= EXPORT_BATCH
+            if should_flush and not self._flush_inflight.locked():
+                # export off the request path: a slow collector must never
+                # add latency to the ingest/query that tipped the batch
+                threading.Thread(target=self.flush, name="otlp-export", daemon=True).start()
+
+    def flush(self) -> bool:
+        """Export buffered spans (OTLP/HTTP JSON); failures drop the batch.
+        Serialized so concurrent exports don't interleave."""
+        if not self.enabled:
+            return False
+        with self._flush_inflight:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> bool:
+        with self._lock:
+            batch, self._spans = self._spans, []
+        if not batch:
+            return True
+        payload = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {"scope": {"name": "parseable_tpu"}, "spans": batch}
+                    ],
+                }
+            ]
+        }
+        try:
+            req = urllib.request.Request(
+                self.endpoint.rstrip("/") + "/v1/traces",
+                data=json.dumps(payload).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status < 300
+        except Exception as e:
+            logger.debug("otlp export failed: %s", e)
+            return False
+
+
+TRACER = Tracer()

@@ -244,3 +244,132 @@ def test_oidc_flow(tmp_path):
         run(with_client(state, fn))
     finally:
         srv.shutdown()
+
+
+def test_telemetry_spans_export(tmp_path):
+    """OTLP self-telemetry (reference: telemetry.rs): spans batch and POST
+    to {endpoint}/v1/traces."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from parseable_tpu.utils.telemetry import Tracer
+
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            assert self.path == "/v1/traces"
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(_json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        tr = Tracer(endpoint=f"http://127.0.0.1:{srv.server_port}")
+        with tr.span("query", engine="tpu"):
+            pass
+        with tr.span("ingest", stream="s"):
+            pass
+        assert tr.flush()
+        spans = received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert {s["name"] for s in spans} == {"query", "ingest"}
+        assert int(spans[0]["endTimeUnixNano"]) >= int(spans[0]["startTimeUnixNano"])
+    finally:
+        srv.shutdown()
+
+    # disabled tracer is a no-op
+    off = Tracer(endpoint=None)
+    with off.span("x"):
+        pass
+    assert not off.flush()
+
+
+def test_tenants_suspension_and_quota(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        # register a tenant with a tiny quota
+        r = await client.put(
+            "/api/v1/tenants/acme", json={"daily_event_quota": 5}, headers=AUTH
+        )
+        assert r.status == 200, await r.text()
+        listed = await (await client.get("/api/v1/tenants", headers=AUTH)).json()
+        assert listed[0]["id"] == "acme"
+
+        h = {**AUTH, "X-P-Stream": "tweb", "X-P-Tenant": "acme"}
+        r = await client.post("/api/v1/ingest", json=[{"a": 1}] * 4, headers=h)
+        assert r.status == 200
+        # next batch blows the daily quota -> 429
+        r = await client.post("/api/v1/ingest", json=[{"a": 1}] * 4, headers=h)
+        assert r.status == 429
+
+        # unregistered tenants are unrestricted
+        h2 = {**AUTH, "X-P-Stream": "tweb", "X-P-Tenant": "other"}
+        r = await client.post("/api/v1/ingest", json=[{"a": 1}] * 50, headers=h2)
+        assert r.status == 200
+
+        # suspension -> 403
+        r = await client.put(
+            "/api/v1/tenants/acme", json={"suspended": True}, headers=AUTH
+        )
+        assert r.status == 200
+        r = await client.post("/api/v1/ingest", json=[{"a": 1}], headers=h)
+        assert r.status == 403
+
+        # delete clears enforcement
+        r = await client.delete("/api/v1/tenants/acme", headers=AUTH)
+        assert r.status == 200
+        r = await client.post("/api/v1/ingest", json=[{"a": 1}], headers=h)
+        assert r.status == 200
+
+    run(with_client(state, fn))
+
+
+def test_kafka_config_and_processor(tmp_path, monkeypatch):
+    """Kafka connector (reference: src/connectors/): config surface +
+    chunked sink processing work without a broker; the consumer itself is
+    gated on confluent-kafka."""
+    import pytest as _pytest
+
+    from parseable_tpu.connectors.kafka import (
+        ConnectorUnavailable,
+        KafkaConfig,
+        KafkaSource,
+        SinkProcessor,
+    )
+
+    monkeypatch.setenv("P_KAFKA_BOOTSTRAP_SERVERS", "broker:9092")
+    monkeypatch.setenv("P_KAFKA_TOPICS", "applogs,audit")
+    monkeypatch.setenv("P_KAFKA_SECURITY_PROTOCOL", "SASL_SSL")
+    monkeypatch.setenv("P_KAFKA_SASL_MECHANISM", "PLAIN")
+    cfg = KafkaConfig()
+    cfg.validate()
+    assert cfg.topics == ["applogs", "audit"]
+    conf = cfg.librdkafka_conf()
+    assert conf["bootstrap.servers"] == "broker:9092"
+    assert conf["sasl.mechanism"] == "PLAIN"
+
+    with _pytest.raises(ValueError):
+        KafkaConfig(bootstrap_servers="", topics=["t"]).validate()
+
+    # processor: chunk by count, malformed records survive as raw
+    state = make_state(tmp_path)
+    small = KafkaConfig(bootstrap_servers="b", topics=["applogs"], buffer_size=3)
+    proc = SinkProcessor(state.p, small)
+    proc.process_record("applogs", b'{"level": "info", "n": 1}')
+    proc.process_record("applogs", b"not-json{{")
+    assert state.p.streams.get("applogs") is None  # not yet flushed
+    proc.process_record("applogs", b'{"level": "error", "n": 2}')  # 3rd -> flush
+    batches = state.p.get_stream("applogs").staging_batches()
+    assert sum(b.num_rows for b in batches) == 3
+
+    # consumer requires the client library
+    with _pytest.raises(ConnectorUnavailable):
+        KafkaSource(state.p, small)
