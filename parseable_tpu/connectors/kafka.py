@@ -93,9 +93,10 @@ class SinkProcessor:
         self._chunk_started: dict[str, float] = {}
         self._lock = threading.Lock()
 
-    def process_record(self, topic: str, value: bytes | str) -> None:
+    def process_record(self, topic: str, value: bytes | str) -> bool:
         """Parse one record; malformed payloads wrap as {"raw": ...} rather
-        than poisoning the chunk."""
+        than poisoning the chunk. Returns True when the chunk flushed (the
+        caller may then commit offsets — at-least-once)."""
         if isinstance(value, bytes):
             value = value.decode("utf-8", errors="replace")
         try:
@@ -112,9 +113,11 @@ class SinkProcessor:
             full = len(chunk) >= self.config.buffer_size
         if full:
             self.flush(topic)
+            return True
+        return False
 
-    def tick(self) -> None:
-        """Age-based drain (chunks_timeout)."""
+    def tick(self) -> list[str]:
+        """Age-based drain (chunks_timeout). Returns flushed topics."""
         now = time.monotonic()
         with self._lock:
             due = [
@@ -124,6 +127,7 @@ class SinkProcessor:
             ]
         for topic in due:
             self.flush(topic)
+        return due
 
     def flush(self, topic: str) -> int:
         with self._lock:
@@ -165,23 +169,43 @@ class KafkaSource:
         self._stop = threading.Event()
 
     def run(self) -> None:
-        from confluent_kafka import Consumer
+        from confluent_kafka import Consumer, TopicPartition
 
         consumer = Consumer(self.config.librdkafka_conf())
         consumer.subscribe(self.config.topics)
+        # offsets commit ONLY after the owning chunk flushed into staging —
+        # committing on receipt would lose buffered records on crash
+        # (at-least-once, like the reference's processor)
+        pending: dict[tuple[str, int], int] = {}
+
+        def commit_topic(topic: str) -> None:
+            tps = [
+                TopicPartition(t, part, off + 1)
+                for (t, part), off in pending.items()
+                if t == topic
+            ]
+            if tps:
+                consumer.commit(offsets=tps, asynchronous=True)
+                for key in [k for k in pending if k[0] == topic]:
+                    pending.pop(key, None)
+
         try:
             while not self._stop.is_set():
                 msg = consumer.poll(1.0)
+                for topic in self.processor.tick():  # age drain EVERY loop
+                    commit_topic(topic)
                 if msg is None:
-                    self.processor.tick()
                     continue
                 if msg.error():
                     logger.warning("kafka error: %s", msg.error())
                     continue
-                self.processor.process_record(msg.topic(), msg.value())
-                consumer.commit(msg, asynchronous=True)
+                pending[(msg.topic(), msg.partition())] = msg.offset()
+                if self.processor.process_record(msg.topic(), msg.value()):
+                    commit_topic(msg.topic())
         finally:
             self.processor.flush_all()
+            for topic in {t for t, _ in pending}:
+                commit_topic(topic)
             consumer.close()
 
     def stop(self) -> None:

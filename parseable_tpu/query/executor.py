@@ -714,6 +714,13 @@ class QueryExecutor:
             for si in range(len(agg.specs)):
                 cols[f"__agg{si}"].append(agg.finalize_value(st, si))
         interim = pa.table(cols) if cols else pa.table({"__dummy": [None] * len(agg.groups)})
+        return self.finalize_from_interim(interim, rewritten)
+
+    def finalize_from_interim(self, interim: pa.Table, rewritten: list[S.SelectItem]) -> pa.Table:
+        """Post-aggregation: HAVING, projection over __g/__agg slots, ORDER
+        BY/LIMIT. Shared by the sparse (dict) fold and the TPU engine's
+        vectorized dense finalize."""
+        sel = self.plan.select
 
         # group exprs referenced post-agg resolve to the key columns.
         # Keyed by structural repr, not display name: `l.a` and `o.a` share

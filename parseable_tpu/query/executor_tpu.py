@@ -70,6 +70,8 @@ from parseable_tpu.utils.timeutil import parse_duration, parse_rfc3339
 logger = logging.getLogger(__name__)
 
 SOURCE_ID_META = b"ptpu_source_id"
+# pow2_block's ceiling: tables beyond this split before encoding
+MAX_BLOCK_ROWS = 1 << 22
 STUB_META = b"ptpu_hot_stub"
 
 
@@ -865,10 +867,23 @@ class TpuQueryExecutor(QueryExecutor):
         # are reusable across queries via the hot set.
         target_rows = max(1 << 16, self.options.device_block_rows)
 
+        max_block_rows = MAX_BLOCK_ROWS
+
         def blocks(src: Iterator[pa.Table]) -> Iterator[pa.Table]:
             buf: list[pa.Table] = []
             rows = 0
             for t in src:
+                if t.num_rows > max_block_rows:
+                    # split oversized tables (giant parquet/arrow inputs);
+                    # slices lose hot-set identity (a partial block must
+                    # not serve future full-block reads)
+                    if buf:
+                        yield _concat_tables(buf)
+                        buf, rows = [], 0
+                    bare = t.replace_schema_metadata(None)
+                    for off in range(0, t.num_rows, max_block_rows):
+                        yield bare.slice(off, max_block_rows)
+                    continue
                 if (t.schema.metadata or {}).get(SOURCE_ID_META) is not None:
                     yield t
                     continue
@@ -1036,10 +1051,88 @@ class TpuQueryExecutor(QueryExecutor):
                 agg.update(t, self._where_mask(t))
 
         dispatch_pending()
+        # vectorized dense finalize: when the run stayed fully on device
+        # (no CPU-fallback partials, no distinct sets), skip the per-group
+        # Python fold entirely — at G=32k the sparse path is ~80% of query
+        # time (VERDICT Weak#5)
+        if acc is not None and not agg.groups and not distinct_idx:
+            interim = self._dense_interim(
+                np.asarray(acc, np.float64), acc_groups, key_specs, specs,
+                n_all, n_sum, n_min, sum_idx, min_idx, max_idx, countcol_idx,
+            )
+            DEVICE_EXECUTE_TIME.labels("groupby").observe(_t.monotonic() - t_start)
+            if interim.num_rows == 0 and not sel.group_by:
+                return self.finalize_aggregate(agg, rewritten, group_names)
+            return self.finalize_from_interim(interim, rewritten)
         if acc is not None:
             flush(acc, acc_groups)
         DEVICE_EXECUTE_TIME.labels("groupby").observe(_t.monotonic() - t_start)
         return self.finalize_aggregate(agg, rewritten, group_names)
+
+    def _dense_interim(
+        self,
+        arr: np.ndarray,
+        num_groups: int,
+        key_specs: list[KeySpec],
+        specs: list[AggSpec],
+        n_all: int,
+        n_sum: int,
+        n_min: int,
+        sum_idx: list[int],
+        min_idx: list[int],
+        max_idx: list[int],
+        countcol_idx: list[int],
+    ) -> pa.Table:
+        """Dense device accumulator -> interim table (__g/__agg columns),
+        fully vectorized: key decode by divmod over capacities, aggregate
+        finalize by numpy masking. One readback, zero per-group Python."""
+        count = arr[0]
+        per_agg_count = arr[1 : 1 + n_all]
+        sums = arr[1 + n_all : 1 + n_all + n_sum]
+        mins = arr[1 + n_all + n_sum : 1 + n_all + n_sum + n_min]
+        maxs = arr[1 + n_all + n_sum + n_min :]
+        idxs = np.nonzero(count > 0)[0]
+
+        stacked_order = sum_idx + min_idx + max_idx + countcol_idx
+        cols: dict[str, pa.Array] = {}
+        rem = idxs.copy()
+        for i, ks in enumerate(key_specs):
+            codes = rem % ks.capacity
+            rem = rem // ks.capacity
+            if ks.kind == "dict":
+                gd = ks.gdict
+                values = np.empty(len(gd) + 1, dtype=object)
+                values[:-1] = gd.values
+                values[-1] = None  # null / overflow slot
+                cols[f"__g{i}"] = pa.array(values[np.minimum(codes, len(gd))].tolist())
+            else:
+                abs_ms = ((ks.origin_rel or 0) + codes) * ks.bin_ms
+                cols[f"__g{i}"] = pa.array(
+                    abs_ms.astype("datetime64[ms]"), pa.timestamp("ms")
+                )
+        for si, spec in enumerate(specs):
+            if spec.func == "count_star":
+                cols[f"__agg{si}"] = pa.array(count[idxs].astype(np.int64))
+                continue
+            pos = stacked_order.index(si)
+            pac = per_agg_count[pos][idxs]
+            seen = pac > 0
+            if spec.func == "count":
+                cols[f"__agg{si}"] = pa.array(pac.astype(np.int64))
+            elif spec.func in ("sum", "avg"):
+                v = sums[sum_idx.index(si)][idxs]
+                if spec.func == "avg":
+                    v = np.divide(v, pac, out=np.zeros_like(v), where=seen)
+                cols[f"__agg{si}"] = pa.array(v, mask=~seen)
+            elif spec.func == "min":
+                v = mins[min_idx.index(si)][idxs]
+                cols[f"__agg{si}"] = pa.array(v, mask=~seen)
+            elif spec.func == "max":
+                v = maxs[max_idx.index(si)][idxs]
+                cols[f"__agg{si}"] = pa.array(v, mask=~seen)
+        if not cols:
+            return pa.table({"__dummy": pa.array([None] * len(idxs))})
+        return pa.table(cols)
 
     # ------------------------------------------------------------- programs
 
@@ -1348,13 +1441,17 @@ class TpuQueryExecutor(QueryExecutor):
                 else:
                     sums_l.append(0.0)
                 if spec.func == "min" and si in n_min_order:
+                    # unseen = per-agg count 0 (the sentinel is f32 3.4e38,
+                    # not inf, so gate on the count instead of the value)
+                    seen = state.per_agg_count[stacked_order.index(si)][flat] > 0
                     v = state.mins[n_min_order.index(si)][flat]
-                    mins_l.append(None if v == np.inf else float(v))
+                    mins_l.append(float(v) if seen else None)
                 else:
                     mins_l.append(None)
                 if spec.func == "max" and si in n_max_order:
+                    seen = state.per_agg_count[stacked_order.index(si)][flat] > 0
                     v = state.maxs[n_max_order.index(si)][flat]
-                    maxs_l.append(None if v == -np.inf else float(v))
+                    maxs_l.append(float(v) if seen else None)
                 else:
                     maxs_l.append(None)
             distincts = None

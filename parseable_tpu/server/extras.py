@@ -124,10 +124,17 @@ async def query_context(request: web.Request) -> web.Response:
     def _ts(value, name):
         """Cursors/anchor are attacker-controlled and get spliced into SQL:
         parse as timestamps and re-serialize, never pass through raw."""
+        import json as _json
+
         try:
             dt = parse_rfc3339(str(value))
-        except (TimeParseError, ValueError) as e:
-            raise web.HTTPBadRequest(reason=f"{name} must be an RFC3339 timestamp: {e}")
+        except (TimeParseError, ValueError):
+            # detail goes in the body, not the HTTP reason line (aiohttp
+            # rejects reasons containing attacker-controlled newlines)
+            raise web.HTTPBadRequest(
+                text=_json.dumps({"error": f"{name} must be an RFC3339 timestamp"}),
+                content_type="application/json",
+            )
         return dt.isoformat().replace("+00:00", "Z")
 
     anchor_iso = _ts(anchor, "anchor")

@@ -28,17 +28,33 @@ TENANT_HEADER = "X-P-Tenant"
 class TenantRegistry:
     """In-memory view of tenant metadata + per-day ingest counters."""
 
+    DOC_TTL_SECS = 10.0
+
     def __init__(self, metastore):
         self.metastore = metastore
         self._lock = threading.Lock()
         # (tenant, date) -> events ingested today (process-local, like the
         # reference's in-memory TENANT_METADATA map)
         self._today_events: dict[tuple[str, str], int] = {}
+        # short-TTL doc cache: check_ingest runs per request; a metastore
+        # GET (object-store round trip) per ingest would dominate the path
+        self._doc_cache: dict[str, tuple[float, dict | None]] = {}
 
     # -- metadata -----------------------------------------------------------
 
     def get(self, tenant_id: str) -> dict | None:
-        return self.metastore.get_document(COLLECTION, tenant_id)
+        import time as _t
+
+        hit = self._doc_cache.get(tenant_id)
+        now = _t.monotonic()
+        if hit is not None and now - hit[0] < self.DOC_TTL_SECS:
+            return hit[1]
+        doc = self.metastore.get_document(COLLECTION, tenant_id)
+        with self._lock:
+            self._doc_cache[tenant_id] = (now, doc)
+            if len(self._doc_cache) > 10_000:
+                self._doc_cache.clear()
+        return doc
 
     def put(self, tenant_id: str, doc: dict) -> dict:
         quota = doc.get("daily_event_quota")
@@ -56,12 +72,14 @@ class TenantRegistry:
             "description": doc.get("description", ""),
         }
         self.metastore.put_document(COLLECTION, tenant_id, doc)
+        self._doc_cache.pop(tenant_id, None)  # changes bite immediately here
         return doc
 
     def delete(self, tenant_id: str) -> bool:
-        if self.get(tenant_id) is None:
+        if self.metastore.get_document(COLLECTION, tenant_id) is None:
             return False
         self.metastore.delete_document(COLLECTION, tenant_id)
+        self._doc_cache.pop(tenant_id, None)
         return True
 
     def list(self) -> list[dict]:

@@ -147,3 +147,37 @@ def test_count_distinct_no_groupby():
     cpu = QueryExecutor(lp1).execute(iter(tables)).to_pylist()
     tpu = ET.TpuQueryExecutor(lp2).execute(iter(tables)).to_pylist()
     assert cpu == tpu == [{"d": 4, "e": 3}]
+
+
+def test_oversized_table_splits_into_blocks(monkeypatch):
+    """Tables beyond the block ceiling split instead of crashing to the CPU
+    path (regression: _pad broadcast error). The ceiling is lowered so a
+    30k-row table actually exceeds it."""
+    monkeypatch.setattr(ET, "MAX_BLOCK_ROWS", 8192)
+    t = make_table(30000, seed=9)
+    sql = "SELECT status, count(*) c FROM t GROUP BY status"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+    tpu = ET.TpuQueryExecutor(lp2).execute(iter([t])).to_pylist()
+    assert_parity(cpu, tpu, sql)
+
+
+def test_min_over_all_null_column_is_none(parseable):
+    """A group whose min/max input column is entirely null must finalize to
+    None, not the f32 sentinel (flush seen-gate regression)."""
+    import pyarrow as pa
+
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array([BASE] * 4, pa.timestamp("ms")),
+            "g": pa.array(["a", "a", "b", "b"]),
+            "v": pa.array([None, None, 1.0, 2.0], pa.float64()),
+        }
+    )
+    sql = "SELECT g, count(*) c, min(v) mn, max(v) mx FROM t GROUP BY g"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+    tpu = ET.TpuQueryExecutor(lp2).execute(iter([t])).to_pylist()
+    assert_parity(cpu, tpu, sql)
+    by_g = {r["g"]: r for r in tpu}
+    assert by_g["a"]["mn"] is None and by_g["a"]["mx"] is None
