@@ -341,6 +341,7 @@ class GroupState:
     mins: list[Any]
     maxs: list[Any]
     distincts: list[set]
+    sumsqs: list[float]
 
 
 class HashAggregator:
@@ -363,6 +364,7 @@ class HashAggregator:
             mins=[None] * n,
             maxs=[None] * n,
             distincts=[set() for _ in range(n)],
+            sumsqs=[0.0] * n,
         )
 
     def update(self, table: pa.Table, mask: pa.Array | None = None) -> None:
@@ -387,6 +389,13 @@ class HashAggregator:
             if spec.func in ("sum", "avg"):
                 aggs.append((f"__a{si}", "sum"))
                 aggs.append((f"__a{si}", "count"))
+            elif spec.func in ("stddev", "var"):
+                # float64 before squaring: int64 squares wrap silently
+                fv = pc.cast(cols[f"__a{si}"], pa.float64(), safe=False)
+                cols[f"__asq{si}"] = pc.multiply(fv, fv)
+                aggs.append((f"__a{si}", "sum"))
+                aggs.append((f"__a{si}", "count"))
+                aggs.append((f"__asq{si}", "sum"))
             elif spec.func == "min":
                 aggs.append((f"__a{si}", "min"))
             elif spec.func == "max":
@@ -414,6 +423,14 @@ class HashAggregator:
                     s = gcols[f"__a{si}_sum"][r]
                     if s is not None:
                         st.sums[si] += s
+                elif spec.func in ("stddev", "var"):
+                    st.count[si] += gcols[f"__a{si}_count"][r]
+                    s = gcols[f"__a{si}_sum"][r]
+                    if s is not None:
+                        st.sums[si] += s
+                    sq = gcols[f"__asq{si}_sum"][r]
+                    if sq is not None:
+                        st.sumsqs[si] += sq
                 elif spec.func == "min":
                     v = gcols[f"__a{si}_min"][r]
                     if v is not None:
@@ -454,6 +471,7 @@ class HashAggregator:
             for si, spec in enumerate(self.specs):
                 mine.count[si] += st.count[si]
                 mine.sums[si] += st.sums[si]
+                mine.sumsqs[si] += st.sumsqs[si]
                 for attr, fn in (("mins", min), ("maxs", max)):
                     a = getattr(mine, attr)[si]
                     b = getattr(st, attr)[si]
@@ -503,6 +521,14 @@ class HashAggregator:
             return st.maxs[si]
         if spec.func == "count_distinct":
             return len(st.distincts[si])
+        if spec.func in ("stddev", "var"):
+            # sample variance (n-1 denominator, DataFusion semantics)
+            n = st.count[si]
+            if n < 2:
+                return None
+            var = (st.sumsqs[si] - st.sums[si] ** 2 / n) / (n - 1)
+            var = max(0.0, var)  # guard f.p. negatives
+            return math.sqrt(var) if spec.func == "stddev" else var
         raise ExecError(f"unknown aggregate {spec.func}")
 
 

@@ -116,6 +116,7 @@ class StreamScan:
         # yields a stub the TPU executor resolves from the hot set
         self.use_hot_stubs = use_hot_stubs
         self._sources: dict[bytes, ManifestFile] = {}
+        self._manifest_files: list[ManifestFile] | None = None
         self.stats = ScanStats()
 
     # ---------------------------------------------------------------- helpers
@@ -149,9 +150,60 @@ class StreamScan:
 
     # ---------------------------------------------------------------- sources
 
+    def legacy_listing_files(self) -> list[ManifestFile]:
+        """Prefix-listing fallback for pre-manifest data (reference:
+        query/listing_table_builder.rs:41-147): when a stream has NO
+        snapshot manifests at all, parquet uploaded by older deployments is
+        discovered by listing `{stream}/date=.../` prefixes bounded by the
+        query's time range."""
+        tb = self.plan.time_bounds
+        if tb.low is not None and tb.high is not None:
+            from parseable_tpu.utils.timeutil import TimeRange
+
+            prefixes = [
+                f"{self.plan.stream}/{p}"
+                for p in TimeRange(tb.low, tb.high).generate_prefixes()
+            ]
+            # too many minute prefixes -> one stream-wide listing wins
+            if len(prefixes) > 256:
+                prefixes = [f"{self.plan.stream}/date="]
+        else:
+            prefixes = [f"{self.plan.stream}/date="]
+        out: list[ManifestFile] = []
+        seen: set[str] = set()
+        errors = 0
+        for prefix in prefixes:
+            try:
+                metas = list(self.p.storage.list_prefix(prefix))
+            except Exception:
+                logger.warning("legacy listing failed for %s", prefix, exc_info=True)
+                errors += 1
+                continue
+            for m in metas:
+                if not m.key.endswith(".parquet") or m.key in seen:
+                    continue
+                seen.add(m.key)
+                self.stats.files_total += 1
+                out.append(ManifestFile(file_path=m.key, num_rows=0, file_size=m.size))
+        if errors == len(prefixes) and errors:
+            # storage down must error, not masquerade as an empty stream
+            raise RuntimeError("legacy listing failed for every prefix (storage unavailable?)")
+        return out
+
     def manifest_files(self) -> list[ManifestFile]:
-        """Manifest entries after time + stats pruning."""
+        """Manifest entries after time + stats pruning; falls back to
+        prefix listing when the stream predates manifests. Memoized for
+        the scan's lifetime — the session consults it up to three times
+        per query (time hint, count fast path, the scan itself)."""
+        if self._manifest_files is not None:
+            return self._manifest_files
+        self._manifest_files = self._manifest_files_uncached()
+        return self._manifest_files
+
+    def _manifest_files_uncached(self) -> list[ManifestFile]:
         snapshot = self.merged_snapshot()
+        if not snapshot.manifest_list:
+            return self.legacy_listing_files()
         items = snapshot.manifests_for_range(self.plan.time_bounds.low, self.plan.time_bounds.high)
         files: list[ManifestFile] = []
         seen: set[str] = set()

@@ -304,3 +304,55 @@ def test_session_time_range_prunes(parseable):
         "SELECT count(*) FROM tr", start_time="2000-01-01T00:00:00Z", end_time="2000-01-02T00:00:00Z"
     )
     assert res.to_json_rows()[0]["count(*)"] == 0
+
+
+def test_stddev_var_aggregates(parseable):
+    """stddev/var (sample, n-1) on the CPU engine; TPU path falls back and
+    matches."""
+    import statistics
+
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = parseable
+    s = p.create_stream_if_not_exists("sd")
+    vals = [float(i * i % 17) for i in range(60)]
+    ev = JsonEvent([{"v": v} for v in vals], "sd").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    for engine in ("cpu", "tpu"):
+        r = QuerySession(p, engine=engine).query("SELECT stddev(v) sd, var(v) vr FROM sd")
+        row = r.to_json_rows()[0]
+        assert abs(row["sd"] - statistics.stdev(vals)) < 1e-6
+        assert abs(row["vr"] - statistics.variance(vals)) < 1e-6
+
+
+def test_legacy_prefix_listing_fallback(parseable):
+    """Parquet uploaded without manifests (pre-catalog deployments) is
+    still queryable via prefix listing (reference:
+    listing_table_builder.rs:41-147)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import io
+    from datetime import UTC, datetime
+
+    p = parseable
+    p.create_stream_if_not_exists("legacyq")
+    ts = datetime(2024, 5, 1, 10, 0, tzinfo=UTC)
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array([ts.replace(tzinfo=None)] * 20, pa.timestamp("ms")),
+            "n": pa.array([float(i) for i in range(20)]),
+        }
+    )
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    # drop the parquet straight into the store with NO manifest/snapshot
+    p.storage.put_object(
+        "legacyq/date=2024-05-01/hour=10/minute=00/old.data.parquet", buf.getvalue()
+    )
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "SELECT count(*) c, sum(n) s FROM legacyq",
+        start_time="2024-05-01T09:00:00Z",
+        end_time="2024-05-01T11:00:00Z",
+    )
+    assert r.to_json_rows() == [{"c": 20, "s": 190.0}]
