@@ -264,47 +264,48 @@ def main() -> None:
 
         print(f"# devices: {jax.devices()}", file=sys.stderr)
 
-        results = {}
-        for name, sql in CONFIGS.items():
+        # measure + EMIT each config as it completes (a killed run still
+        # records whatever finished); the north-star config runs last so
+        # its line stays the final one when everything completes
+        def measure_and_emit(name: str, sql: str) -> None:
             cpu_t, rows, cpu_rows = best_of(p, "bench", "cpu", sql, max(1, repeats - 1))
-
             # compile first (one-time XLA cost), THEN measure cold: the cold
             # number is the data path (parquet read + encode + transfer +
             # compute, overlapped by the prefetcher), not compilation
             run_query(p, "bench", "tpu", sql)
             clear_hot_state()
-            cold_t, _, tpu_rows_cold = run_query(p, "bench", "tpu", sql)
+            cold_t, _, _ = run_query(p, "bench", "tpu", sql)
             warm_t, _, tpu_rows = best_of(p, "bench", "tpu", sql, repeats)
-
             if not rows_match(cpu_rows, tpu_rows):
                 print(f"# WARNING: {name} results differ!", file=sys.stderr)
                 print(f"#   cpu: {cpu_rows[:2]} tpu: {tpu_rows[:2]}", file=sys.stderr)
-            results[name] = (cpu_t, cold_t, warm_t, rows)
             print(
                 f"# {name}: cpu {cpu_t:.3f}s | tpu cold {cold_t:.3f}s "
                 f"({rows/cold_t:,.0f} r/s, {cpu_t/cold_t:.1f}x) | tpu warm {warm_t:.3f}s "
                 f"({rows/warm_t:,.0f} r/s, {cpu_t/warm_t:.1f}x)",
                 file=sys.stderr,
             )
-
-        bench_distributed_subprocess(total_rows)
-
-        for name in ("groupby", "regex_filter"):
-            cpu_t, cold_t, warm_t, rows = results[name]
+            metric = (
+                "topk_multicol_groupby_rows_per_sec_tpu"
+                if name == "topk_multicol"
+                else f"{name}_scan_rows_per_sec_tpu"
+            )
             emit(
-                f"{name}_scan_rows_per_sec_tpu",
+                metric,
                 rows / warm_t,
                 cpu_t / warm_t,
-                {"cold_rows_per_sec": round(rows / cold_t, 1), "cold_vs_baseline": round(cpu_t / cold_t, 3)},
+                {
+                    "cold_rows_per_sec": round(rows / cold_t, 1),
+                    "cold_vs_baseline": round(cpu_t / cold_t, 3),
+                },
             )
-        # north star LAST: top-K + multi-column GROUP BY (config 4)
-        cpu_t, cold_t, warm_t, rows = results["topk_multicol"]
-        emit(
-            "topk_multicol_groupby_rows_per_sec_tpu",
-            rows / warm_t,
-            cpu_t / warm_t,
-            {"cold_rows_per_sec": round(rows / cold_t, 1), "cold_vs_baseline": round(cpu_t / cold_t, 3)},
-        )
+
+        for name, sql in CONFIGS.items():
+            if name != "topk_multicol":
+                measure_and_emit(name, sql)
+        bench_distributed_subprocess(total_rows)
+        # north star LAST (config 4)
+        measure_and_emit("topk_multicol", CONFIGS["topk_multicol"])
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

@@ -124,10 +124,34 @@ class ServerState:
         def loop(interval: int, fn, name: str):
             def run():
                 while not self._sync_stop.wait(interval):
+                    # slow-task watchdog (reference: monitor_task_duration
+                    # sync.rs:106-135): a tick overrunning its interval gets
+                    # logged while still running, not just after the fact.
+                    # Per-tick state binds as defaults — late-bound closure
+                    # vars would let a stale watchdog latch onto the next
+                    # tick's event.
+                    started = time.monotonic()
+                    done = threading.Event()
+
+                    def watch(done=done, started=started):
+                        while not done.wait(max(interval, 30)):
+                            logger.warning(
+                                "%s tick still running after %.0fs (interval %ds)",
+                                name,
+                                time.monotonic() - started,
+                                interval,
+                            )
+
+                    w = threading.Thread(target=watch, name=f"{name}-watchdog", daemon=True)
+                    w.start()
                     try:
                         fn()
                     except Exception:
+                        # per-tick isolation: the loop itself never dies
+                        # (reference: catch_unwind + respawn sync.rs:160-165)
                         logger.exception("%s tick failed", name)
+                    finally:
+                        done.set()
 
             t = threading.Thread(target=run, name=name, daemon=True)
             t.start()
