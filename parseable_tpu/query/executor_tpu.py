@@ -566,8 +566,10 @@ _PROGRAM_CACHE: dict[tuple, Callable] = {}
 
 # how many programs were built with a mesh (shard_map psum path) — the
 # stable signal tests/bench use to assert distributed execution happened
-# (cache-key positions are an implementation detail)
+# (cache-key positions are an implementation detail); the second counter
+# tracks programs whose ACCUMULATOR sharded over the 2D `groups` axis
 MESH_PROGRAMS_BUILT = 0
+GROUP_SHARDED_PROGRAMS_BUILT = 0
 
 
 # ------------------------------------------------------------------- the mesh
@@ -584,10 +586,13 @@ _MESH_CACHE: dict[str, Any] = {}
 def resolve_mesh(options: Options | None = None):
     """Device mesh for distributed aggregation, or None (single chip).
 
-    `P_TPU_MESH`: "off" disables; "data:N" / "N" pins the data-axis size;
-    empty auto-shards over all visible devices when more than one exists.
-    The axis size is clamped to the largest power of two so it always
-    divides the power-of-two row blocks.
+    `P_TPU_MESH`: "off" disables; "data:N" / "N" pins a 1D data axis;
+    "NxM" (e.g. "4x2") builds the 2D (data x groups) layout where the
+    group space ALSO shards — each device owns G/M accumulator buckets,
+    so giant group spaces scale past one chip's HBM (parallel/mesh.py
+    distributed_groupby_2d design). Empty auto-shards a 1D data axis over
+    all visible devices. Axis sizes clamp to powers of two so they always
+    divide the power-of-two row blocks / group capacities.
     """
     shape = (options.mesh_shape if options is not None else "").strip().lower()
     if shape in _MESH_CACHE:
@@ -598,25 +603,59 @@ def resolve_mesh(options: Options | None = None):
             import jax
 
             n_avail = jax.device_count()
-            want = None
-            if shape.startswith("data:"):
-                want = int(shape.split(":", 1)[1])
-            elif shape.isdigit():
-                want = int(shape)
-            elif n_avail > 1:
-                want = n_avail
-            if want and want > 1:
-                n = min(want, n_avail)
-                n = 1 << (n.bit_length() - 1)  # largest pow2 <= n
-                if n > 1:
-                    from parseable_tpu.parallel.mesh import make_mesh
+            parts = shape.split("x", 1) if "x" in shape else None
+            if parts is not None and all(p.isdigit() and p for p in parts):
+                n_data, n_groups = (int(v) for v in parts)
+                # pow2 clamp like the 1D path: row blocks and group
+                # capacities are powers of two, so non-pow2 axes would
+                # silently never engage
+                pow2 = lambda n: 1 << (n.bit_length() - 1) if n >= 1 else 1
+                cd, cg = pow2(n_data), pow2(n_groups)
+                if (cd, cg) != (n_data, n_groups):
+                    logger.warning(
+                        "P_TPU_MESH=%s clamped to %dx%d (axes must be powers of two)",
+                        shape, cd, cg,
+                    )
+                n_data, n_groups = cd, cg
+                if n_data * n_groups <= n_avail:
+                    from parseable_tpu.parallel.mesh import make_mesh, make_mesh_2d
 
-                    mesh = make_mesh(n)
+                    if n_groups == 1:
+                        mesh = make_mesh(n_data)
+                    else:
+                        mesh = make_mesh_2d(n_data, n_groups)
+                else:
+                    logger.warning(
+                        "P_TPU_MESH=%s needs %d devices, have %d; single-chip",
+                        shape, n_data * n_groups, n_avail,
+                    )
+            elif parts is not None:
+                logger.warning("P_TPU_MESH=%r is malformed (want e.g. '4x2'); single-chip", shape)
+            else:
+                want = None
+                if shape.startswith("data:"):
+                    want = int(shape.split(":", 1)[1])
+                elif shape.isdigit():
+                    want = int(shape)
+                elif n_avail > 1:
+                    want = n_avail
+                if want and want > 1:
+                    n = min(want, n_avail)
+                    n = 1 << (n.bit_length() - 1)  # largest pow2 <= n
+                    if n > 1:
+                        from parseable_tpu.parallel.mesh import make_mesh
+
+                        mesh = make_mesh(n)
     except Exception:
         logger.exception("mesh resolution failed; running single-chip")
         mesh = None
     _MESH_CACHE[shape] = mesh
     return mesh
+
+
+def _mesh_group_shards(mesh) -> int:
+    """Size of the `groups` axis (1 on 1D meshes)."""
+    return mesh.shape.get("groups", 1) if mesh is not None else 1
 
 
 def _mesh_shardings(mesh):
@@ -1027,7 +1066,12 @@ class TpuQueryExecutor(QueryExecutor):
                 if pending and sig != pending_sig:
                     dispatch_pending()
                 pending_sig = sig
-                if self.mesh is not None and enc.block_rows % self.mesh.size == 0:
+                mesh_data = (
+                    self.mesh.shape.get("data", self.mesh.size)
+                    if self.mesh is not None
+                    else 1
+                )
+                if self.mesh is not None and enc.block_rows % mesh_data == 0:
                     import jax
 
                     _, rep_s = _mesh_shardings(self.mesh)
@@ -1159,8 +1203,23 @@ class TpuQueryExecutor(QueryExecutor):
         Cached process-wide; the key covers everything baked into the trace.
         """
         mesh = self.mesh
-        if mesh is not None and enc.block_rows % mesh.size:
+        n_data_shards = mesh.shape.get("data", mesh.size) if mesh is not None else 1
+        if mesh is not None and enc.block_rows % n_data_shards:
             mesh = None
+            n_data_shards = 1
+        # 2D layout: the accumulator itself shards over the `groups` axis
+        # when the group space divides; otherwise that axis idles (inputs
+        # replicated over it, fold identical per shard)
+        n_group_shards = _mesh_group_shards(mesh)
+        shard_groups = (
+            n_group_shards
+            if n_group_shards > 1 and num_groups % n_group_shards == 0 and num_groups >= n_group_shards
+            else 1
+        )
+        if shard_groups > 1 and layout.distinct_caps:
+            # distinct bitmaps aren't group-sharded yet: idle the groups
+            # axis (replicated fold) rather than losing the device entirely
+            shard_groups = 1
         kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
         bounds_s = self._bounds_seconds()
         key = (
@@ -1184,6 +1243,7 @@ class TpuQueryExecutor(QueryExecutor):
             tuple(layout.distinct_cols),
             layout.distinct_caps,
             dremap_shapes,
+            shard_groups,
         )
         prog = _PROGRAM_CACHE.get(key)
         if prog is not None:
@@ -1194,6 +1254,7 @@ class TpuQueryExecutor(QueryExecutor):
 
         sel_where = self.plan.select.where
         compiler = PredicateCompiler()
+        kernel_groups = num_groups // shard_groups  # per-device group window
         n_sum, n_min, n_max = len(layout.sum_cols), len(layout.min_cols), len(layout.max_cols)
         key_specs = [
             KeySpec(ks.kind, ks.column, ks.expr, ks.bin_ms, ks.gdict, cap, orig)
@@ -1242,6 +1303,16 @@ class TpuQueryExecutor(QueryExecutor):
                     stride *= cap
                 ids = ids.astype(jnp.int32)
 
+            # group-sharded (2D) layout: this device owns one contiguous
+            # window of the group space; rows outside it mask off instead
+            # of routing (parallel/mesh.py distributed_groupby_2d design)
+            if shard_groups > 1:
+                gshard = jax.lax.axis_index("groups")
+                local = ids - gshard * jnp.int32(kernel_groups)
+                in_window = jnp.logical_and(local >= 0, local < kernel_groups)
+                mask = jnp.logical_and(mask, in_window)
+                ids = jnp.clip(local, 0, kernel_groups - 1)
+
             def stack(names):
                 if not names:
                     return jnp.zeros((0, local_rows), jnp.float32)
@@ -1259,7 +1330,7 @@ class TpuQueryExecutor(QueryExecutor):
                 stack(layout.min_cols),
                 stack(layout.max_cols),
                 stack_valid(layout.stacked_cols),
-                num_groups,
+                kernel_groups,
                 n_sum,
                 n_min,
                 n_max,
@@ -1272,7 +1343,7 @@ class TpuQueryExecutor(QueryExecutor):
                 dm = jnp.logical_and(mask, dev[f"{dcol}__valid"])
                 flat = ids * jnp.int32(dcap) + codes
                 upd = jax.ops.segment_max(
-                    dm.astype(jnp.float32), flat, num_segments=num_groups * dcap
+                    dm.astype(jnp.float32), flat, num_segments=kernel_groups * dcap
                 )
                 if mesh is not None:
                     upd = jax.lax.pmax(upd, "data")
@@ -1317,8 +1388,11 @@ class TpuQueryExecutor(QueryExecutor):
             n_remaps = sum(1 for s in remap_shapes if s is not None)
             n_dremaps = len(dremap_shapes)
             dev_spec = {k: P("data") for k in dev_keys}
+            # accumulator: replicated on 1D meshes; its G axis shards over
+            # `groups` on the 2D layout (each device owns G/shard buckets)
+            acc_spec = P(None, "groups") if shard_groups > 1 else P()
             in_specs = (
-                P(),  # accumulator: replicated
+                acc_spec,
                 tuple(P() for _ in layout.distinct_caps),  # presence bitmaps
                 tuple(dev_spec for _ in range(n_blocks)),
                 tuple(tuple(P() for _ in lut_shapes) for _ in range(n_blocks)),
@@ -1326,7 +1400,7 @@ class TpuQueryExecutor(QueryExecutor):
                 tuple(tuple(P() for _ in range(n_dremaps)) for _ in range(n_blocks)),
                 tuple(P("data") for _ in range(n_blocks)),
             )
-            out_specs = (P(), tuple(P() for _ in layout.distinct_caps))
+            out_specs = (acc_spec, tuple(P() for _ in layout.distinct_caps))
             prog_body = shard_map(prog_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         else:
             prog_body = prog_fn
@@ -1336,8 +1410,10 @@ class TpuQueryExecutor(QueryExecutor):
         # call); the G-sized accumulator copy is far cheaper
         prog = jax.jit(prog_body)
         if mesh is not None:
-            global MESH_PROGRAMS_BUILT
+            global MESH_PROGRAMS_BUILT, GROUP_SHARDED_PROGRAMS_BUILT
             MESH_PROGRAMS_BUILT += 1
+            if shard_groups > 1:
+                GROUP_SHARDED_PROGRAMS_BUILT += 1
         _PROGRAM_CACHE[key] = prog
         return prog
 
@@ -1480,7 +1556,7 @@ def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
     """
     import jax.numpy as jnp
 
-    if mesh is not None and enc.block_rows % mesh.size:
+    if mesh is not None and enc.block_rows % mesh.shape.get("data", mesh.size):
         mesh = None  # block not shardable; keep it single-device
     if mesh is not None:
         import jax

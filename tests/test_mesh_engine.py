@@ -181,3 +181,44 @@ def test_min_over_all_null_column_is_none(parseable):
     assert_parity(cpu, tpu, sql)
     by_g = {r["g"]: r for r in tpu}
     assert by_g["a"]["mn"] is None and by_g["a"]["mx"] is None
+
+
+def test_2d_mesh_group_sharded_accumulator(parseable):
+    """P_TPU_MESH=4x2: rows shard over `data` AND the accumulator shards
+    over `groups` — each device owns half the group space (VERDICT: the
+    2D path for large G; parallel/mesh.py distributed_groupby_2d)."""
+    from parseable_tpu.config import Options
+
+    opts = Options()
+    opts.mesh_shape = "4x2"
+    tables = [make_table(8000, seed=s) for s in range(3)]
+    sql = (
+        "SELECT status, host, count(*) c, sum(bytes) s, min(bytes) mn "
+        "FROM t GROUP BY status, host"
+    )
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter(tables)).to_pylist()
+    ex = ET.TpuQueryExecutor(lp2, opts)
+    assert ex.mesh is not None
+    assert ex.mesh.shape == {"data": 4, "groups": 2}
+    before_gs = ET.GROUP_SHARDED_PROGRAMS_BUILT
+    tpu = ex.execute(iter(tables)).to_pylist()
+    assert ET.GROUP_SHARDED_PROGRAMS_BUILT > before_gs, (
+        "accumulator did not shard over the groups axis"
+    )
+    assert_parity(cpu, tpu, sql)
+
+
+def test_2d_mesh_distinct_falls_back_exact(parseable):
+    """count_distinct on a 2D mesh degrades to the idle-groups-axis device
+    fold (distinct bitmaps aren't group-sharded) and stays exact."""
+    from parseable_tpu.config import Options
+
+    opts = Options()
+    opts.mesh_shape = "4x2"
+    t = make_table(6000, seed=4)
+    sql = "SELECT status, count(distinct host) d FROM t GROUP BY status"
+    lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+    tpu = ET.TpuQueryExecutor(lp2, opts).execute(iter([t])).to_pylist()
+    assert_parity(cpu, tpu, sql)
