@@ -97,7 +97,10 @@ class HotTierManager:
         downloaded = 0
         used = self.used_bytes(stream)
         wanted: set[Path] = set()
+        paused = False
         for item in items:
+            if paused:
+                break
             prefix = item.manifest_path[: -len("/manifest.json")]
             manifest = self.p.metastore.get_manifest(prefix)
             if manifest is None:
@@ -109,6 +112,16 @@ class HotTierManager:
                     continue
                 if used + f.file_size > budget:
                     continue  # out of budget: skip older files
+                if self._disk_over_ceiling():
+                    # the disk itself is full (other tenants count too):
+                    # re-downloading what the guard evicts would thrash
+                    logger.warning(
+                        "hot tier paused for %s: disk over %d%% ceiling",
+                        stream,
+                        int(self.DISK_USAGE_CEILING * 100),
+                    )
+                    paused = True
+                    break
                 try:
                     self.p.storage.download_file(f.file_path, local)
                 except Exception:
@@ -117,7 +130,10 @@ class HotTierManager:
                 used += f.file_size
                 downloaded += 1
                 HOT_TIER_DOWNLOAD_BYTES.labels(stream).inc(f.file_size)
-        self._evict(stream, budget, wanted)
+        if not paused:
+            # `wanted` is only complete after a full manifest sweep; an
+            # early pause must not treat unvisited files as orphaned
+            self._evict(stream, budget, wanted)
         HOT_TIER_SIZE.labels(stream).set(self.used_bytes(stream))
         return downloaded
 
@@ -142,7 +158,51 @@ class HotTierManager:
             files[i].unlink(missing_ok=True)
             i += 1
 
+    # refuse to fill the disk past this fraction, regardless of budgets
+    # (reference: disk-usage guard hottier.rs:1596-1665)
+    DISK_USAGE_CEILING = 0.85
+
+    def _disk_over_ceiling(self) -> bool:
+        usage = shutil.disk_usage(self.base)
+        return usage.used / usage.total > self.DISK_USAGE_CEILING
+
+    def disk_usage_guard(self) -> int:
+        """Evict oldest files across ALL streams while the underlying disk
+        is above the ceiling. Returns files evicted. Budgets cap per-stream
+        size; this guards the shared disk itself (other tenants of the
+        volume count against it too). Reconcile skips downloads while the
+        disk stays over the ceiling, so evictions don't thrash."""
+        if not self._disk_over_ceiling():
+            return 0
+        # chronological ACROSS streams: order by the date=... path under
+        # the stream dir, not the full path (stream names would dominate)
+        files = sorted(
+            (f for f in self.base.rglob("*.parquet") if f.is_file()),
+            key=lambda f: ("/".join(f.relative_to(self.base).parts[1:]), str(f)),
+        )
+        evicted = 0
+        touched: set[str] = set()
+        for f in files:
+            if not self._disk_over_ceiling():
+                break
+            touched.add(f.relative_to(self.base).parts[0])
+            f.unlink(missing_ok=True)
+            evicted += 1
+        for stream in touched:
+            HOT_TIER_SIZE.labels(stream).set(self.used_bytes(stream))
+        if evicted:
+            logger.warning(
+                "hot tier disk-usage guard evicted %d files (disk >%d%% full)",
+                evicted,
+                int(self.DISK_USAGE_CEILING * 100),
+            )
+        return evicted
+
     def tick(self) -> None:
+        try:
+            self.disk_usage_guard()
+        except Exception:
+            logger.exception("hot tier disk-usage guard failed")
         for stream in list(self.budgets):
             try:
                 self.reconcile(stream)

@@ -61,3 +61,43 @@ def test_disable_clears(parseable, tmp_path):
     mgr.disable("gone")
     assert mgr.used_bytes("gone") == 0
     assert mgr.get_budget("gone") is None
+
+
+def test_disk_usage_guard_evicts_oldest(tmp_path, monkeypatch):
+    """Above the disk ceiling the guard evicts oldest hot-tier files across
+    streams until under (reference: hottier.rs:1596-1665)."""
+    import shutil as _shutil
+    from collections import namedtuple
+
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.storage.hottier import HotTierManager
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    mgr = HotTierManager(p, tmp_path / "hottier")
+    # oldest data lives in stream "z" — eviction order must follow the
+    # date, not the stream name
+    for stream, day in (("z", "2024-05-01"), ("a", "2024-05-02"), ("a", "2024-05-03")):
+        f = mgr.base / stream / f"date={day}" / "x.data.parquet"
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_bytes(b"x" * 128)
+
+    Usage = namedtuple("Usage", "total used free")
+    calls = {"n": 0}
+
+    def fake_usage(path):
+        # over the ceiling for the first three checks (initial + 2 evictions)
+        calls["n"] += 1
+        over = calls["n"] <= 3
+        return Usage(total=100, used=95 if over else 10, free=5)
+
+    import parseable_tpu.storage.hottier as H
+
+    monkeypatch.setattr(H.shutil, "disk_usage", fake_usage)
+    evicted = mgr.disk_usage_guard()
+    assert evicted == 2
+    remaining = sorted(str(f.relative_to(mgr.base)) for f in mgr.base.rglob("*.parquet"))
+    # the two oldest dates went first, across streams
+    assert remaining == ["a/date=2024-05-03/x.data.parquet"]
