@@ -188,6 +188,12 @@ class Options:
     send_analytics: bool = field(default_factory=lambda: _env_bool("P_SEND_ANONYMOUS_USAGE_DATA", False))
     cpu_threshold_pct: float = field(default_factory=lambda: _env_float("P_CPU_THRESHOLD", 90.0))
     memory_threshold_pct: float = field(default_factory=lambda: _env_float("P_MEMORY_THRESHOLD", 90.0))
+    # console UI bundle directory, served at / when set (the reference
+    # embeds a prebuilt console via build.rs; here it's an external dir)
+    ui_dir: Path | None = field(
+        default_factory=lambda: Path(_env("P_UI_DIR")) if _env("P_UI_DIR") else None
+    )
+
     # --- OIDC (reference: src/oidc.rs P_OIDC_* options) ----------------------
     oidc_issuer: str | None = field(default_factory=lambda: _env("P_OIDC_ISSUER"))
     oidc_client_id: str | None = field(default_factory=lambda: _env("P_OIDC_CLIENT_ID"))

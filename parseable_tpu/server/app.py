@@ -196,10 +196,18 @@ _INGEST_PATHS = ("/api/v1/ingest", "/v1/")
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     state: ServerState = request.app["state"]
+    ui_enabled = state.p.options.ui_dir is not None
     if (
         request.path in ("/api/v1/liveness", "/api/v1/readiness")
         or request.path.startswith("/api/v1/o/")  # OIDC login flow
         or request.method == "OPTIONS"
+        or (
+            # the console shell + bundle are public (the app itself logs in
+            # against the API); everything under /api//v1 still needs auth
+            ui_enabled
+            and request.method == "GET"
+            and not request.path.startswith(("/api/", "/v1/"))
+        )
     ):
         return await handler(request)
     # shed ingest under resource pressure (reference: resource_check.rs:120)
@@ -1437,6 +1445,23 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/cluster/metrics", cluster_metrics)
     r.add_delete("/api/v1/cluster/{node_id}", remove_node_handler)
     r.add_post("/api/v1/internal/rbac/reload", internal_rbac_reload)
+
+    # console UI (reference embeds the prebuilt bundle via build.rs;
+    # here P_UI_DIR points at an unpacked console build, served at /)
+    ui_dir = state.p.options.ui_dir
+    if ui_dir and ui_dir.is_dir():
+        if not (ui_dir / "index.html").is_file():
+            logger.error("P_UI_DIR %s has no index.html; console disabled", ui_dir)
+        else:
+            async def ui_index(request: web.Request) -> web.FileResponse:
+                return web.FileResponse(ui_dir / "index.html")
+
+            r.add_get("/", ui_index)
+            if (ui_dir / "assets").is_dir():
+                r.add_static("/assets", ui_dir / "assets")
+            # SPA fallback: browser refreshes on console routes (anything
+            # that isn't the API) get the app shell back
+            r.add_get("/{tail:(?!api/|v1/|assets/).*}", ui_index)
     return app
 
 

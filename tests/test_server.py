@@ -477,3 +477,56 @@ def test_query_timeout_maps_to_504(tmp_path):
         assert r.status == 504
 
     run(with_client(state, fn))
+
+
+def test_ui_static_serving(tmp_path):
+    """P_UI_DIR serves the console bundle at / without auth (reference:
+    build.rs embedded console; here an external dir)."""
+    ui = tmp_path / "console"
+    (ui / "assets").mkdir(parents=True)
+    (ui / "index.html").write_text("<html>console</html>")
+    (ui / "assets" / "app.js").write_text("// js")
+    state = make_state(tmp_path)
+    state.p.options.ui_dir = ui
+
+    async def fn(client):
+        r = await client.get("/")  # no auth
+        assert r.status == 200
+        assert "console" in await r.text()
+        r = await client.get("/assets/app.js")
+        assert r.status == 200
+        # API still requires auth
+        r = await client.get("/api/v1/logstream")
+        assert r.status == 401
+
+    run(with_client(state, fn))
+
+
+def test_ui_spa_fallback_and_missing_index(tmp_path):
+    state = make_state(tmp_path)
+    # dir without index.html -> console disabled, / is a plain 404/401 surface
+    broken = tmp_path / "broken-ui"
+    broken.mkdir()
+    state.p.options.ui_dir = broken
+
+    async def fn(client):
+        r = await client.get("/")
+        assert r.status == 404  # no route registered; not a 500
+
+    run(with_client(state, fn))
+
+    # proper bundle: deep links serve the shell, API stays authed
+    ui = tmp_path / "ui"
+    (ui / "assets").mkdir(parents=True)
+    (ui / "index.html").write_text("<html>shell</html>")
+    state2 = make_state(tmp_path / "s2")
+    state2.p.options.ui_dir = ui
+
+    async def fn2(client):
+        r = await client.get("/explore/streams")  # SPA deep link, no auth
+        assert r.status == 200
+        assert "shell" in await r.text()
+        r = await client.get("/api/v1/logstream")
+        assert r.status == 401
+
+    run(with_client(state2, fn2))
