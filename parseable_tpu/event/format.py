@@ -15,6 +15,7 @@ Parity targets (reference: src/event/format/mod.rs:148-620, json.rs:42-556):
 from __future__ import annotations
 
 import hashlib
+import logging
 from dataclasses import dataclass, field as dc_field
 from datetime import UTC, datetime
 from enum import Enum
@@ -23,6 +24,8 @@ from typing import Any
 import pyarrow as pa
 
 from parseable_tpu.utils.timeutil import parse_rfc3339
+
+logger = logging.getLogger(__name__)
 
 # Field-name fragments that suggest a timestamp value
 # (reference: event/format/mod.rs:46 TIME_FIELD_NAME_PARTS)
@@ -312,6 +315,24 @@ def prepare_event(
 ) -> EventSchema:
     """Full `to_data` pipeline: conflict renames -> inference -> overrides."""
     stored = stored_schema or {}
+    # normalize '@'-prefixed keys in the RECORDS too — the schema infers
+    # normalized names, and decode() looks values up by those names (a
+    # schema-only normalization silently dropped the values). When '@x'
+    # and '_x' coexist in one record, the explicit '_x' value wins
+    # (deterministic; logged so the drop is diagnosable).
+    if any(k.startswith("@") for rec in records for k in rec):
+        normalized_records = []
+        for rec in records:
+            new_rec: dict[str, Any] = {}
+            for k, v in rec.items():
+                nk = normalize_field_name(k)
+                if nk in new_rec or (k.startswith("@") and nk in rec):
+                    if k.startswith("@"):
+                        logger.debug("field %r collides with %r; keeping the latter", k, nk)
+                        continue
+                new_rec[nk] = v
+            normalized_records.append(new_rec)
+        records = normalized_records
     renames = detect_schema_conflicts(records, stored, schema_version)
     records = rename_per_record_type_mismatches(records, stored, renames)
     inferred = infer_json_schema(records, schema_version, infer_timestamp)
@@ -358,6 +379,132 @@ def _coerce(value: Any, t: pa.DataType) -> Any:
             return None
         return [_coerce(v, t.value_type) for v in value]
     return value
+
+
+def prepare_and_decode_fast(
+    records: list[dict[str, Any]],
+    stored_schema: dict[str, pa.Field] | None,
+    schema_version: SchemaVersion = SchemaVersion.V1,
+    time_partition: str | None = None,
+    infer_timestamp: bool = True,
+) -> tuple[pa.RecordBatch, pa.Schema] | None:
+    """Vectorized prepare+decode through Arrow's C++ builders — the ingest
+    hot loop's fast path (~15x over the per-value Python pipeline; the
+    reference leans on arrow-json's Decoder + rayon the same way,
+    ingest.rs:60, json.rs:189).
+
+    Returns None whenever the batch needs the exact slow-path semantics:
+    per-record type-conflict renames, mixed-type columns, nested values,
+    time partitions, or time-ish strings that only partially parse. The
+    caller then runs prepare_event + decode, so behavior is identical —
+    this path only accelerates batches whose columns are cleanly typed.
+    """
+    if schema_version != SchemaVersion.V1 or time_partition is not None or not records:
+        return None
+    try:
+        tbl = pa.Table.from_pylist(records)
+    except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+        return None  # mixed-type column etc. -> slow path
+    # from_pylist infers columns from the first record; sparse batches
+    # (later records adding keys) need the per-record slow path
+    union_keys = set()
+    for rec in records:
+        union_keys.update(rec)
+    if len(union_keys) != len(tbl.column_names):
+        return None
+    import pyarrow.compute as pc
+
+    stored = stored_schema or {}
+    normalized = [normalize_field_name(n) for n in tbl.column_names]
+    if len(set(normalized)) != len(normalized):
+        return None  # '@x' colliding with 'x' needs per-record handling
+
+    out: dict[str, pa.Array] = {}
+    for raw_name, name in zip(tbl.column_names, normalized):
+        col = tbl.column(raw_name).combine_chunks()
+        t = col.type
+        stored_f = stored.get(name)
+        if pa.types.is_struct(t) or pa.types.is_list(t) or pa.types.is_large_list(t):
+            return None  # nested residue / list coercion: slow path
+        # V1 base mapping
+        if pa.types.is_null(t):
+            target: pa.DataType = pa.string()
+        elif pa.types.is_boolean(t):
+            target = pa.bool_()
+        elif pa.types.is_integer(t) or pa.types.is_floating(t):
+            target = pa.float64()
+        elif pa.types.is_string(t) or pa.types.is_large_string(t):
+            target = pa.string()
+        elif pa.types.is_timestamp(t):
+            target = pa.timestamp("ms")
+        else:
+            return None
+        # timestamp inference for time-ish string columns: the slow path
+        # types the column ts when ANY value parses and nulls the rest;
+        # the fast path takes only the all-parse case and falls back on
+        # partial parses
+        wants_ts = (
+            target == pa.string()
+            and infer_timestamp
+            and _is_timestampy(name)
+            and not (stored_f is not None and pa.types.is_string(stored_f.type))
+        )
+        if stored_f is not None and pa.types.is_timestamp(stored_f.type):
+            wants_ts = pa.types.is_string(t) or pa.types.is_timestamp(t)
+            if not wants_ts:
+                return None  # non-string under a ts column: slow path
+        if wants_ts and pa.types.is_string(t):
+            parsed = None
+            try:
+                # tz-suffixed strings -> UTC -> naive, matching
+                # parse_rfc3339().replace(tzinfo=None)
+                parsed = pc.cast(
+                    pc.cast(col, pa.timestamp("ms", tz="UTC")), pa.timestamp("ms")
+                )
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                try:
+                    # zone-less naive ISO strings cast directly
+                    parsed = pc.cast(col, pa.timestamp("ms"))
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    parsed = None
+            if parsed is not None:
+                col = parsed
+                target = pa.timestamp("ms")
+            else:
+                # Arrow couldn't parse every value (partial parses, mixed
+                # zones, sub-ms precision, or plain non-time strings): the
+                # slow path decides per value — never silently commit a
+                # string column where it would infer timestamp
+                return None
+        # stored-schema overrides + column-level compatibility
+        if stored_f is not None and not pa.types.is_timestamp(stored_f.type):
+            st = stored_f.type
+            if pa.types.is_string(st):
+                if not (pa.types.is_string(target)):
+                    return None  # e.g. numbers under a stored string column
+            elif pa.types.is_floating(st):
+                if not pa.types.is_floating(target):
+                    return None
+            elif pa.types.is_integer(st):
+                # V1 widened everything to float64; an int-typed stored
+                # column means V0 data — slow path handles it
+                return None
+            elif pa.types.is_boolean(st):
+                if not pa.types.is_boolean(target):
+                    return None
+            else:
+                return None
+            target = st
+        if col.type != target:
+            try:
+                col = pc.cast(col, target)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                return None
+        out[name] = col
+    names = sorted(out)
+    schema = pa.schema([pa.field(n, out[n].type, nullable=True) for n in names])
+    batch = pa.record_batch([out[n] for n in names], schema=schema)
+    return batch, schema
 
 
 def decode(records: list[dict[str, Any]], schema: pa.Schema) -> pa.RecordBatch:

@@ -17,6 +17,7 @@ from parseable_tpu.event.format import (
     LogSource,
     SchemaVersion,
     decode,
+    prepare_and_decode_fast,
     prepare_event,
 )
 from parseable_tpu.streams import LogStreamMetadata
@@ -64,14 +65,24 @@ class JsonEvent:
                     f"fields {extra} are not part of the static schema for "
                     f"stream {self.stream_name!r}"
                 )
-        prepared = prepare_event(
+        fast = prepare_and_decode_fast(
             self.records,
             metadata.schema or None,
             metadata.schema_version,
             metadata.time_partition,
             metadata.infer_timestamp,
         )
-        batch = decode(prepared.records, prepared.schema)
+        if fast is not None:
+            batch, _schema = fast
+        else:
+            prepared = prepare_event(
+                self.records,
+                metadata.schema or None,
+                metadata.schema_version,
+                metadata.time_partition,
+                metadata.infer_timestamp,
+            )
+            batch = decode(prepared.records, prepared.schema)
         batch = add_parseable_fields(batch, self.p_timestamp, self.custom_fields)
 
         parsed_timestamp = self.p_timestamp

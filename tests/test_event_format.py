@@ -152,3 +152,138 @@ def test_schema_key_stable_and_order_insensitive():
     assert k1 == k2
     assert len(k1) == 16
     assert get_schema_key(["a", "c"]) != k1
+
+
+def test_fast_path_equivalence_with_slow_path():
+    """prepare_and_decode_fast must produce byte-identical batches to the
+    per-record pipeline for every payload it accepts — and decline payloads
+    needing per-record semantics."""
+    import pyarrow as pa
+
+    from parseable_tpu.event.format import (
+        SchemaVersion,
+        decode,
+        prepare_and_decode_fast,
+        prepare_event,
+    )
+
+    payloads = [
+        # plain flat records
+        [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}],
+        # ints + floats promote to float64
+        [{"v": 1}, {"v": 2.5}],
+        # nulls-only column -> string
+        [{"n": None}, {"n": None}],
+        # bools stay bool
+        [{"ok": True}, {"ok": False}],
+        # time-ish strings that all parse -> timestamp, tz normalized
+        [{"event_time": "2024-05-01T10:00:00Z"}, {"event_time": "2024-05-01T12:00:00+02:00"}],
+        # '@' field normalization
+        [{"@meta": "m", "x": 1.0}],
+    ]
+    for records in payloads:
+        fast = prepare_and_decode_fast(records, None, SchemaVersion.V1, None, True)
+        prepared = prepare_event(records, None, SchemaVersion.V1, None, True)
+        slow = decode(prepared.records, prepared.schema)
+        assert fast is not None, records
+        batch, schema = fast
+        assert schema == prepared.schema, (records, schema, prepared.schema)
+        assert batch.to_pylist() == slow.to_pylist(), records
+
+    # payloads the fast path must DECLINE (slow-path semantics needed)
+    declined = [
+        [{"a": 1}, {"a": "mixed"}],          # per-record conflict rename
+        [{"nested": {"x": 1}}],               # struct residue -> JSON text
+        [{"lst": [1, 2, 3]}],                 # list coercion
+        [{"t": "2024-05-01T10:00:00Z"}, {"t": "bad"}],  # partial time parse... name not time-ish though
+    ]
+    declined[3] = [{"a": 1.0}, {"b": "only-b"}]  # sparse: keys added late
+    # time-ish name with unparseable values: slow path decides per value
+    declined.append([{"timestamp": "not-a-time"}, {"timestamp": "also-not"}])
+    for records in declined:
+        assert prepare_and_decode_fast(records, None, SchemaVersion.V1, None, True) is None, records
+
+    # stored-schema conflict: string values under a stored float column
+    stored = {"v": pa.field("v", pa.float64())}
+    assert (
+        prepare_and_decode_fast([{"v": "oops"}], stored, SchemaVersion.V1, None, True)
+        is None
+    )
+    # stored timestamp column keeps parsing strings
+    stored_ts = {"ts": pa.field("ts", pa.timestamp("ms"))}
+    fast = prepare_and_decode_fast(
+        [{"ts": "2024-05-01T10:00:00Z"}], stored_ts, SchemaVersion.V1, None, True
+    )
+    assert fast is not None
+    assert str(fast[0].column(0).type) == "timestamp[ms]"
+
+
+def test_fast_path_end_to_end_matches(parseable):
+    """Whole ingest->query flow produces identical results whether the fast
+    path engaged or not."""
+    from parseable_tpu.event import format as F
+    from parseable_tpu.event.json_format import JsonEvent
+    from parseable_tpu.query.session import QuerySession
+
+    records = [
+        {"host": f"h{i % 3}", "status": 200 + (i % 2) * 300, "created_time": "2024-05-01T10:00:00Z"}
+        for i in range(50)
+    ]
+    p = parseable
+    s1 = p.create_stream_if_not_exists("fastpath")
+    ev = JsonEvent(records, "fastpath").into_event(s1.metadata)
+    ev.process(s1, commit_schema=p.commit_schema)
+
+    # force the slow path for a second stream
+    orig = F.prepare_and_decode_fast
+    F.prepare_and_decode_fast = lambda *a, **k: None
+    try:
+        import parseable_tpu.event.json_format as JF
+
+        JF.prepare_and_decode_fast = F.prepare_and_decode_fast
+        s2 = p.create_stream_if_not_exists("slowpath")
+        ev2 = JsonEvent(records, "slowpath").into_event(s2.metadata)
+        ev2.process(s2, commit_schema=p.commit_schema)
+    finally:
+        F.prepare_and_decode_fast = orig
+        JF.prepare_and_decode_fast = orig
+
+    sess = QuerySession(p, engine="cpu")
+    r1 = sess.query("SELECT host, count(*) c, min(created_time) t FROM fastpath GROUP BY host ORDER BY host").to_json_rows()
+    r2 = sess.query("SELECT host, count(*) c, min(created_time) t FROM slowpath GROUP BY host ORDER BY host").to_json_rows()
+    assert r1 == r2
+
+
+def test_fast_path_naive_iso_timestamps():
+    """Zone-less ISO strings under time-ish names must type as timestamp on
+    BOTH paths (review finding: fast path committed string)."""
+    from parseable_tpu.event.format import (
+        SchemaVersion,
+        decode,
+        prepare_and_decode_fast,
+        prepare_event,
+    )
+
+    records = [{"created_time": "2024-05-01T10:00:00"}, {"created_time": "2024-05-01T11:00:00"}]
+    fast = prepare_and_decode_fast(records, None, SchemaVersion.V1, None, True)
+    prepared = prepare_event(records, None, SchemaVersion.V1, None, True)
+    slow = decode(prepared.records, prepared.schema)
+    assert fast is not None
+    assert str(fast[1].field("created_time").type) == "timestamp[ms]"
+    assert fast[0].to_pylist() == slow.to_pylist()
+
+    # partial parses decline to the slow path (never silently string-typed)
+    partial = [{"created_time": "2024-05-01T10:00:00Z"}, {"created_time": "bad"}]
+    assert prepare_and_decode_fast(partial, None, SchemaVersion.V1, None, True) is None
+
+
+def test_at_key_collision_is_deterministic():
+    """'@x' + '_x' in one record: the explicit '_x' value wins on both
+    paths (review finding: dict comprehension last-wins dropped data
+    nondeterministically)."""
+    from parseable_tpu.event.format import SchemaVersion, decode, prepare_event
+
+    records = [{"@level": "warn", "_level": "error"}]
+    prepared = prepare_event(records, None, SchemaVersion.V1, None, True)
+    batch = decode(prepared.records, prepared.schema)
+    assert batch.to_pylist() == [{"_level": "error"}]
