@@ -439,52 +439,69 @@ def alert_tick(state) -> None:
         except Exception as e:
             logger.warning("alert %s evaluation failed: %s", alert_id, e)
             continue
-        record = _update_state_machine(prev, outcome, rfc3339_now())
-        record["notify_count"] = prev.get("notify_count", {})
-        record["last_notified"] = prev.get("last_notified", {})
+        record_outcome(p, config, outcome, prev=prev, now=now)
 
-        transitioned = prev.get("state") != outcome.state
-        if transitioned:
-            ALERTS_STATES.labels(config.get("title", alert_id), outcome.state).inc()
-            logger.info("%s", outcome.message)
-            ALERT_EVENTS.publish(
-                {
-                    "id": alert_id,
-                    "title": config.get("title"),
-                    "state": outcome.state,
-                    "actual": outcome.actual,
-                    "message": outcome.message,
-                    "at": record["last_eval"],
-                }
-            )
-        to_fire = []
-        for target_id in config.get("targets", []):
-            target = p.metastore.get_document("targets", target_id)
-            if not target:
-                continue
-            fire = transitioned or (
-                outcome.state == "triggered" and _should_repeat(target, record, now)
-            )
-            if not fire:
-                continue
-            if transitioned:
-                record["notify_count"][str(target_id)] = 0
-            to_fire.append((target_id, target))
-        # deliveries run concurrently with a hard per-alert wall budget —
-        # one blackholed endpoint must not stall the whole eval loop;
-        # undelivered targets simply retry on the next repeat/transition
-        if to_fire:
-            futures = {
-                tid: _DELIVERY_POOL.submit(_deliver, target, config, outcome)
-                for tid, target in to_fire
+
+def record_outcome(
+    p, config: dict, outcome: AlertOutcome, prev: dict | None = None, now: datetime | None = None
+) -> dict:
+    """Apply an evaluation outcome: state machine + metrics + SSE +
+    target notifications + persisted alert_state. Shared by the scheduled
+    tick and the manual PUT /alerts/{id}/evaluate_alert endpoint, so a
+    manual evaluation is a REAL evaluation, not a dry run."""
+    from parseable_tpu.utils.metrics import ALERTS_STATES
+
+    alert_id = config.get("id", "unknown")
+    now = now or datetime.now(UTC)
+    if prev is None:
+        prev = p.metastore.get_document("alert_state", alert_id) or {}
+    record = _update_state_machine(prev, outcome, rfc3339_now())
+    record["notify_count"] = prev.get("notify_count", {})
+    record["last_notified"] = prev.get("last_notified", {})
+
+    transitioned = prev.get("state") != outcome.state
+    if transitioned:
+        ALERTS_STATES.labels(config.get("title", alert_id), outcome.state).inc()
+        logger.info("%s", outcome.message)
+        ALERT_EVENTS.publish(
+            {
+                "id": alert_id,
+                "title": config.get("title"),
+                "state": outcome.state,
+                "actual": outcome.actual,
+                "message": outcome.message,
+                "at": record["last_eval"],
             }
-            import concurrent.futures as _cf
+        )
+    to_fire = []
+    for target_id in config.get("targets", []):
+        target = p.metastore.get_document("targets", target_id)
+        if not target:
+            continue
+        fire = transitioned or (
+            outcome.state == "triggered" and _should_repeat(target, record, now)
+        )
+        if not fire:
+            continue
+        if transitioned:
+            record["notify_count"][str(target_id)] = 0
+        to_fire.append((target_id, target))
+    # deliveries run concurrently with a hard per-alert wall budget —
+    # one blackholed endpoint must not stall the whole eval loop;
+    # undelivered targets simply retry on the next repeat/transition
+    if to_fire:
+        futures = {
+            tid: _DELIVERY_POOL.submit(_deliver, target, config, outcome)
+            for tid, target in to_fire
+        }
+        import concurrent.futures as _cf
 
-            done, _ = _cf.wait(futures.values(), timeout=DELIVERY_WALL_BUDGET)
-            for tid, fut in futures.items():
-                if fut in done and fut.result():
-                    record["notify_count"][str(tid)] = (
-                        record["notify_count"].get(str(tid), 0) + 1
-                    )
-                    record["last_notified"][str(tid)] = rfc3339_now()
-        p.metastore.put_document("alert_state", alert_id, record)
+        done, _ = _cf.wait(futures.values(), timeout=DELIVERY_WALL_BUDGET)
+        for tid, fut in futures.items():
+            if fut in done and fut.result():
+                record["notify_count"][str(tid)] = (
+                    record["notify_count"].get(str(tid), 0) + 1
+                )
+                record["last_notified"][str(tid)] = rfc3339_now()
+    p.metastore.put_document("alert_state", alert_id, record)
+    return record

@@ -432,6 +432,11 @@ def prepare_and_decode_fast(
         elif pa.types.is_boolean(t):
             target = pa.bool_()
         elif pa.types.is_integer(t) or pa.types.is_floating(t):
+            # pyarrow treats Python bool as numeric: a bool mixed into a
+            # numeric column would silently become 1.0/0.0 here, while the
+            # slow path types the column string — decline instead
+            if any(isinstance(rec.get(raw_name), bool) for rec in records):
+                return None
             target = pa.float64()
         elif pa.types.is_string(t) or pa.types.is_large_string(t):
             target = pa.string()

@@ -1102,6 +1102,136 @@ async def internal_staging(request: web.Request) -> web.Response:
     return web.Response(body=data, content_type="application/vnd.apache.arrow.stream")
 
 
+async def logout(request: web.Request) -> web.Response:
+    """GET /api/v1/logout — invalidate the presented session."""
+    state: ServerState = request.app["state"]
+    token = None
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        token = auth[7:]
+    elif "session" in request.cookies:
+        token = request.cookies["session"]
+    if token:
+        state.rbac.sessions.pop(token, None)
+    resp = web.json_response({"message": "logged out"})
+    resp.del_cookie("session")
+    return resp
+
+
+@require(Action.CREATE_STREAM)
+async def schema_detect(request: web.Request) -> web.Response:
+    """POST /api/v1/logstream/schema/detect — infer the Arrow schema a
+    payload would produce, without creating anything (reference:
+    logstream.rs detect_schema)."""
+    from parseable_tpu.event.format import SchemaVersion, infer_json_schema
+    from parseable_tpu.server.ingest_utils import flatten_json_records
+
+    state: ServerState = request.app["state"]
+    try:
+        payload = await request.json()
+    except json.JSONDecodeError as e:
+        return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+    records = payload if isinstance(payload, list) else [payload]
+    if not all(isinstance(r, dict) for r in records):
+        return web.json_response({"error": "expected JSON object(s)"}, status=400)
+    try:
+        # the same depth-guarded pipeline ingest runs (shared helper, so
+        # detect and ingest can't diverge on nesting limits)
+        rows = flatten_json_records(
+            records,
+            state.p.options.event_flatten_level,
+            None,
+            None,
+            None,
+            state.p.options.event_max_chunk_age,
+        )
+        schema = infer_json_schema(rows, SchemaVersion.V1, True)
+    except Exception as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(
+        {
+            "fields": [
+                {"name": f.name, "data_type": str(f.type), "nullable": f.nullable}
+                for f in schema
+            ]
+        }
+    )
+
+
+@require(Action.PUT_ALERT)
+async def alert_set_enabled(request: web.Request) -> web.Response:
+    """PUT /api/v1/alerts/{id}/{enable|disable} (reference: alert enable/
+    disable routes)."""
+    state: ServerState = request.app["state"]
+    alert_id = request.match_info["id"]
+    action = request.match_info["action"]
+    doc = state.p.metastore.get_document("alerts", alert_id)
+    if doc is None:
+        return web.json_response({"error": "unknown alert"}, status=404)
+    doc["state"] = "disabled" if action == "disable" else "enabled"
+    state.p.metastore.put_document("alerts", alert_id, doc)
+    return web.json_response({"message": f"alert {action}d"})
+
+
+@require(Action.PUT_ALERT)
+async def alert_evaluate_now(request: web.Request) -> web.Response:
+    """PUT /api/v1/alerts/{id}/evaluate_alert — run one evaluation
+    immediately (reference: evaluate_alert route)."""
+    from parseable_tpu.alerts import evaluate_alert, record_outcome
+
+    state: ServerState = request.app["state"]
+    alert_id = request.match_info["id"]
+    doc = state.p.metastore.get_document("alerts", alert_id)
+    if doc is None:
+        return web.json_response({"error": "unknown alert"}, status=404)
+
+    def work():
+        outcome = evaluate_alert(state.p, doc)
+        # a manual evaluation is a real one: state machine, MTTR, SSE,
+        # and target notifications all apply (review finding)
+        record_outcome(state.p, doc, outcome)
+        return outcome
+
+    try:
+        outcome = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    except Exception as e:
+        return web.json_response({"error": f"evaluation failed: {e}"}, status=400)
+    return web.json_response(
+        {"id": alert_id, "state": outcome.state, "actual": outcome.actual, "message": outcome.message}
+    )
+
+
+@require(Action.GET_DASHBOARD)
+async def dashboards_list_tags(request: web.Request) -> web.Response:
+    """GET /api/v1/dashboards/list_tags (reference: users/dashboards.rs)."""
+    state: ServerState = request.app["state"]
+    tags: set[str] = set()
+    for doc in state.p.metastore.list_documents("dashboards"):
+        for tag in doc.get("tags") or []:
+            tags.add(str(tag))
+    return web.json_response(sorted(tags))
+
+
+@require(Action.CREATE_DASHBOARD)
+async def dashboard_add_tile(request: web.Request) -> web.Response:
+    """PUT /api/v1/dashboards/{id}/add_tile (reference: add_tile route)."""
+    state: ServerState = request.app["state"]
+    dash_id = request.match_info["id"]
+    doc = state.p.metastore.get_document("dashboards", dash_id)
+    if doc is None:
+        return web.json_response({"error": "unknown dashboard"}, status=404)
+    try:
+        tile = await request.json()
+    except json.JSONDecodeError as e:
+        return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+    if not isinstance(tile, dict) or not tile.get("title"):
+        return web.json_response({"error": "tile needs a title"}, status=400)
+    doc.setdefault("tiles", []).append(tile)
+    doc["modified"] = rfc3339_now()
+    state.p.metastore.put_document("dashboards", dash_id, doc)
+    return web.json_response(doc)
+
+
 @require(Action.GET_ALERT)
 async def alert_state_handler(request: web.Request) -> web.Response:
     """GET /api/v1/alerts/{id}/state — current state incl. MTTR fields."""
@@ -1409,10 +1539,16 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/role", list_roles)
     r.add_delete("/api/v1/role/{name}", delete_role)
 
-    # alert-state SSE + state reads must register before the generic
-    # /alerts/{id} routes (aiohttp matches in registration order)
+    # alert-state SSE + sub-resource routes must register before the
+    # generic /alerts/{id} routes (aiohttp matches in registration order)
     r.add_get("/api/v1/alerts/sse", alerts_sse)
     r.add_get("/api/v1/alerts/{id}/state", alert_state_handler)
+    r.add_put("/api/v1/alerts/{id}/{action:(enable|disable)}", alert_set_enabled)
+    r.add_put("/api/v1/alerts/{id}/evaluate_alert", alert_evaluate_now)
+    r.add_get("/api/v1/dashboards/list_tags", dashboards_list_tags)
+    r.add_put("/api/v1/dashboards/{id}/add_tile", dashboard_add_tile)
+    r.add_get("/api/v1/logout", logout)
+    r.add_post("/api/v1/logstream/schema/detect", schema_detect)
 
     # alerts / targets / dashboards / filters / correlations
     for coll, base, acts in (

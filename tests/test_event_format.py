@@ -287,3 +287,20 @@ def test_at_key_collision_is_deterministic():
     prepared = prepare_event(records, None, SchemaVersion.V1, None, True)
     batch = decode(prepared.records, prepared.schema)
     assert batch.to_pylist() == [{"_level": "error"}]
+
+
+def test_fast_path_declines_bool_in_numeric_column():
+    """[2.5, true] in one column: slow path types string; the fast path
+    must decline, never commit true -> 1.0 (fuzz-confirmed divergence)."""
+    from parseable_tpu.event.format import (
+        SchemaVersion,
+        decode,
+        prepare_and_decode_fast,
+        prepare_event,
+    )
+
+    records = [{"flag": 2.5}, {"flag": True}]
+    assert prepare_and_decode_fast(records, None, SchemaVersion.V1, None, True) is None
+    prepared = prepare_event(records, None, SchemaVersion.V1, None, True)
+    slow = decode(prepared.records, prepared.schema)
+    assert str(slow.field("flag").type) == "string"

@@ -530,3 +530,74 @@ def test_ui_spa_fallback_and_missing_index(tmp_path):
         assert r.status == 401
 
     run(with_client(state2, fn2))
+
+
+def test_logout_schema_detect_alert_controls(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        # login -> token works -> logout -> token dead
+        r = await client.get("/api/v1/login", headers=AUTH)
+        token = (await r.json())["token"]
+        bearer = {"Authorization": f"Bearer {token}"}
+        assert (await client.get("/api/v1/logstream", headers=bearer)).status == 200
+        assert (await client.get("/api/v1/logout", headers=bearer)).status == 200
+        assert (await client.get("/api/v1/logstream", headers=bearer)).status == 401
+
+        # schema detect: nested payload -> flattened inferred fields
+        r = await client.post(
+            "/api/v1/logstream/schema/detect",
+            json=[{"a": 1, "nested": {"b": "x"}, "event_time": "2024-05-01T10:00:00Z"}],
+            headers=AUTH,
+        )
+        assert r.status == 200, await r.text()
+        fields = {f["name"]: f["data_type"] for f in (await r.json())["fields"]}
+        assert fields["a"] == "double"
+        assert fields["nested_b"] == "string"
+        assert fields["event_time"].startswith("timestamp")
+
+        # alert enable/disable + manual evaluation
+        await client.post(
+            "/api/v1/ingest", json=[{"status": 500}] * 5, headers={**AUTH, "X-P-Stream": "ev"}
+        )
+        alert = {
+            "id": "al1",
+            "title": "manual",
+            "stream": "ev",
+            "threshold_config": {"agg": "count", "operator": ">", "value": 3},
+        }
+        r = await client.post("/api/v1/alerts", json=alert, headers=AUTH)
+        assert r.status == 200, await r.text()
+        alert_id = (await r.json())["id"]
+        r = await client.put(f"/api/v1/alerts/{alert_id}/evaluate_alert", headers=AUTH)
+        assert r.status == 200, await r.text()
+        assert (await r.json())["state"] == "triggered"
+        # a manual evaluation records real state (MTTR machine ran)
+        r = await client.get(f"/api/v1/alerts/{alert_id}/state", headers=AUTH)
+        st = await r.json()
+        assert st["state"] == "triggered" and st["incidents"] == 1
+        r = await client.put(f"/api/v1/alerts/{alert_id}/disable", headers=AUTH)
+        assert r.status == 200
+        doc = state.p.metastore.get_document("alerts", alert_id)
+        assert doc["state"] == "disabled"
+        r = await client.put(f"/api/v1/alerts/{alert_id}/enable", headers=AUTH)
+        assert (await r.json())["message"] == "alert enabled"
+
+        # dashboards: add_tile + list_tags
+        r = await client.post(
+            "/api/v1/dashboards",
+            json={"title": "ops", "tags": ["prod", "web"]},
+            headers=AUTH,
+        )
+        dash_id = (await r.json())["id"]
+        r = await client.put(
+            f"/api/v1/dashboards/{dash_id}/add_tile",
+            json={"title": "errors", "query": "select count(*) from ev"},
+            headers=AUTH,
+        )
+        assert r.status == 200
+        assert len((await r.json())["tiles"]) == 1
+        r = await client.get("/api/v1/dashboards/list_tags", headers=AUTH)
+        assert await r.json() == ["prod", "web"]
+
+    run(with_client(state, fn))
