@@ -442,6 +442,69 @@ def alert_tick(state) -> None:
         record_outcome(p, config, outcome, prev=prev, now=now)
 
 
+def is_muted(config: dict, now: datetime | None = None) -> bool:
+    """Notification state (reference: NotificationState alert_structs.rs):
+    "notify" (default) delivers; "indefinite" mutes until changed; an
+    RFC3339 value mutes until that instant."""
+    state = config.get("notification_state", "notify")
+    if state in ("notify", "", None):
+        return False
+    if state == "indefinite":
+        return True
+    from parseable_tpu.utils.timeutil import parse_rfc3339
+
+    try:
+        until = parse_rfc3339(str(state))
+    except ValueError:
+        return False
+    return (now or datetime.now(UTC)) < until
+
+
+def check_outbound_policy(p, endpoint: str, policy: dict | None = None) -> str | None:
+    """None = allowed; else a denial reason (reference:
+    outbound_http_policy.rs — domain/CIDR allow/deny lists guard where
+    alert notifications may POST). Pass `policy` to skip the metastore
+    fetch (record_outcome loads it once per evaluation)."""
+    import ipaddress
+    import socket
+    from urllib.parse import urlparse
+
+    if policy is None:
+        policy = p.metastore.get_document("policies", "outbound_policy")
+    if not policy:
+        return None
+    host = urlparse(endpoint).hostname or ""
+    denied = [d.lower() for d in policy.get("denied_domains") or []]
+    allowed = [d.lower() for d in policy.get("allowed_domains") or []]
+    lhost = host.lower()
+    if any(lhost == d or lhost.endswith("." + d) for d in denied):
+        return f"target domain {host!r} is denied by outbound policy"
+    cidrs = []
+    for cidr in policy.get("denied_cidrs") or []:
+        try:
+            cidrs.append(ipaddress.ip_network(cidr, strict=False))
+        except ValueError:
+            continue
+    if cidrs:
+        # resolve hostnames too — "localhost" or decimal forms must not
+        # bypass a CIDR deny (fail CLOSED on resolution failure: delivery
+        # would fail anyway, and an unresolvable name can't be vetted)
+        try:
+            addrs = [
+                ipaddress.ip_address(info[4][0])
+                for info in socket.getaddrinfo(host, None)
+            ]
+        except (socket.gaierror, ValueError, OSError):
+            return f"target host {host!r} could not be resolved for outbound policy checks"
+        for addr in addrs:
+            for net in cidrs:
+                if addr.version == net.version and addr in net:
+                    return f"target address {addr} is denied by outbound policy"
+    if allowed and not any(lhost == d or lhost.endswith("." + d) for d in allowed):
+        return f"target domain {host!r} is not in the outbound allowlist"
+    return None
+
+
 def record_outcome(
     p, config: dict, outcome: AlertOutcome, prev: dict | None = None, now: datetime | None = None
 ) -> dict:
@@ -474,6 +537,12 @@ def record_outcome(
             }
         )
     to_fire = []
+    muted = is_muted(config, now)
+    outbound_policy = (
+        p.metastore.get_document("policies", "outbound_policy")
+        if config.get("targets")
+        else None
+    )
     for target_id in config.get("targets", []):
         target = p.metastore.get_document("targets", target_id)
         if not target:
@@ -485,6 +554,16 @@ def record_outcome(
             continue
         if transitioned:
             record["notify_count"][str(target_id)] = 0
+        if muted:
+            logger.info("alert %s is muted; skipping notification", alert_id)
+            continue
+        if outbound_policy:
+            denial = check_outbound_policy(
+                p, target.get("endpoint", ""), policy=outbound_policy
+            )
+            if denial:
+                logger.warning("target %s blocked: %s", target.get("id"), denial)
+                continue
         to_fire.append((target_id, target))
     # deliveries run concurrently with a hard per-alert wall budget —
     # one blackholed endpoint must not stall the whole eval loop;

@@ -278,6 +278,7 @@ class ObjectStoreMetastore(Metastore):
         "users": f"{USERS_ROOT_DIR}/users",
         "llmconfigs": ".llmconfigs",
         "hottier": SETTINGS_ROOT_DIRECTORY,
+        "policies": ".policies",
         "chats": ".chats",
         "tenants": ".tenants",
     }

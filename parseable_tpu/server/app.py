@@ -1201,6 +1201,68 @@ async def alert_evaluate_now(request: web.Request) -> web.Response:
     )
 
 
+@require(Action.PUT_ALERT)
+async def alert_update_notification_state(request: web.Request) -> web.Response:
+    """PUT /api/v1/alerts/{id}/update_notification_state
+    {"state": "notify" | "indefinite" | "<rfc3339 until>"} (reference:
+    NotificationState — mute/snooze alert notifications)."""
+    state: ServerState = request.app["state"]
+    alert_id = request.match_info["id"]
+    doc = state.p.metastore.get_document("alerts", alert_id)
+    if doc is None:
+        return web.json_response({"error": "unknown alert"}, status=404)
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+    new_state = str(body.get("state", "notify"))
+    if new_state not in ("notify", "indefinite"):
+        from parseable_tpu.utils.timeutil import parse_rfc3339
+
+        try:
+            parse_rfc3339(new_state)
+        except (TimeParseError, ValueError):
+            return web.json_response(
+                {"error": "state must be notify, indefinite, or an RFC3339 instant"},
+                status=400,
+            )
+    doc["notification_state"] = new_state
+    state.p.metastore.put_document("alerts", alert_id, doc)
+    return web.json_response({"message": "notification state updated", "state": new_state})
+
+
+@require(Action.PUT_ALERT)
+async def put_outbound_policy(request: web.Request) -> web.Response:
+    """PUT /api/v1/alert-target-policy — domain/CIDR allow/deny lists for
+    where notifications may POST (reference: outbound_http_policy.rs)."""
+    state: ServerState = request.app["state"]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError as e:
+        return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+    import ipaddress
+
+    for cidr in body.get("denied_cidrs") or []:
+        try:
+            ipaddress.ip_network(cidr, strict=False)
+        except ValueError:
+            return web.json_response({"error": f"invalid CIDR {cidr!r}"}, status=400)
+    policy = {
+        "allowed_domains": [str(d) for d in body.get("allowed_domains") or []],
+        "denied_domains": [str(d) for d in body.get("denied_domains") or []],
+        "denied_cidrs": [str(c) for c in body.get("denied_cidrs") or []],
+    }
+    state.p.metastore.put_document("policies", "outbound_policy", policy)
+    return web.json_response(policy)
+
+
+@require(Action.GET_ALERT)
+async def get_outbound_policy(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    policy = state.p.metastore.get_document("policies", "outbound_policy") or {}
+    return web.json_response(policy)
+
+
 @require(Action.GET_DASHBOARD)
 async def dashboards_list_tags(request: web.Request) -> web.Response:
     """GET /api/v1/dashboards/list_tags (reference: users/dashboards.rs)."""
@@ -1545,6 +1607,9 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/alerts/{id}/state", alert_state_handler)
     r.add_put("/api/v1/alerts/{id}/{action:(enable|disable)}", alert_set_enabled)
     r.add_put("/api/v1/alerts/{id}/evaluate_alert", alert_evaluate_now)
+    r.add_put("/api/v1/alerts/{id}/update_notification_state", alert_update_notification_state)
+    r.add_put("/api/v1/alert-target-policy", put_outbound_policy)
+    r.add_get("/api/v1/alert-target-policy", get_outbound_policy)
     r.add_get("/api/v1/dashboards/list_tags", dashboards_list_tags)
     r.add_put("/api/v1/dashboards/{id}/add_tile", dashboard_add_tile)
     r.add_get("/api/v1/logout", logout)

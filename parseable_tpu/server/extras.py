@@ -305,9 +305,55 @@ async def prism_logstream(request: web.Request) -> web.Response:
     return web.json_response(out)
 
 
+async def prism_datasets(request: web.Request) -> web.Response:
+    """POST /api/v1/prism/datasets {"names": [...]} — per-dataset bundles
+    in one call (reference: prism dataset routes). Unauthorized or unknown
+    names are skipped, not errors (the UI renders what it may see)."""
+    import asyncio
+
+    state = request.app["state"]
+    _require(state, request, Action.LIST_STREAM)
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    names = body.get("names") or []
+    allowed = state.rbac.user_allowed_streams(request["username"])
+
+    def work():
+        out = []
+        for name in names:
+            if allowed is not None and name not in allowed:
+                continue
+            stream = state.p.streams.get(name)
+            if stream is None:
+                continue
+            m = stream.metadata
+            events = storage = 0
+            for fmt in state.p.metastore.get_all_stream_jsons(name):
+                events += fmt.stats.events
+                storage += fmt.stats.storage
+            out.append(
+                {
+                    "title": name,
+                    "telemetry_type": m.telemetry_type,
+                    "stream_type": m.stream_type,
+                    "events": events,
+                    "storage_bytes": storage,
+                    "retention": m.retention or [],
+                }
+            )
+        return out
+
+    return web.json_response(
+        await asyncio.get_running_loop().run_in_executor(state.workers, work)
+    )
+
+
 def register(router) -> None:
     router.add_post("/api/v1/demodata", demo_data)
     router.add_post("/api/v1/queryContext", query_context)
     router.add_get("/api/v1/prism/home", prism_home)
     router.add_get("/api/v1/prism/home/search", prism_home_search)
     router.add_get("/api/v1/prism/logstream/{name}", prism_logstream)
+    router.add_post("/api/v1/prism/datasets", prism_datasets)

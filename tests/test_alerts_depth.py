@@ -244,3 +244,57 @@ def test_like_escape_quotes_and_tpu_regex_parity():
     rx = re.compile(_like_to_regex(r"%100\%%"))
     assert rx.search("a 100% b")
     assert not rx.search("a 100x b")
+
+
+def test_notification_mute_and_outbound_policy(receiver, tmp_path):
+    """Muted alerts evaluate but never notify; the outbound policy blocks
+    disallowed endpoints (reference: NotificationState +
+    outbound_http_policy.rs)."""
+    url, handler = receiver
+    from parseable_tpu.alerts import (
+        AlertOutcome,
+        check_outbound_policy,
+        is_muted,
+        record_outcome,
+    )
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    p.metastore.put_document("targets", "hook", {"id": "hook", "type": "webhook", "endpoint": url})
+    config = {
+        "id": "m1", "title": "muted", "stream": "s",
+        "threshold_config": {"agg": "count", "operator": ">", "value": 0},
+        "targets": ["hook"],
+        "notification_state": "indefinite",
+    }
+    assert is_muted(config)
+    outcome = AlertOutcome("m1", "triggered", 9.0, "boom")
+    rec = record_outcome(p, config, outcome)
+    assert rec["state"] == "triggered"  # state machine still ran
+    assert not handler.received  # but nothing delivered
+
+    # future-until mute expires
+    config["notification_state"] = "2000-01-01T00:00:00Z"  # past -> not muted
+    assert not is_muted(config)
+
+    # outbound policy: deny the mock receiver's address space
+    p.metastore.put_document(
+        "policies", "outbound_policy", {"denied_cidrs": ["127.0.0.0/8"]}
+    )
+    assert check_outbound_policy(p, url) is not None
+    config["notification_state"] = "notify"
+    config["id"] = "m2"
+    record_outcome(p, config, AlertOutcome("m2", "triggered", 9.0, "boom"))
+    assert not handler.received  # policy blocked it
+
+    # allowlist pass-through
+    p.metastore.put_document(
+        "policies", "outbound_policy", {"allowed_domains": ["127.0.0.1"]}
+    )
+    assert check_outbound_policy(p, url) is None
+    config["id"] = "m3"
+    record_outcome(p, config, AlertOutcome("m3", "triggered", 9.0, "boom"))
+    assert handler.received  # delivered now

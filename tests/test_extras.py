@@ -76,6 +76,15 @@ def test_demo_data_and_prism(tmp_path):
         results = await r.json()
         assert any(x["title"] == "demodata" for x in results)
 
+        # bulk datasets bundle
+        r = await client.post(
+            "/api/v1/prism/datasets", json={"names": ["demodata", "nope"]}, headers=AUTH
+        )
+        assert r.status == 200
+        ds_bulk = await r.json()
+        assert len(ds_bulk) == 1 and ds_bulk[0]["title"] == "demodata"
+        assert ds_bulk[0]["events"] == 200
+
         # per-stream bundle
         r = await client.get("/api/v1/prism/logstream/demodata", headers=AUTH)
         bundle = await r.json()
