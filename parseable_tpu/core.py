@@ -18,7 +18,6 @@ import logging
 import re
 import threading
 from datetime import UTC, datetime, timedelta
-from pathlib import Path
 
 import pyarrow as pa
 

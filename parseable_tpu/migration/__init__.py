@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import logging
-from typing import Any
 
 from parseable_tpu.storage import (
     CURRENT_OBJECT_STORE_VERSION,

@@ -17,7 +17,7 @@ turns pyarrow columns into device-friendly ndarrays:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from datetime import UTC, datetime
 from typing import Any
 
