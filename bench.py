@@ -242,9 +242,51 @@ print(json.dumps({"ok": True, "rows_per_sec": n / dt, "devices": 8}))
             print(out.stderr[-2000:], file=sys.stderr)
 
 
+def tpu_available(timeout_secs: float = 90.0) -> bool:
+    """Probe the device with a timeout: a wedged tunnel must produce a
+    recorded result, not a killed silent bench."""
+    import threading
+
+    result: list = []
+
+    def probe():
+        try:
+            import jax
+
+            devs = jax.devices()
+            import jax.numpy as jnp
+
+            jnp.ones(8).sum().block_until_ready()
+            result.append(devs)
+        except Exception as e:  # noqa: BLE001
+            result.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_secs)
+    if not result or isinstance(result[0], Exception):
+        print(f"# TPU probe failed: {result[0] if result else 'timeout'}", file=sys.stderr)
+        return False
+    print(f"# devices: {result[0]}", file=sys.stderr)
+    return True
+
+
 def main() -> None:
     total_rows = int(os.environ.get("BENCH_ROWS", "32000000"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+
+    if not tpu_available():
+        # the virtual-mesh distributed config runs without the chip: emit
+        # the unreachable marker first, then the one real measurable
+        # number last (the driver records the final line)
+        emit(
+            "tpu_unreachable",
+            0.0,
+            0.0,
+            {"note": "device probe timed out (tunnel down); TPU configs skipped"},
+        )
+        bench_distributed_subprocess(total_rows)
+        return
 
     workdir = tempfile.mkdtemp(prefix="ptpu-bench-")
     try:
@@ -259,10 +301,6 @@ def main() -> None:
         t0 = time.perf_counter()
         build_dataset(p, "bench", total_rows)
         print(f"# dataset: {total_rows} rows built+cataloged in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
-
-        import jax
-
-        print(f"# devices: {jax.devices()}", file=sys.stderr)
 
         # measure + EMIT each config as it completes (a killed run still
         # records whatever finished); the north-star config runs last so
