@@ -356,3 +356,45 @@ def test_legacy_prefix_listing_fallback(parseable):
         end_time="2024-05-01T11:00:00Z",
     )
     assert r.to_json_rows() == [{"c": 20, "s": 190.0}]
+
+
+def test_schema_evolution_across_files(parseable):
+    """SURVEY hard-part: type widening + conflict renames must keep queries
+    working over MIXED files written before/after the schema evolved."""
+    from parseable_tpu.event.json_format import JsonEvent
+
+    p = parseable
+    s = p.create_stream_if_not_exists("evolve")
+    # epoch 1: status is numeric
+    ev = JsonEvent([{"status": 200, "msg": "ok"}] * 10, "evolve").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    # epoch 2: a client sends status as a STRING -> conflict rename
+    ev = JsonEvent([{"status": "timeout", "msg": "bad"}] * 5, "evolve").into_event(
+        s.metadata
+    )
+    ev.process(s, commit_schema=p.commit_schema)
+    # epoch 3: numeric again, plus a brand-new column (widening union)
+    ev = JsonEvent([{"status": 500, "msg": "err", "retry": 1}] * 3, "evolve").into_event(
+        s.metadata
+    )
+    ev.process(s, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    for engine in ("cpu", "tpu"):
+        sess = QuerySession(p, engine=engine)
+        rows = sess.query(
+            "SELECT status, count(*) c FROM evolve GROUP BY status ORDER BY status"
+        ).to_json_rows()
+        # string-typed conflicts live in status_str; numeric rows grouped
+        assert {r["status"]: r["c"] for r in rows} == {200.0: 10, 500.0: 3, None: 5}
+        renamed = sess.query(
+            "SELECT count(status_str) c FROM evolve WHERE status_str = 'timeout'"
+        ).to_json_rows()
+        assert renamed[0]["c"] == 5
+        # new column is NULL for old files, present for new
+        retry = sess.query("SELECT count(retry) c FROM evolve").to_json_rows()
+        assert retry[0]["c"] == 3
