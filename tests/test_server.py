@@ -601,3 +601,53 @@ def test_logout_schema_detect_alert_controls(tmp_path):
         assert await r.json() == ["prod", "web"]
 
     run(with_client(state, fn))
+
+
+def test_kinesis_firehose_ingest(tmp_path):
+    """Kinesis Firehose payloads decode base64 records and enrich with
+    requestId/timestamp (reference: handlers/http/kinesis.rs)."""
+    import base64 as b64
+
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        payload = {
+            "requestId": "req-1",
+            "timestamp": 1714557600000,
+            "records": [
+                {"data": b64.b64encode(json.dumps({"level": "info", "n": 1}).encode()).decode()},
+                {"data": b64.b64encode(json.dumps({"level": "error", "n": 2}).encode()).decode()},
+                {"data": b64.b64encode(b'"bare string"').decode()},
+            ],
+        }
+        r = await client.post(
+            "/api/v1/ingest",
+            json=payload,
+            headers={**AUTH, "X-P-Stream": "kin", "X-P-Log-Source": "kinesis"},
+        )
+        assert r.status == 200, await r.text()
+        r = await client.post(
+            "/api/v1/query",
+            json={
+                "query": "SELECT level, requestId, message FROM kin ORDER BY n",
+                "startTime": "1h",
+                "endTime": "now",
+            },
+            headers=AUTH,
+        )
+        rows = await r.json()
+        assert len(rows) == 3
+        by_level = {r.get("level"): r for r in rows}
+        assert by_level["info"]["requestId"] == "req-1"
+        assert by_level["error"]["n"] == 2
+        assert by_level[None]["message"] == "bare string"
+
+        # malformed base64 -> clean 400
+        r = await client.post(
+            "/api/v1/ingest",
+            json={"records": [{"data": "!!!notb64"}]},
+            headers={**AUTH, "X-P-Stream": "kin", "X-P-Log-Source": "kinesis"},
+        )
+        assert r.status == 400
+
+    run(with_client(state, fn))
