@@ -629,7 +629,7 @@ def test_kinesis_firehose_ingest(tmp_path):
         r = await client.post(
             "/api/v1/query",
             json={
-                "query": "SELECT level, requestId, message FROM kin ORDER BY n",
+                "query": "SELECT level, requestId, message, n FROM kin",
                 "startTime": "1h",
                 "endTime": "now",
             },
