@@ -68,7 +68,12 @@ def tokenize(sql: str) -> list[Token]:
             try:
                 value = int(text)
             except ValueError:
-                value = float(text)
+                try:
+                    value = float(text)
+                except ValueError:
+                    # malformed exponent like "1.5e" must surface as a
+                    # parse error, not an unhandled 500
+                    raise SqlError(f"invalid number literal {text!r} at {i}") from None
             tokens.append(Token("number", value, i))
             i = j
             continue

@@ -93,3 +93,26 @@ def test_aggregate_detection():
     assert S.is_aggregate(q.items[0].expr)
     q2 = parse_sql("SELECT a + 1 FROM t")
     assert not S.is_aggregate(q2.items[0].expr)
+
+
+def test_parser_never_crashes_on_garbage():
+    """Property: arbitrary input raises SqlError (or parses), never an
+    unhandled exception — the parser fronts an HTTP endpoint."""
+    import random
+
+    from parseable_tpu.query.sql import SqlError, parse_sql
+
+    rng = random.Random(7)
+    corpus = [
+        "SELECT", "FROM", "WHERE", "GROUP", "BY", "ORDER", "LIMIT", "JOIN",
+        "ON", "(", ")", ",", "'abc", "''", "*", "count", "1.5e", "@", ".",
+        "p_timestamp", "interval", "'5m'", "CASE", "WHEN", "END", "CAST",
+        "AS", "IN", "BETWEEN", "NOT", "NULL", ";", "--", "\"q", "`t", "%",
+    ]
+    for _ in range(500):
+        n = rng.randint(1, 12)
+        text = " ".join(rng.choice(corpus) for _ in range(n))
+        try:
+            parse_sql(text)
+        except SqlError:
+            pass  # expected for garbage
