@@ -1216,10 +1216,9 @@ class TpuQueryExecutor(QueryExecutor):
             if n_group_shards > 1 and num_groups % n_group_shards == 0 and num_groups >= n_group_shards
             else 1
         )
-        if shard_groups > 1 and layout.distinct_caps:
-            # distinct bitmaps aren't group-sharded yet: idle the groups
-            # axis (replicated fold) rather than losing the device entirely
-            shard_groups = 1
+        # distinct presence bitmaps shard over `groups` too: the flat
+        # groups-major layout (group * Vcap + code) makes each shard's
+        # window contiguous, so P("groups") on the flat dim is exact
         kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
         bounds_s = self._bounds_seconds()
         key = (
@@ -1391,16 +1390,17 @@ class TpuQueryExecutor(QueryExecutor):
             # accumulator: replicated on 1D meshes; its G axis shards over
             # `groups` on the 2D layout (each device owns G/shard buckets)
             acc_spec = P(None, "groups") if shard_groups > 1 else P()
+            dacc_spec = P("groups") if shard_groups > 1 else P()
             in_specs = (
                 acc_spec,
-                tuple(P() for _ in layout.distinct_caps),  # presence bitmaps
+                tuple(dacc_spec for _ in layout.distinct_caps),  # presence bitmaps
                 tuple(dev_spec for _ in range(n_blocks)),
                 tuple(tuple(P() for _ in lut_shapes) for _ in range(n_blocks)),
                 tuple(tuple(P() for _ in range(n_remaps)) for _ in range(n_blocks)),
                 tuple(tuple(P() for _ in range(n_dremaps)) for _ in range(n_blocks)),
                 tuple(P("data") for _ in range(n_blocks)),
             )
-            out_specs = (acc_spec, tuple(P() for _ in layout.distinct_caps))
+            out_specs = (acc_spec, tuple(dacc_spec for _ in layout.distinct_caps))
             prog_body = shard_map(prog_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         else:
             prog_body = prog_fn

@@ -209,16 +209,19 @@ def test_2d_mesh_group_sharded_accumulator(parseable):
     assert_parity(cpu, tpu, sql)
 
 
-def test_2d_mesh_distinct_falls_back_exact(parseable):
-    """count_distinct on a 2D mesh degrades to the idle-groups-axis device
-    fold (distinct bitmaps aren't group-sharded) and stays exact."""
+def test_2d_mesh_distinct_group_sharded(parseable):
+    """count_distinct on the 2D mesh: presence bitmaps shard over the
+    groups axis (flat groups-major windows are contiguous) and stay
+    exact."""
     from parseable_tpu.config import Options
 
     opts = Options()
     opts.mesh_shape = "4x2"
     t = make_table(6000, seed=4)
-    sql = "SELECT status, count(distinct host) d FROM t GROUP BY status"
+    sql = "SELECT status, count(distinct host) d, count(*) c FROM t GROUP BY status"
     lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
     cpu = QueryExecutor(lp1).execute(iter([t])).to_pylist()
+    before_gs = ET.GROUP_SHARDED_PROGRAMS_BUILT
     tpu = ET.TpuQueryExecutor(lp2, opts).execute(iter([t])).to_pylist()
+    assert ET.GROUP_SHARDED_PROGRAMS_BUILT > before_gs, "did not group-shard"
     assert_parity(cpu, tpu, sql)
