@@ -742,9 +742,22 @@ async def stream_stats(request: web.Request) -> web.Response:
         fmts = []
     if not fmts and not state.p.streams.contains(name):
         return web.json_response({"error": f"stream {name} not found"}, status=404)
-    events = sum(f.stats.events for f in fmts)
-    ingestion = sum(f.stats.ingestion for f in fmts)
-    storage = sum(f.stats.storage for f in fmts)
+    date = request.query.get("date")
+    if date:
+        # per-date stats from the day-partitioned manifest items — durable
+        # across restarts, unlike the reference's in-memory per-date
+        # counters (logstream.rs get_stats_date)
+        events = ingestion = storage = 0
+        for fmt in fmts:
+            for item in fmt.snapshot.manifest_list:
+                if item.time_lower_bound.date().isoformat() == date:
+                    events += item.events_ingested
+                    ingestion += item.ingestion_size
+                    storage += item.storage_size
+    else:
+        events = sum(f.stats.events for f in fmts)
+        ingestion = sum(f.stats.ingestion for f in fmts)
+        storage = sum(f.stats.storage for f in fmts)
     return web.json_response(
         {
             "stream": name,

@@ -651,3 +651,39 @@ def test_kinesis_firehose_ingest(tmp_path):
         assert r.status == 400
 
     run(with_client(state, fn))
+
+
+def test_stats_date_param_and_shutdown_drain(tmp_path):
+    """?date= filters stats to a day's manifest items (reference:
+    get_stats_date); ServerState.stop() drains staging to the store."""
+    from datetime import UTC, datetime
+
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/ingest", json=[{"a": i} for i in range(40)],
+            headers={**AUTH, "X-P-Stream": "dated"},
+        )
+        assert r.status == 200
+
+    run(with_client(state, fn))
+    # drain on shutdown: nothing was uploaded yet; stop() must flush
+    state.stop()
+    fmts = state.p.metastore.get_all_stream_jsons("dated")
+    assert sum(f.stats.events for f in fmts) == 40
+
+    # per-date stats: today's partition has the rows; a bogus date has none
+    state2 = make_state(tmp_path / "v2")
+    state2.p = state.p  # same store
+
+    async def fn2(client):
+        today = datetime.now(UTC).date().isoformat()
+        r = await client.get(f"/api/v1/logstream/dated/stats?date={today}", headers=AUTH)
+        assert (await r.json())["ingestion"]["count"] == 40
+        r = await client.get("/api/v1/logstream/dated/stats?date=1999-01-01", headers=AUTH)
+        assert (await r.json())["ingestion"]["count"] == 0
+        r = await client.get("/api/v1/logstream/dated/stats", headers=AUTH)
+        assert (await r.json())["ingestion"]["count"] == 40
+
+    run(with_client(state2, fn2))
