@@ -116,3 +116,17 @@ def test_field_stats_pipeline(parseable):
     batches = pstats.staging_batches()
     rows = sum(b.num_rows for b in batches)
     assert rows >= 2  # one row per field of 'statsy'
+
+    # pstats is queryable like any stream (reference: field_stats.rs —
+    # stats land in an internal stream served by the normal engine)
+    from parseable_tpu.query.session import QuerySession
+
+    res = QuerySession(p, engine="cpu").query(
+        "SELECT field, count, distinct_count FROM pstats "
+        "WHERE stream = 'statsy' ORDER BY field",
+        "1h",
+        "now",
+    )
+    by_field = {r["field"]: r for r in res.to_json_rows()}
+    assert by_field["k"]["count"] == 2
+    assert by_field["k"]["distinct_count"] == 2
