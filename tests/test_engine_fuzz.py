@@ -1,0 +1,101 @@
+"""Differential engine fuzz: random queries, CPU vs TPU must agree.
+
+Every query shape the generator emits is within both engines' contract
+(the TPU engine may fall back internally — that's part of the contract).
+Mismatches are real bugs. The suite runs a bounded number of trials;
+crank FUZZ_TRIALS up for a deep soak.
+"""
+
+import os
+import random
+from datetime import datetime, timedelta
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+TRIALS = int(os.environ.get("FUZZ_TRIALS", "40"))
+BASE = datetime(2024, 5, 1, 10, 0)
+
+
+def make_table(rng: random.Random, n: int) -> pa.Table:
+    np_rng = np.random.default_rng(rng.randrange(1 << 30))
+    ts = [
+        BASE + timedelta(seconds=int(s)) for s in np_rng.integers(0, 7200, n)
+    ]
+    cols = {
+        DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+        "host": pa.array(np_rng.choice([f"h{i}" for i in range(rng.choice([2, 5, 40]))], n).tolist()),
+        "path": pa.array(np_rng.choice([f"/p{i}" for i in range(12)], n).tolist()),
+        "status": pa.array(np_rng.choice([200.0, 301.0, 404.0, 500.0], n)),
+        "lat": pa.array(np_rng.random(n) * 100),
+    }
+    # sprinkle nulls into one column
+    null_mask = np_rng.random(n) < 0.1
+    lat = np.where(null_mask, np.nan, np_rng.random(n) * 100)
+    cols["lat"] = pa.array([None if m else float(v) for m, v in zip(null_mask, lat)])
+    return pa.table(cols)
+
+
+AGGS = ["count(*)", "count(lat)", "sum(lat)", "avg(lat)", "min(lat)", "max(lat)",
+        "sum(status)", "count(distinct host)", "count(distinct path)"]
+GROUPS = ["host", "path", "status", "date_bin(interval '10m', p_timestamp)",
+          "date_trunc('minute', p_timestamp)"]
+FILTERS = [
+    "status >= 400", "status = 200", "lat > 50", "lat IS NOT NULL",
+    "host != 'h0'", "host IN ('h0', 'h1')", "path LIKE '/p1%'",
+    "status >= 300 AND lat < 80", "status = 500 OR status = 404",
+    "p_timestamp >= '2024-05-01T10:30:00Z'",
+    "p_timestamp < '2024-05-01T11:00:00Z'",
+    "NOT (host = 'h1')",
+]
+
+
+def gen_query(rng: random.Random) -> str:
+    n_aggs = rng.randint(1, 3)
+    aggs = [f"{a} a{i}" for i, a in enumerate(rng.sample(AGGS, n_aggs))]
+    n_groups = rng.randint(0, 2)
+    groups = rng.sample(GROUPS, n_groups)
+    sel = ", ".join(([f"{g} g{i}" for i, g in enumerate(groups)]) + aggs)
+    sql = f"SELECT {sel} FROM t"
+    if rng.random() < 0.7:
+        sql += f" WHERE {rng.choice(FILTERS)}"
+    if groups:
+        sql += " GROUP BY " + ", ".join(f"g{i}" for i in range(len(groups)))
+    return sql
+
+
+def rows_equal(cpu: list[dict], tpu: list[dict], sql: str) -> None:
+    # sort on ALL fields (floats rounded so f32 noise can't reorder rows)
+    def key(r):
+        return tuple(
+            f"{r[k]:.4g}" if isinstance(r[k], float) else str(r[k]) for k in sorted(r)
+        )
+    cpu, tpu = sorted(cpu, key=key), sorted(tpu, key=key)
+    assert len(cpu) == len(tpu), f"{sql}\ncpu={len(cpu)} tpu={len(tpu)} rows"
+    for rc, rt in zip(cpu, tpu):
+        assert set(rc) == set(rt), sql
+        for k in rc:
+            a, b = rc[k], rt[k]
+            if isinstance(a, float) and isinstance(b, float):
+                assert a == pytest.approx(b, rel=2e-4, abs=1e-6), (sql, k, a, b)
+            else:
+                assert a == b, (sql, k, a, b)
+
+
+def test_differential_fuzz():
+    rng = random.Random(int(os.environ.get("FUZZ_SEED", "1234")))
+    for trial in range(TRIALS):
+        n_tables = rng.randint(1, 3)
+        tables = [make_table(rng, rng.choice([500, 3000])) for _ in range(n_tables)]
+        sql = gen_query(rng)
+        lp1, lp2 = build_plan(parse_sql(sql)), build_plan(parse_sql(sql))
+        cpu = QueryExecutor(lp1).execute(iter(tables)).to_pylist()
+        tpu = TpuQueryExecutor(lp2).execute(iter(tables)).to_pylist()
+        rows_equal(cpu, tpu, f"[trial {trial}] {sql}")
