@@ -304,3 +304,46 @@ def test_fast_path_declines_bool_in_numeric_column():
     prepared = prepare_event(records, None, SchemaVersion.V1, None, True)
     slow = decode(prepared.records, prepared.schema)
     assert str(slow.field("flag").type) == "string"
+
+
+def test_fast_path_differential_fuzz():
+    """Random payloads: wherever the fast path accepts, its batch must be
+    byte-identical to the slow path (FUZZ_TRIALS env for deep soaks)."""
+    import os
+    import random
+
+    from parseable_tpu.event.format import (
+        SchemaVersion,
+        decode,
+        prepare_and_decode_fast,
+        prepare_event,
+    )
+
+    rng = random.Random(int(os.environ.get("FUZZ_SEED", "5")))
+    trials = int(os.environ.get("FUZZ_TRIALS", "60"))
+    keys = ["a", "b", "event_time", "@tag", "msg", "n"]
+    values = [
+        1, 2.5, True, False, None, "text", "2024-05-01T10:00:00Z",
+        "2024-05-01T10:00:00", "not-a-time", 0, -7, 1e18, "x" * 50,
+    ]
+    accepted = 0
+    for trial in range(trials):
+        n_rows = rng.randint(1, 8)
+        n_keys = rng.randint(1, 4)
+        chosen = rng.sample(keys, n_keys)
+        records = [
+            {k: rng.choice(values) for k in chosen} for _ in range(n_rows)
+        ]
+        fast = prepare_and_decode_fast(records, None, SchemaVersion.V1, None, True)
+        if fast is None:
+            continue
+        accepted += 1
+        prepared = prepare_event(
+            [dict(r) for r in records], None, SchemaVersion.V1, None, True
+        )
+        slow = decode(prepared.records, prepared.schema)
+        assert fast[1] == prepared.schema, (trial, records, fast[1], prepared.schema)
+        assert fast[0].to_pylist() == slow.to_pylist(), (trial, records)
+    # the generator's payloads are mostly clean; the fast path must engage
+    # for a reasonable share or it's not a fast path
+    assert accepted >= trials // 10, f"fast path engaged only {accepted}/{trials}"

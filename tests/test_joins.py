@@ -193,3 +193,47 @@ def test_empty_side_does_not_create_false_ambiguity(joined):
     )
     # no rows match, but 'code' (only in errs) resolves fine
     assert r.to_json_rows() == []
+
+
+def test_join_differential_fuzz(parseable):
+    """Random inner/left joins vs a nested-loop oracle (FUZZ_TRIALS for
+    deep soaks)."""
+    import os
+    import random
+
+    from parseable_tpu.event.json_format import JsonEvent
+
+    rng = random.Random(int(os.environ.get("FUZZ_SEED", "11")))
+    trials = int(os.environ.get("FUZZ_TRIALS", "12"))
+    p = parseable
+    sess = QuerySession(p, engine="cpu")
+
+    for trial in range(trials):
+        ln, rn = rng.randint(0, 25), rng.randint(0, 25)
+        lkeys = [f"k{rng.randint(0, 6)}" for _ in range(ln)]
+        rkeys = [f"k{rng.randint(0, 6)}" for _ in range(rn)]
+        lrows = [{"k": k, "lv": float(i)} for i, k in enumerate(lkeys)]
+        rrows = [{"k": k, "rv": float(100 + i)} for i, k in enumerate(rkeys)]
+        ls, rs = f"fl{trial}", f"fr{trial}"
+        for name, rows in ((ls, lrows), (rs, rrows)):
+            stream = p.create_stream_if_not_exists(name)
+            if rows:
+                ev = JsonEvent([dict(r) for r in rows], name).into_event(stream.metadata)
+                ev.process(stream, commit_schema=p.commit_schema)
+        kind = rng.choice(["JOIN", "LEFT JOIN"])
+        sql = (
+            f"SELECT l.k, l.lv, r.rv FROM {ls} l {kind} {rs} r ON l.k = r.k"
+        )
+        got = sorted(
+            (row["k"], row["lv"], row.get("rv"))
+            for row in sess.query(sql, "1h", "now").to_json_rows()
+        )
+        # nested-loop oracle
+        want = []
+        for lr in lrows:
+            matches = [rr for rr in rrows if rr["k"] == lr["k"]]
+            if matches:
+                want.extend((lr["k"], lr["lv"], rr["rv"]) for rr in matches)
+            elif kind == "LEFT JOIN":
+                want.append((lr["k"], lr["lv"], None))
+        assert got == sorted(want), (trial, sql, got[:5], sorted(want)[:5])
