@@ -237,3 +237,30 @@ def test_join_differential_fuzz(parseable):
             elif kind == "LEFT JOIN":
                 want.append((lr["k"], lr["lv"], None))
         assert got == sorted(want), (trial, sql, got[:5], sorted(want)[:5])
+
+
+def test_subquery_caps_and_nesting(joined):
+    """IN-subquery row cap and nesting depth guard (query/multi.py)."""
+    from parseable_tpu.query import multi as M
+
+    sess = QuerySession(joined, engine="cpu")
+
+    # row cap: shrink it so the guard trips
+    orig = M.MAX_SUBQUERY_ROWS
+    M.MAX_SUBQUERY_ROWS = 10
+    try:
+        with pytest.raises(Exception, match="rows"):
+            sess.query("SELECT count(*) FROM reqs WHERE trace IN (SELECT trace FROM reqs)")
+    finally:
+        M.MAX_SUBQUERY_ROWS = orig
+
+    # scalar subquery with >1 row errors cleanly
+    with pytest.raises(Exception, match="more than one row"):
+        sess.query("SELECT count(*) FROM reqs WHERE ms > (SELECT ms FROM reqs)")
+
+    # nesting beyond the session bound errors cleanly
+    deep = "SELECT trace FROM errs"
+    for _ in range(6):
+        deep = f"SELECT trace FROM errs WHERE trace IN ({deep})"
+    with pytest.raises(Exception, match="deep"):
+        sess.query(f"SELECT count(*) FROM reqs WHERE trace IN ({deep})")
