@@ -415,7 +415,22 @@ class QuerySession:
                 table = pa.table({name: pa.array([fast], pa.int64())})
                 return QueryResult(table, [name], {"fast_path": "manifest_count"})
 
-        if self.engine == "tpu":
+        use_tpu = self.engine == "tpu"
+        fallback = False
+        if use_tpu:
+            from parseable_tpu.utils.devicecheck import device_healthy
+
+            # bound the probe by the query's own deadline so the health
+            # check can never be what times the query out
+            max_wait = None
+            if lp.deadline is not None:
+                max_wait = max(0.0, lp.deadline - _time.monotonic() - 1.0)
+            if not device_healthy(max_wait=max_wait):
+                # wedged/unreachable accelerator: the CPU engine is a
+                # complete fallback — degrade instead of hanging a worker
+                use_tpu = False
+                fallback = True
+        if use_tpu:
             from parseable_tpu.query.executor_tpu import TpuQueryExecutor
             from parseable_tpu.query.provider import prefetch_iter
 
@@ -429,7 +444,8 @@ class QuerySession:
             executor = QueryExecutor(lp)
             tables = scan.tables()
         table = executor.execute(tables)
-        return QueryResult(table, table.column_names)
+        stats = {"engine_fallback": "device unhealthy"} if fallback else {}
+        return QueryResult(table, table.column_names, stats)
 
     @staticmethod
     def _set_scan_time_hint(lp: LogicalPlan, scan: StreamScan) -> None:

@@ -1,0 +1,96 @@
+"""Device health probe: is the accelerator actually answering?
+
+On tunneled PJRT setups the device can wedge (jax calls hang forever, not
+error). A query routed to the TPU engine would then hang a worker thread
+indefinitely — but the CPU engine is a complete fallback, so the session
+probes device health (tiny compute under a watchdog, cached with a TTL)
+and silently degrades to CPU while the device is unresponsive.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+PROBE_TIMEOUT_SECS = 20.0
+RECHECK_SECS = 120.0  # how often to re-probe an unhealthy device
+PROBE_STALE_SECS = 300.0  # a probe hung this long is abandoned; try anew
+
+_lock = threading.Lock()
+_state: dict = {
+    "healthy": None,
+    "checked_at": 0.0,
+    "probing": False,
+    "probe_started_at": 0.0,
+}
+
+
+def _probe() -> None:
+    ok = False
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jnp.ones(8).sum().block_until_ready()
+        ok = True
+    except Exception as e:  # noqa: BLE001
+        logger.warning("device probe failed: %s", e)
+    with _lock:
+        prev = _state["healthy"]
+        _state["healthy"] = ok
+        _state["checked_at"] = time.monotonic()
+        _state["probing"] = False
+    if prev is not True and ok:
+        logger.info("accelerator healthy; TPU engine enabled")
+    elif prev is not False and not ok:
+        logger.warning("accelerator unresponsive; queries fall back to the CPU engine")
+
+
+def device_healthy(max_wait: float | None = None) -> bool:
+    """True when the accelerator answered a probe recently.
+
+    Blocks at most min(PROBE_TIMEOUT_SECS, max_wait). While a re-probe of
+    a previously-healthy device is in flight, the last-known value is
+    served (a routine recheck must not degrade concurrent queries). A
+    probe hung past PROBE_STALE_SECS is abandoned and a fresh one starts,
+    so recovery is detected without a process restart."""
+    now = time.monotonic()
+    with _lock:
+        healthy = _state["healthy"]
+        fresh = now - _state["checked_at"] < RECHECK_SECS
+        if healthy is not None and fresh:
+            return healthy
+        if _state["probing"]:
+            if now - _state["probe_started_at"] <= PROBE_STALE_SECS:
+                # a probe is in flight: serve the last-known value (None ->
+                # pessimistic False, this is a first-ever wedged probe)
+                return bool(healthy)
+            # the outstanding probe is hung beyond hope; launch another
+        _state["probing"] = True
+        _state["probe_started_at"] = now
+    t = threading.Thread(target=_probe, name="device-probe", daemon=True)
+    t.start()
+    wait = PROBE_TIMEOUT_SECS if max_wait is None else max(0.0, min(PROBE_TIMEOUT_SECS, max_wait))
+    t.join(wait)
+    with _lock:
+        if _state["probing"]:
+            # probe still hung (or still running past our budget)
+            return False
+        return bool(_state["healthy"])
+
+
+def reset() -> None:
+    """Test hook."""
+    with _lock:
+        _state.update(
+            {"healthy": None, "checked_at": 0.0, "probing": False, "probe_started_at": 0.0}
+        )
+
+
+def mark(healthy: bool) -> None:
+    """Test hook: pin the cached state."""
+    with _lock:
+        _state.update({"healthy": healthy, "checked_at": time.monotonic(), "probing": False})

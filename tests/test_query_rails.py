@@ -131,3 +131,50 @@ def test_session_applies_rails(parseable):
     with pytest.raises(QueryTimeout):
         sess.query("SELECT a, count(*) FROM railed GROUP BY a")
     p.options.query_timeout_secs = 300
+
+
+def test_device_unhealthy_falls_back_to_cpu(parseable):
+    """A wedged accelerator must degrade queries to the CPU engine, not
+    hang a worker (found live when the TPU tunnel wedged mid-session)."""
+    from parseable_tpu.event.json_format import JsonEvent
+    from parseable_tpu.query.session import QuerySession
+    from parseable_tpu.utils import devicecheck
+
+    p = parseable
+    s = p.create_stream_if_not_exists("wedge")
+    ev = JsonEvent([{"a": float(i)} for i in range(20)], "wedge").into_event(s.metadata)
+    ev.process(s, commit_schema=p.commit_schema)
+
+    devicecheck.mark(False)  # pretend the device is wedged
+    try:
+        res = QuerySession(p, engine="tpu").query(
+            "SELECT count(*) c, sum(a) s FROM wedge"
+        )
+        assert res.to_json_rows() == [{"c": 20, "s": 190.0}]
+        assert res.stats.get("engine_fallback") == "device unhealthy"
+    finally:
+        devicecheck.reset()
+
+    # healthy again: the TPU path resumes
+    devicecheck.mark(True)
+    try:
+        res = QuerySession(p, engine="tpu").query("SELECT count(*) c FROM wedge")
+        assert res.to_json_rows() == [{"c": 20}]
+        assert "engine_fallback" not in res.stats
+    finally:
+        devicecheck.reset()
+
+
+def test_device_probe_state_machine():
+    from parseable_tpu.utils import devicecheck
+
+    devicecheck.reset()
+    try:
+        # on the test host jax answers on CPU devices -> healthy
+        assert devicecheck.device_healthy() is True
+        # cached: no re-probe needed
+        assert devicecheck.device_healthy() is True
+        devicecheck.mark(False)
+        assert devicecheck.device_healthy() is False
+    finally:
+        devicecheck.reset()
