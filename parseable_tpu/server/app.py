@@ -170,6 +170,12 @@ class ServerState:
             loop(60, lambda: alert_tick(self), "alerts")
             self.hot_tier()  # restore budgets
             loop(60, lambda: self.hot_tier().tick(), "hot-tier")
+            if self.p.options.query_engine == "tpu":
+                # warm the device-health probe off the request path so the
+                # first query never pays the watchdog wait
+                from parseable_tpu.utils.devicecheck import device_healthy
+
+                self.workers.submit(device_healthy)
         if self.p.options.send_analytics:
             from parseable_tpu.analytics import analytics_tick
 
