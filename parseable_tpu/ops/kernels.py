@@ -24,7 +24,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-F32_MAX = jnp.float32(3.4e38)
+# numpy, not jnp: a module-level jnp scalar would contact the device at
+# IMPORT time (hanging every import on a wedged tunnel); jnp ops accept
+# numpy scalars transparently
+import numpy as _np
+
+F32_MAX = _np.float32(3.4e38)
 
 
 # ------------------------------------------------------------------ predicates
