@@ -687,3 +687,51 @@ def test_stats_date_param_and_shutdown_drain(tmp_path):
         assert (await r.json())["ingestion"]["count"] == 40
 
     run(with_client(state2, fn2))
+
+
+def test_notification_state_and_policy_endpoints(tmp_path):
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        alert = {
+            "id": "mute1", "title": "m", "stream": "s",
+            "threshold_config": {"agg": "count", "operator": ">", "value": 1},
+        }
+        r = await client.post("/api/v1/alerts", json=alert, headers=AUTH)
+        assert r.status == 200
+
+        # mute indefinitely, then un-mute, then bad state -> 400
+        r = await client.put(
+            "/api/v1/alerts/mute1/update_notification_state",
+            json={"state": "indefinite"}, headers=AUTH,
+        )
+        assert r.status == 200
+        doc = state.p.metastore.get_document("alerts", "mute1")
+        assert doc["notification_state"] == "indefinite"
+        r = await client.put(
+            "/api/v1/alerts/mute1/update_notification_state",
+            json={"state": "notify"}, headers=AUTH,
+        )
+        assert r.status == 200
+        r = await client.put(
+            "/api/v1/alerts/mute1/update_notification_state",
+            json={"state": "whenever"}, headers=AUTH,
+        )
+        assert r.status == 400
+
+        # outbound policy CRUD + CIDR validation
+        r = await client.put(
+            "/api/v1/alert-target-policy",
+            json={"denied_cidrs": ["10.0.0.0/8"], "allowed_domains": ["hooks.example.com"]},
+            headers=AUTH,
+        )
+        assert r.status == 200
+        r = await client.get("/api/v1/alert-target-policy", headers=AUTH)
+        policy = await r.json()
+        assert policy["denied_cidrs"] == ["10.0.0.0/8"]
+        r = await client.put(
+            "/api/v1/alert-target-policy", json={"denied_cidrs": ["not-a-cidr"]}, headers=AUTH
+        )
+        assert r.status == 400
+
+    run(with_client(state, fn))
