@@ -67,3 +67,38 @@ def test_lru_eviction_by_budget():
     hs.put(("c",), HotEntry(dev={}, meta=None, nbytes=1000))
     assert hs.get(("c",)) is None
     assert len(hs) == 1
+
+
+def test_stub_eviction_race_rereads_source(loaded):
+    """A block evicted between the provider's hot check and execution must
+    re-read from its source (executor.source_loader), not fail or return
+    partial results."""
+    from parseable_tpu.ops.hotset import get_hotset
+    from parseable_tpu.query.session import QuerySession
+
+    sess = QuerySession(loaded, engine="tpu")
+    sql = "SELECT host, count(*) c FROM hot GROUP BY host ORDER BY host"
+    first = sess.query(sql).to_json_rows()
+
+    # second run: scan yields stubs for hot blocks; evict EVERYTHING after
+    # planning by clearing inside a wrapped hotset.get (simulating pressure
+    # mid-query)
+    hs = get_hotset()
+    orig_get = hs.get
+    state = {"cleared": False}
+
+    def evil_get(key):
+        entry = orig_get(key)
+        if entry is not None and not state["cleared"]:
+            # let the provider see it as hot, then evict before execution
+            state["cleared"] = True
+            hs.clear()
+            return None
+        return entry
+
+    hs.get = evil_get
+    try:
+        again = sess.query(sql).to_json_rows()
+    finally:
+        hs.get = orig_get
+    assert again == first
