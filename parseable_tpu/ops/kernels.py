@@ -79,6 +79,25 @@ def topk(values: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
 MATMUL_MAX_GROUPS = 8192
 
 
+# VMEM ceiling for the pallas path: the (ROW_TILE=2048, G) f32 one-hot
+# tile must fit on-chip (2048*512*4B = 4MB, comfortable on 16MB v5e)
+PALLAS_MAX_GROUPS = 512
+
+
+def _use_pallas() -> bool:
+    """Opt-in pallas additive reduction (P_TPU_USE_PALLAS=1): VMEM-resident
+    one-hot tiles (ops/pallas_groupby.py); off by default until it
+    benchmarks faster than the XLA dot on hardware.
+
+    NOTE: read at TRACE time — fused_groupby_block's jit cache bakes the
+    routing in, so toggling mid-process needs
+    `fused_groupby_block.clear_cache()` (a process-level deployment
+    choice, not a per-query switch)."""
+    import os
+
+    return os.environ.get("P_TPU_USE_PALLAS", "") == "1"
+
+
 @partial(jax.jit, static_argnames=("num_groups", "n_sum", "n_min", "n_max"))
 def fused_groupby_block(
     group_ids: jnp.ndarray,  # int32 [N] in [0, num_groups)
@@ -111,8 +130,40 @@ def fused_groupby_block(
     """
     n_all = valid.shape[0]
     vmask = jnp.logical_and(valid, mask[None, :])
+    additive = None  # (count, per_agg_count, sums) when a branch computed them
 
-    if num_groups <= MATMUL_MAX_GROUPS:
+    if _use_pallas() and num_groups <= PALLAS_MAX_GROUPS:
+        # opt-in pallas path: the (ROW_TILE, G) one-hot tile lives in VMEM,
+        # so G is capped well below MATMUL_MAX_GROUPS (tile bytes =
+        # ROW_TILE * G * 4 must fit ~16MB v5e VMEM with headroom)
+        try:
+            from parseable_tpu.ops.pallas_groupby import (
+                PALLAS_AVAILABLE,
+                ROW_TILE,
+                additive_groupby_pallas,
+            )
+        except ImportError:
+            PALLAS_AVAILABLE = False
+        n = group_ids.shape[0]
+        if PALLAS_AVAILABLE and n % ROW_TILE == 0:
+            rows = jnp.concatenate(
+                [
+                    mask[None, :].astype(jnp.float32),
+                    vmask.astype(jnp.float32),
+                    jnp.where(vmask[:n_sum], sum_values, 0.0),
+                ],
+                axis=0,
+            )
+            # interpret mode off-TPU: the mosaic lowering is TPU-only; the
+            # interpreter keeps CPU test runs exact
+            adds = additive_groupby_pallas(
+                group_ids, rows, num_groups, interpret=jax.default_backend() != "tpu"
+            )
+            additive = (adds[0], adds[1 : 1 + n_all], adds[1 + n_all :])
+
+    if additive is not None:
+        count, per_agg_count, sums = additive
+    elif num_groups <= MATMUL_MAX_GROUPS:
         # Split-precision one-hot reduction: the 0/1 rows (count + per-agg
         # counts) ride a bf16 x bf16 -> f32 MXU dot — 0 and 1 are exactly
         # representable in bf16 and accumulation is f32, so counts stay

@@ -96,3 +96,32 @@ def test_distributed_groupby_2d_shards_group_space():
         if mask[i]:
             ref[ids[i]] += 1
     np.testing.assert_allclose(count, ref)
+
+
+def test_pallas_groupby_opt_in_parity(monkeypatch):
+    """P_TPU_USE_PALLAS=1 routes the additive reduction through the pallas
+    kernel (interpret mode off-TPU) with results matching the XLA path."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import parseable_tpu.ops.kernels as K
+
+    rng = np.random.default_rng(0)
+    n, g = 4096, 128
+    ids = jnp.asarray(rng.integers(0, g, n).astype(np.int32))
+    mask = jnp.asarray(rng.random(n) < 0.9)
+    sums = jnp.asarray(rng.random((1, n)).astype(np.float32))
+    mins = jnp.asarray(rng.random((1, n)).astype(np.float32))
+    empty = jnp.zeros((0, n), jnp.float32)
+    valid = jnp.ones((2, n), bool)
+
+    base = K.fused_groupby_block(ids, mask, sums, mins, empty, valid, g, 1, 1, 0)
+    monkeypatch.setenv("P_TPU_USE_PALLAS", "1")
+    K.fused_groupby_block.clear_cache()
+    try:
+        pal = K.fused_groupby_block(ids, mask, sums, mins, empty, valid, g, 1, 1, 0)
+    finally:
+        monkeypatch.delenv("P_TPU_USE_PALLAS")
+        K.fused_groupby_block.clear_cache()
+    for a, b in zip(base, pal):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
