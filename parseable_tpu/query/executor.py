@@ -331,6 +331,17 @@ def _collect_aggs(e: S.Expr, out: list[AggSpec], counter: list[int]) -> S.Expr:
             [(_collect_aggs(w, out, counter), _collect_aggs(t, out, counter)) for w, t in e.whens],
             _collect_aggs(e.else_expr, out, counter) if e.else_expr else None,
         )
+    if isinstance(e, S.WindowCall):
+        # windows over aggregate output (`rank() OVER (ORDER BY sum(b))`):
+        # the aggregate inputs rewrite to slots; the window itself
+        # evaluates post-aggregation over the interim table
+        return S.WindowCall(
+            e.name,
+            [_collect_aggs(a, out, counter) for a in e.args],
+            [_collect_aggs(p, out, counter) for p in e.partition_by],
+            [S.OrderItem(_collect_aggs(o.expr, out, counter), o.desc) for o in e.order_by],
+            e.frame,
+        )
     return e
 
 
@@ -589,6 +600,10 @@ class QueryExecutor:
 
     def _execute_select(self, tables: Iterator[pa.Table]) -> pa.Table:
         sel = self.plan.select
+        if any(S.contains_window(i.expr) for i in sel.items) or any(
+            S.contains_window(o.expr) for o in sel.order_by
+        ):
+            return self._execute_select_windows(tables)
         out_parts: list[pa.Table] = []
         rows_needed = None
         if sel.limit is not None and not sel.distinct:
@@ -634,7 +649,106 @@ class QueryExecutor:
         if sel.distinct:
             result = result.group_by(result.column_names).aggregate([])
         result = self._order_limit(result)
-        return result
+        return self._strip_order_carry(result)
+
+    def _strip_order_carry(self, result: pa.Table) -> pa.Table:
+        sel = self.plan.select
+        if any(isinstance(i.expr, S.Star) for i in sel.items):
+            return result
+        declared = [i.alias or S.expr_name(i.expr) for i in sel.items]
+        carried = [
+            S.expr_name(o.expr)
+            for o in sel.order_by
+            if S.expr_name(o.expr) not in declared
+        ]
+        if not carried:
+            return result
+        keep = [c for c in result.column_names if c not in carried]
+        return result.select(keep)
+
+    def _execute_select_windows(self, tables: Iterator[pa.Table]) -> pa.Table:
+        """Non-aggregate SELECT carrying window functions: materialize the
+        filtered scan (windows need the whole input before any row's value
+        is known), attach `__w{i}` columns, project with rewritten items.
+
+        Reference parity: DataFusion WindowAggExec over the filtered scan
+        (the reference gets this from src/query/mod.rs:212-276)."""
+        from parseable_tpu.query import window as W
+
+        sel = self.plan.select
+        budget = self._memory_budget()
+        held = 0
+        parts: list[pa.Table] = []
+        for table in tables:
+            self._check_deadline()
+            table = self._bounds_filter(table)
+            mask = self._where_mask(table)
+            if mask is not None:
+                table = table.filter(mask)
+            if table.num_rows == 0:
+                continue
+            parts.append(table)
+            held += table.nbytes
+            if budget is not None and held > budget:
+                raise MemoryLimitExceeded(
+                    f"window query holds {held} bytes of input (limit {budget}); "
+                    "add filters or raise P_QUERY_MEMORY_LIMIT"
+                )
+        if not parts:
+            full = _empty_like(self.plan)
+        else:
+            full = _unify_parts(parts)
+        windows: list[S.WindowCall] = []
+        for item in sel.items:
+            windows.extend(W.window_calls(item.expr))
+        for o in sel.order_by:
+            windows.extend(W.window_calls(o.expr))
+        aug, mapping = W.attach_window_columns(full, windows)
+        items = [
+            S.SelectItem(
+                W.rewrite_windows(item.expr, mapping),
+                item.alias or S.expr_name(item.expr),
+            )
+            for item in sel.items
+        ]
+        # ORDER BY may carry windows too (`ORDER BY row_number() OVER ...`):
+        # rewrite them to the computed slots and sort under the rewritten
+        # spec so _sorted never meets a raw WindowCall
+        rewritten_order = [
+            S.OrderItem(W.rewrite_windows(o.expr, mapping), o.desc) for o in sel.order_by
+        ]
+        names: list[str] = []
+        arrays: list[pa.Array] = []
+        for item in items:
+            if isinstance(item.expr, S.Star):
+                for name in aug.column_names:
+                    if name.startswith("__w"):
+                        continue  # window slots are not part of `*`
+                    names.append(name)
+                    arrays.append(aug.column(name).combine_chunks())
+                continue
+            names.append(item.alias)
+            arrays.append(_arr(evaluate(item.expr, aug), aug))
+        import copy as _copy
+
+        shim = _copy.copy(sel)
+        shim.order_by = rewritten_order
+        prev_sel = self.plan.select
+        self.plan.select = shim
+        try:
+            if not any(isinstance(i.expr, S.Star) for i in items):
+                for nm in self._order_carry_names(names, aug):
+                    for o in rewritten_order:
+                        if S.expr_name(o.expr) == nm:
+                            names.append(nm)
+                            arrays.append(_arr(evaluate(o.expr, aug), aug))
+                            break
+            result = pa.table(_dedup(names, arrays))
+            if sel.distinct:
+                result = result.group_by(result.column_names).aggregate([])
+            return self._strip_order_carry(self._order_limit(result))
+        finally:
+            self.plan.select = prev_sel
 
     def execute_select_stream(self, tables: Iterator[pa.Table]) -> Iterator[pa.Table]:
         """Stream filtered + projected blocks one at a time (reference:
@@ -644,7 +758,12 @@ class QueryExecutor:
         first row can be emitted, so those yield the materialized table.
         """
         sel = self.plan.select
-        if self.plan.is_aggregate or sel.order_by or sel.distinct:
+        if (
+            self.plan.is_aggregate
+            or sel.order_by
+            or sel.distinct
+            or any(S.contains_window(i.expr) for i in sel.items)
+        ):
             yield self.execute(tables)
             return
         # chunk emissions at the execution batch size (reference: DF batch
@@ -677,6 +796,27 @@ class QueryExecutor:
             if remaining == 0:
                 return
 
+    def _order_carry_names(self, declared: list[str], table: pa.Table) -> list[str]:
+        """ORDER BY columns the projection would drop: carried through the
+        output under their own names so the final sort can see them, then
+        stripped (`SELECT ms FROM t ORDER BY rn` must sort by rn, not by an
+        all-null placeholder)."""
+        from parseable_tpu.query.planner import referenced_columns
+
+        sel = self.plan.select
+        out: list[str] = []
+        if sel.distinct:
+            # DISTINCT + ORDER BY an unselected column is ill-defined
+            return out
+        for o in sel.order_by:
+            nm = S.expr_name(o.expr)
+            if nm in declared or nm in out:
+                continue
+            refs = referenced_columns(o.expr)
+            if refs and all(r in table.column_names for r in refs):
+                out.append(nm)
+        return out
+
     def _project(self, table: pa.Table) -> pa.Table:
         sel = self.plan.select
         names: list[str] = []
@@ -696,6 +836,13 @@ class QueryExecutor:
                 continue
             names.append(item.alias or S.expr_name(item.expr))
             arrays.append(_arr(evaluate(item.expr, table), table))
+        if not any(isinstance(i.expr, S.Star) for i in sel.items):
+            for nm in self._order_carry_names(names, table):
+                for o in sel.order_by:
+                    if S.expr_name(o.expr) == nm:
+                        names.append(nm)
+                        arrays.append(_arr(evaluate(o.expr, table), table))
+                        break
         return pa.table(dict(zip(names, arrays)) if len(set(names)) == len(names) else _dedup(names, arrays))
 
     # -- aggregate -----------------------------------------------------------
@@ -771,16 +918,36 @@ class QueryExecutor:
                 return S.UnaryOp(e.op, rewrite_groups(e.operand))
             if isinstance(e, S.Cast):
                 return S.Cast(rewrite_groups(e.expr), e.type_name)
+            if isinstance(e, S.WindowCall):
+                return S.WindowCall(
+                    e.name,
+                    [rewrite_groups(a) for a in e.args],
+                    [rewrite_groups(p) for p in e.partition_by],
+                    [S.OrderItem(rewrite_groups(o.expr), o.desc) for o in e.order_by],
+                    e.frame,
+                )
             return e
 
         if getattr(self, "_having", None) is not None:
             hmask = _arr(evaluate(rewrite_groups(self._having), interim), interim)
             interim = interim.filter(hmask)
 
+        items = [S.SelectItem(rewrite_groups(i.expr), i.alias) for i in rewritten]
+        if any(S.contains_window(i.expr) for i in items):
+            # windows over the aggregated output (one row per group):
+            # `rank() OVER (ORDER BY sum(b) DESC)` etc.
+            from parseable_tpu.query import window as W
+
+            windows: list[S.WindowCall] = []
+            for i in items:
+                windows.extend(W.window_calls(i.expr))
+            interim, mapping = W.attach_window_columns(interim, windows)
+            items = [S.SelectItem(W.rewrite_windows(i.expr, mapping), i.alias) for i in items]
+
         names, arrays = [], []
-        for item in rewritten:
+        for item in items:
             names.append(item.alias)
-            arrays.append(_arr(evaluate(rewrite_groups(item.expr), interim), interim))
+            arrays.append(_arr(evaluate(item.expr, interim), interim))
         result = pa.table(_dedup(names, arrays))
         result = self._order_limit(result)
         return result
@@ -799,6 +966,12 @@ class QueryExecutor:
             elif name in table.column_names:
                 keys.append((name, "descending" if o.desc else "ascending"))
             else:
+                if S.contains_window(o.expr):
+                    raise ExecError(
+                        "a window function in ORDER BY of an aggregate query "
+                        "must also appear in the SELECT list (alias it and "
+                        "order by the alias)"
+                    )
                 aux = f"__sort{aux_cols}"
                 aux_cols += 1
                 table = table.append_column(aux, _arr(evaluate(o.expr, table), table))

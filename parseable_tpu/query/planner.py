@@ -242,6 +242,13 @@ def referenced_columns(e: S.Expr | None) -> set[str]:
         elif isinstance(x, S.FunctionCall):
             for a in x.args:
                 visit(a)
+        elif isinstance(x, S.WindowCall):
+            for a in x.args:
+                visit(a)
+            for p in x.partition_by:
+                visit(p)
+            for o in x.order_by:
+                visit(o.expr)
         elif isinstance(x, S.Cast):
             visit(x.expr)
         elif isinstance(x, S.Case):

@@ -63,7 +63,12 @@ def collect_streams(select: S.Select) -> set[str]:
     walk(select.having)
     for i in select.items:
         walk(i.expr)
-    return out
+    for _, branch in select.set_ops:
+        out.update(collect_streams(branch))
+    cte_names = set(select.ctes)
+    for cte_sel in select.ctes.values():
+        out.update(collect_streams(cte_sel))
+    return out - cte_names
 
 
 def _qualified_refs(e: S.Expr | None) -> list[S.Column]:
@@ -145,12 +150,19 @@ class QuerySession:
         t0: float | None = None,
     ) -> QueryResult:
         t0 = t0 if t0 is not None else _time.monotonic()
+        if select.ctes:
+            return self._query_with_ctes(select, start_time, end_time, allowed_streams, t0)
+        if select.set_ops:
+            return self._query_union(select, start_time, end_time, allowed_streams, t0)
         has_sub = any(
             S.contains_subquery(x)
             for x in [select.where, select.having, *(i.expr for i in select.items)]
         )
         if select.joins or has_sub:
             return self._query_multi(select, start_time, end_time, allowed_streams, t0)
+        cte_tables = getattr(self, "_cte_tables", None)
+        if cte_tables is not None and select.table in cte_tables:
+            return self._query_cte_table(select, cte_tables[select.table], t0)
         lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
 
         scan = StreamScan(
@@ -228,7 +240,17 @@ class QuerySession:
         full. Row export is IO-bound, so it always runs the CPU engine —
         the device path exists for aggregation."""
         t0 = _time.monotonic()
-        lp = self._plan(sql_text, start_time, end_time, allowed_streams, t0)
+        select = S.parse_sql(sql_text)
+        if select.set_ops or select.ctes or select.joins or any(
+            S.contains_subquery(x)
+            for x in [select.where, select.having, *(i.expr for i in select.items)]
+        ):
+            # set operations / CTEs / joins need the full result before the
+            # first row can stream; materialize through the normal path and
+            # emit the table as one chunk
+            result = self._query_ast(select, start_time, end_time, allowed_streams, t0)
+            return iter([result.table])
+        lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
         # streaming exports are paced by the client (resp.write backpressure
         # counts as wall time); the SQL timeout would truncate every large
         # download, so it doesn't apply here — memory stays bounded by the
@@ -237,6 +259,130 @@ class QuerySession:
         scan = StreamScan(self.p, lp, hot_tier_dir=self._hot_dir(lp.stream))
         executor = QueryExecutor(lp)
         return executor.execute_select_stream(scan.tables())
+
+    # ------------------------------------------------------- CTE / UNION
+
+    def _query_with_ctes(
+        self,
+        select: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+    ) -> QueryResult:
+        """WITH bindings: materialize each CTE in declaration order (later
+        CTEs and the main body see earlier ones), then run the body.
+        Reference parity: DataFusion CTE inlining (src/query/mod.rs)."""
+        import copy
+
+        prev = getattr(self, "_cte_tables", None)
+        tables = dict(prev or {})
+        self._cte_tables = tables
+        try:
+            for name, cte_sel in select.ctes.items():
+                sub = copy.deepcopy(cte_sel)
+                # RBAC applies to the CTE's underlying streams, not its name
+                tables[name] = self._query_ast(
+                    sub, start_time, end_time, allowed_streams, t0
+                ).table
+            body = copy.copy(select)
+            body.ctes = {}
+            return self._query_ast(body, start_time, end_time, allowed_streams, t0)
+        finally:
+            if prev is None:
+                del self._cte_tables
+            else:
+                self._cte_tables = prev
+
+    def _query_union(
+        self,
+        select: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+    ) -> QueryResult:
+        """UNION [ALL]: branches execute independently (RBAC/time range per
+        branch), match by position, fold left with distinct at each non-ALL
+        step (standard SQL associativity); the hoisted ORDER BY/LIMIT apply
+        to the combined result."""
+        import copy
+
+        from parseable_tpu.query.executor import QueryExecutor as _QE
+        from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+        head = copy.copy(select)
+        head.set_ops = []
+        head.order_by = []
+        head.limit = None
+        head.offset = None
+        acc = self._query_ast(head, start_time, end_time, allowed_streams, t0).table
+        n_cols = acc.num_columns
+        out_names = acc.column_names
+
+        def distinct(t: pa.Table) -> pa.Table:
+            return t.group_by(t.column_names, use_threads=False).aggregate([])
+
+        for is_all, branch in select.set_ops:
+            bt = self._query_ast(
+                copy.copy(branch), start_time, end_time, allowed_streams, t0
+            ).table
+            if bt.num_columns != n_cols:
+                raise QueryError(
+                    f"UNION branches have {n_cols} vs {bt.num_columns} columns"
+                )
+            bt = bt.rename_columns(out_names)
+            schema = merge_schemas([acc.schema, bt.schema])
+            batches = [adapt_batch(schema, b) for t in (acc, bt) for b in t.to_batches()]
+            acc = pa.Table.from_batches(batches, schema=schema)
+            if not is_all:
+                acc = distinct(acc)
+
+        if select.order_by or select.limit is not None or select.offset is not None:
+            from parseable_tpu.query.planner import LogicalPlan, TimeBounds
+
+            shim = S.Select(
+                items=[S.SelectItem(S.Star())],
+                table="__union",
+                order_by=select.order_by,
+                limit=select.limit,
+                offset=select.offset,
+            )
+            lp = LogicalPlan(
+                select=shim, stream="__union", time_bounds=TimeBounds(),
+                constraints=[], needed_columns=None,
+            )
+            acc = _QE(lp)._order_limit(acc)
+        elapsed = _time.monotonic() - t0
+        return QueryResult(
+            acc,
+            acc.column_names,
+            {"elapsed_secs": round(elapsed, 6), "engine": self.engine, "set_op": "union"},
+        )
+
+    def _query_cte_table(self, select: S.Select, table: pa.Table, t0: float) -> QueryResult:
+        """FROM <cte>: run the remaining SELECT over the materialized CTE
+        output with the CPU executor (time bounds were applied when the CTE
+        scanned its streams; they do not re-apply to derived rows)."""
+        import copy
+
+        from parseable_tpu.query.planner import TimeBounds, plan as build_plan
+
+        sel = copy.deepcopy(select)  # joins/subqueries were routed to _query_multi already
+        lp = build_plan(sel)
+        lp.time_bounds = TimeBounds()
+        timeout = self.p.options.query_timeout_secs
+        if timeout:
+            lp.deadline = t0 + timeout
+        lp.memory_limit_bytes = self.p.options.query_memory_limit_bytes
+        executor = QueryExecutor(lp)
+        out = executor.execute(iter([table]))
+        elapsed = _time.monotonic() - t0
+        return QueryResult(
+            out,
+            out.column_names,
+            {"elapsed_secs": round(elapsed, 6), "engine": "cpu", "cte": select.table},
+        )
 
     # ------------------------------------------------------- multi-stream
 
@@ -280,7 +426,9 @@ class QuerySession:
         M,
     ) -> QueryResult:
         # RBAC over every referenced stream, before anything executes
-        streams = collect_streams(sel)
+        # (CTE names are session-local bindings, not streams)
+        cte_tables = getattr(self, "_cte_tables", None) or {}
+        streams = collect_streams(sel) - set(cte_tables)
         if allowed_streams is not None:
             for name in streams:
                 if name not in allowed_streams:
@@ -326,6 +474,15 @@ class QuerySession:
         sides: list[tuple[str, pa.Table]] = []
         for name, alias in refs:
             needed = None if star else (needed_by_alias[alias] | needed_all)
+            if name in cte_tables:
+                t = cte_tables[name]
+                if needed is not None:
+                    keep = [c for c in t.column_names if c in needed]
+                    t = t.select(keep)
+                sides.append((alias, t))
+                for c in t.column_names:
+                    owner_of[c] = "__ambiguous__" if c in owner_of else alias
+                continue
             self.resolve_stream(name)
             t = self._materialize_stream(name, needed, start_time, end_time, t0)
             sides.append((alias, t))

@@ -33,6 +33,10 @@ KEYWORDS = {
 # "left"/"on"/"join" must keep parsing as plain columns elsewhere.
 JOIN_WORDS = {"join", "inner", "left", "right", "full", "outer", "cross", "on"}
 
+# contextual words that terminate an implicit alias position ("FROM t UNION"
+# must not read UNION as t's alias)
+NON_ALIAS_WORDS = JOIN_WORDS | {"union"}
+
 
 @dataclass
 class Token:
@@ -227,6 +231,28 @@ class Subquery(Expr):
 
 
 @dataclass
+class WindowCall(Expr):
+    """`fn(args) OVER (PARTITION BY ... ORDER BY ... [frame])`.
+
+    Reference parity: the DataFusion window functions dashboards and the
+    queryContext handler lean on (src/query/mod.rs:212-276 gives the
+    reference the full window surface; src/handlers/http/query_context.rs
+    pages rows around an anchor — expressible as a row_number window).
+    Frames: only UNBOUNDED PRECEDING..CURRENT ROW — implicit (RANGE
+    semantics when ORDER BY is present, whole partition otherwise) or
+    explicit. `frame` is "cumulative" (RANGE: peers share the frame) |
+    "rows_cumulative" (ROWS: each row ends its own frame) | None
+    (default-by-order-presence).
+    """
+
+    name: str  # lowercase function name
+    args: list[Expr]
+    partition_by: list[Expr] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+    frame: str | None = None
+
+
+@dataclass
 class Join:
     table: str
     alias: str | None
@@ -259,6 +285,12 @@ class Select:
     distinct: bool = False
     table_alias: str | None = None
     joins: list[Join] = field(default_factory=list)
+    # UNION [ALL] branches: (is_all, branch); ORDER BY/LIMIT parsed after
+    # the last branch are hoisted up here and apply to the union result
+    set_ops: list[tuple[bool, "Select"]] = field(default_factory=list)
+    # WITH name AS (...) bindings, in declaration order; later CTEs (and
+    # the main body) may reference earlier ones
+    ctes: dict[str, "Select"] = field(default_factory=dict)
 
 
 def contains_subquery(e: Expr | None) -> bool:
@@ -282,13 +314,60 @@ def contains_subquery(e: Expr | None) -> bool:
         return contains_subquery(e.expr)
     if isinstance(e, Case):
         return any(contains_subquery(w) or contains_subquery(t) for w, t in e.whens) or contains_subquery(e.else_expr)
+    if isinstance(e, WindowCall):
+        return (
+            any(contains_subquery(a) for a in e.args)
+            or any(contains_subquery(p) for p in e.partition_by)
+            or any(contains_subquery(o.expr) for o in e.order_by)
+        )
     return False
 
 
 AGGREGATE_FUNCS = {"count", "sum", "min", "max", "avg", "approx_distinct", "count_distinct", "stddev", "var"}
 
+# pure window functions (aggregate names also work windowed: sum(...) OVER)
+WINDOW_FUNCS = {
+    "row_number", "rank", "dense_rank", "ntile", "lag", "lead",
+    "first_value", "last_value",
+}
+
+
+def contains_window(e: Expr | None) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, WindowCall):
+        return True
+    if isinstance(e, BinaryOp):
+        return contains_window(e.left) or contains_window(e.right)
+    if isinstance(e, UnaryOp):
+        return contains_window(e.operand)
+    if isinstance(e, InList):
+        return contains_window(e.expr) or any(contains_window(i) for i in e.items)
+    if isinstance(e, Between):
+        return any(contains_window(x) for x in (e.expr, e.low, e.high))
+    if isinstance(e, IsNull):
+        return contains_window(e.expr)
+    if isinstance(e, FunctionCall):
+        return any(contains_window(a) for a in e.args)
+    if isinstance(e, Cast):
+        return contains_window(e.expr)
+    if isinstance(e, Case):
+        return any(contains_window(w) or contains_window(t) for w, t in e.whens) or contains_window(
+            e.else_expr
+        )
+    return False
+
 
 def is_aggregate(e: Expr) -> bool:
+    if isinstance(e, WindowCall):
+        # a window call is NOT itself an aggregate — but its inputs may be
+        # (`rank() OVER (ORDER BY sum(b))` in a GROUP BY query runs over
+        # the aggregated output)
+        return (
+            any(is_aggregate(a) for a in e.args)
+            or any(is_aggregate(p) for p in e.partition_by)
+            or any(is_aggregate(o.expr) for o in e.order_by)
+        )
     if isinstance(e, FunctionCall):
         if e.name in AGGREGATE_FUNCS:
             return True
@@ -363,12 +442,57 @@ class Parser:
 
     # -- entry ---------------------------------------------------------------
     def parse(self) -> Select:
-        self.expect_kw("select")
-        sel = self.parse_select_body()
+        # WITH name AS (SELECT ...)[, ...] — CTEs bind for the whole
+        # statement; "with" is contextual (a column named "with" stays a
+        # column everywhere else)
+        ctes: dict[str, Select] = {}
+        if self.peek().kind == "ident" and self.peek().value.lower() == "with":
+            self.next()
+            while True:
+                name_t = self.next()
+                if name_t.kind != "ident":
+                    raise SqlError(f"expected CTE name at {name_t.pos}")
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self._parse_set_expr()
+                self.expect_op(")")
+                if name_t.value in ctes:
+                    raise SqlError(f"duplicate CTE name {name_t.value!r}")
+                ctes[name_t.value] = sub
+                if not self.accept_op(","):
+                    break
+        sel = self._parse_set_expr()
+        sel.ctes = ctes
         self.accept_op(";")
         if self.peek().kind != "eof":
             raise SqlError(f"trailing tokens at {self.peek().pos}")
         return sel
+
+    def _parse_set_expr(self) -> Select:
+        """SELECT ... [UNION [ALL] SELECT ...]*; trailing ORDER BY / LIMIT
+        bind to the whole union (standard SQL: branches can't carry them)."""
+        self.expect_kw("select")
+        first = self.parse_select_body()
+        branches: list[tuple[bool, Select]] = []
+        while self.peek().kind == "ident" and self.peek().value.lower() == "union":
+            self.next()
+            is_all = bool(self.accept_word("all"))
+            self.expect_kw("select")
+            branches.append((is_all, self.parse_select_body()))
+        if branches:
+            if first.order_by or first.limit is not None:
+                raise SqlError("ORDER BY/LIMIT before UNION is not supported")
+            for _, b in branches[:-1]:
+                if b.order_by or b.limit is not None:
+                    raise SqlError("ORDER BY/LIMIT inside a UNION branch is not supported")
+            # the trailing ORDER BY/LIMIT parsed into the last branch apply
+            # to the union result: hoist them to the head select
+            last = branches[-1][1]
+            first.order_by, last.order_by = last.order_by, []
+            first.limit, last.limit = last.limit, None
+            first.offset, last.offset = last.offset, None
+            first.set_ops = branches
+        return first
 
     def parse_select_body(self) -> Select:
         distinct = bool(self.accept_kw("distinct"))
@@ -467,7 +591,7 @@ class Parser:
             if a.kind != "ident":
                 raise SqlError(f"expected alias at {a.pos}")
             alias = a.value
-        elif self.peek().kind == "ident" and self.peek().value.lower() not in JOIN_WORDS:
+        elif self.peek().kind == "ident" and self.peek().value.lower() not in NON_ALIAS_WORDS:
             alias = self.next().value
         return t.value, alias
 
@@ -481,7 +605,7 @@ class Parser:
             if t.kind not in ("ident", "string"):
                 raise SqlError(f"expected alias at {t.pos}")
             alias = t.value
-        elif self.peek().kind == "ident":
+        elif self.peek().kind == "ident" and self.peek().value.lower() != "union":
             alias = self.next().value
         return SelectItem(e, alias)
 
@@ -659,9 +783,48 @@ class Parser:
                 else:
                     args.append(self.parse_expr())
             self.expect_op(")")
+        if self.peek().kind == "ident" and self.peek().value.lower() == "over":
+            self.next()
+            if distinct:
+                raise SqlError("DISTINCT window aggregates are not supported")
+            return self.parse_over(lname, args)
         if lname == "count" and distinct:
             return FunctionCall("count_distinct", args)
         return FunctionCall(lname, args, distinct)
+
+    def parse_over(self, fname: str, args: list[Expr]) -> Expr:
+        """OVER ([PARTITION BY ...] [ORDER BY ...] [frame]) — frames beyond
+        the SQL defaults are rejected (DataFusion-default parity)."""
+        if fname not in WINDOW_FUNCS and fname not in AGGREGATE_FUNCS:
+            raise SqlError(f"{fname}() cannot be used as a window function")
+        self.expect_op("(")
+        partition_by: list[Expr] = []
+        order_by: list[OrderItem] = []
+        frame: str | None = None
+        if self.accept_word("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        unit = self.accept_word("rows", "range")
+        if unit:
+            # only the UNBOUNDED PRECEDING..CURRENT ROW frames are
+            # expressible; ROWS and RANGE differ on tied order keys (peers
+            # share the frame under RANGE, not under ROWS)
+            self.expect_kw("between")
+            self.expect_word("unbounded")
+            self.expect_word("preceding")
+            self.expect_kw("and")
+            self.expect_word("current")
+            self.expect_word("row")
+            frame = "rows_cumulative" if unit == "rows" else "cumulative"
+        self.expect_op(")")
+        return WindowCall(fname, args, partition_by, order_by, frame)
 
     def parse_case(self) -> Expr:
         self.expect_kw("case")
@@ -704,4 +867,6 @@ def expr_name(e: Expr) -> str:
         return expr_name(e.expr)
     if isinstance(e, IntervalLit):
         return f"interval '{e.text}'"
+    if isinstance(e, WindowCall):
+        return f"{e.name}({','.join(expr_name(a) for a in e.args)}) over"
     return e.__class__.__name__.lower()

@@ -1,0 +1,430 @@
+"""Window-function evaluation over a materialized table.
+
+The reference gets `row_number()/rank()/lag() OVER (...)` for free from
+DataFusion (src/query/mod.rs:212-276); here windows evaluate post-scan on the
+host, vectorized: one pyarrow sort per distinct (PARTITION BY, ORDER BY)
+spec, then numpy segment arithmetic on the sorted order, scattered back to
+the input row order. Sorting is the only O(n log n) step; every window
+function itself is O(n) vectorized.
+
+Default frames follow SQL/DataFusion semantics:
+- with ORDER BY: RANGE UNBOUNDED PRECEDING..CURRENT ROW — running values
+  where *peer rows* (equal order keys) share the frame result;
+- without ORDER BY: the whole partition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from parseable_tpu.query import sql as S
+
+
+class WindowError(ValueError):
+    pass
+
+
+def window_calls(e: S.Expr | None) -> list[S.WindowCall]:
+    """All WindowCall nodes in an expression tree (document order)."""
+    out: list[S.WindowCall] = []
+    if e is None:
+        return out
+
+    def walk(x: S.Expr) -> None:
+        if isinstance(x, S.WindowCall):
+            out.append(x)
+            return  # window args cannot nest further windows
+        if isinstance(x, S.BinaryOp):
+            walk(x.left)
+            walk(x.right)
+        elif isinstance(x, S.UnaryOp):
+            walk(x.operand)
+        elif isinstance(x, S.InList):
+            walk(x.expr)
+            for i in x.items:
+                walk(i)
+        elif isinstance(x, S.Between):
+            walk(x.expr)
+            walk(x.low)
+            walk(x.high)
+        elif isinstance(x, S.IsNull):
+            walk(x.expr)
+        elif isinstance(x, S.FunctionCall):
+            for a in x.args:
+                walk(a)
+        elif isinstance(x, S.Cast):
+            walk(x.expr)
+        elif isinstance(x, S.Case):
+            for w, t in x.whens:
+                walk(w)
+                walk(t)
+            if x.else_expr is not None:
+                walk(x.else_expr)
+
+    walk(e)
+    return out
+
+
+def rewrite_windows(e: S.Expr, mapping: dict[str, str]) -> S.Expr:
+    """Replace WindowCall nodes with Column refs per `mapping` (repr keyed)."""
+    if isinstance(e, S.WindowCall):
+        return S.Column(mapping[repr(e)])
+    if isinstance(e, S.BinaryOp):
+        return S.BinaryOp(e.op, rewrite_windows(e.left, mapping), rewrite_windows(e.right, mapping))
+    if isinstance(e, S.UnaryOp):
+        return S.UnaryOp(e.op, rewrite_windows(e.operand, mapping))
+    if isinstance(e, S.InList):
+        return S.InList(
+            rewrite_windows(e.expr, mapping),
+            [rewrite_windows(i, mapping) for i in e.items],
+            e.negated,
+        )
+    if isinstance(e, S.Between):
+        return S.Between(
+            rewrite_windows(e.expr, mapping),
+            rewrite_windows(e.low, mapping),
+            rewrite_windows(e.high, mapping),
+            e.negated,
+        )
+    if isinstance(e, S.IsNull):
+        return S.IsNull(rewrite_windows(e.expr, mapping), e.negated)
+    if isinstance(e, S.FunctionCall):
+        return S.FunctionCall(e.name, [rewrite_windows(a, mapping) for a in e.args], e.distinct)
+    if isinstance(e, S.Cast):
+        return S.Cast(rewrite_windows(e.expr, mapping), e.type_name)
+    if isinstance(e, S.Case):
+        return S.Case(
+            [(rewrite_windows(w, mapping), rewrite_windows(t, mapping)) for w, t in e.whens],
+            rewrite_windows(e.else_expr, mapping) if e.else_expr else None,
+        )
+    return e
+
+
+def _segment_starts(cols: list[pa.Array]) -> np.ndarray:
+    """starts[i] = True where row i begins a new segment (any key differs
+    from row i-1; nulls compare equal to nulls)."""
+    n = len(cols[0]) if cols else 0
+    starts = np.zeros(n, dtype=bool)
+    if n:
+        starts[0] = True
+    for col in cols:
+        a = col.slice(1)
+        b = col.slice(0, n - 1)
+        neq = pc.fill_null(pc.not_equal(a, b), False).to_numpy(zero_copy_only=False)
+        # null vs non-null is a boundary; null vs null is not
+        an = pc.is_null(a).to_numpy(zero_copy_only=False)
+        bn = pc.is_null(b).to_numpy(zero_copy_only=False)
+        starts[1:] |= np.asarray(neq, bool) | (np.asarray(an, bool) != np.asarray(bn, bool))
+    return starts
+
+
+def _part_start_idx(starts: np.ndarray) -> np.ndarray:
+    """For each row, the index of its segment's first row."""
+    n = len(starts)
+    idx = np.arange(n)
+    return np.maximum.accumulate(np.where(starts, idx, 0))
+
+
+def _peer_end_idx(peer_starts: np.ndarray) -> np.ndarray:
+    """For each row, the index of its peer group's last row (the reverse
+    minimum-accumulate of each peer group's closing index)."""
+    n = len(peer_starts)
+    idx = np.arange(n)
+    is_last = np.zeros(n, bool)
+    is_last[:-1] = peer_starts[1:]
+    if n:
+        is_last[-1] = True
+    return np.minimum.accumulate(np.where(is_last, idx, n)[::-1])[::-1]
+
+
+def _evaluate(e: S.Expr, table: pa.Table) -> pa.Array:
+    from parseable_tpu.query.executor import _arr, evaluate
+
+    return _arr(evaluate(e, table), table)
+
+
+def compute_window(w: S.WindowCall, table: pa.Table) -> pa.Array:
+    """Evaluate one window call over `table`, returning a full-length array
+    aligned to the input row order."""
+    n = table.num_rows
+    if n == 0:
+        return pa.nulls(0)
+
+    part_cols = [_evaluate(p, table) for p in w.partition_by]
+    order_cols = [_evaluate(o.expr, table) for o in w.order_by]
+
+    # one sort arranges rows partition-major, order-minor
+    sort_tbl = pa.table(
+        {f"__p{i}": c for i, c in enumerate(part_cols)}
+        | {f"__o{i}": c for i, c in enumerate(order_cols)}
+        or {"__d": pa.nulls(n, pa.int8())}
+    )
+    sort_keys = [(f"__p{i}", "ascending") for i in range(len(part_cols))] + [
+        (f"__o{i}", "descending" if o.desc else "ascending")
+        for i, o in enumerate(w.order_by)
+    ]
+    if sort_keys:
+        sort_idx = pc.sort_indices(sort_tbl, sort_keys=sort_keys).to_numpy(
+            zero_copy_only=False
+        ).astype(np.int64)
+    else:
+        sort_idx = np.arange(n, dtype=np.int64)
+
+    take = pa.array(sort_idx)
+    sp = [c.take(take) for c in part_cols]
+    so = [c.take(take) for c in order_cols]
+
+    part_starts = _segment_starts(sp) if sp else _one_segment(n)
+    peer_starts = part_starts | (_segment_starts(sp + so) if so else part_starts)
+    pstart = _part_start_idx(part_starts)
+    pos = np.arange(n) - pstart  # 0-based position within partition
+
+    cumulative = bool(w.order_by) or w.frame in ("cumulative", "rows_cumulative")
+    # ROWS frames end at the row itself; RANGE frames extend to the last peer
+    frame_end = (
+        np.arange(n) if w.frame == "rows_cumulative" else _peer_end_idx(peer_starts)
+    )
+
+    name = w.name
+    out_sorted: pa.Array
+    if name == "row_number":
+        out_sorted = pa.array(pos + 1, pa.int64())
+    elif name == "rank":
+        peer_first = _part_start_idx(peer_starts)
+        out_sorted = pa.array(peer_first - pstart + 1, pa.int64())
+    elif name == "dense_rank":
+        dr = np.cumsum(peer_starts)
+        out_sorted = pa.array(dr - dr[pstart] + 1, pa.int64())
+    elif name == "ntile":
+        out_sorted = pa.array(_ntile(w, table, pos, part_starts), pa.int64())
+    elif name in ("lag", "lead"):
+        out_sorted = _lag_lead(w, table, take, pstart, part_starts, name)
+    elif name in ("first_value", "last_value"):
+        if not w.args:
+            raise WindowError(f"{name}(expr) requires an argument")
+        v = _evaluate(w.args[0], table).take(take)
+        if name == "first_value":
+            out_sorted = v.take(pa.array(pstart))
+        elif cumulative:
+            out_sorted = v.take(pa.array(frame_end))
+        else:
+            # whole-partition frame: last row of the partition
+            pend = _peer_end_idx(part_starts)
+            out_sorted = v.take(pa.array(pend))
+    elif name in ("count", "count_star", "sum", "avg", "min", "max"):
+        out_sorted = _window_agg(
+            w, table, take, part_starts, frame_end, pstart, pos, cumulative
+        )
+    else:
+        raise WindowError(f"unsupported window function {name}")
+
+    # scatter back to input order
+    inv = np.empty(n, dtype=np.int64)
+    inv[sort_idx] = np.arange(n)
+    return out_sorted.take(pa.array(inv))
+
+
+def _literal_value(e: S.Expr, what: str):
+    if isinstance(e, S.Literal):
+        return e.value
+    if isinstance(e, S.UnaryOp) and e.op == "-" and isinstance(e.operand, S.Literal):
+        return -e.operand.value
+    raise WindowError(f"{what} must be a literal")
+
+
+def _one_segment(n: int) -> np.ndarray:
+    s = np.zeros(n, bool)
+    if n:
+        s[0] = True
+    return s
+
+
+def _ntile(w: S.WindowCall, table: pa.Table, pos: np.ndarray, part_starts: np.ndarray) -> np.ndarray:
+    if not w.args:
+        raise WindowError("ntile(n) requires an integer literal")
+    tiles = int(_literal_value(w.args[0], "ntile(n)"))
+    if tiles <= 0:
+        raise WindowError("ntile(n) requires n > 0")
+    n = len(pos)
+    # partition sizes, broadcast to rows
+    start_idx = np.nonzero(part_starts)[0]
+    sizes = np.diff(np.append(start_idx, n))
+    size_per_row = np.repeat(sizes, sizes)
+    base = size_per_row // tiles
+    rem = size_per_row % tiles
+    cut = rem * (base + 1)
+    big = pos < cut
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t_big = pos // np.maximum(base + 1, 1)
+        t_small = rem + (pos - cut) // np.maximum(base, 1)
+    return np.where(big, t_big, t_small) + 1
+
+
+def _lag_lead(
+    w: S.WindowCall,
+    table: pa.Table,
+    take: pa.Array,
+    pstart: np.ndarray,
+    part_starts: np.ndarray,
+    name: str,
+) -> pa.Array:
+    if not w.args:
+        raise WindowError(f"{name}(expr[, offset[, default]])")
+    v = _evaluate(w.args[0], table).take(take)
+    off = 1
+    if len(w.args) > 1:
+        off = int(_literal_value(w.args[1], f"{name} offset"))
+    default = None
+    if len(w.args) > 2:
+        default = _literal_value(w.args[2], f"{name} default")
+    if off < 0:
+        # SQL: lag(x, -n) == lead(x, n) — normalize so the partition-edge
+        # checks below match the actual read direction
+        name = "lead" if name == "lag" else "lag"
+        off = -off
+    n = len(pstart)
+    pos = np.arange(n)
+    pend = _peer_end_idx(part_starts)  # last index of partition
+    if name == "lag":
+        src = pos - off
+        bad = src < pstart
+    else:
+        src = pos + off
+        bad = src > pend
+    src = np.clip(src, 0, max(n - 1, 0))
+    out = v.take(pa.array(src))
+    if bad.any():
+        mask = pa.array(~bad)
+        if default is None:
+            out = pc.if_else(mask, out, pa.scalar(None, out.type))
+        else:
+            out = pc.if_else(mask, out, pa.scalar(default, type=out.type))
+    return out
+
+
+def _cum_with_resets(vals: np.ndarray, part_starts: np.ndarray, op: str) -> np.ndarray:
+    """Running sum/min/max with resets at partition starts, vectorized."""
+    if op == "sum":
+        cs = np.cumsum(vals)
+        starts_idx = np.nonzero(part_starts)[0]
+        # subtract the cumsum just before each partition's first row
+        base = cs[starts_idx] - vals[starts_idx]
+        seg_id = np.cumsum(part_starts) - 1
+        return cs - base[seg_id]
+    # min/max: loop over partitions (counts are small relative to rows;
+    # each partition is a vectorized accumulate)
+    out = np.empty_like(vals)
+    starts = np.nonzero(part_starts)[0]
+    bounds = np.append(starts, len(vals))
+    fn = np.minimum.accumulate if op == "min" else np.maximum.accumulate
+    for i in range(len(starts)):
+        lo, hi = bounds[i], bounds[i + 1]
+        out[lo:hi] = fn(vals[lo:hi])
+    return out
+
+
+def _window_agg(
+    w: S.WindowCall,
+    table: pa.Table,
+    take: pa.Array,
+    part_starts: np.ndarray,
+    frame_end: np.ndarray,
+    pstart: np.ndarray,
+    pos: np.ndarray,
+    cumulative: bool,
+) -> pa.Array:
+    n = len(pos)
+    name = w.name
+    star = not w.args or isinstance(w.args[0], S.Star)
+    if star and name != "count":
+        raise WindowError(f"{name}(*) is not valid")
+    int_result = False
+    if star:
+        valid = np.ones(n, bool)
+        vals = np.ones(n, np.float64)
+    else:
+        arr = _evaluate(w.args[0], table).take(take)
+        valid = pc.is_valid(arr).to_numpy(zero_copy_only=False).astype(bool)
+        if name == "count":
+            vals = valid.astype(np.float64)
+        else:
+            t = arr.type
+            if not (
+                pa.types.is_integer(t) or pa.types.is_floating(t) or pa.types.is_boolean(t)
+            ):
+                raise WindowError(
+                    f"windowed {name}() over a {t} column is not supported"
+                )
+            # integer inputs keep integer output for sum/min/max (matches
+            # the non-window aggregate path); avg is always double
+            int_result = pa.types.is_integer(t) and name != "avg"
+            vals = np.asarray(
+                pc.cast(arr, pa.float64(), safe=False).fill_null(0.0).to_numpy(
+                    zero_copy_only=False
+                ),
+                np.float64,
+            )
+
+    def out_arr(vals_out: np.ndarray, seen: np.ndarray) -> pa.Array:
+        if int_result:
+            return pa.array(vals_out.astype(np.int64), mask=~seen)
+        return pa.array(vals_out, mask=~seen)
+
+    if not cumulative:
+        # whole-partition aggregate broadcast to every row
+        starts_idx = np.nonzero(part_starts)[0]
+        bounds = np.append(starts_idx, n)
+        sizes = np.diff(bounds)
+        cnt = np.add.reduceat(valid.astype(np.float64), starts_idx)
+        seen = np.repeat(cnt, sizes) > 0
+        if name in ("count", "count_star"):
+            return pa.array(np.repeat(cnt, sizes).astype(np.int64))
+        if name == "sum":
+            seg = np.add.reduceat(np.where(valid, vals, 0.0), starts_idx)
+            return out_arr(np.repeat(seg, sizes), seen)
+        if name == "avg":
+            seg = np.add.reduceat(np.where(valid, vals, 0.0), starts_idx)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                seg = np.where(cnt > 0, seg / np.maximum(cnt, 1), np.nan)
+            return pa.array(np.repeat(seg, sizes), mask=~seen)
+        # min/max
+        fill = np.inf if name == "min" else -np.inf
+        seg_fn = np.minimum.reduceat if name == "min" else np.maximum.reduceat
+        seg = seg_fn(np.where(valid, vals, fill), starts_idx)
+        return out_arr(np.repeat(seg, sizes), seen)
+
+    # cumulative: running value read at the frame end (ROWS: own row;
+    # RANGE: last peer)
+    cnt = _cum_with_resets(valid.astype(np.float64), part_starts, "sum")[frame_end]
+    seen = cnt > 0
+    if name in ("count", "count_star"):
+        return pa.array(cnt.astype(np.int64))
+    if name == "sum":
+        run = _cum_with_resets(np.where(valid, vals, 0.0), part_starts, "sum")[frame_end]
+        return out_arr(run, seen)
+    if name == "avg":
+        run = _cum_with_resets(np.where(valid, vals, 0.0), part_starts, "sum")[frame_end]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = np.where(cnt > 0, run / np.maximum(cnt, 1), np.nan)
+        return pa.array(out, mask=~seen)
+    fill = np.inf if name == "min" else -np.inf
+    run = _cum_with_resets(np.where(valid, vals, fill), part_starts, name)[frame_end]
+    return out_arr(run, seen)
+
+
+def attach_window_columns(
+    table: pa.Table, windows: list[S.WindowCall]
+) -> tuple[pa.Table, dict[str, str]]:
+    """Compute every distinct window call as a `__w{i}` column appended to
+    `table`; returns (augmented table, repr(WindowCall) -> column name)."""
+    mapping: dict[str, str] = {}
+    for w in windows:
+        key = repr(w)
+        if key in mapping:
+            continue
+        col = f"__w{len(mapping)}"
+        table = table.append_column(col, compute_window(w, table))
+        mapping[key] = col
+    return table, mapping

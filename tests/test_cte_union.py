@@ -1,0 +1,180 @@
+"""CTEs (WITH) and UNION [ALL] end-to-end through the session (reference:
+DataFusion SQL surface, src/query/mod.rs:212-276), plus the queryContext
+rows-around-an-anchor pattern expressed as a window query
+(src/handlers/http/query_context.rs)."""
+
+import pytest
+
+from parseable_tpu.query.session import QueryError, QuerySession
+from parseable_tpu.query.sql import parse_sql
+
+
+@pytest.fixture()
+def p(parseable):
+    from parseable_tpu.event.json_format import JsonEvent
+
+    s1 = parseable.create_stream_if_not_exists("web")
+    ev = JsonEvent(
+        [
+            {"host": f"h{i % 3}", "status": float(200 + (i % 2) * 300), "ms": float(i)}
+            for i in range(30)
+        ],
+        "web",
+    ).into_event(s1.metadata)
+    ev.process(s1, commit_schema=parseable.commit_schema)
+    s2 = parseable.create_stream_if_not_exists("api")
+    ev = JsonEvent(
+        [{"host": f"h{i % 2}", "status": 200.0, "ms": float(100 + i)} for i in range(10)],
+        "api",
+    ).into_event(s2.metadata)
+    ev.process(s2, commit_schema=parseable.commit_schema)
+    return parseable
+
+
+def test_union_all(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "SELECT host, ms FROM web WHERE ms < 2 UNION ALL SELECT host, ms FROM api "
+        "WHERE ms < 102 ORDER BY ms"
+    )
+    rows = r.to_json_rows()
+    assert [x["ms"] for x in rows] == [0.0, 1.0, 100.0, 101.0]
+
+
+def test_union_distinct_dedupes(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query("SELECT host FROM web UNION SELECT host FROM api ORDER BY host")
+    assert [x["host"] for x in r.to_json_rows()] == ["h0", "h1", "h2"]
+
+
+def test_union_column_count_mismatch(p):
+    sess = QuerySession(p, engine="cpu")
+    with pytest.raises(QueryError):
+        sess.query("SELECT host, ms FROM web UNION ALL SELECT host FROM api")
+
+
+def test_union_aggregate_branches(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "SELECT host, count(*) c FROM web GROUP BY host "
+        "UNION ALL SELECT host, count(*) c FROM api GROUP BY host ORDER BY host, c"
+    )
+    rows = r.to_json_rows()
+    # web: h0 x10, h1 x10, h2 x10; api: h0 x5, h1 x5
+    assert rows == [
+        {"host": "h0", "c": 5},
+        {"host": "h0", "c": 10},
+        {"host": "h1", "c": 5},
+        {"host": "h1", "c": 10},
+        {"host": "h2", "c": 10},
+    ]
+
+
+def test_cte_basic(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "WITH errors AS (SELECT host, ms FROM web WHERE status = 500) "
+        "SELECT host, count(*) c FROM errors GROUP BY host ORDER BY host"
+    )
+    assert r.to_json_rows() == [
+        {"host": "h0", "c": 5},
+        {"host": "h1", "c": 5},
+        {"host": "h2", "c": 5},
+    ]
+
+
+def test_cte_chained_references(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "WITH errs AS (SELECT host, ms FROM web WHERE status = 500), "
+        "slow AS (SELECT host FROM errs WHERE ms > 10) "
+        "SELECT count(*) c FROM slow"
+    )
+    # errors have odd i (status 500): i in 1..29 odd; ms>10 -> 11..29 odd = 10
+    assert r.to_json_rows() == [{"c": 10}]
+
+
+def test_cte_join_with_stream(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "WITH hot AS (SELECT host, count(*) c FROM web GROUP BY host) "
+        "SELECT a.host, hot.c FROM api a JOIN hot ON a.host = hot.host "
+        "GROUP BY a.host, hot.c ORDER BY a.host"
+    )
+    assert r.to_json_rows() == [{"host": "h0", "c": 10}, {"host": "h1", "c": 10}]
+
+
+def test_cte_in_union(p):
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "WITH w AS (SELECT host FROM web WHERE ms < 1) "
+        "SELECT host FROM w UNION ALL SELECT host FROM api WHERE ms < 101 ORDER BY host"
+    )
+    assert [x["host"] for x in r.to_json_rows()] == ["h0", "h0"]
+
+
+def test_cte_rbac_checks_underlying_stream(p):
+    sess = QuerySession(p, engine="cpu")
+    with pytest.raises(QueryError):
+        sess.query(
+            "WITH w AS (SELECT host FROM web) SELECT count(*) FROM w",
+            allowed_streams={"api"},
+        )
+    # allowed when the underlying stream is authorized
+    r = sess.query(
+        "WITH w AS (SELECT host FROM web) SELECT count(*) c FROM w",
+        allowed_streams={"web"},
+    )
+    assert r.to_json_rows() == [{"c": 30}]
+
+
+def test_union_rbac_checks_every_branch(p):
+    sess = QuerySession(p, engine="cpu")
+    with pytest.raises(QueryError):
+        sess.query(
+            "SELECT host FROM web UNION ALL SELECT host FROM api",
+            allowed_streams={"web"},
+        )
+
+
+def test_query_context_anchor_window(p):
+    """queryContext-style paging: N rows around an anchor expressed with
+    row_number (reference: src/handlers/http/query_context.rs:874-922)."""
+    sess = QuerySession(p, engine="cpu")
+    r = sess.query(
+        "WITH ordered AS (SELECT ms, row_number() OVER (ORDER BY ms) rn FROM web) "
+        "SELECT ms FROM ordered WHERE rn BETWEEN 14 AND 16 ORDER BY rn"
+    )
+    assert [x["ms"] for x in r.to_json_rows()] == [13.0, 14.0, 15.0]
+
+
+def test_query_stream_union_materializes_all_branches(p):
+    sess = QuerySession(p, engine="cpu")
+    chunks = list(
+        sess.query_stream(
+            "SELECT host, ms FROM web WHERE ms < 2 UNION ALL "
+            "SELECT host, ms FROM api WHERE ms < 102 ORDER BY ms"
+        )
+    )
+    rows = [r for c in chunks for r in c.to_pylist()]
+    assert [r["ms"] for r in rows] == [0.0, 1.0, 100.0, 101.0]
+
+
+def test_query_stream_cte(p):
+    sess = QuerySession(p, engine="cpu")
+    chunks = list(
+        sess.query_stream(
+            "WITH w AS (SELECT ms FROM web WHERE ms < 3) SELECT ms FROM w ORDER BY ms"
+        )
+    )
+    rows = [r for c in chunks for r in c.to_pylist()]
+    assert [r["ms"] for r in rows] == [0.0, 1.0, 2.0]
+
+
+def test_streams_collected_through_ctes_and_unions():
+    from parseable_tpu.query.session import collect_streams
+
+    sel = parse_sql(
+        "WITH w AS (SELECT a FROM s1) SELECT a FROM w UNION ALL SELECT a FROM s2"
+    )
+    assert collect_streams(sel) == {"s1", "s2"}

@@ -1,0 +1,264 @@
+"""Window functions, CTEs, and UNION [ALL] (reference: the DataFusion SQL
+surface the reference gets for free, src/query/mod.rs:212-276; the
+queryContext rows-around-an-anchor pattern, src/handlers/http/query_context.rs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.query import sql as S
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.executor import QueryExecutor
+
+
+def run(sql: str, table: pa.Table) -> list[dict]:
+    lp = build_plan(S.parse_sql(sql))
+    out = QueryExecutor(lp).execute(iter([table]))
+    return out.to_pylist()
+
+
+@pytest.fixture()
+def t() -> pa.Table:
+    return pa.table(
+        {
+            "host": ["a", "a", "a", "b", "b", "c"],
+            "lat": [10.0, 30.0, 20.0, 5.0, 15.0, 7.0],
+            "seq": [1, 2, 3, 1, 2, 1],
+        }
+    )
+
+
+# ------------------------------------------------------------------- parsing
+
+
+def test_parse_window_call():
+    sel = S.parse_sql(
+        "SELECT host, row_number() OVER (PARTITION BY host ORDER BY lat DESC) rn FROM t"
+    )
+    w = sel.items[1].expr
+    assert isinstance(w, S.WindowCall)
+    assert w.name == "row_number"
+    assert len(w.partition_by) == 1 and len(w.order_by) == 1
+    assert w.order_by[0].desc
+
+
+def test_parse_window_frame_rows():
+    sel = S.parse_sql(
+        "SELECT sum(lat) OVER (ORDER BY seq ROWS BETWEEN UNBOUNDED PRECEDING "
+        "AND CURRENT ROW) FROM t"
+    )
+    assert sel.items[0].expr.frame == "rows_cumulative"
+
+
+def test_parse_window_unsupported_frame():
+    with pytest.raises(S.SqlError):
+        S.parse_sql("SELECT sum(lat) OVER (ORDER BY seq ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) FROM t")
+
+
+def test_parse_union_and_cte():
+    sel = S.parse_sql(
+        "WITH top AS (SELECT host FROM a), rest AS (SELECT host FROM b) "
+        "SELECT host FROM top UNION ALL SELECT host FROM rest ORDER BY host LIMIT 3"
+    )
+    assert set(sel.ctes) == {"top", "rest"}
+    assert len(sel.set_ops) == 1 and sel.set_ops[0][0] is True
+    # hoisted to the union level
+    assert sel.limit == 3 and len(sel.order_by) == 1
+    assert sel.set_ops[0][1].limit is None
+
+
+def test_column_named_over_still_parses():
+    sel = S.parse_sql("SELECT over FROM t WHERE over > 1")
+    assert isinstance(sel.items[0].expr, S.Column)
+
+
+# ------------------------------------------------------------------ executor
+
+
+def test_row_number_partitioned(t):
+    rows = run(
+        "SELECT host, lat, row_number() OVER (PARTITION BY host ORDER BY lat DESC) rn "
+        "FROM t ORDER BY host, rn",
+        t,
+    )
+    assert [(r["host"], r["lat"], r["rn"]) for r in rows] == [
+        ("a", 30.0, 1), ("a", 20.0, 2), ("a", 10.0, 3),
+        ("b", 15.0, 1), ("b", 5.0, 2), ("c", 7.0, 1),
+    ]
+
+
+def test_rank_and_dense_rank_with_ties():
+    t = pa.table({"g": ["x"] * 5, "v": [10, 20, 20, 30, 30]})
+    rows = run(
+        "SELECT v, rank() OVER (ORDER BY v) rk, dense_rank() OVER (ORDER BY v) dr "
+        "FROM t ORDER BY v, rk",
+        t,
+    )
+    assert [(r["v"], r["rk"], r["dr"]) for r in rows] == [
+        (10, 1, 1), (20, 2, 2), (20, 2, 2), (30, 4, 3), (30, 4, 3),
+    ]
+
+
+def test_lag_lead_defaults(t):
+    rows = run(
+        "SELECT host, seq, lag(seq) OVER (PARTITION BY host ORDER BY seq) prev, "
+        "lead(seq, 1, -1) OVER (PARTITION BY host ORDER BY seq) nxt "
+        "FROM t ORDER BY host, seq",
+        t,
+    )
+    got = [(r["host"], r["seq"], r["prev"], r["nxt"]) for r in rows]
+    assert got == [
+        ("a", 1, None, 2), ("a", 2, 1, 3), ("a", 3, 2, -1),
+        ("b", 1, None, 2), ("b", 2, 1, -1), ("c", 1, None, -1),
+    ]
+
+
+def test_running_sum_and_partition_total(t):
+    rows = run(
+        "SELECT host, seq, sum(lat) OVER (PARTITION BY host ORDER BY seq) run, "
+        "sum(lat) OVER (PARTITION BY host) total "
+        "FROM t ORDER BY host, seq",
+        t,
+    )
+    a_total = 10.0 + 30.0 + 20.0
+    got = [(r["host"], r["seq"], r["run"], r["total"]) for r in rows]
+    assert got[0] == ("a", 1, 10.0, a_total)
+    assert got[1] == ("a", 2, 40.0, a_total)
+    assert got[2] == ("a", 3, 60.0, a_total)
+    assert got[3] == ("b", 1, 5.0, 20.0)
+
+
+def test_running_sum_peers_share_frame():
+    t = pa.table({"v": [1.0, 2.0, 3.0], "k": [1, 1, 2]})
+    rows = run("SELECT k, sum(v) OVER (ORDER BY k) s FROM t ORDER BY k, s", t)
+    # rows with equal ORDER BY keys are peers: both k=1 rows see 3.0
+    assert [r["s"] for r in rows] == [3.0, 3.0, 6.0]
+
+
+def test_first_last_value(t):
+    rows = run(
+        "SELECT host, seq, first_value(lat) OVER (PARTITION BY host ORDER BY seq) f, "
+        "last_value(lat) OVER (PARTITION BY host) l "
+        "FROM t ORDER BY host, seq",
+        t,
+    )
+    got = [(r["host"], r["f"], r["l"]) for r in rows]
+    assert got[0] == ("a", 10.0, 20.0)  # last by seq order within partition
+    assert got[3] == ("b", 5.0, 15.0)
+
+
+def test_ntile():
+    t = pa.table({"v": list(range(7))})
+    rows = run("SELECT v, ntile(3) OVER (ORDER BY v) b FROM t ORDER BY v", t)
+    assert [r["b"] for r in rows] == [1, 1, 1, 2, 2, 3, 3]
+
+
+def test_running_min_max():
+    t = pa.table({"g": ["x", "x", "x", "y", "y"], "v": [3.0, 1.0, 2.0, 9.0, 4.0]})
+    rows = run(
+        "SELECT g, v, min(v) OVER (PARTITION BY g ORDER BY v DESC) m, "
+        "max(v) OVER (PARTITION BY g ORDER BY v DESC) x FROM t ORDER BY g, v DESC",
+        t,
+    )
+    got = [(r["g"], r["v"], r["m"], r["x"]) for r in rows]
+    assert got == [
+        ("x", 3.0, 3.0, 3.0), ("x", 2.0, 2.0, 3.0), ("x", 1.0, 1.0, 3.0),
+        ("y", 9.0, 9.0, 9.0), ("y", 4.0, 4.0, 9.0),
+    ]
+
+
+def test_window_over_aggregate_output():
+    t = pa.table({"path": ["p1", "p1", "p2", "p3"], "b": [1.0, 2.0, 10.0, 5.0]})
+    rows = run(
+        "SELECT path, sum(b) s, rank() OVER (ORDER BY sum(b) DESC) rk "
+        "FROM t GROUP BY path ORDER BY rk",
+        t,
+    )
+    assert [(r["path"], r["s"], r["rk"]) for r in rows] == [
+        ("p2", 10.0, 1), ("p3", 5.0, 2), ("p1", 3.0, 3),
+    ]
+
+
+def test_window_numpy_parity_large():
+    rng = np.random.default_rng(7)
+    n = 20_000
+    g = rng.integers(0, 50, n)
+    v = rng.standard_normal(n)
+    t = pa.table({"g": g, "v": v})
+    rows = run(
+        "SELECT g, v, row_number() OVER (PARTITION BY g ORDER BY v) rn FROM t",
+        t,
+    )
+    # verify against a pandas-free numpy reference: per-group sorted ranks
+    import collections
+
+    by_g = collections.defaultdict(list)
+    for r in rows:
+        by_g[r["g"]].append((r["v"], r["rn"]))
+    for vals in by_g.values():
+        vals.sort()
+        assert [rn for _, rn in vals] == list(range(1, len(vals) + 1))
+
+
+def test_rows_frame_differs_from_range_on_ties():
+    # peers share the frame under RANGE but not under ROWS
+    t = pa.table({"k": [1, 1, 1, 2, 2], "o": [10, 10, 20, 5, 5], "x": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    rows = run(
+        "SELECT x, sum(x) OVER (PARTITION BY k ORDER BY o ROWS BETWEEN UNBOUNDED "
+        "PRECEDING AND CURRENT ROW) r FROM t ORDER BY x",
+        t,
+    )
+    assert [r["r"] for r in rows] == [1.0, 3.0, 6.0, 4.0, 9.0]
+    rows = run(
+        "SELECT x, sum(x) OVER (PARTITION BY k ORDER BY o) r FROM t ORDER BY x",
+        t,
+    )
+    assert [r["r"] for r in rows] == [3.0, 3.0, 6.0, 9.0, 9.0]
+
+
+def test_lag_negative_offset_is_lead():
+    t = pa.table({"k": [1, 1, 1, 2, 2], "o": [1, 2, 3, 1, 2], "x": [1, 2, 3, 4, 5]})
+    rows = run(
+        "SELECT x, lag(x, -1) OVER (PARTITION BY k ORDER BY o) nxt FROM t ORDER BY x",
+        t,
+    )
+    # lag(x,-1) == lead(x,1): NULL past the partition edge, never a
+    # neighbor partition's row
+    assert [r["nxt"] for r in rows] == [2, 3, None, 5, None]
+
+
+def test_windowed_sum_integer_stays_integer():
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    lp = build_plan(S.parse_sql("SELECT sum(x) OVER () s FROM t"))
+    out = QueryExecutor(lp).execute(iter([t]))
+    assert pa.types.is_integer(out.schema.field("s").type)
+    assert out.to_pylist() == [{"s": 6}, {"s": 6}, {"s": 6}]
+
+
+def test_windowed_min_over_string_clean_error():
+    from parseable_tpu.query.window import WindowError
+
+    t = pa.table({"s": ["b", "a"], "k": [1, 1]})
+    with pytest.raises(WindowError):
+        run("SELECT min(s) OVER (PARTITION BY k) m FROM t", t)
+
+
+def test_window_only_in_order_by(t):
+    rows = run(
+        "SELECT lat FROM t ORDER BY row_number() OVER (ORDER BY lat DESC) LIMIT 2",
+        t,
+    )
+    assert [r["lat"] for r in rows] == [30.0, 20.0]
+    assert [c for c in rows[0]] == ["lat"]
+
+
+def test_windows_with_where_and_limit(t):
+    rows = run(
+        "SELECT host, row_number() OVER (PARTITION BY host ORDER BY lat) rn "
+        "FROM t WHERE lat > 6 ORDER BY host, rn LIMIT 3",
+        t,
+    )
+    assert [(r["host"], r["rn"]) for r in rows] == [("a", 1), ("a", 2), ("a", 3)]
