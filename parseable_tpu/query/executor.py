@@ -864,6 +864,27 @@ class QueryExecutor:
 
     def _execute_aggregate(self, tables: Iterator[pa.Table]) -> pa.Table:
         agg, rewritten, group_names = self.build_aggregator()
+        sel = self.plan.select
+        from parseable_tpu.query import partials as PT
+
+        if sel.group_by and PT.specs_partializable(agg.specs):
+            # two-phase: per-block pyarrow partials + ONE vectorized merge —
+            # no per-group Python, so 1M-group queries don't cliff
+            # (DataFusion partial/final split parity)
+            parts: list[pa.Table] = []
+            for table in tables:
+                self._check_deadline()
+                table = self._bounds_filter(table)
+                mask = self._where_mask(table)
+                if mask is not None:
+                    table = table.filter(mask)
+                pt = PT.partial_from_block(table, sel.group_by, agg.specs)
+                if pt is not None:
+                    parts.append(pt)
+            if parts:
+                interim = PT.merge_partials(parts, agg.specs, len(sel.group_by))
+                return self.finalize_from_interim(interim, rewritten)
+            return self.finalize_aggregate(agg, rewritten, group_names)
         for table in tables:
             self._check_deadline()
             table = self._bounds_filter(table)

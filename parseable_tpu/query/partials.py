@@ -1,0 +1,110 @@
+"""Partial-aggregate tables and their vectorized merge.
+
+Two-phase aggregation's shared host half (reference: DataFusion's
+partial/final hash-aggregate split, /root/reference/src/query/mod.rs:212-276):
+each scanned block reduces to a *partial table* — group keys as `__g{i}`
+columns plus `__cnt` (rows per group) and per-spec `__pac{si}` (non-null
+input count), `__sum{si}`, `__min{si}`, `__max{si}` — and ONE pyarrow
+group_by merges every partial at finalize. Both engines produce partials
+(the TPU engine from dense device accumulators, the CPU engine from
+per-block group_bys), so a 1M-group query costs one Arrow C++ hash
+aggregation, never a per-group Python loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+# aggregate functions expressible in partial format (stddev/var/distinct
+# need extra state and take the classic HashAggregator path)
+PARTIALIZABLE_FUNCS = {"count_star", "count", "sum", "avg", "min", "max"}
+
+
+def specs_partializable(specs) -> bool:
+    return all(s.func in PARTIALIZABLE_FUNCS for s in specs)
+
+
+def partial_from_block(table: pa.Table, group_exprs: list, specs: list) -> pa.Table | None:
+    """CPU half: one block's partial aggregate via pyarrow group_by."""
+    from parseable_tpu.query.executor import _arr, evaluate
+
+    if table.num_rows == 0:
+        return None
+    cols: dict[str, Any] = {}
+    key_names = []
+    for i, g in enumerate(group_exprs):
+        key_names.append(f"__g{i}")
+        cols[f"__g{i}"] = _arr(evaluate(g, table), table)
+    aggs: list[tuple] = [([], "count_all")]
+    for si, spec in enumerate(specs):
+        if spec.func == "count_star":
+            continue
+        cols[f"__a{si}"] = _arr(evaluate(spec.arg, table), table)
+        aggs.append((f"__a{si}", "count"))
+        if spec.func in ("sum", "avg"):
+            aggs.append((f"__a{si}", "sum"))
+        elif spec.func == "min":
+            aggs.append((f"__a{si}", "min"))
+        elif spec.func == "max":
+            aggs.append((f"__a{si}", "max"))
+    tmp = pa.table(cols) if cols else pa.table(
+        {"__d": pa.nulls(table.num_rows, pa.int8())}
+    )
+    g = tmp.group_by(key_names, use_threads=False).aggregate(aggs)
+    out: dict[str, Any] = {}
+    for k in key_names:
+        out[k] = g.column(k)
+    out["__cnt"] = pc.cast(g.column("count_all"), pa.float64())
+    for si, spec in enumerate(specs):
+        if spec.func == "count_star":
+            continue
+        out[f"__pac{si}"] = pc.cast(g.column(f"__a{si}_count"), pa.float64())
+        if spec.func in ("sum", "avg"):
+            out[f"__sum{si}"] = pc.cast(g.column(f"__a{si}_sum"), pa.float64())
+        elif spec.func == "min":
+            out[f"__min{si}"] = g.column(f"__a{si}_min")
+        elif spec.func == "max":
+            out[f"__max{si}"] = g.column(f"__a{si}_max")
+    return pa.table(out)
+
+
+def merge_partials(partials: list[pa.Table], specs: list, nkeys: int) -> pa.Table:
+    """Final half: merge partial tables -> interim (__g/__agg) table for
+    finalize_from_interim. One vectorized group_by over all partials."""
+    t = pa.concat_tables(partials, promote_options="permissive")
+    keys = [f"__g{i}" for i in range(nkeys)]
+    aggs: list[tuple] = [("__cnt", "sum")]
+    for si, spec in enumerate(specs):
+        if spec.func == "count_star":
+            continue
+        aggs.append((f"__pac{si}", "sum"))
+        if spec.func in ("sum", "avg"):
+            aggs.append((f"__sum{si}", "sum"))
+        elif spec.func == "min":
+            aggs.append((f"__min{si}", "min"))
+        elif spec.func == "max":
+            aggs.append((f"__max{si}", "max"))
+    g = t.group_by(keys, use_threads=False).aggregate(aggs)
+    cols: dict[str, Any] = {}
+    for i in range(nkeys):
+        cols[f"__g{i}"] = g.column(f"__g{i}")
+    for si, spec in enumerate(specs):
+        if spec.func == "count_star":
+            cols[f"__agg{si}"] = pc.cast(g.column("__cnt_sum"), pa.int64(), safe=False)
+            continue
+        pacv = g.column(f"__pac{si}_sum")
+        if spec.func == "count":
+            cols[f"__agg{si}"] = pc.cast(pacv, pa.int64(), safe=False)
+        elif spec.func in ("sum", "avg"):
+            s = g.column(f"__sum{si}_sum")
+            seen = pc.greater(pacv, 0)
+            val = pc.divide(s, pacv) if spec.func == "avg" else s
+            cols[f"__agg{si}"] = pc.if_else(seen, val, pa.scalar(None, pa.float64()))
+        elif spec.func == "min":
+            cols[f"__agg{si}"] = g.column(f"__min{si}_min")
+        elif spec.func == "max":
+            cols[f"__agg{si}"] = g.column(f"__max{si}_max")
+    return pa.table(cols)

@@ -1,0 +1,233 @@
+"""High-cardinality GROUP BY: block-local two-phase aggregation
+(reference: DataFusion hash-aggregate handles unbounded cardinality,
+/root/reference/src/query/mod.rs:212-276; here the device folds each block
+on its own dictionary codes and one vectorized pyarrow group_by merges the
+partials — VERDICT r2 item #2)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.query import executor_tpu as ET
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+
+def run_both(sql: str, tables: list[pa.Table]) -> tuple[list, list]:
+    lp_cpu = build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp_cpu).execute(iter(tables))
+    lp_tpu = build_plan(parse_sql(sql))
+    tpu = ET.TpuQueryExecutor(lp_tpu).execute(iter(tables))
+
+    def norm(t: pa.Table) -> list:
+        rows = [tuple(r.values()) for r in t.to_pylist()]
+        return sorted(rows, key=lambda r: tuple(str(v) for v in r))
+
+    return norm(cpu), norm(tpu)
+
+
+def assert_rows_close(cpu: list, tpu: list) -> None:
+    assert len(cpu) == len(tpu)
+    for rc, rt in zip(cpu, tpu):
+        assert len(rc) == len(rt)
+        for vc, vt in zip(rc, rt):
+            if isinstance(vc, float) and isinstance(vt, float):
+                assert vt == pytest.approx(vc, rel=1e-4, abs=1e-6)
+            else:
+                assert vc == vt
+
+
+def local_programs_built() -> int:
+    return sum(1 for k in ET._PROGRAM_CACHE if k and k[0] == "local")
+
+
+@pytest.fixture()
+def highcard_tables() -> list[pa.Table]:
+    """Three blocks, ~120k distinct user ids total, overlapping across
+    blocks so the merge phase has real work."""
+    rng = np.random.default_rng(11)
+    tables = []
+    for b in range(3):
+        n = 60_000
+        uid = rng.integers(b * 30_000, b * 30_000 + 60_000, n)
+        tables.append(
+            pa.table(
+                {
+                    "user": pa.array([f"u{int(x)}" for x in uid]),
+                    "bytes": pa.array(rng.random(n) * 100.0),
+                    "lat": pa.array(rng.random(n) * 10.0),
+                }
+            )
+        )
+    return tables
+
+
+def test_highcard_groupby_parity(highcard_tables):
+    before = local_programs_built()
+    orig = ET.DENSE_G_MAX
+    ET.DENSE_G_MAX = 1 << 14
+    try:
+        cpu, tpu = run_both(
+            "SELECT user, count(*) c, sum(bytes) s, min(lat) mn, max(lat) mx, avg(bytes) a "
+            "FROM t GROUP BY user",
+            highcard_tables,
+        )
+    finally:
+        ET.DENSE_G_MAX = orig
+    assert len(cpu) > 80_000  # genuinely high-cardinality
+    assert_rows_close(cpu, tpu)
+    assert local_programs_built() > before, "block-local mode did not engage"
+
+
+def test_highcard_with_where_filter(highcard_tables):
+    orig = ET.DENSE_G_MAX
+    ET.DENSE_G_MAX = 1 << 14
+    try:
+        cpu, tpu = run_both(
+            "SELECT user, count(*) c FROM t WHERE bytes > 50 GROUP BY user",
+            highcard_tables,
+        )
+    finally:
+        ET.DENSE_G_MAX = orig
+    assert_rows_close(cpu, tpu)
+
+
+def test_lowcard_query_stays_dense():
+    """A small group space must keep using the dense global path."""
+    rng = np.random.default_rng(3)
+    t = pa.table(
+        {
+            "k": pa.array([f"k{int(x)}" for x in rng.integers(0, 50, 20_000)]),
+            "v": pa.array(rng.random(20_000)),
+        }
+    )
+    before = local_programs_built()
+    cpu, tpu = run_both("SELECT k, count(*) c, sum(v) s FROM t GROUP BY k", [t])
+    assert_rows_close(cpu, tpu)
+    assert local_programs_built() == before
+
+
+def test_dense_epoch_merges_into_local_mode():
+    """Blocks that start low-cardinality and then explode: the dense
+    epoch's accumulator must convert to a partial and merge exactly."""
+    rng = np.random.default_rng(5)
+    low = pa.table(
+        {
+            "k": pa.array([f"k{int(x)}" for x in rng.integers(0, 20, 30_000)]),
+            "v": pa.array(rng.random(30_000)),
+        }
+    )
+    high = pa.table(
+        {
+            "k": pa.array([f"h{i}" for i in range(3_000_000, 3_000_000 + 30_000)]),
+            "v": pa.array(rng.random(30_000)),
+        }
+    )
+    # force a tiny dense budget so the second block triggers the switch
+    orig = ET.DENSE_G_MAX
+    ET.DENSE_G_MAX = 1 << 12
+    try:
+        cpu, tpu = run_both("SELECT k, count(*) c, sum(v) s FROM t GROUP BY k", [low, high])
+    finally:
+        ET.DENSE_G_MAX = orig
+    assert_rows_close(cpu, tpu)
+
+
+def test_highcard_multikey_blocklocal():
+    """Two keys whose per-block product still fits LOCAL_G_MAX."""
+    rng = np.random.default_rng(7)
+    n = 50_000
+    t = pa.table(
+        {
+            "a": pa.array([f"a{int(x)}" for x in rng.integers(0, 2_000, n)]),
+            "b": pa.array([f"b{int(x)}" for x in rng.integers(0, 500, n)]),
+            "v": pa.array(rng.random(n)),
+        }
+    )
+    orig = ET.DENSE_G_MAX
+    ET.DENSE_G_MAX = 1 << 12
+    try:
+        cpu, tpu = run_both("SELECT a, b, count(*) c, sum(v) s FROM t GROUP BY a, b", [t])
+    finally:
+        ET.DENSE_G_MAX = orig
+    assert_rows_close(cpu, tpu)
+
+
+def test_highcard_count_distinct_falls_back_exact(highcard_tables):
+    """count(distinct) in a high-card group space: CPU fallback, exact."""
+    cpu, tpu = run_both(
+        "SELECT user, count(distinct lat) d FROM t GROUP BY user",
+        highcard_tables[:1],
+    )
+    assert_rows_close(cpu, tpu)
+
+
+def test_highcard_timebin_plus_dict_key():
+    from datetime import datetime, timedelta
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+    rng = np.random.default_rng(9)
+    n = 40_000
+    base = datetime(2024, 5, 1)
+    ts = [base + timedelta(seconds=int(s)) for s in rng.integers(0, 1800, n)]
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "user": pa.array([f"u{int(x)}" for x in rng.integers(0, 30_000, n)]),
+            "v": pa.array(rng.random(n)),
+        }
+    )
+    orig = ET.DENSE_G_MAX
+    ET.DENSE_G_MAX = 1 << 14
+    try:
+        cpu, tpu = run_both(
+            "SELECT date_bin(interval '1 minute', p_timestamp) b, user, count(*) c "
+            "FROM t GROUP BY b, user",
+            [t],
+        )
+    finally:
+        ET.DENSE_G_MAX = orig
+    assert_rows_close(cpu, tpu)
+
+
+def test_highcard_nulls_in_key():
+    rng = np.random.default_rng(13)
+    n = 40_000
+    vals = [f"u{int(x)}" if x % 7 else None for x in rng.integers(0, 40_000, n)]
+    t = pa.table({"user": pa.array(vals), "v": pa.array(rng.random(n))})
+    orig = ET.DENSE_G_MAX
+    ET.DENSE_G_MAX = 1 << 12
+    try:
+        cpu, tpu = run_both("SELECT user, count(*) c, sum(v) s FROM t GROUP BY user", [t])
+    finally:
+        ET.DENSE_G_MAX = orig
+    assert_rows_close(cpu, tpu)
+
+
+def test_vectorized_absorb_parity():
+    """GlobalDict.absorb: vectorized path must match the slow path."""
+    gd_fast = ET.GlobalDict()
+    batches = [
+        ["a", "b", None, "c"],
+        ["c", "d", "a", None, "e"],
+        [f"x{i}" for i in range(5_000)],
+        ["d", "x42", "zz"],
+    ]
+    luts = [gd_fast.absorb(b) for b in batches]
+    # reference: naive dict-based absorb
+    values: list = []
+    index: dict = {}
+    for b, lut in zip(batches, luts):
+        for i, v in enumerate(b):
+            if v is None:
+                assert lut[i] >= 2**29  # sentinel
+                continue
+            if v not in index:
+                index[v] = len(values)
+                values.append(v)
+            assert lut[i] == index[v], (v, lut[i], index[v])
+    assert gd_fast.values == values
