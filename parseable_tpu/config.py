@@ -228,13 +228,19 @@ class StorageOptions:
     # s3/gcs/blob
     bucket: str | None = field(default_factory=lambda: _env("P_S3_BUCKET") or _env("P_GCS_BUCKET"))
     region: str | None = field(default_factory=lambda: _env("P_S3_REGION"))
-    endpoint_url: str | None = field(default_factory=lambda: _env("P_S3_URL"))
+    endpoint_url: str | None = field(
+        default_factory=lambda: _env("P_S3_URL") or _env("P_GCS_URL")
+    )
     access_key: str | None = field(default_factory=lambda: _env("P_S3_ACCESS_KEY"))
     secret_key: str | None = field(default_factory=lambda: _env("P_S3_SECRET_KEY"))
     # azure (blob-store): account + its own key; container rides `bucket` —
     # kept separate from the S3 credentials so stale env vars can't cross-wire
     account: str | None = field(default_factory=lambda: _env("P_AZR_ACCOUNT"))
     azure_access_key: str | None = field(default_factory=lambda: _env("P_AZR_ACCESS_KEY"))
+    # gcs (gcs-store): explicit bearer token; without it the client asks the
+    # TPU-VM/GCE metadata server (the production path), else runs anonymous
+    # (emulator). P_GCS_URL targets fake-gcs-server/emulators.
+    gcs_token: str | None = field(default_factory=lambda: _env("P_GCS_TOKEN"))
 
 
 def generate_node_id() -> str:

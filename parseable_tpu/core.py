@@ -82,6 +82,7 @@ class Parseable:
             secret_key=self.storage_options.secret_key,
             account=getattr(self.storage_options, "account", None),
             azure_access_key=getattr(self.storage_options, "azure_access_key", None),
+            gcs_token=getattr(self.storage_options, "gcs_token", None),
             multipart_threshold=self.options.multipart_threshold_bytes,
             download_chunk_bytes=self.options.hot_tier_download_chunk_bytes,
             download_concurrency=self.options.hot_tier_download_concurrency,

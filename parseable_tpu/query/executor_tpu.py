@@ -1368,10 +1368,6 @@ class TpuQueryExecutor(QueryExecutor):
         num_groups = 1
         for c in caps:
             num_groups *= c
-        if num_groups > LOCAL_G_MAX:
-            raise UnsupportedOnDevice(
-                "block-local group space too large (multi-key high cardinality)"
-            )
 
         mesh = self.mesh
         n_data = mesh.shape.get("data", mesh.size) if mesh is not None else 1
@@ -1379,30 +1375,75 @@ class TpuQueryExecutor(QueryExecutor):
         if use_mesh:
             import jax
 
-            _, rep_s = _mesh_shardings(mesh)
+            row_s, rep_s = _mesh_shardings(self.mesh)
             put_rep = lambda a: jax.device_put(a, rep_s)
+            put_row = lambda a: jax.device_put(a, row_s)
         else:
             put_rep = jnp.asarray
+            put_row = jnp.asarray
         dev_luts = tuple(put_rep(l) for l in luts)
+        row_mask = dev.get("__rowmask", dev["__ones"])
+
+        composite_vals: np.ndarray | None = None
+        if num_groups > LOCAL_G_MAX:
+            # cap product exceeds the budget, but the block's ACTUAL key
+            # combos can't exceed its rows: compact (c0..ck) tuples with one
+            # np.unique and fold on dense pair codes instead
+            comp = None
+            origin_units = CANON_TIME_ORIGIN_MS // CANON_TIME_UNIT_MS
+            for ks, cap, origin in zip(key_specs, caps, origins):
+                vals = self._host_codes(enc, dev, ks.column)
+                if ks.kind == "dict":
+                    codes = np.minimum(vals.astype(np.int64), cap - 1)
+                else:
+                    bin_units = max(1, ks.bin_ms // CANON_TIME_UNIT_MS)
+                    base_units = origin * bin_units - origin_units
+                    codes = np.clip(
+                        (vals.astype(np.int64) - base_units) // bin_units, 0, cap - 1
+                    )
+                comp = codes if comp is None else comp * cap + codes
+            uniq, inv = np.unique(comp, return_inverse=True)
+            num_groups = _pow2(max(2, len(uniq)))
+            if num_groups > LOCAL_G_MAX:
+                raise UnsupportedOnDevice(
+                    "distinct key combos exceed the device group budget"
+                )
+            composite_vals = uniq
+            dev = dict(dev)
+            dev["__pairkey"] = put_row(inv.astype(np.int32))
+
         program = self._get_local_program(
             enc,
             tuple(caps),
             tuple(origins),
-            tuple((ks.kind, ks.column, ks.bin_ms) for ks in key_specs),
+            tuple((ks.kind, ks.column, ks.bin_ms) for ks in key_specs)
+            if composite_vals is None
+            else (("pair", "__pairkey", 0),),
             layout,
             tuple(l.shape for l in luts),
             tuple(sorted(dev.keys())),
             num_groups,
         )
-        row_mask = dev.get("__rowmask", dev["__ones"])
         outs = program(dev, dev_luts, row_mask)
         count, pac, sums, mins, maxs = (np.asarray(o, np.float64) for o in outs)
         pt = self._partial_from_arrays(
             count, pac, sums, mins, maxs, keyinfo, specs,
             sum_idx, min_idx, max_idx, countcol_idx,
+            composite_vals=composite_vals,
         )
         if pt is not None:
             partials.append(pt)
+
+    @staticmethod
+    def _host_codes(enc: EncodedBatch, dev: dict, column: str) -> np.ndarray:
+        """A column's encoded codes on host: the encode-time array when it
+        still exists, else a readback (hot-set entries strip host copies)."""
+        col = enc.columns.get(column)
+        if col is None:
+            raise UnsupportedOnDevice(f"group key column {column} missing")
+        if col.values is not None and len(col.values):
+            return col.values
+        return np.asarray(dev[column])
 
     def _get_local_program(
         self,
@@ -1430,6 +1471,7 @@ class TpuQueryExecutor(QueryExecutor):
             key_sig,
             caps,
             origins,
+            num_groups,
             tuple(layout.stacked_cols),
             tuple(layout.sum_cols),
             tuple(layout.min_cols),
@@ -1466,23 +1508,28 @@ class TpuQueryExecutor(QueryExecutor):
                 if hi is not None:
                     mask = jnp.logical_and(mask, ts < jnp.int32(hi))
                 mask = jnp.logical_and(mask, dev[f"{DEFAULT_TIMESTAMP_KEY}__valid"])
-            ids = None
-            stride = 1
-            for (kind, column, bin_ms), cap, origin in zip(key_sig, caps, origins):
-                if kind == "dict":
-                    codes = jnp.minimum(dev[column], cap - 1)
-                else:
-                    bin_units = max(1, bin_ms // CANON_TIME_UNIT_MS)
-                    base_units = origin * bin_units - origin_units
-                    codes = jnp.clip(
-                        (dev[column] - jnp.int32(base_units)) // jnp.int32(bin_units),
-                        0,
-                        cap - 1,
-                    )
-                part = codes * jnp.int32(stride)
-                ids = part if ids is None else ids + part
-                stride *= cap
-            ids = (ids if ids is not None else jnp.zeros(local_rows, jnp.int32)).astype(jnp.int32)
+            if key_sig and key_sig[0][0] == "pair":
+                # host-compacted composite codes (multi-key high cardinality)
+                ids = jnp.minimum(dev["__pairkey"], num_groups - 1)
+            else:
+                ids = None
+                stride = 1
+                for (kind, column, bin_ms), cap, origin in zip(key_sig, caps, origins):
+                    if kind == "dict":
+                        codes = jnp.minimum(dev[column], cap - 1)
+                    else:
+                        bin_units = max(1, bin_ms // CANON_TIME_UNIT_MS)
+                        base_units = origin * bin_units - origin_units
+                        codes = jnp.clip(
+                            (dev[column] - jnp.int32(base_units)) // jnp.int32(bin_units),
+                            0,
+                            cap - 1,
+                        )
+                    part = codes * jnp.int32(stride)
+                    ids = part if ids is None else ids + part
+                    stride *= cap
+                ids = (ids if ids is not None else jnp.zeros(local_rows, jnp.int32)).astype(jnp.int32)
+            ids = ids.astype(jnp.int32)
 
             def stack(names):
                 if not names:
@@ -1532,6 +1579,18 @@ class TpuQueryExecutor(QueryExecutor):
         _PROGRAM_CACHE[key] = prog
         return prog
 
+    @staticmethod
+    def _decode_key_col(info: tuple, code: np.ndarray) -> pa.Array:
+        """One key's codes -> typed arrow values (dictionary take / time bin)."""
+        if info[0] == "dict":
+            values = info[1]  # last entry is the null slot (None)
+            arr = pa.array(values) if values else pa.nulls(1)
+            take = np.minimum(code, len(values) - 1 if values else 0)
+            return arr.take(pa.array(take))
+        origin_bin, bin_ms = info[1], info[2]
+        abs_ms = (origin_bin + code) * bin_ms
+        return pa.array(abs_ms.astype("datetime64[ms]"), pa.timestamp("ms"))
+
     def _partial_from_arrays(
         self,
         count: np.ndarray,
@@ -1545,31 +1604,38 @@ class TpuQueryExecutor(QueryExecutor):
         min_idx: list[int],
         max_idx: list[int],
         countcol_idx: list[int],
+        composite_vals: np.ndarray | None = None,
     ) -> pa.Table | None:
         """Nonzero groups of one dense partial -> partial-format table
         (__g{i} keys, __cnt, per-spec __pac/__sum/__min/__max), fully
-        vectorized: divmod key decode + dictionary takes."""
+        vectorized: divmod key decode + dictionary takes.
+
+        Default layout: group id = sum(code_i * stride_i), first key minor.
+        With `composite_vals` (pair-compacted mode): group g's keys decode
+        from composite_vals[g] = ((c0*cap1 + c1)*cap2 + c2)..., first key
+        MAJOR — the np.unique compaction order."""
         idxs = np.nonzero(count > 0)[0]
         if len(idxs) == 0:
             return None
         stacked_order = sum_idx + min_idx + max_idx + countcol_idx
         cols: dict[str, pa.Array] = {}
-        rem = idxs.copy()
-        for i, info in enumerate(keyinfo):
-            cap = info[-1]
-            code = rem % cap
-            rem = rem // cap
-            if info[0] == "dict":
-                values = info[1]  # last entry is the null slot (None)
-                arr = pa.array(values) if values else pa.nulls(1)
-                take = np.minimum(code, len(values) - 1 if values else 0)
-                cols[f"__g{i}"] = arr.take(pa.array(take))
-            else:
-                origin_bin, bin_ms = info[1], info[2]
-                abs_ms = (origin_bin + code) * bin_ms
-                cols[f"__g{i}"] = pa.array(
-                    abs_ms.astype("datetime64[ms]"), pa.timestamp("ms")
-                )
+        if composite_vals is None:
+            rem = idxs.copy()
+            for i, info in enumerate(keyinfo):
+                cap = info[-1]
+                code = rem % cap
+                rem = rem // cap
+                cols[f"__g{i}"] = self._decode_key_col(info, code)
+        else:
+            rem = composite_vals[idxs].copy()
+            decoded: list[np.ndarray] = []
+            for info in reversed(keyinfo[1:]):
+                cap = info[-1]
+                decoded.append(rem % cap)
+                rem = rem // cap
+            decoded.append(rem)
+            for i, (info, code) in enumerate(zip(keyinfo, reversed(decoded))):
+                cols[f"__g{i}"] = self._decode_key_col(info, code)
         cols["__cnt"] = pa.array(count[idxs])
         for si, spec in enumerate(specs):
             if spec.func == "count_star":

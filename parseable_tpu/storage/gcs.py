@@ -1,105 +1,319 @@
 """GCS object storage backend (reference: src/storage/gcs.rs).
 
-Primary backend for TPU-VMs (SURVEY §2 row 7: "GCS first"). Wraps the
-google-cloud-storage SDK behind the same ObjectStorage trait; large
-downloads use parallel ranged reads like the S3 backend, and uploads above
-the multipart threshold use the SDK's resumable upload (GCS's equivalent
-of S3 multipart).
+Primary backend for TPU-VMs (SURVEY §2 row 7: "GCS first"). A self-contained
+REST client over the GCS JSON API (`requests` only — no google-cloud-storage
+SDK dependency), mirroring the S3 backend's treatment:
 
-Supports a custom `endpoint` (fake-gcs-server / emulator) via
-client_options, which is also how tests drive it without egress.
+- basic ops: media GET (+Range), metadata GET, media upload, DELETE,
+  `objects/list` with prefix/delimiter/pageToken pagination;
+- `upload_file` switches to a RESUMABLE upload session above
+  `multipart_threshold` (GCS's multipart equivalent: POST uploadType=
+  resumable -> session URI -> chunked PUTs with Content-Range, 308
+  continuation; reference: object_store crate's gcs multipart path);
+- `download_file` fetches large objects as parallel ranged GETs via the
+  shared ObjectStorage.download_file fan-out (s3.rs:383-492 analogue);
+- auth: Bearer token from (in order) an explicit token, the TPU-VM/GCE
+  metadata server (the production path on TPU-VMs — no key files), or
+  anonymous (emulator / tests/gcs_mock.py).
+
+Service-account JWT key-file signing is intentionally absent: on TPU-VMs
+the metadata server supplies tokens for the attached service account.
 """
 
 from __future__ import annotations
 
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Iterator
+from urllib.parse import quote
 
 from parseable_tpu.storage.object_storage import (
     NoSuchKey,
     ObjectMeta,
     ObjectStorage,
+    ObjectStorageError,
     _timed,
 )
 
+_METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/"
+    "instance/service-accounts/default/token"
+)
+
+
+class GcsTokenProvider:
+    """Bearer tokens with expiry-aware caching.
+
+    Modes: explicit static token; GCE/TPU-VM metadata server; anonymous
+    (emulators accept unauthenticated requests)."""
+
+    def __init__(self, token: str | None = None, use_metadata_server: bool = True):
+        self._static = token
+        self._use_mds = use_metadata_server
+        self._cached: str | None = None
+        self._expires_at = 0.0
+        self._lock = threading.Lock()
+
+    def token(self) -> str | None:
+        if self._static:
+            return self._static
+        if not self._use_mds:
+            return None
+        with self._lock:
+            now = time.monotonic()
+            if self._cached and now < self._expires_at - 60:
+                return self._cached
+            try:
+                import requests
+
+                resp = requests.get(
+                    _METADATA_TOKEN_URL,
+                    headers={"Metadata-Flavor": "Google"},
+                    timeout=5,
+                )
+                if resp.status_code == 200:
+                    obj = resp.json()
+                    self._cached = obj.get("access_token")
+                    self._expires_at = now + float(obj.get("expires_in", 300))
+                    return self._cached
+            except Exception:
+                pass
+            # not on GCE / no metadata server: run anonymous (emulator)
+            self._use_mds = False
+            return None
+
 
 class GcsStorage(ObjectStorage):
+    """GCS JSON-API client over requests."""
+
     name = "gcs"
 
     def __init__(
         self,
         bucket: str,
         endpoint: str | None = None,
+        token: str | None = None,
         multipart_threshold: int = 25 * 1024 * 1024,
+        resumable_chunk_size: int = 16 * 1024 * 1024,
         download_chunk_bytes: int = 8 * 1024 * 1024,
         download_concurrency: int = 16,
     ):
-        from google.cloud import storage as gcs
+        import os
 
-        kwargs = {}
-        if endpoint:
-            import google.auth.credentials
+        import requests
 
-            kwargs["client_options"] = {"api_endpoint": endpoint}
-            kwargs["credentials"] = google.auth.credentials.AnonymousCredentials()
-        self.client = gcs.Client(**kwargs)
-        self.bucket = self.client.bucket(bucket)
+        self.bucket = bucket
+        self.endpoint = (endpoint or "https://storage.googleapis.com").rstrip("/")
+        self.tokens = GcsTokenProvider(
+            token or os.environ.get("P_GCS_TOKEN"),
+            # a custom endpoint means an emulator/mock: skip the metadata
+            # server probe entirely
+            use_metadata_server=endpoint is None,
+        )
         self.multipart_threshold = multipart_threshold
+        # GCS requires resumable chunks in 256 KiB multiples
+        self.resumable_chunk_size = max(
+            256 * 1024, resumable_chunk_size // (256 * 1024) * (256 * 1024)
+        )
         self.download_chunk_bytes = max(1 << 20, download_chunk_bytes)
         self.download_concurrency = max(1, download_concurrency)
+        self._session = requests.Session()
+
+    # ---------------------------------------------------------------- request
+
+    def _headers(self, extra: dict | None = None) -> dict:
+        h = dict(extra or {})
+        tok = self.tokens.token()
+        if tok:
+            h["Authorization"] = f"Bearer {tok}"
+        return h
+
+    def _obj_url(self, key: str) -> str:
+        return (
+            f"{self.endpoint}/storage/v1/b/{quote(self.bucket, safe='')}"
+            f"/o/{quote(key, safe='')}"
+        )
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        params: dict | None = None,
+        data: bytes | None = None,
+        headers: dict | None = None,
+    ):
+        return self._session.request(
+            method,
+            url,
+            params=params,
+            data=data,
+            headers=self._headers(headers),
+            timeout=60,
+        )
+
+    def _check(self, resp, key: str = ""):
+        if resp.status_code == 404:
+            raise NoSuchKey(key)
+        if resp.status_code >= 300:
+            raise ObjectStorageError(
+                f"gcs {resp.request.method} {key!r} -> {resp.status_code}: {resp.text[:200]}"
+            )
+        return resp
+
+    # -------------------------------------------------------------- trait ops
 
     def get_object(self, key: str) -> bytes:
-        from google.api_core import exceptions as gexc
-
         with _timed(self.name, "GET"):
-            try:
-                return self.bucket.blob(key).download_as_bytes()
-            except gexc.NotFound as e:
-                raise NoSuchKey(key) from e
+            resp = self._request("GET", self._obj_url(key), params={"alt": "media"})
+            return self._check(resp, key).content
 
     def put_object(self, key: str, data: bytes) -> None:
         with _timed(self.name, "PUT"):
-            self.bucket.blob(key).upload_from_string(data)
+            url = f"{self.endpoint}/upload/storage/v1/b/{quote(self.bucket, safe='')}/o"
+            resp = self._request(
+                "POST",
+                url,
+                params={"uploadType": "media", "name": key},
+                data=data,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            self._check(resp, key)
 
     def delete_object(self, key: str) -> None:
-        from google.api_core import exceptions as gexc
-
         with _timed(self.name, "DELETE"):
-            try:
-                self.bucket.blob(key).delete()
-            except gexc.NotFound:
-                pass
+            resp = self._request("DELETE", self._obj_url(key))
+            if resp.status_code not in (200, 204, 404):
+                self._check(resp, key)
 
     def head(self, key: str) -> ObjectMeta:
         with _timed(self.name, "HEAD"):
-            blob = self.bucket.get_blob(key)
-            if blob is None:
-                raise NoSuchKey(key)
-            ts = blob.updated.timestamp() if blob.updated else 0.0
-            return ObjectMeta(key=key, size=blob.size or 0, last_modified=ts)
+            resp = self._request("GET", self._obj_url(key))
+            self._check(resp, key)
+            obj = resp.json()
+            return ObjectMeta(key=key, size=int(obj.get("size", 0)), last_modified=0.0)
 
     def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
         with _timed(self.name, "LIST"):
-            delimiter = None if recursive else "/"
-            for blob in self.client.list_blobs(self.bucket, prefix=prefix, delimiter=delimiter):
-                ts = blob.updated.timestamp() if blob.updated else 0.0
-                yield ObjectMeta(key=blob.name, size=blob.size or 0, last_modified=ts)
+            url = f"{self.endpoint}/storage/v1/b/{quote(self.bucket, safe='')}/o"
+            token = None
+            while True:
+                params = {"prefix": prefix}
+                if not recursive:
+                    params["delimiter"] = "/"
+                if token:
+                    params["pageToken"] = token
+                obj = self._check(self._request("GET", url, params=params)).json()
+                for item in obj.get("items", []):
+                    yield ObjectMeta(
+                        key=item["name"],
+                        size=int(item.get("size", 0)),
+                        last_modified=0.0,
+                    )
+                token = obj.get("nextPageToken")
+                if not token:
+                    break
 
     def list_dirs(self, prefix: str) -> list[str]:
         with _timed(self.name, "LIST"):
             p = prefix.rstrip("/") + "/" if prefix else ""
-            it = self.client.list_blobs(self.bucket, prefix=p, delimiter="/")
-            list(it)  # prefixes populate after iteration
-            return sorted(x[len(p) :].rstrip("/") for x in it.prefixes)
+            url = f"{self.endpoint}/storage/v1/b/{quote(self.bucket, safe='')}/o"
+            out: list[str] = []
+            token = None
+            while True:
+                params = {"prefix": p, "delimiter": "/"}
+                if token:
+                    params["pageToken"] = token
+                obj = self._check(self._request("GET", url, params=params)).json()
+                for full in obj.get("prefixes", []):
+                    out.append(full[len(p) :].rstrip("/"))
+                token = obj.get("nextPageToken")
+                if not token:
+                    break
+            return sorted(out)
+
+    # ------------------------------------------------------------- upload path
 
     def upload_file(self, key: str, path: Path) -> None:
-        with _timed(self.name, "PUT"):
-            blob = self.bucket.blob(key)
-            if path.stat().st_size > self.multipart_threshold:
-                # resumable upload = GCS's multipart analogue
-                blob.chunk_size = 8 * 1024 * 1024
-            blob.upload_from_filename(str(path))
+        size = path.stat().st_size
+        if size <= self.multipart_threshold:
+            self.put_object(key, path.read_bytes())
+            return
+        self._upload_resumable(key, path, size)
+
+    def _upload_resumable(self, key: str, path: Path, size: int) -> None:
+        """Resumable upload session: chunked PUTs with Content-Range; the
+        server answers 308 until the final chunk lands (GCS's multipart)."""
+        with _timed(self.name, "PUT_MULTIPART"):
+            url = f"{self.endpoint}/upload/storage/v1/b/{quote(self.bucket, safe='')}/o"
+            resp = self._request(
+                "POST",
+                url,
+                params={"uploadType": "resumable", "name": key},
+                data=json.dumps({"name": key}).encode(),
+                headers={
+                    "Content-Type": "application/json; charset=UTF-8",
+                    "X-Upload-Content-Length": str(size),
+                },
+            )
+            self._check(resp, key)
+            session = resp.headers.get("Location") or resp.headers.get("location")
+            if not session:
+                raise ObjectStorageError(
+                    f"gcs resumable init for {key!r} returned no session URI"
+                )
+            chunk = self.resumable_chunk_size
+            sent = 0
+            with path.open("rb") as f:
+                while sent < size:
+                    part = f.read(chunk)
+                    if not part:
+                        raise ObjectStorageError(
+                            f"gcs resumable upload for {key!r}: file truncated at {sent}/{size}"
+                        )
+                    end = sent + len(part) - 1
+                    r = self._request(
+                        "PUT",
+                        session,
+                        data=part,
+                        headers={"Content-Range": f"bytes {sent}-{end}/{size}"},
+                    )
+                    if r.status_code == 308:
+                        sent = end + 1
+                        continue
+                    if r.status_code >= 300:
+                        # best-effort session cancel
+                        try:
+                            self._session.delete(session, timeout=10)
+                        except Exception:
+                            pass
+                        raise ObjectStorageError(
+                            f"gcs resumable chunk for {key!r} -> {r.status_code}: {r.text[:200]}"
+                        )
+                    sent = end + 1
+            if sent != size:
+                raise ObjectStorageError(f"gcs resumable upload for {key!r} incomplete")
+
+    # ----------------------------------------------------------- download path
 
     def get_range(self, key: str, start: int, end: int) -> bytes:
         """Ranged read primitive for the shared parallel download."""
-        return self.bucket.blob(key).download_as_bytes(start=start, end=end)
+        resp = self._request(
+            "GET",
+            self._obj_url(key),
+            params={"alt": "media"},
+            headers={"Range": f"bytes={start}-{end}"},
+        )
+        return self._check(resp, key).content
+
+    def delete_prefix(self, prefix: str) -> None:
+        """GCS JSON API has no batch delete: fan per-key deletes over a
+        small pool (the object_store crate does the same)."""
+        with _timed(self.name, "DELETE_PREFIX"):
+            keys = [m.key for m in self.list_prefix(prefix)]
+            if not keys:
+                return
+            with ThreadPoolExecutor(max_workers=min(8, len(keys))) as pool:
+                list(pool.map(self.delete_object, keys))

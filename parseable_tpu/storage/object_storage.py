@@ -262,29 +262,32 @@ class LocalFSProvider(ObjectStorageProvider):
 class GcsProvider(ObjectStorageProvider):
     """GCS backend — primary target on TPU-VMs (reference src/storage/gcs.rs).
 
-    Wraps google-cloud-storage (present in this image); a custom endpoint
-    targets fake-gcs-server/emulators.
+    Self-contained JSON-API REST client (storage/gcs.py) — no SDK. A custom
+    endpoint targets fake-gcs-server/emulators/tests/gcs_mock.py; tokens
+    come from P_GCS_TOKEN or the TPU-VM metadata server.
     """
 
-    def __init__(self, bucket: str, endpoint: str | None = None, **tuning):
+    def __init__(
+        self,
+        bucket: str,
+        endpoint: str | None = None,
+        token: str | None = None,
+        **tuning,
+    ):
         self.bucket = bucket
         self.endpoint = endpoint
+        self.token = token
         self.tuning = tuning
 
     def construct_client(self) -> ObjectStorage:
-        try:
-            from parseable_tpu.storage.gcs import GcsStorage
+        from parseable_tpu.storage.gcs import GcsStorage
 
-            # the SDK import happens inside GcsStorage.__init__, so the
-            # construction itself must sit in the gated block
-            return GcsStorage(self.bucket, endpoint=self.endpoint, **self.tuning)
-        except ImportError as e:
-            raise StorageUnavailable(
-                "google-cloud-storage SDK not installed; use local-store"
-            ) from e
+        return GcsStorage(
+            self.bucket, endpoint=self.endpoint, token=self.token, **self.tuning
+        )
 
     def get_endpoint(self) -> str:
-        return f"gs://{self.bucket}"
+        return self.endpoint or f"gs://{self.bucket}"
 
 
 class S3Provider(ObjectStorageProvider):
@@ -361,7 +364,9 @@ def make_provider(backend: str, **kw) -> ObjectStorageProvider:
     if backend in ("local-store", "localfs", "drive"):
         return LocalFSProvider(kw["root"])
     if backend in ("gcs-store", "gcs"):
-        return GcsProvider(kw["bucket"], kw.get("endpoint"), **tuning)
+        return GcsProvider(
+            kw["bucket"], kw.get("endpoint"), token=kw.get("gcs_token"), **tuning
+        )
     if backend in ("s3-store", "s3"):
         return S3Provider(
             kw["bucket"],

@@ -1,0 +1,210 @@
+"""Minimal in-process GCS JSON-API server for exercising GcsStorage.
+
+Plays the role fake-gcs-server plays in the reference's GCS compose harness
+(docker-compose-gcs-distributed-test.yaml, SURVEY §4) without a container:
+object CRUD (media get with Range, metadata get, media upload), resumable
+upload sessions (308 continuation protocol), objects/list with
+prefix/delimiter/pageToken, and delete. Bearer tokens are accepted but not
+verified (recorded for assertions).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class _State:
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+        self.sessions: dict[str, dict] = {}  # upload_id -> {name, total, data}
+        self.lock = threading.Lock()
+        self.seq = 0
+        self.seen_auth: list[str] = []
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State  # set by serve()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # -- helpers ------------------------------------------------------------
+
+    def _route(self):
+        u = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(u.query, keep_blank_values=True).items()}
+        auth = self.headers.get("Authorization")
+        if auth:
+            self.state.seen_auth.append(auth)
+        return unquote(u.path), q
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def _send(self, code: int, body: bytes = b"", headers: dict | None = None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: dict, headers: dict | None = None):
+        self._send(code, json.dumps(obj).encode(), dict(headers or {}, **{"Content-Type": "application/json"}))
+
+    @staticmethod
+    def _obj_key(path: str) -> str | None:
+        # /storage/v1/b/<bucket>/o/<object>  (object is URL-decoded already)
+        marker = "/o/"
+        i = path.find(marker)
+        if i < 0:
+            return None
+        return path[i + len(marker) :]
+
+    # -- methods ------------------------------------------------------------
+
+    def do_GET(self):
+        path, q = self._route()
+        st = self.state
+        key = self._obj_key(path)
+        if key is None or key == "":
+            # objects/list
+            prefix = q.get("prefix", "")
+            delimiter = q.get("delimiter")
+            max_results = int(q.get("maxResults", 1000))
+            page_token = q.get("pageToken", "")
+            with st.lock:
+                keys = sorted(k for k in st.objects if k.startswith(prefix))
+            if page_token:
+                keys = [k for k in keys if k > page_token]
+            items, prefixes = [], []
+            for k in keys:
+                if delimiter:
+                    rest = k[len(prefix) :]
+                    if delimiter in rest:
+                        cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                        if cp not in prefixes:
+                            prefixes.append(cp)
+                        continue
+                items.append(k)
+            truncated = len(items) > max_results
+            items = items[:max_results]
+            out: dict = {"kind": "storage#objects"}
+            with st.lock:
+                out["items"] = [
+                    {"name": k, "size": str(len(st.objects.get(k, b"")))} for k in items
+                ]
+            if prefixes:
+                out["prefixes"] = prefixes
+            if truncated and items:
+                out["nextPageToken"] = items[-1]
+            self._send_json(200, out)
+            return
+        with st.lock:
+            data = st.objects.get(key)
+        if data is None:
+            self._send_json(404, {"error": {"code": 404, "message": "Not Found"}})
+            return
+        if q.get("alt") == "media":
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                lo, hi = rng[len("bytes=") :].split("-")
+                lo, hi = int(lo), int(hi)
+                chunk = data[lo : hi + 1]
+                self._send(
+                    206, chunk, {"Content-Range": f"bytes {lo}-{hi}/{len(data)}"}
+                )
+                return
+            self._send(200, data)
+            return
+        self._send_json(200, {"name": key, "size": str(len(data))})
+
+    def do_POST(self):
+        path, q = self._route()
+        st = self.state
+        body = self._body()
+        if "/upload/" in path:
+            upload_type = q.get("uploadType")
+            name = q.get("name")
+            if upload_type == "media" and name:
+                with st.lock:
+                    st.objects[name] = body
+                self._send_json(200, {"name": name, "size": str(len(body))})
+                return
+            if upload_type == "resumable" and name:
+                with st.lock:
+                    st.seq += 1
+                    uid = f"sess-{st.seq}"
+                    st.sessions[uid] = {"name": name, "data": b""}
+                host = self.headers.get("Host", "127.0.0.1")
+                loc = f"http://{host}/upload/storage/v1/b/bucket/o?uploadType=resumable&upload_id={uid}"
+                self._send(200, b"{}", {"Location": loc, "Content-Type": "application/json"})
+                return
+        self._send_json(400, {"error": {"code": 400, "message": "bad request"}})
+
+    def do_PUT(self):
+        path, q = self._route()
+        st = self.state
+        body = self._body()
+        uid = q.get("upload_id")
+        if uid:
+            cr = self.headers.get("Content-Range", "")
+            # "bytes start-end/total"
+            try:
+                rng, total = cr.split(" ", 1)[1].split("/")
+                start, end = (int(x) for x in rng.split("-"))
+                total = int(total)
+            except (ValueError, IndexError):
+                self._send_json(400, {"error": {"code": 400, "message": f"bad Content-Range {cr!r}"}})
+                return
+            with st.lock:
+                sess = st.sessions.get(uid)
+                if sess is None:
+                    self._send_json(404, {"error": {"code": 404, "message": "no session"}})
+                    return
+                if start != len(sess["data"]):
+                    self._send_json(
+                        400,
+                        {"error": {"code": 400, "message": f"offset {start} != {len(sess['data'])}"}},
+                    )
+                    return
+                sess["data"] += body
+                done = len(sess["data"]) >= total
+                if done:
+                    st.objects[sess["name"]] = sess["data"]
+                    st.sessions.pop(uid, None)
+                    name = sess["name"]
+                    size = len(st.objects[name])
+            if done:
+                self._send_json(200, {"name": name, "size": str(size)})
+            else:
+                self._send(308, b"", {"Range": f"bytes=0-{start + len(body) - 1}"})
+            return
+        self._send_json(400, {"error": {"code": 400, "message": "bad request"}})
+
+    def do_DELETE(self):
+        path, q = self._route()
+        st = self.state
+        uid = q.get("upload_id")
+        key = self._obj_key(path)
+        with st.lock:
+            if uid:
+                st.sessions.pop(uid, None)
+            elif key:
+                st.objects.pop(key, None)
+        self._send(204)
+
+
+def serve() -> tuple[ThreadingHTTPServer, str, _State]:
+    """Start the mock on an ephemeral port; returns (server, endpoint, state)."""
+    state = _State()
+    handler = type("Handler", (_Handler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_port}", state

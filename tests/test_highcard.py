@@ -156,6 +156,33 @@ def test_highcard_multikey_blocklocal():
     assert_rows_close(cpu, tpu)
 
 
+def test_multikey_pair_compaction():
+    """Cap product beyond LOCAL_G_MAX: actual combos compact via np.unique
+    and fold on dense pair codes (still on device, still exact)."""
+    rng = np.random.default_rng(21)
+    n = 30_000
+    t = pa.table(
+        {
+            "a": pa.array([f"a{int(x)}" for x in rng.integers(0, 500, n)]),
+            "b": pa.array([f"b{int(x)}" for x in rng.integers(0, 500, n)]),
+            "v": pa.array(rng.random(n)),
+        }
+    )
+    orig_d, orig_l = ET.DENSE_G_MAX, ET.LOCAL_G_MAX
+    ET.DENSE_G_MAX = 1 << 12
+    ET.LOCAL_G_MAX = 1 << 16  # 512*512 cap product = 2^18 > budget
+    try:
+        cpu, tpu = run_both(
+            "SELECT a, b, count(*) c, sum(v) s, min(v) mn FROM t GROUP BY a, b", [t]
+        )
+    finally:
+        ET.DENSE_G_MAX, ET.LOCAL_G_MAX = orig_d, orig_l
+    assert_rows_close(cpu, tpu)
+    assert any(
+        k[0] == "local" and k[3] and k[3][0][0] == "pair" for k in ET._PROGRAM_CACHE
+    ), "pair-compacted program did not build"
+
+
 def test_highcard_count_distinct_falls_back_exact(highcard_tables):
     """count(distinct) in a high-card group space: CPU fallback, exact."""
     cpu, tpu = run_both(
