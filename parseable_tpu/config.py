@@ -173,6 +173,11 @@ class Options:
     # --- sync intervals (overridable for tests) -------------------------------
     local_sync_interval_secs: int = field(default_factory=lambda: _env_int("P_LOCAL_SYNC_INTERVAL", 60))
     upload_interval_secs: int = field(default_factory=lambda: _env_int("P_STORAGE_UPLOAD_INTERVAL", 30))
+    # querier-side billing scrape -> internal pmeta stream (reference:
+    # cluster metrics schedular, cluster/mod.rs:1623-1784)
+    cluster_metrics_interval_secs: int = field(
+        default_factory=lambda: _env_int("P_CLUSTER_METRICS_INTERVAL", 600)
+    )
 
     # --- TPU / mesh -----------------------------------------------------------
     # Logical mesh axes for the query reduce tree ("data" shards row blocks).
@@ -267,6 +272,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def parse_cli(argv: list[str] | None = None) -> tuple[Options, StorageOptions]:
     args = build_parser().parse_args(argv)
+    # first-run UX (reference: interactive.rs via parseable/mod.rs:140-156):
+    # load .parseable.env, prompt for missing storage vars on a TTY, and
+    # persist what was collected once option construction succeeds
+    from parseable_tpu import interactive as _interactive
+
+    collected = _interactive.prompt_missing_envs(args.backend)
     opts = Options()
     if args.mode:
         opts.mode = Mode(args.mode)
@@ -283,6 +294,8 @@ def parse_cli(argv: list[str] | None = None) -> tuple[Options, StorageOptions]:
             storage.root = Path(args.fs_dir)
         if getattr(args, "bucket", None):
             storage.bucket = args.bucket
+    # options accepted the collected values — safe to persist
+    _interactive.save_collected_envs(collected)
     return opts, storage
 
 

@@ -1,0 +1,156 @@
+"""First-run interactive env prompting (reference: src/interactive.rs;
+wired at startup in parseable/mod.rs:140-156).
+
+Flow, matching the reference:
+1. load any previously saved values from `.parseable.env` (never
+   overriding variables already present in the environment);
+2. for the selected storage subcommand, find required env vars that are
+   still missing; on an interactive terminal, prompt for them (secrets via
+   getpass — not echoed); non-interactive runs leave validation to the
+   normal config errors;
+3. after option parsing succeeds, persist the collected values back to
+   `.parseable.env` (0600) and print export lines so the user can
+   `source` them.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable
+
+ENV_FILE_NAME = ".parseable.env"
+
+
+@dataclass
+class EnvPrompt:
+    env_var: str
+    display_name: str
+    required: bool = True
+    is_secret: bool = False
+
+
+def storage_prompts(subcommand: str) -> list[EnvPrompt]:
+    """Per-backend prompt sets (reference: interactive.rs get_storage_prompts)."""
+    if subcommand == "s3-store":
+        return [
+            EnvPrompt("P_S3_URL", "S3 Endpoint URL"),
+            EnvPrompt("P_S3_REGION", "S3 Region"),
+            EnvPrompt("P_S3_BUCKET", "S3 Bucket Name"),
+            EnvPrompt("P_S3_ACCESS_KEY", "S3 Access Key", required=False),
+            EnvPrompt("P_S3_SECRET_KEY", "S3 Secret Key", required=False, is_secret=True),
+        ]
+    if subcommand == "blob-store":
+        return [
+            EnvPrompt("P_AZR_URL", "Azure Blob Endpoint URL"),
+            EnvPrompt("P_AZR_ACCOUNT", "Azure Storage Account"),
+            EnvPrompt("P_AZR_CONTAINER", "Azure Container Name"),
+            EnvPrompt("P_AZR_ACCESS_KEY", "Azure Access Key", required=False, is_secret=True),
+        ]
+    if subcommand == "gcs-store":
+        return [EnvPrompt("P_GCS_BUCKET", "GCS Bucket Name")]
+    return []
+
+
+def load_env_file(path: Path | None = None, environ: dict | None = None) -> int:
+    """Load KEY=VALUE lines from `.parseable.env`; existing environment
+    variables win. Returns the number of variables loaded."""
+    environ = environ if environ is not None else os.environ
+    path = path or Path.cwd() / ENV_FILE_NAME
+    if not path.is_file():
+        return 0
+    loaded = 0
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, value = line.split("=", 1)
+        key = key.strip()
+        value = value.strip().strip('"')
+        if key and key not in environ:
+            environ[key] = value
+            loaded += 1
+    return loaded
+
+
+def save_collected_envs(
+    collected: list[tuple[str, str]],
+    path: Path | None = None,
+    output: Callable[[str], None] = print,
+) -> None:
+    """Persist collected values to `.parseable.env` (0600), merging with any
+    existing entries; print export lines (reference: save_collected_envs).
+    Best-effort — a read-only working directory must not block startup."""
+    if not collected:
+        return
+    path = path or Path.cwd() / ENV_FILE_NAME
+    try:
+        existing: dict[str, str] = {}
+        if path.is_file():
+            for line in path.read_text().splitlines():
+                if "=" in line and not line.strip().startswith("#"):
+                    k, v = line.split("=", 1)
+                    existing[k.strip()] = v.strip()
+        for k, v in collected:
+            existing[k] = v
+        body = "".join(f"{k}={v}\n" for k, v in existing.items())
+        path.write_text(body)
+        try:
+            path.chmod(0o600)
+        except OSError:
+            pass
+        output(f"Saved {len(collected)} value(s) to {path}")
+        for k, _ in collected:
+            output(f"  export {k}=...")
+    except OSError as e:
+        output(f"warning: could not persist {path}: {e}")
+
+
+def prompt_missing_envs(
+    subcommand: str | None,
+    environ: dict | None = None,
+    input_fn: Callable[[str], str] | None = None,
+    secret_input_fn: Callable[[str], str] | None = None,
+    isatty: bool | None = None,
+    output: Callable[[str], None] = print,
+    env_file: Path | None = None,
+) -> list[tuple[str, str]]:
+    """Collect missing storage env vars, interactively when on a TTY.
+
+    Returns the (env_var, value) pairs collected; the caller persists them
+    with `save_collected_envs` AFTER option validation succeeds (so a typo
+    never gets saved). Injection points (environ/input/isatty) exist for
+    tests and embedders."""
+    environ = environ if environ is not None else os.environ
+    if subcommand is None:
+        return []
+    load_env_file(env_file, environ)
+    prompts = [p for p in storage_prompts(subcommand) if p.env_var not in environ]
+    if not prompts:
+        return []
+    interactive = isatty if isatty is not None else sys.stdin.isatty()
+    if not interactive:
+        return []  # config validation reports what's missing
+    if input_fn is None:
+        input_fn = input
+    if secret_input_fn is None:
+        import getpass
+
+        secret_input_fn = getpass.getpass
+    collected: list[tuple[str, str]] = []
+    output(f"Missing configuration for {subcommand}; enter values "
+           "(empty skips optional entries):")
+    for p in prompts:
+        ask = secret_input_fn if p.is_secret else input_fn
+        while True:
+            value = ask(f"{p.display_name} ({p.env_var}): ").strip()
+            if value:
+                environ[p.env_var] = value
+                collected.append((p.env_var, value))
+                break
+            if not p.required:
+                break
+            output(f"{p.display_name} is required")
+    return collected

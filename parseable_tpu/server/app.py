@@ -170,6 +170,15 @@ class ServerState:
             loop(60, lambda: alert_tick(self), "alerts")
             self.hot_tier()  # restore budgets
             loop(60, lambda: self.hot_tier().tick(), "hot-tier")
+            # scheduled cluster billing scrape -> internal pmeta stream
+            # (reference: init_cluster_metrics_schedular cluster/mod.rs:1623)
+            from parseable_tpu.server import cluster as _C
+
+            loop(
+                self.p.options.cluster_metrics_interval_secs,
+                lambda: _C.ingest_cluster_metrics(self.p),
+                "pmeta-scrape",
+            )
             if self.p.options.query_engine == "tpu":
                 # warm the device-health probe off the request path so the
                 # first query never pays the watchdog wait
@@ -1499,8 +1508,15 @@ async def delete_tenant(request: web.Request) -> web.Response:
 
 @require(Action.LIST_CLUSTER)
 async def cluster_info(request: web.Request) -> web.Response:
+    # array shape matches the reference's Vec<ClusterInfo>
+    # (cluster/mod.rs:1001); each entry carries the latest pmeta scrape
+    # state so billing collection is observable from the cluster plane
     state: ServerState = request.app["state"]
+    from parseable_tpu.server import cluster as C
+
     nodes = state.p.metastore.list_nodes()
+    for n in nodes:
+        n["pmeta_last_scrape"] = C.LAST_PMETA_SCRAPE
     return web.json_response(nodes)
 
 

@@ -242,6 +242,118 @@ def parse_prometheus(text: str) -> dict[str, float]:
     return totals
 
 
+def parse_prometheus_dated(text: str) -> dict[tuple[str, str], float]:
+    """Per-(family, date-label) sums — the date-wise billing counters the
+    reference rolls into pmeta (metrics/mod.rs:203-360 *_date families)."""
+    out: dict[tuple[str, str], float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#") or "{" not in line:
+            continue
+        try:
+            name_part, value = line.rsplit(" ", 1)
+            name, labels = name_part.split("{", 1)
+            labels = labels.rstrip("}")
+            date = None
+            for pair in labels.split(","):
+                if "=" not in pair:
+                    continue
+                k, v = pair.split("=", 1)
+                if k.strip() == "date":
+                    date = v.strip().strip('"')
+            if date is None:
+                continue
+            key = (name, date)
+            out[key] = out.get(key, 0.0) + float(value)
+        except ValueError:
+            continue
+    return out
+
+
+# billing-relevant families persisted per scrape (reference pmeta ingest:
+# cluster/mod.rs:74-339 Metrics model via prom_utils.rs)
+_PMETA_FAMILIES = (
+    "parseable_events_ingested",
+    "parseable_events_ingested_size",
+    "parseable_lifetime_events_ingested",
+    "parseable_lifetime_events_ingested_size",
+    "parseable_storage_size",
+    "parseable_events_deleted",
+    "parseable_staging_files",
+    "parseable_total_query_bytes_scanned_date",
+)
+
+LAST_PMETA_SCRAPE: dict[str, float | str | int | None] = {
+    "at": None,
+    "nodes": 0,
+    "rows": 0,
+}
+
+
+def ingest_cluster_metrics(p: Parseable) -> int:
+    """Scheduled scrape -> rows in the internal `pmeta` stream
+    (reference: cluster/mod.rs:1147-1320 fetch_cluster_metrics +
+    :1623-1784 init_cluster_metrics_schedular ingesting into pmeta).
+
+    Two row shapes per node, distinguished by `event_type`:
+    - "node-metrics": one row of billing family totals;
+    - "billing-date": one row per (node, date) for date-labeled billing
+      counters (events/bytes per day — what the bill reads).
+    Returns the number of pmeta rows written."""
+    import time as _time
+
+    from parseable_tpu import INTERNAL_STREAM_NAME
+    from parseable_tpu.storage import rfc3339_now
+
+    rows: list[dict] = []
+    scraped_nodes = 0
+    for kind in ("ingestor", "querier", "all"):
+        for n in p.metastore.list_nodes(kind):
+            domain = n["domain_name"]
+            if n.get("node_id") != p.node_id and not check_liveness(domain):
+                continue
+            try:
+                with _http(p, "GET", f"{domain}/api/v1/metrics", timeout=5.0) as resp:
+                    text = resp.read().decode()
+            except (urllib.error.URLError, OSError) as e:
+                logger.warning("pmeta scrape of %s failed: %s", domain, e)
+                continue
+            scraped_nodes += 1
+            totals = parse_prometheus(text)
+            base = {
+                "event_type": "node-metrics",
+                "node_id": n.get("node_id"),
+                "node_type": kind,
+                "domain_name": domain,
+                "scraped_at": rfc3339_now(),
+            }
+            row = dict(base)
+            for fam in _PMETA_FAMILIES:
+                if fam in totals:
+                    row[fam.removeprefix("parseable_")] = totals[fam]
+            rows.append(row)
+            by_date: dict[str, dict] = {}
+            for (fam, date), value in parse_prometheus_dated(text).items():
+                if not fam.startswith("parseable_"):
+                    continue
+                d = by_date.setdefault(
+                    date, dict(base, event_type="billing-date", date=date)
+                )
+                d[fam.removeprefix("parseable_")] = value
+            rows.extend(by_date.values())
+    if rows:
+        from parseable_tpu.event.json_format import JsonEvent
+
+        stream = p.create_stream_if_not_exists(
+            INTERNAL_STREAM_NAME, stream_type="Internal"
+        )
+        ev = JsonEvent(rows, INTERNAL_STREAM_NAME).into_event(stream.metadata)
+        ev.process(stream, commit_schema=p.commit_schema)
+    LAST_PMETA_SCRAPE.update(
+        {"at": _time.time(), "nodes": scraped_nodes, "rows": len(rows)}
+    )
+    return len(rows)
+
+
 def remove_node(p: Parseable, node_id: str) -> bool:
     """Deregister a DEAD node (reference: cluster/mod.rs:1185 remove_node —
     live nodes are refused)."""

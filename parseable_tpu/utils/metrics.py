@@ -87,6 +87,17 @@ HOT_TIER_SIZE = _gauge("hot_tier_size", "Hot tier size bytes", ["stream"])
 # --- alerts --------------------------------------------------------------
 ALERTS_STATES = _counter("alerts_states", "Alert state transitions", ["name", "state"])
 
+# --- kafka connector (reference: connectors/kafka/metrics.rs) -------------
+KAFKA_RECORDS_CONSUMED = _counter(
+    "kafka_records_consumed", "Kafka records consumed", ["topic"]
+)
+KAFKA_FLUSHED_ROWS = _counter(
+    "kafka_flushed_rows", "Kafka rows flushed into staging", ["topic"]
+)
+KAFKA_REBALANCES = _counter(
+    "kafka_rebalances", "Kafka consumer group rebalances", ["group"]
+)
+
 
 def render() -> bytes:
     return generate_latest(REGISTRY)

@@ -149,6 +149,67 @@ def test_cluster_metrics_and_node_removal(tmp_path):
     run(scenario())
 
 
+def test_pmeta_billing_scrape_queryable(tmp_path):
+    """Scheduled cluster billing scrape persists per-node rows into the
+    internal pmeta stream, queryable through the normal engine (reference:
+    cluster/mod.rs:1147-1320, 1623-1784)."""
+
+    async def scenario():
+        ing = make_parseable(tmp_path, "ing", Mode.INGEST)
+        ing_state = ServerState(ing)
+        ing_server = TestServer(build_app(ing_state))
+        await ing_server.start_server()
+        ing.register_node(f"127.0.0.1:{ing_server.port}")
+
+        # give the ingestor some billing signal
+        from parseable_tpu.event.json_format import JsonEvent
+
+        s = ing.create_stream_if_not_exists("billedlogs")
+        ev = JsonEvent([{"v": float(i)} for i in range(50)], "billedlogs").into_event(
+            s.metadata
+        )
+        ev.process(s, commit_schema=ing.commit_schema)
+
+        q = make_parseable(tmp_path, "query", Mode.QUERY)
+        q_state = ServerState(q)
+        q_client = TestClient(TestServer(build_app(q_state)))
+        await q_client.start_server()
+
+        from parseable_tpu.server import cluster as C
+
+        # off the event loop (the scrape is synchronous HTTP, as in the
+        # real scheduler thread)
+        rows_written = await asyncio.get_running_loop().run_in_executor(
+            None, C.ingest_cluster_metrics, q
+        )
+        assert rows_written >= 1
+
+        # the scrape row for the OTHER node is queryable via SQL on pmeta
+        from parseable_tpu.query.session import QuerySession
+
+        rows = (
+            QuerySession(q, engine="cpu")
+            .query(
+                "SELECT node_id, events_ingested FROM pmeta "
+                "WHERE event_type = 'node-metrics'"
+            )
+            .to_json_rows()
+        )
+        by_node = {r["node_id"]: r for r in rows}
+        assert ing.node_id in by_node
+        assert by_node[ing.node_id]["events_ingested"] >= 50
+
+        # surfaced in cluster-info
+        r = await q_client.get("/api/v1/cluster/info", headers=AUTH)
+        assert r.status == 200
+        info = await r.json()
+        assert info and info[0]["pmeta_last_scrape"]["rows"] >= 1
+        await q_client.close()
+        await ing_server.close()
+
+    run(scenario())
+
+
 def test_querier_round_robin(tmp_path):
     async def scenario():
         from parseable_tpu.server import cluster as C

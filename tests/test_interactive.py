@@ -1,0 +1,123 @@
+"""First-run interactive env prompting (reference: src/interactive.rs,
+parseable/mod.rs:140-156): TTY-driven collection with injected IO,
+.parseable.env persistence + reload, env precedence."""
+
+from parseable_tpu.interactive import (
+    ENV_FILE_NAME,
+    load_env_file,
+    prompt_missing_envs,
+    save_collected_envs,
+)
+
+
+def test_prompt_collects_missing_s3_vars(tmp_path):
+    env: dict = {}
+    answers = iter(
+        ["http://minio:9000", "us-east-1", "mybucket", "AKIA"]  # visible
+    )
+    secrets = iter(["sekret"])
+    out: list[str] = []
+    collected = prompt_missing_envs(
+        "s3-store",
+        environ=env,
+        input_fn=lambda prompt: next(answers),
+        secret_input_fn=lambda prompt: next(secrets),
+        isatty=True,
+        output=out.append,
+        env_file=tmp_path / ENV_FILE_NAME,
+    )
+    assert env["P_S3_URL"] == "http://minio:9000"
+    assert env["P_S3_BUCKET"] == "mybucket"
+    assert env["P_S3_SECRET_KEY"] == "sekret"
+    assert ("P_S3_SECRET_KEY", "sekret") in collected
+
+
+def test_required_reprompts_until_value(tmp_path):
+    env: dict = {}
+    answers = iter(["", "", "bucket-1"])
+    out: list[str] = []
+    prompt_missing_envs(
+        "gcs-store",
+        environ=env,
+        input_fn=lambda prompt: next(answers),
+        isatty=True,
+        output=out.append,
+        env_file=tmp_path / ENV_FILE_NAME,
+    )
+    assert env["P_GCS_BUCKET"] == "bucket-1"
+    assert any("required" in line for line in out)
+
+
+def test_optional_skipped_on_empty(tmp_path):
+    env = {"P_S3_URL": "u", "P_S3_REGION": "r", "P_S3_BUCKET": "b"}
+    answers = iter([""])  # skip optional access key
+    secrets = iter([""])  # skip optional secret
+    collected = prompt_missing_envs(
+        "s3-store",
+        environ=env,
+        input_fn=lambda prompt: next(answers),
+        secret_input_fn=lambda prompt: next(secrets),
+        isatty=True,
+        output=lambda s: None,
+        env_file=tmp_path / ENV_FILE_NAME,
+    )
+    assert collected == []
+    assert "P_S3_ACCESS_KEY" not in env
+
+
+def test_non_interactive_collects_nothing(tmp_path):
+    env: dict = {}
+    collected = prompt_missing_envs(
+        "gcs-store", environ=env, isatty=False, env_file=tmp_path / ENV_FILE_NAME
+    )
+    assert collected == [] and env == {}
+
+
+def test_save_and_reload_roundtrip(tmp_path, capsys):
+    path = tmp_path / ENV_FILE_NAME
+    save_collected_envs([("P_GCS_BUCKET", "bk"), ("P_S3_SECRET_KEY", "s3cr3t")], path=path)
+    text = path.read_text()
+    assert "P_GCS_BUCKET=bk" in text and "P_S3_SECRET_KEY=s3cr3t" in text
+    assert oct(path.stat().st_mode & 0o777) == "0o600"
+    # export lines never echo the secret value
+    printed = capsys.readouterr().out
+    assert "s3cr3t" not in printed
+
+    env: dict = {}
+    assert load_env_file(path, env) == 2
+    assert env["P_GCS_BUCKET"] == "bk"
+    # pre-set environment wins over the file
+    env2 = {"P_GCS_BUCKET": "winner"}
+    load_env_file(path, env2)
+    assert env2["P_GCS_BUCKET"] == "winner"
+
+
+def test_env_file_feeds_prompting(tmp_path):
+    """Values saved on a previous run suppress re-prompting."""
+    path = tmp_path / ENV_FILE_NAME
+    save_collected_envs([("P_GCS_BUCKET", "saved")], path=path, output=lambda s: None)
+    env: dict = {}
+    collected = prompt_missing_envs(
+        "gcs-store",
+        environ=env,
+        input_fn=lambda prompt: (_ for _ in ()).throw(AssertionError("prompted!")),
+        isatty=True,
+        env_file=path,
+        output=lambda s: None,
+    )
+    assert collected == []
+    assert env["P_GCS_BUCKET"] == "saved"
+
+
+def test_parse_cli_runs_prompt_flow(tmp_path, monkeypatch):
+    """End-to-end through parse_cli: a TTY-less run with the env file
+    present picks the saved bucket up into StorageOptions."""
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ENV_FILE_NAME).write_text("P_GCS_BUCKET=from-file\n")
+    monkeypatch.delenv("P_GCS_BUCKET", raising=False)
+    from parseable_tpu.config import parse_cli
+
+    _, storage = parse_cli(["gcs-store"])
+    assert storage.backend == "gcs-store"
+    assert storage.bucket == "from-file"
+    monkeypatch.delenv("P_GCS_BUCKET", raising=False)

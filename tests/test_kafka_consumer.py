@@ -1,0 +1,264 @@
+"""Kafka consumer loop with a scripted fake broker (VERDICT r2 #5): the
+poll / per-partition chunk / commit-after-flush / rebalance / graceful
+shutdown loop executes fully; only the transport (confluent-kafka) is
+swapped for the fake. Reference: src/connectors/kafka/{consumer.rs,
+partition_stream.rs, sink.rs:93-122}."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from parseable_tpu.connectors.kafka import (
+    ConnectorUnavailable,
+    KafkaConfig,
+    KafkaSource,
+    Record,
+)
+
+
+class FakeConsumer:
+    """Scripted consumer: a list of events — Record, ("revoke", parts),
+    ("assign", parts), ("stop", source) — played back through poll()."""
+
+    def __init__(self, script: list):
+        self.script = list(script)
+        self.commits: list[tuple[list, bool]] = []
+        self.closed = False
+        self._on_assign = None
+        self._on_revoke = None
+
+    def subscribe(self, topics, on_assign=None, on_revoke=None):
+        self.topics = topics
+        self._on_assign = on_assign
+        self._on_revoke = on_revoke
+
+    def poll(self, timeout):
+        while self.script:
+            ev = self.script.pop(0)
+            if isinstance(ev, Record):
+                return ev
+            kind = ev[0]
+            if kind == "revoke" and self._on_revoke:
+                self._on_revoke(ev[1])
+                continue
+            if kind == "assign" and self._on_assign:
+                self._on_assign(ev[1])
+                continue
+            if kind == "stop":
+                ev[1].stop()
+                return None
+        return None
+
+    def commit(self, offsets, sync=False):
+        self.commits.append((list(offsets), sync))
+
+    def close(self):
+        self.closed = True
+
+
+def committed_next(commits, topic, partition):
+    """Latest committed next-offset for a partition."""
+    out = None
+    for offsets, _sync in commits:
+        for t, p, off in offsets:
+            if (t, p) == (topic, partition):
+                out = off
+    return out
+
+
+@pytest.fixture()
+def parseable(tmp_path):
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    return Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+
+
+def staged_rows(p, stream: str) -> int:
+    s = p.streams.get(stream)
+    if s is None:
+        return 0
+    return sum(b.num_rows for b in s.staging_batches())
+
+
+def make_source(parseable, script, **cfg_kw) -> tuple[KafkaSource, FakeConsumer]:
+    cfg = KafkaConfig(
+        bootstrap_servers="fake:9092", topics=["applogs"], buffer_size=3,
+        buffer_timeout_secs=3600.0, **cfg_kw,
+    )
+    fake = FakeConsumer(script)
+    return KafkaSource(parseable, cfg, consumer_factory=lambda: fake), fake
+
+
+def recs(topic, partition, start, n):
+    return [
+        Record(topic, partition, start + i, b'{"n": %d, "p": %d}' % (start + i, partition))
+        for i in range(n)
+    ]
+
+
+def test_chunk_flush_then_commit(parseable):
+    """Offsets commit only AFTER the owning chunk flushes (at-least-once)."""
+    source, fake = make_source(parseable, [])
+    script = recs("applogs", 0, 0, 2)  # buffered, no flush (size 3)
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    # shutdown drained the partial chunk, then committed
+    assert staged_rows(parseable, "applogs") == 2
+    assert committed_next(fake.commits, "applogs", 0) == 2
+    assert fake.closed
+
+
+def test_full_chunk_commits_inline(parseable):
+    source, fake = make_source(parseable, [])
+    script = recs("applogs", 0, 0, 3)  # exactly one full chunk
+    script += recs("applogs", 0, 3, 1)  # one more buffered
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 4
+    # first commit happened at the chunk boundary (next offset 3), before
+    # the shutdown commit (next offset 4)
+    nexts = [
+        off for offsets, _ in fake.commits for t, p, off in offsets if (t, p) == ("applogs", 0)
+    ]
+    assert nexts == [3, 4]
+
+
+def test_per_partition_chunks_and_commits(parseable):
+    """Partitions chunk and commit independently (partition_stream.rs)."""
+    source, fake = make_source(parseable, [])
+    script = []
+    # interleave two partitions; p0 fills a chunk (3), p1 stays partial (2)
+    script += recs("applogs", 0, 10, 2)
+    script += recs("applogs", 1, 70, 2)
+    script += recs("applogs", 0, 12, 1)
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 5
+    assert committed_next(fake.commits, "applogs", 0) == 13
+    assert committed_next(fake.commits, "applogs", 1) == 72
+    # p0's chunk commit fired before shutdown; p1 only at shutdown (sync)
+    p0_commits = [
+        (off, sync) for offsets, sync in fake.commits
+        for t, p, off in offsets if (t, p) == ("applogs", 0)
+    ]
+    assert p0_commits[0] == (13, False)
+
+
+def test_rebalance_revoke_flushes_and_sync_commits(parseable):
+    """Revoked partitions flush + commit synchronously before handoff."""
+    source, fake = make_source(parseable, [])
+    script = recs("applogs", 0, 0, 2)  # buffered
+    script.append(("revoke", [("applogs", 0)]))
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert source.rebalances == 1
+    assert staged_rows(parseable, "applogs") == 2
+    # the revoke commit is synchronous and covers the buffered offsets
+    revoke_commits = [
+        (off, sync) for offsets, sync in fake.commits
+        for t, p, off in offsets if (t, p) == ("applogs", 0)
+    ]
+    assert (2, True) in revoke_commits
+
+
+def test_at_least_once_across_simulated_rebalance(parseable):
+    """e2e topic -> stream -> query with a rebalance mid-stream: every
+    record lands exactly once here (the fake redelivers nothing), and the
+    commit watermarks prove redelivery could only duplicate, never lose."""
+    source, fake = make_source(parseable, [])
+    script = recs("applogs", 0, 0, 3)  # full chunk -> flush+commit
+    script += recs("applogs", 1, 0, 2)  # buffered on p1
+    script.append(("revoke", [("applogs", 1)]))  # p1 moves away
+    script.append(("assign", [("applogs", 0)]))
+    script += recs("applogs", 0, 3, 3)  # another full chunk
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 8
+    # every commit watermark trails or equals the rows durably staged
+    assert committed_next(fake.commits, "applogs", 0) == 6
+    assert committed_next(fake.commits, "applogs", 1) == 2
+
+    from parseable_tpu.query.session import QuerySession
+
+    rows = (
+        QuerySession(parseable, engine="cpu")
+        .query("SELECT count(*) c FROM applogs")
+        .to_json_rows()
+    )
+    assert rows == [{"c": 8}]
+
+
+def test_age_based_drain_commits(parseable):
+    source, fake = make_source(parseable, [])
+    source.config.buffer_timeout_secs = 0.0  # everything is instantly due
+    script = recs("applogs", 0, 0, 1)
+    # a poll tick after the record lets tick() drain it
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 1
+    assert committed_next(fake.commits, "applogs", 0) == 1
+
+
+def test_broker_error_records_skipped(parseable):
+    source, fake = make_source(parseable, [])
+    script = [Record("applogs", 0, -1, b"", error="broker gone")]
+    script += recs("applogs", 0, 5, 3)
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 3
+    assert committed_next(fake.commits, "applogs", 0) == 8
+
+
+def test_malformed_payloads_survive(parseable):
+    source, fake = make_source(parseable, [])
+    script = [
+        Record("applogs", 0, 0, b"not-json{{"),
+        Record("applogs", 0, 1, b'[1, 2]'),
+        Record("applogs", 0, 2, b'{"ok": 1}'),
+    ]
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 3
+
+
+def test_consumer_unavailable_without_binding(parseable):
+    cfg = KafkaConfig(bootstrap_servers="b", topics=["t"])
+    with pytest.raises(ConnectorUnavailable):
+        KafkaSource(parseable, cfg)  # no injected factory, no confluent-kafka
+
+
+def test_graceful_stop_from_another_thread(parseable):
+    """stop() from outside the loop drains and closes."""
+    source_holder: dict = {}
+
+    class BlockingFake(FakeConsumer):
+        def poll(self, timeout):
+            rec = super().poll(timeout)
+            if rec is None and not self.script:
+                # simulate an idle broker until stop() lands
+                source_holder["source"].stop()
+            return rec
+
+    cfg = KafkaConfig(bootstrap_servers="b", topics=["applogs"], buffer_size=100)
+    fake = BlockingFake(recs("applogs", 0, 0, 2))
+    source = KafkaSource(parseable, cfg, consumer_factory=lambda: fake)
+    source_holder["source"] = source
+    t = threading.Thread(target=source.run)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert fake.closed
+    assert staged_rows(parseable, "applogs") == 2
