@@ -459,20 +459,28 @@ def prepare_and_decode_fast(
             if not wants_ts:
                 return None  # non-string under a ts column: slow path
         if wants_ts and pa.types.is_string(t):
+            # Arrow refuses LOSSY string->timestamp casts, so sub-ms
+            # precision strings (OTel emits microseconds) must parse at a
+            # finer unit first, then truncate to ms with safe=False —
+            # exactly what the slow path's parse_rfc3339 -> ms flooring does
             parsed = None
-            try:
-                # tz-suffixed strings -> UTC -> naive, matching
-                # parse_rfc3339().replace(tzinfo=None)
-                parsed = pc.cast(
-                    pc.cast(col, pa.timestamp("ms", tz="UTC")), pa.timestamp("ms")
-                )
-            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            for unit in ("ms", "us", "ns"):
                 try:
-                    # zone-less naive ISO strings cast directly
-                    parsed = pc.cast(col, pa.timestamp("ms"))
+                    # tz-suffixed strings -> UTC -> naive, matching
+                    # parse_rfc3339().replace(tzinfo=None)
+                    parsed = pc.cast(col, pa.timestamp(unit, tz="UTC"))
+                    parsed = pc.cast(parsed, pa.timestamp(unit))
+                    break
                 except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-                    parsed = None
+                    try:
+                        # zone-less naive ISO strings cast directly
+                        parsed = pc.cast(col, pa.timestamp(unit))
+                        break
+                    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                        parsed = None
             if parsed is not None:
+                if parsed.type != pa.timestamp("ms"):
+                    parsed = pc.cast(parsed, pa.timestamp("ms"), safe=False)
                 col = parsed
                 target = pa.timestamp("ms")
             else:

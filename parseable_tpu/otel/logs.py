@@ -11,7 +11,7 @@ from typing import Any
 from parseable_tpu.otel.otel_utils import (
     convert_anyvalue,
     flatten_attributes,
-    nanos_to_rfc3339,
+    nanos_to_rfc3339_batch,
     scope_and_resource_fields,
 )
 
@@ -41,12 +41,17 @@ def flatten_otel_logs(payload: dict) -> list[dict[str, Any]]:
             base = scope_and_resource_fields(resource, scope)
             if sl.get("schemaUrl"):
                 base["schema_url"] = sl["schemaUrl"]
-            for rec in sl.get("logRecords", []):
+            records = sl.get("logRecords", [])
+            # vectorized timestamp formatting (the per-record datetime
+            # path dominated the flatten profile)
+            times = nanos_to_rfc3339_batch([r.get("timeUnixNano") for r in records])
+            observed = nanos_to_rfc3339_batch(
+                [r.get("observedTimeUnixNano") for r in records]
+            )
+            for i, rec in enumerate(records):
                 row = dict(base)
-                row["time_unix_nano"] = nanos_to_rfc3339(rec.get("timeUnixNano"))
-                row["observed_time_unix_nano"] = nanos_to_rfc3339(
-                    rec.get("observedTimeUnixNano")
-                )
+                row["time_unix_nano"] = times[i]
+                row["observed_time_unix_nano"] = observed[i]
                 sev_num = rec.get("severityNumber")
                 if sev_num is not None:
                     sev_num = int(sev_num)

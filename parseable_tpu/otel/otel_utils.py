@@ -41,6 +41,9 @@ def flatten_attributes(attrs: list[dict] | None, prefix: str = "") -> dict[str, 
     return out
 
 
+_EPOCH = datetime(1970, 1, 1, tzinfo=UTC)
+
+
 def nanos_to_rfc3339(nanos: Any) -> str | None:
     if nanos in (None, "", 0, "0"):
         return None
@@ -48,8 +51,41 @@ def nanos_to_rfc3339(nanos: Any) -> str | None:
         n = int(nanos)
     except (TypeError, ValueError):
         return None
-    dt = datetime.fromtimestamp(n / 1e9, UTC)
+    # integer microseconds via timedelta: exact (float seconds would wobble
+    # by ~hundreds of ns at 2024-era epochs), and identical to the batch
+    # variant below
+    from datetime import timedelta
+
+    dt = _EPOCH + timedelta(microseconds=n // 1000)
     return dt.isoformat(timespec="microseconds").replace("+00:00", "Z")
+
+
+def nanos_to_rfc3339_batch(values: list) -> list[str | None]:
+    """Vectorized nanos_to_rfc3339 over one scope-group's records: ONE
+    numpy datetime_as_string call instead of per-record datetime objects
+    (the flatteners' hottest line)."""
+    import numpy as np
+
+    n = len(values)
+    out: list[str | None] = [None] * n
+    ints = np.zeros(n, dtype=np.int64)
+    valid_idx: list[int] = []
+    for i, v in enumerate(values):
+        if v in (None, "", 0, "0"):
+            continue
+        try:
+            ints[i] = int(v)
+        except (TypeError, ValueError):
+            continue
+        valid_idx.append(i)
+    if not valid_idx:
+        return out
+    idx = np.asarray(valid_idx)
+    us = (ints[idx] // 1000).astype("datetime64[us]")
+    strs = np.char.add(np.datetime_as_string(us, unit="us"), "Z")
+    for pos, s in zip(valid_idx, strs.tolist()):
+        out[pos] = s
+    return out
 
 
 def scope_and_resource_fields(resource: dict | None, scope: dict | None) -> dict[str, Any]:

@@ -12,6 +12,7 @@ from typing import Any
 from parseable_tpu.otel.otel_utils import (
     flatten_attributes,
     nanos_to_rfc3339,
+    nanos_to_rfc3339_batch,
     scope_and_resource_fields,
 )
 
@@ -72,7 +73,10 @@ def flatten_otel_traces(payload: dict) -> list[dict[str, Any]]:
             base = scope_and_resource_fields(resource, scope)
             if ss.get("schemaUrl"):
                 base["schema_url"] = ss["schemaUrl"]
-            for span in ss.get("spans", []):
+            spans = ss.get("spans", [])
+            starts = nanos_to_rfc3339_batch([s.get("startTimeUnixNano") for s in spans])
+            ends = nanos_to_rfc3339_batch([s.get("endTimeUnixNano") for s in spans])
+            for i, span in enumerate(spans):
                 row = dict(base)
                 row["span_trace_id"] = span.get("traceId")
                 row["span_span_id"] = span.get("spanId")
@@ -85,8 +89,8 @@ def flatten_otel_traces(payload: dict) -> list[dict[str, Any]]:
                 if kind is not None:
                     row["span_kind"] = int(kind)
                     row["span_kind_description"] = SPAN_KIND.get(int(kind), str(kind))
-                row["span_start_time_unix_nano"] = nanos_to_rfc3339(span.get("startTimeUnixNano"))
-                row["span_end_time_unix_nano"] = nanos_to_rfc3339(span.get("endTimeUnixNano"))
+                row["span_start_time_unix_nano"] = starts[i]
+                row["span_end_time_unix_nano"] = ends[i]
                 row.update(flatten_attributes(span.get("attributes"), prefix="span_"))
                 ev = _events_json(span.get("events", []))
                 if ev is not None:
