@@ -300,6 +300,26 @@ class Parseable:
             entry = create_from_parquet_file(self.storage.absolute_url(key), f)
             manifest_files.append(entry)
             uploaded.append(key)
+            if self.options.mode != Mode.INGEST and self.options.query_engine == "tpu":
+                # seed the encoded-block cache while the parquet bytes are
+                # page-cache warm: first cold query then skips decode+encode
+                # entirely (the TPU hot-tier design, SURVEY row 43)
+                try:
+                    import pyarrow.parquet as pq
+
+                    from parseable_tpu.ops.device import encode_table
+                    from parseable_tpu.ops.enccache import get_enccache
+
+                    cache = get_enccache(self.options)
+                    if cache is not None:
+                        source_id = (
+                            f"{entry.file_path}|{entry.file_size}|{entry.num_rows}"
+                        ).encode()
+                        enc = encode_table(pq.read_table(f), None)
+                        if enc is not None:
+                            cache.put(source_id, enc)
+                except Exception:
+                    logger.exception("encoded-cache seed failed for %s", f)
             if self.options.collect_dataset_stats and stream.name not in (
                 "pstats",
                 "pmeta",

@@ -480,7 +480,20 @@ def prepare_and_decode_fast(
                         parsed = None
             if parsed is not None:
                 if parsed.type != pa.timestamp("ms"):
-                    parsed = pc.cast(parsed, pa.timestamp("ms"), safe=False)
+                    # FLOOR to ms (Arrow's unsafe cast truncates toward
+                    # zero, which would round pre-1970 values up by 1 ms vs
+                    # the slow path's parse_rfc3339 flooring)
+                    unit_per_ms = {"us": 1_000, "ns": 1_000_000}[parsed.type.unit]
+                    ints = pc.cast(parsed, pa.int64())
+                    nulls = pc.is_null(ints).to_numpy(zero_copy_only=False)
+                    filled = pc.fill_null(ints, 0).to_numpy(zero_copy_only=False)
+                    import numpy as _np
+
+                    floored = _np.floor_divide(filled, unit_per_ms)
+                    parsed = pc.cast(
+                        pa.array(floored, type=pa.int64(), mask=nulls),
+                        pa.timestamp("ms"),
+                    )
                 col = parsed
                 target = pa.timestamp("ms")
             else:

@@ -73,6 +73,17 @@ def _pad(a: np.ndarray, n: int, fill=0) -> np.ndarray:
     return out
 
 
+def _code_dtype(card: int) -> np.dtype:
+    """Narrowest dtype holding codes 0..card (card = null/padding slot):
+    transfer bytes are the cold-scan budget, and a 64-value dictionary's
+    codes fit a byte. Device gathers accept any integer index dtype."""
+    if card <= 127:
+        return np.dtype(np.int8)
+    if card <= 32767:
+        return np.dtype(np.int16)
+    return np.dtype(np.int32)
+
+
 def encode_column(
     name: str,
     col: pa.ChunkedArray | pa.Array,
@@ -102,9 +113,9 @@ def encode_column(
         denc = pc.dictionary_encode(col)
         if isinstance(denc, pa.ChunkedArray):
             denc = denc.combine_chunks()
-        codes = np.asarray(denc.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+        codes = np.asarray(denc.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int64)
         dictionary = denc.dictionary.to_pylist()
-        codes = np.where(codes < 0, len(dictionary), codes)
+        codes = np.where(codes < 0, len(dictionary), codes).astype(_code_dtype(len(dictionary)))
         return EncodedColumn(
             name,
             "dict",
@@ -145,10 +156,10 @@ def encode_column(
         denc = pc.dictionary_encode(col)
         if isinstance(denc, pa.ChunkedArray):
             denc = denc.combine_chunks()
-        codes = np.asarray(denc.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+        codes = np.asarray(denc.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int64)
         # null -> extra slot at end so gathers stay in-bounds
         dictionary = denc.dictionary.to_pylist()
-        codes = np.where(codes < 0, len(dictionary), codes)
+        codes = np.where(codes < 0, len(dictionary), codes).astype(_code_dtype(len(dictionary)))
         return EncodedColumn(
             name,
             "dict",
@@ -158,9 +169,9 @@ def encode_column(
             all_valid=all_valid,
         )
     if pa.types.is_dictionary(t):
-        codes = np.asarray(col.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int32)
+        codes = np.asarray(col.indices.fill_null(-1).to_numpy(zero_copy_only=False)).astype(np.int64)
         dictionary = col.dictionary.to_pylist()
-        codes = np.where(codes < 0, len(dictionary), codes)
+        codes = np.where(codes < 0, len(dictionary), codes).astype(_code_dtype(len(dictionary)))
         return EncodedColumn(
             name,
             "dict",

@@ -78,6 +78,12 @@ def topk(values: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
 # Above this group count the one-hot matmul's N*G work loses to scatter
 MATMUL_MAX_GROUPS = 8192
 
+# The one-hot operand may MATERIALIZE (N, G) when XLA declines to fuse it
+# into the dot; bound its footprint (elements) or take the scatter path —
+# a 1M-row block at G=8192 is a 16 GB bf16 tensor otherwise (observed as a
+# CPU-backend OOM and as memory-bound slowness on chip)
+MATMUL_MAX_ONEHOT_ELEMS = 1 << 30
+
 
 # VMEM ceiling for the pallas path: the (ROW_TILE=2048, G) f32 one-hot
 # tile must fit on-chip (2048*512*4B = 4MB, comfortable on 16MB v5e)
@@ -161,18 +167,23 @@ def fused_groupby_block(
             )
             additive = (adds[0], adds[1 : 1 + n_all], adds[1 + n_all :])
 
+    n_rows = group_ids.shape[0]
     if additive is not None:
         count, per_agg_count, sums = additive
-    elif num_groups <= MATMUL_MAX_GROUPS:
+    elif (
+        num_groups <= MATMUL_MAX_GROUPS
+        and n_rows * num_groups <= MATMUL_MAX_ONEHOT_ELEMS
+    ):
         # Split-precision one-hot reduction: the 0/1 rows (count + per-agg
         # counts) ride a bf16 x bf16 -> f32 MXU dot — 0 and 1 are exactly
         # representable in bf16 and accumulation is f32, so counts stay
         # EXACT while the one-hot's HBM traffic halves (~1.8x measured on
-        # v5e). The value sums keep the f32 one-hot (bf16 would truncate
-        # the summed values themselves).
-        onehot_bf16 = (
-            group_ids[:, None] == jnp.arange(num_groups, dtype=jnp.int32)[None, :]
-        ).astype(jnp.bfloat16)
+        # v5e). The value sums use their own independently-generated f32
+        # one-hot: deriving it from the bf16 tensor (astype) gave the
+        # one-hot two consumers and forced XLA to materialize it — each
+        # dot must be the sole consumer of its operand for fusion.
+        iota = jnp.arange(num_groups, dtype=jnp.int32)[None, :]
+        onehot_bf16 = (group_ids[:, None] == iota).astype(jnp.bfloat16)
         count_rows = jnp.concatenate(
             [mask[None, :].astype(jnp.bfloat16), vmask.astype(jnp.bfloat16)], axis=0
         )
@@ -183,9 +194,10 @@ def fused_groupby_block(
         count = count_adds[0]
         per_agg_count = count_adds[1 : 1 + n_all]
         if n_sum:
+            onehot_f32 = (group_ids[:, None] == iota).astype(jnp.float32)
             sum_rows = jnp.where(vmask[:n_sum], sum_values, 0.0)
             sums = jax.lax.dot_general(
-                sum_rows, onehot_bf16.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                sum_rows, onehot_f32, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         else:

@@ -77,6 +77,12 @@ def nanos_to_rfc3339_batch(values: list) -> list[str | None]:
             ints[i] = int(v)
         except (TypeError, ValueError):
             continue
+        except OverflowError:
+            # OTLP timeUnixNano is fixed64: values >= 2^63 overflow the
+            # int64 staging array but the scalar path (Python bigint)
+            # handles them — fall through per value
+            out[i] = nanos_to_rfc3339(v)
+            continue
         valid_idx.append(i)
     if not valid_idx:
         return out

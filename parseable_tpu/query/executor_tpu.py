@@ -385,7 +385,7 @@ class PredicateCompiler:
                 if col.kind != "dict":
                     raise UnsupportedOnDevice("string predicate on non-string column")
                 lut = next(luts)
-                return jnp.logical_and(lut[dev[col.name]], dev[f"{col.name}__valid"])
+                return jnp.logical_and(lut[_as_index(dev[col.name])], dev[f"{col.name}__valid"])
         if isinstance(e, S.UnaryOp) and e.op == "not":
             return jnp.logical_not(self._visit(e.operand, enc, dev, luts))
         if isinstance(e, S.Between):
@@ -405,7 +405,7 @@ class PredicateCompiler:
             if col.kind != "dict":
                 raise UnsupportedOnDevice("regex on non-string column")
             lut = next(luts)
-            return jnp.logical_and(lut[dev[col.name]], dev[f"{col.name}__valid"])
+            return jnp.logical_and(lut[_as_index(dev[col.name])], dev[f"{col.name}__valid"])
         if isinstance(e, S.Literal) and isinstance(e.value, bool):
             # size from the device array, not enc.block_rows: under
             # shard_map this trace sees the per-device row shard
@@ -448,7 +448,7 @@ class PredicateCompiler:
         values = dev[col.name]
         if col.kind == "dict":
             lut = next(luts)
-            mask = lut[values]
+            mask = lut[_as_index(values)]
         elif col.kind == "time":
             mask = _num_cmp(values, op, self._time_threshold(op, lit))
         elif col.kind in ("num", "bool"):
@@ -488,7 +488,7 @@ class PredicateCompiler:
         valid = dev[f"{col.name}__valid"]
         if col.kind == "dict":
             lut = next(luts)
-            return jnp.logical_and(lut[dev[col.name]], valid)
+            return jnp.logical_and(lut[_as_index(dev[col.name])], valid)
         if col.kind in ("num", "bool"):
             lits = [self._literal_of(i) for i in e.items]
             mask = jnp.zeros_like(valid)
@@ -578,6 +578,16 @@ class PredicateCompiler:
         lut = self._padded(lut)
         cache[key] = lut
         return lut
+
+
+def _as_index(a):
+    """Dictionary codes ship in the narrowest dtype (int8/int16) but index
+    LUTs whose SIZE may exceed that dtype's range — JAX gathers materialize
+    the array size in the index dtype, so upcast to int32 in-program (XLA
+    fuses the convert; transfer stays narrow)."""
+    import jax.numpy as jnp
+
+    return a if a.dtype == jnp.int32 else a.astype(jnp.int32)
 
 
 def _num_cmp(values, op: str, threshold):
@@ -843,25 +853,41 @@ class TpuQueryExecutor(QueryExecutor):
     ) -> tuple[EncodedBatch, dict]:
         """Encode a table (or fetch its device-resident encoding).
 
-        Hot-set keys carry the source id the provider stamped into the table
-        metadata plus the column-set signature. Staging data (no source id)
-        is never cached.
+        Resolution order per source-id'd block: device hot set (zero
+        transfer) -> encoded-block disk cache (zero parquet decode /
+        dictionary encode; ops/enccache.py) -> live encode, which
+        writes-behind into the disk cache. Staging data (no source id)
+        always encodes live.
         """
         hotset = get_hotset()
         meta = table.schema.metadata or {}
         source = meta.get(SOURCE_ID_META)
         key = None
+        enccache = None
         if source is not None:
             key = hot_key(source, needed, dict_cols)
             entry = hotset.get(key)
             if entry is not None:
                 return entry.meta, entry.dev
+            from parseable_tpu.ops.enccache import get_enccache
+
+            enccache = get_enccache(self.options)
+            if enccache is not None:
+                enc = enccache.get(source, needed, dict_cols)
+                if enc is not None:
+                    dev, nbytes = _transfer(enc, self.mesh)
+                    _strip_host_values(enc)
+                    hotset.put(key, HotEntry(dev=dev, meta=enc, nbytes=nbytes))
+                    return enc, dev
         table = self._materialize(table)
         enc = encode_table(table, needed, dict_columns=dict_cols)
         if enc is None:
             raise UnsupportedOnDevice("unencodable column in batch")
         dev, nbytes = _transfer(enc, self.mesh)
         if key is not None:
+            if enccache is not None:
+                # snapshot-by-reference then persist off the query path
+                enccache.put_async(source, enc)
             _strip_host_values(enc)
             hotset.put(key, HotEntry(dev=dev, meta=enc, nbytes=nbytes))
         return enc, dev
@@ -1849,7 +1875,7 @@ class TpuQueryExecutor(QueryExecutor):
                 for ks in key_specs:
                     cap = ks.capacity
                     if ks.kind == "dict":
-                        codes = jnp.minimum(remaps[ri][dev[ks.column]], cap - 1)
+                        codes = jnp.minimum(remaps[ri][_as_index(dev[ks.column])], cap - 1)
                         ri += 1
                     else:
                         bin_units = max(1, ks.bin_ms // CANON_TIME_UNIT_MS)
@@ -1901,7 +1927,7 @@ class TpuQueryExecutor(QueryExecutor):
             # distinct presence: OR (max) each (group, value-code) bit
             dacc_new = []
             for di, (dcol, dcap) in enumerate(zip(layout.distinct_cols, layout.distinct_caps)):
-                codes = jnp.minimum(dremaps[di][dev[dcol]], dcap - 1)
+                codes = jnp.minimum(dremaps[di][_as_index(dev[dcol])], dcap - 1)
                 dm = jnp.logical_and(mask, dev[f"{dcol}__valid"])
                 flat = ids * jnp.int32(dcap) + codes
                 upd = jax.ops.segment_max(

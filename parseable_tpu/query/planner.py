@@ -278,6 +278,10 @@ class LogicalPlan:
     # scan's overall [min, max] event time (from manifests): lets the TPU
     # engine pre-size time-bin group capacities and flush exactly once
     scan_time_hint: tuple[datetime, datetime] | None = None
+    # True when p_timestamp entered needed_columns only for time-bounds
+    # filtering: a query with no bounds can then skip encoding/shipping the
+    # column entirely (transfer bytes are the cold-scan budget)
+    ts_artificial: bool = False
     # safety rails (set by the session from Options; reference:
     # query/mod.rs:92,152-165 timeout + :216-226 memory pool)
     deadline: float | None = None  # time.monotonic() cutoff
@@ -350,6 +354,7 @@ def plan(select: S.Select) -> LogicalPlan:
     constraints = extract_column_constraints(select.where)
 
     needed: set[str] | None = set()
+    ts_artificial = False
     for item in select.items:
         if isinstance(item.expr, S.Star):
             needed = None
@@ -360,10 +365,15 @@ def plan(select: S.Select) -> LogicalPlan:
         for g in select.group_by:
             needed |= referenced_columns(g)
         needed |= referenced_columns(select.having)
+        # ORDER BY resolves select ALIASES against the output table; an
+        # alias name is not an input column (it would poison column-pruned
+        # scans and encoded-cache lookups with a phantom column)
+        alias_names = {i.alias for i in select.items if i.alias}
         for o in select.order_by:
-            needed |= referenced_columns(o.expr)
+            needed |= referenced_columns(o.expr) - alias_names
         # engines row-filter by time bounds themselves (scan tables arrive
         # unfiltered so device encodings stay query-independent)
+        ts_artificial = DEFAULT_TIMESTAMP_KEY not in needed
         needed.add(DEFAULT_TIMESTAMP_KEY)
 
     is_agg = bool(select.group_by) or any(S.is_aggregate(i.expr) for i in select.items)
@@ -374,4 +384,5 @@ def plan(select: S.Select) -> LogicalPlan:
         constraints=constraints,
         needed_columns=needed,
         is_aggregate=is_agg,
+        ts_artificial=ts_artificial,
     )

@@ -143,8 +143,13 @@ class StreamScan:
         if needed is None:
             return None
         cols = [c for c in available if c in needed]
-        # always carry the timestamp column for time filtering
-        if DEFAULT_TIMESTAMP_KEY in available and DEFAULT_TIMESTAMP_KEY not in cols:
+        # carry the timestamp column for time filtering — unless the plan
+        # dropped it (no bounds, no expression touches it)
+        tb = self.plan.time_bounds
+        wants_ts = (
+            DEFAULT_TIMESTAMP_KEY in needed or tb.low is not None or tb.high is not None
+        )
+        if wants_ts and DEFAULT_TIMESTAMP_KEY in available and DEFAULT_TIMESTAMP_KEY not in cols:
             cols.append(DEFAULT_TIMESTAMP_KEY)
         return cols
 
@@ -324,8 +329,10 @@ class StreamScan:
                 t = self._apply_time_filter(t)
                 if t.num_rows:
                     yield t
-        hotset = key_fn = None
+        hotset = key_fn = enccache = None
+        dict_cols: set[str] = set()
         if self.use_hot_stubs:
+            from parseable_tpu.ops.enccache import get_enccache
             from parseable_tpu.ops.hotset import get_hotset
             from parseable_tpu.query.executor_tpu import (
                 dict_group_columns,
@@ -334,6 +341,7 @@ class StreamScan:
             )
 
             hotset = get_hotset()
+            enccache = get_enccache(self.p.options)
             dict_cols = dict_group_columns(self.plan.select)
             key_fn = lambda sid: hot_key(sid, self.plan.needed_columns, dict_cols)
             make_stub_fn = make_stub
@@ -347,6 +355,14 @@ class StreamScan:
                 if entry is not None:
                     self.stats.rows_scanned += entry.meta.num_rows
                     yield make_stub_fn(source_id, entry.meta.num_rows)
+                    continue
+                # encoded-block disk cache: the executor loads device-ready
+                # columns; skip the parquet read entirely
+                if enccache is not None and enccache.can_serve(
+                    source_id, self.plan.needed_columns, dict_cols
+                ):
+                    self.stats.rows_scanned += f.num_rows
+                    yield make_stub_fn(source_id, f.num_rows)
                     continue
             t = self._read_parquet(f)
             if t is None or t.num_rows == 0:

@@ -591,6 +591,18 @@ class QuerySession:
             from parseable_tpu.query.executor_tpu import TpuQueryExecutor
             from parseable_tpu.query.provider import prefetch_iter
 
+            if (
+                lp.ts_artificial
+                and lp.time_bounds.low is None
+                and lp.time_bounds.high is None
+                and lp.needed_columns is not None
+            ):
+                # no bounds and no expression touches the timestamp: skip
+                # encoding/shipping it (the column is ~a third of a typical
+                # scan's transfer bytes)
+                from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+                lp.needed_columns.discard(DEFAULT_TIMESTAMP_KEY)
             self._set_scan_time_hint(lp, scan)
             executor: QueryExecutor = TpuQueryExecutor(lp, self.p.options)
             executor.source_loader = scan.read_source

@@ -1,0 +1,236 @@
+"""Encoded-block disk cache (ops/enccache.py): the TPU-native hot tier's
+device-feed layer (SURVEY §2 row 43). Roundtrip fidelity, variant
+selection, invalidation-by-source-id, eviction, and the cold-query path
+serving from cache with exact results."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.ops.device import encode_table
+from parseable_tpu.ops.enccache import EncodedBlockCache
+
+
+@pytest.fixture()
+def table() -> pa.Table:
+    rng = np.random.default_rng(3)
+    n = 5000
+    return pa.table(
+        {
+            "host": pa.array([f"h{int(x)}" for x in rng.integers(0, 40, n)]),
+            "status": pa.array(rng.choice([200.0, 404.0, 500.0], n)),
+            "lat": pa.array(rng.random(n) * 9.0),
+            "msg": pa.array(
+                [f"m{int(x)}" if x % 5 else None for x in rng.integers(0, 30, n)]
+            ),
+        }
+    )
+
+
+def norm_cols(enc):
+    out = {}
+    for name, c in enc.columns.items():
+        out[name] = (
+            c.kind,
+            c.values[: enc.num_rows].tolist(),
+            c.valid[: enc.num_rows].tolist(),
+            c.dictionary,
+            c.vmin,
+            c.vmax,
+        )
+    return out
+
+
+def test_roundtrip_exact(tmp_path, table):
+    cache = EncodedBlockCache(tmp_path)
+    enc = encode_table(table, {"host", "status", "lat", "msg"})
+    assert cache.put(b"src-1", enc)
+    got = cache.get(b"src-1", {"host", "status", "lat", "msg"}, set())
+    assert got is not None
+    assert got.num_rows == enc.num_rows and got.block_rows == enc.block_rows
+    assert norm_cols(got) == norm_cols(enc)
+
+
+def test_narrow_dtypes_preserved(tmp_path, table):
+    cache = EncodedBlockCache(tmp_path)
+    enc = encode_table(table, {"host"})
+    assert enc.columns["host"].values.dtype == np.int8  # 40-value dict
+    cache.put(b"src-1", enc)
+    got = cache.get(b"src-1", {"host"}, set())
+    assert got.columns["host"].values.dtype == np.int8
+
+
+def test_variant_merge_and_selection(tmp_path, table):
+    """A numeric column stores both its f32 and forced-dict variants; each
+    query shape picks the right one."""
+    cache = EncodedBlockCache(tmp_path)
+    enc_plain = encode_table(table, {"status"})
+    cache.put(b"s", enc_plain)
+    # group-by shape wants dict codes: miss until the variant is added
+    assert cache.get(b"s", {"status"}, {"status"}) is None
+    enc_forced = encode_table(table, {"status"}, dict_columns={"status"})
+    cache.put(b"s", enc_forced)
+    got_dict = cache.get(b"s", {"status"}, {"status"})
+    assert got_dict is not None and got_dict.columns["status"].kind == "dict"
+    got_num = cache.get(b"s", {"status"}, set())
+    assert got_num is not None and got_num.columns["status"].kind == "num"
+    # the forced numeric dict must never serve a non-group-by read
+    assert got_num.columns["status"].values.dtype == np.float32
+
+
+def test_string_dict_serves_both_shapes(tmp_path, table):
+    cache = EncodedBlockCache(tmp_path)
+    cache.put(b"s", encode_table(table, {"host"}))
+    assert cache.get(b"s", {"host"}, {"host"}).columns["host"].kind == "dict"
+    assert cache.get(b"s", {"host"}, set()).columns["host"].kind == "dict"
+
+
+def test_missing_column_misses(tmp_path, table):
+    cache = EncodedBlockCache(tmp_path)
+    cache.put(b"s", encode_table(table, {"host"}))
+    assert cache.get(b"s", {"host", "lat"}, set()) is None
+
+
+def test_source_id_isolation(tmp_path, table):
+    cache = EncodedBlockCache(tmp_path)
+    cache.put(b"path|100|5000", encode_table(table, {"host"}))
+    # same path, different size (rewritten object) -> different entry
+    assert cache.get(b"path|200|5000", {"host"}, set()) is None
+
+
+def test_timestamp_vmin_vmax_roundtrip(tmp_path):
+    from datetime import datetime, timedelta
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+
+    base = datetime(2024, 5, 1)
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(
+                [base + timedelta(seconds=i) for i in range(100)], pa.timestamp("ms")
+            )
+        }
+    )
+    cache = EncodedBlockCache(tmp_path)
+    enc = encode_table(t, {DEFAULT_TIMESTAMP_KEY})
+    cache.put(b"s", enc)
+    got = cache.get(b"s", {DEFAULT_TIMESTAMP_KEY}, set())
+    col = got.columns[DEFAULT_TIMESTAMP_KEY]
+    assert (col.vmin, col.vmax) == (
+        enc.columns[DEFAULT_TIMESTAMP_KEY].vmin,
+        enc.columns[DEFAULT_TIMESTAMP_KEY].vmax,
+    )
+
+
+def test_eviction_by_budget(tmp_path, table):
+    cache = EncodedBlockCache(tmp_path, budget_bytes=1)  # everything over
+    enc = encode_table(table, {"host"})
+    cache.put(b"a", enc)
+    import time
+
+    time.sleep(0.02)
+    cache.put(b"b", enc)
+    files = list(tmp_path.glob("*.enc"))
+    assert len(files) <= 1  # oldest evicted
+
+
+def test_cold_query_serves_from_cache(tmp_path):
+    """Pipeline: ingest -> parquet+sidecar -> clear hot set -> cold query
+    reads the sidecar (no parquet decode) with exact results."""
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.event.json_format import JsonEvent
+    from parseable_tpu.ops import enccache as EC
+    from parseable_tpu.ops.hotset import get_hotset
+    from parseable_tpu.query.session import QuerySession
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    opts.query_engine = "tpu"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    s = p.create_stream_if_not_exists("enc")
+    rows = [{"host": f"h{i % 5}", "v": float(i)} for i in range(5000)]
+    JsonEvent(rows, "enc").into_event(s.metadata).process(
+        s, commit_schema=p.commit_schema
+    )
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    cache = EC.get_enccache(opts)
+    assert cache is not None
+    assert list((tmp_path / "staging" / "encoded_cache").glob("*.enc")), (
+        "upload did not seed the encoded cache"
+    )
+
+    sess = QuerySession(p, engine="tpu")
+    sql = "SELECT host, count(*) c, sum(v) s FROM enc GROUP BY host ORDER BY host"
+    expected = QuerySession(p, engine="cpu").query(sql).to_json_rows()
+
+    get_hotset().clear()
+    hits_before = cache.hits
+
+    # make a live parquet decode loud: cold hits must not need it
+    import parseable_tpu.query.provider as PV
+
+    reads = {"n": 0}
+    orig = PV.StreamScan._read_parquet
+
+    def counting(self, f):
+        reads["n"] += 1
+        return orig(self, f)
+
+    PV.StreamScan._read_parquet = counting
+    try:
+        got = sess.query(sql).to_json_rows()
+    finally:
+        PV.StreamScan._read_parquet = orig
+    assert got == expected
+    assert cache.hits > hits_before, "cold query bypassed the encoded cache"
+    assert reads["n"] == 0, "cold query still decoded parquet"
+
+
+def test_concurrent_puts_no_corruption(tmp_path, table):
+    """Racing writers must never install a torn file (unique tmp + lock)."""
+    import threading
+
+    cache = EncodedBlockCache(tmp_path)
+    enc_plain = encode_table(table, {"status", "lat"})
+    enc_forced = encode_table(table, {"status", "host"}, dict_columns={"status"})
+    errs = []
+
+    def writer(enc):
+        for _ in range(10):
+            if not cache.put(b"same-src", enc):
+                pass
+
+    ts = [threading.Thread(target=writer, args=(e,)) for e in (enc_plain, enc_forced)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    # file readable and serves both shapes
+    assert cache.get(b"same-src", {"status"}, set()) is not None
+    assert cache.get(b"same-src", {"status"}, {"status"}) is not None
+
+
+def test_put_async_survives_strip(tmp_path, table):
+    """put_async snapshots references BEFORE the hot set strips arrays."""
+    import numpy as np
+    import time
+
+    from parseable_tpu.query.executor_tpu import _strip_host_values
+
+    cache = EncodedBlockCache(tmp_path)
+    enc = encode_table(table, {"host", "lat"})
+    cache.put_async(b"async-src", enc)
+    _strip_host_values(enc)  # what _encoded_block does right after
+    for _ in range(100):
+        if cache.get(b"async-src", {"host", "lat"}, set()) is not None:
+            break
+        time.sleep(0.05)
+    got = cache.get(b"async-src", {"host", "lat"}, set())
+    assert got is not None
+    assert len(got.columns["lat"].values) >= got.num_rows

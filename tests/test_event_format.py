@@ -347,3 +347,26 @@ def test_fast_path_differential_fuzz():
     # the generator's payloads are mostly clean; the fast path must engage
     # for a reasonable share or it's not a fast path
     assert accepted >= trials // 10, f"fast path engaged only {accepted}/{trials}"
+
+
+def test_fast_path_floors_pre_epoch_submillisecond():
+    """Sub-ms strings BEFORE 1970 must floor (not truncate toward zero),
+    matching the slow path's parse_rfc3339 -> ms semantics."""
+    import pyarrow as pa
+
+    from parseable_tpu.event.format import prepare_and_decode_fast
+
+    records = [
+        {"timestamp": "1969-12-31T23:59:59.999500Z"},
+        {"timestamp": "1970-01-01T00:00:00.000400Z"},
+    ]
+    out = prepare_and_decode_fast(records, None)
+    assert out is not None
+    batch, _ = out
+    col = batch.column(batch.schema.names.index("timestamp"))
+    import datetime as dt
+
+    assert col.to_pylist() == [
+        dt.datetime(1969, 12, 31, 23, 59, 59, 999000),
+        dt.datetime(1970, 1, 1, 0, 0, 0, 0),
+    ]

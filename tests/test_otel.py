@@ -323,3 +323,13 @@ def test_otel_logs_fast_decode_differential(parseable):
         slow_t = slow_ev.rb.select(slow_cols)
         assert fast_t.schema == slow_t.schema, f"trial {trial} schema diverged"
         assert fast_t == slow_t, f"trial {trial} rows diverged"
+
+
+def test_nanos_batch_overflow_values():
+    """fixed64 timeUnixNano values >= 2^63 must not crash the batch path."""
+    from parseable_tpu.otel.otel_utils import nanos_to_rfc3339, nanos_to_rfc3339_batch
+
+    vals = [2**63, str(2**63 + 5), 2**64 - 1, 1714521600000000000]
+    batch = nanos_to_rfc3339_batch(vals)
+    for v, got in zip(vals, batch):
+        assert got == nanos_to_rfc3339(v)
