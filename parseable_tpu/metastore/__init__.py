@@ -187,10 +187,10 @@ class ObjectStoreMetastore(Metastore):
 
     # -- streams ------------------------------------------------------------
     @staticmethod
-    def _migrate(obj: dict) -> dict:
+    def _migrate(obj: dict, stream: str | None = None) -> dict:
         from parseable_tpu.migration import migrate_stream_json
 
-        return migrate_stream_json(obj)
+        return migrate_stream_json(obj, stream_name=stream)
 
     def get_stream_json(self, stream: str, node_id: str | None = None) -> ObjectStoreFormat:
         obj = self._get_json(stream_json_path(stream, node_id))
@@ -198,7 +198,7 @@ class ObjectStoreMetastore(Metastore):
             raise MetastoreError(f"stream {stream} not found")
         # reads always upgrade older layouts (migration/__init__.py), so
         # data written by any earlier deployment version stays loadable
-        return ObjectStoreFormat.from_json(self._migrate(obj))
+        return ObjectStoreFormat.from_json(self._migrate(obj, stream))
 
     def get_all_stream_jsons(self, stream: str) -> list[ObjectStoreFormat]:
         """All nodes' stream jsons — queriers merge these at scan time
@@ -209,7 +209,7 @@ class ObjectStoreMetastore(Metastore):
             if meta.key.endswith("stream.json"):
                 obj = self._get_json(meta.key)
                 if obj is not None:
-                    out.append(ObjectStoreFormat.from_json(self._migrate(obj)))
+                    out.append(ObjectStoreFormat.from_json(self._migrate(obj, stream)))
         return out
 
     def list_stream_json_raw(self, stream: str):

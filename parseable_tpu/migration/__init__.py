@@ -37,14 +37,63 @@ class MigrationError(Exception):
 # ------------------------------------------------------------- stream json
 
 
-def migrate_stream_json(obj: dict) -> dict:
+# v5->v6 scalar log_source enum -> snake/kebab format names (reference:
+# stream_metadata_migration.rs map_log_source_format; unknown -> json)
+_LOG_SOURCE_FORMATS = {
+    "Kinesis": "kinesis",
+    "OtelLogs": "otel-logs",
+    "OtelTraces": "otel-traces",
+    "OtelMetrics": "otel-metrics",
+    "Pmeta": "pmeta",
+    "Json": "json",
+    # already-migrated spellings pass through
+    "kinesis": "kinesis",
+    "otel-logs": "otel-logs",
+    "otel-traces": "otel-traces",
+    "otel-metrics": "otel-metrics",
+    "pmeta": "pmeta",
+    "json": "json",
+}
+
+# v6->v7: telemetry type derived from the (migrated) log source
+_TELEMETRY_BY_SOURCE = {
+    "otel-logs": "logs",
+    "otel-traces": "traces",
+    "otel-metrics": "metrics",
+}
+
+
+def _migrate_snapshot_v1(snapshot: dict) -> dict:
+    """v1 snapshot manifests lack the per-manifest rollup counters
+    (reference: v1_v2_snapshot_migration): add zeroed counters + bump."""
+    new_list = []
+    for m in snapshot.get("manifest_list", []) or []:
+        new_list.append(
+            {
+                "manifest_path": m.get("manifest_path"),
+                "time_lower_bound": m.get("time_lower_bound"),
+                "time_upper_bound": m.get("time_upper_bound"),
+                "events_ingested": m.get("events_ingested", 0),
+                "ingestion_size": m.get("ingestion_size", 0),
+                "storage_size": m.get("storage_size", 0),
+            }
+        )
+    return {"version": "v2", "manifest_list": new_list}
+
+
+def migrate_stream_json(obj: dict, stream_name: str | None = None) -> dict:
     """Upgrade any historical stream.json shape to the current one.
 
     Handled drift (mirroring v1->v7 in stream_metadata_migration.rs):
-    - v1 flat `stats` {events, ingestion, storage} -> current/lifetime/
+    - v1-v3 flat `stats` {events, ingestion, storage} -> current/lifetime/
       deleted triplet (lifetime seeded from current; deleted zero);
+    - v1 snapshot manifests without rollup counters -> zeroed counters
+      (v1_v2_snapshot_migration);
+    - v4->v5 missing `stream_type` -> Internal for pmeta else UserDefined;
+    - v5->v6 scalar `log_source` enum -> [{log_source_format, fields}]
+      with the reference's format-name mapping (unknown -> json);
+    - v6->v7 missing `telemetry_type` derived from the log source;
     - `objectstore-format` missing or under `object_store_format`;
-    - scalar `log_source` string -> [{log_source_format, fields}];
     - camelCase keys (createdAt, firstEventAt, staticSchemaFlag,
       timePartition, customPartition, streamType) -> current names;
     - missing snapshot -> empty manifest list.
@@ -82,16 +131,37 @@ def migrate_stream_json(obj: dict) -> dict:
             "deleted_stats": {"events": 0, "ingestion": 0, "storage": 0},
         }
 
-    # log source --------------------------------------------------------
+    # stream type (v4->v5) ---------------------------------------------
+    if "stream_type" not in out:
+        from parseable_tpu import INTERNAL_STREAM_NAME
+
+        out["stream_type"] = (
+            "Internal" if stream_name == INTERNAL_STREAM_NAME else "UserDefined"
+        )
+
+    # log source (v5->v6) ----------------------------------------------
     ls = out.get("log_source")
     if isinstance(ls, str):
-        out["log_source"] = [{"log_source_format": ls, "fields": []}]
+        fmt = _LOG_SOURCE_FORMATS.get(ls, "json")
+        out["log_source"] = [{"log_source_format": fmt, "fields": []}]
     elif ls is None:
-        out["log_source"] = []
+        out["log_source"] = [{"log_source_format": "json", "fields": []}]
+
+    # telemetry type (v6->v7) ------------------------------------------
+    if "telemetry_type" not in out:
+        first = (
+            out["log_source"][0].get("log_source_format", "json")
+            if isinstance(out.get("log_source"), list) and out["log_source"]
+            else "json"
+        )
+        out["telemetry_type"] = _TELEMETRY_BY_SOURCE.get(first, "logs")
 
     # snapshot ----------------------------------------------------------
-    if "snapshot" not in out or out["snapshot"] is None:
+    snap = out.get("snapshot")
+    if not snap:
         out["snapshot"] = {"version": "v2", "manifest_list": []}
+    elif str(snap.get("version", "v1")) == "v1":
+        out["snapshot"] = _migrate_snapshot_v1(snap)
 
     if "created-at" not in out:
         out["created-at"] = rfc3339_now()
@@ -205,7 +275,7 @@ def run_migrations(p) -> int:
     for name in names:
         try:
             for node_id, raw in p.metastore.list_stream_json_raw(name):
-                migrated = migrate_stream_json(raw)
+                migrated = migrate_stream_json(raw, stream_name=name)
                 if migrated != raw:
                     p.metastore.put_stream_json_raw(name, migrated, node_id)
                     upgraded += 1

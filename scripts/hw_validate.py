@@ -1,0 +1,243 @@
+"""Hardware validation battery: run the moment the TPU tunnel answers.
+
+Captures, in order of value-per-second (the tunnel may die again):
+1. transfer bandwidth + dispatch latency;
+2. fused group-by kernel matmul-vs-scatter across G (the one-hot
+   materialization question, ops/kernels.py);
+3. Pallas group-by vs XLA at its small-G envelope (VERDICT r2 #8);
+4. warm/cold engine smoke on the persistent .benchwork dataset (config 4
+   shape) — encoded-cache cold vs live cold vs hot-set warm.
+
+Writes JSON lines to scripts/hw_results.jsonl (append; timestamped by the
+caller's wall clock).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+OUT = Path(__file__).with_name("hw_results.jsonl")
+
+
+def emit(kind: str, **kw) -> None:
+    rec = {"kind": kind, "at": time.time(), **kw}
+    with OUT.open("a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def probe(timeout_secs: float = 60.0) -> bool:
+    import threading
+
+    ok: list = []
+
+    def go():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jnp.ones(8).sum().block_until_ready()
+            ok.append(jax.devices())
+        except Exception as e:  # noqa: BLE001
+            ok.append(e)
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    t.join(timeout_secs)
+    return bool(ok) and not isinstance(ok[0], Exception)
+
+
+def bench_transfer() -> None:
+    import jax
+    import numpy as np
+
+    a = np.random.rand(32 * 1024 * 256).astype(np.float32)  # 32 MB
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_put(a).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x.sum())
+    x = jnp.ones(1024)
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(20):
+        f(x).block_until_ready()
+    emit(
+        "transfer",
+        mb_per_s=round(32 / best, 1),
+        dispatch_ms=round((time.perf_counter() - t0) / 20 * 1000, 3),
+    )
+
+
+def bench_kernel_matrix() -> None:
+    """matmul vs scatter across G at N=1M, via the real fused kernel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parseable_tpu.ops import kernels as K
+
+    n = 1 << 20
+    rng = np.random.default_rng(0)
+    mask = jnp.asarray(np.ones(n, bool))
+    sumv = jnp.asarray(rng.random((1, n), np.float32))
+    z = jnp.zeros((0, n), jnp.float32)
+    valid = jnp.asarray(np.ones((2, n), bool))
+    for g in (256, 1024, 4096, 8192, 16384, 65536, 1 << 20):
+        ids = jnp.asarray(rng.integers(0, g, n).astype(np.int32))
+        for route, max_elems in (("matmul", 1 << 62), ("scatter", 0)):
+            if route == "matmul" and g > 8192:
+                continue
+            orig_g, orig_e = K.MATMUL_MAX_GROUPS, K.MATMUL_MAX_ONEHOT_ELEMS
+            K.MATMUL_MAX_GROUPS = 8192 if route == "matmul" else 0
+            K.MATMUL_MAX_ONEHOT_ELEMS = max_elems if route == "matmul" else 0
+            try:
+                K.fused_groupby_block.clear_cache()
+                args = (ids, mask, sumv, z, z, valid, g, 1, 0, 0)
+                try:
+                    out = K.fused_groupby_block(*args)
+                    jax.block_until_ready(out)
+                    t0 = time.perf_counter()
+                    for _ in range(5):
+                        out = K.fused_groupby_block(*args)
+                    jax.block_until_ready(out)
+                    dt = (time.perf_counter() - t0) / 5
+                    emit(
+                        "kernel", g=g, route=route,
+                        ms_per_1m_block=round(dt * 1000, 3),
+                        m_rows_per_s=round(n / dt / 1e6, 1),
+                    )
+                except Exception as e:  # noqa: BLE001
+                    emit("kernel", g=g, route=route, error=str(e)[:200])
+            finally:
+                K.MATMUL_MAX_GROUPS, K.MATMUL_MAX_ONEHOT_ELEMS = orig_g, orig_e
+                K.fused_groupby_block.clear_cache()
+
+
+def bench_pallas() -> None:
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parseable_tpu.ops import kernels as K
+
+    try:
+        from parseable_tpu.ops.pallas_groupby import PALLAS_AVAILABLE
+    except ImportError:
+        PALLAS_AVAILABLE = False
+    if not PALLAS_AVAILABLE:
+        emit("pallas", error="pallas unavailable")
+        return
+    n = 1 << 20
+    rng = np.random.default_rng(0)
+    mask = jnp.asarray(np.ones(n, bool))
+    sumv = jnp.asarray(rng.random((1, n), np.float32))
+    z = jnp.zeros((0, n), jnp.float32)
+    valid = jnp.asarray(np.ones((2, n), bool))
+    for g in (64, 256, 512):
+        ids = jnp.asarray(rng.integers(0, g, n).astype(np.int32))
+        for use in ("0", "1"):
+            os.environ["P_TPU_USE_PALLAS"] = use
+            K.fused_groupby_block.clear_cache()
+            args = (ids, mask, sumv, z, z, valid, g, 1, 0, 0)
+            try:
+                out = K.fused_groupby_block(*args)
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    out = K.fused_groupby_block(*args)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / 5
+                emit(
+                    "pallas", g=g, pallas=use == "1",
+                    ms_per_1m_block=round(dt * 1000, 3),
+                )
+            except Exception as e:  # noqa: BLE001
+                emit("pallas", g=g, pallas=use == "1", error=str(e)[:200])
+    os.environ.pop("P_TPU_USE_PALLAS", None)
+    K.fused_groupby_block.clear_cache()
+
+
+def bench_engine_smoke() -> None:
+    """Config-4 shape on the persistent dataset: live cold, cache cold,
+    hot warm."""
+    workdir = Path("/root/repo/.benchwork")
+    if not workdir.exists():
+        emit("engine", error="no .benchwork dataset")
+        return
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.ops import enccache as EC
+    from parseable_tpu.ops.hotset import get_hotset
+    from parseable_tpu.query.session import QuerySession
+
+    opts = Options()
+    opts.local_staging_path = workdir / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=workdir / "data"))
+    sess_cpu = QuerySession(p, engine="cpu")
+    sess = QuerySession(p, engine="tpu")
+    rows_total = 8_000_000
+    for name, sql in (
+        (
+            "topk_multicol",
+            "SELECT path, host, count(*) AS c, sum(bytes) AS s FROM bench "
+            "GROUP BY path, host ORDER BY s DESC LIMIT 10",
+        ),
+        (
+            "groupby",
+            "SELECT date_bin(interval '1 minute', p_timestamp) AS t, status, "
+            "count(*) AS c, sum(bytes) AS b, avg(latency_ms) AS l FROM bench "
+            "GROUP BY t, status",
+        ),
+        (
+            "regex_filter",
+            "SELECT status, count(*) AS c, avg(latency_ms) AS l FROM bench "
+            "WHERE message LIKE '%error%' GROUP BY status",
+        ),
+    ):
+        t0 = time.perf_counter()
+        sess_cpu.query(sql)
+        cpu_t = time.perf_counter() - t0
+        sess.query(sql)  # compile + seed caches
+        get_hotset().clear()
+        t0 = time.perf_counter()
+        sess.query(sql)
+        cache_cold_t = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sess.query(sql)
+        warm_t = time.perf_counter() - t0
+        emit(
+            "engine",
+            config=name,
+            cpu_s=round(cpu_t, 3),
+            cache_cold_s=round(cache_cold_t, 3),
+            warm_s=round(warm_t, 3),
+            cold_x=round(cpu_t / cache_cold_t, 2),
+            warm_x=round(cpu_t / warm_t, 2),
+            rows_per_s_warm=round(rows_total / warm_t),
+        )
+
+
+def main() -> None:
+    if not probe():
+        emit("probe", ok=False)
+        sys.exit(2)
+    emit("probe", ok=True)
+    bench_transfer()
+    bench_kernel_matrix()
+    bench_pallas()
+    bench_engine_smoke()
+    emit("done")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    main()

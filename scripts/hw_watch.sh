@@ -1,0 +1,16 @@
+#!/bin/bash
+# Poll the TPU; run the validation battery the moment it answers.
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 600 python scripts/hw_validate.py >> scripts/hw_watch.log 2>&1; then
+    echo "VALIDATION COMPLETE at $(date -u)" >> scripts/hw_watch.log
+    exit 0
+  fi
+  rc=$?
+  if [ "$rc" != "2" ]; then
+    echo "validate rc=$rc at $(date -u) (partial results possible)" >> scripts/hw_watch.log
+  fi
+  sleep 120
+done
+echo "gave up after 200 probes" >> scripts/hw_watch.log
+exit 1

@@ -129,3 +129,140 @@ def test_reconcile_same_deployment_ok(tmp_path):
     first = resolve_parseable_metadata(p)
     second = resolve_parseable_metadata(p)
     assert second["deployment_id"] == first["deployment_id"]
+
+
+# --------------------------------------------------------- reference shapes
+# Fixtures below mirror the exact document shapes the reference's migration
+# code consumes (src/migration/stream_metadata_migration.rs v1_v4..v6_v7),
+# not synthetic approximations.
+
+
+def test_v1_reference_shape_with_v1_snapshot():
+    """v1: flat stats + v1 snapshot whose manifests lack rollup counters
+    (v1_v4 + v1_v2_snapshot_migration)."""
+    from parseable_tpu.migration import migrate_stream_json
+
+    doc = {
+        "version": "v1",
+        "stats": {"events": 120, "ingestion": 4096, "storage": 2048},
+        "snapshot": {
+            "version": "v1",
+            "manifest_list": [
+                {
+                    "manifest_path": "web/date=2023-01-02/manifest.json",
+                    "time_lower_bound": "2023-01-02T00:00:00Z",
+                    "time_upper_bound": "2023-01-02T23:59:59Z",
+                }
+            ],
+        },
+        "created-at": "2023-01-01T00:00:00Z",
+        "owner": {"id": "admin", "group": "admin"},
+    }
+    out = migrate_stream_json(doc, stream_name="web")
+    assert out["version"] == "v7"
+    assert out["stats"]["lifetime_stats"]["events"] == 120
+    assert out["stats"]["current_stats"]["ingestion"] == 4096
+    assert out["stats"]["deleted_stats"] == {"events": 0, "ingestion": 0, "storage": 0}
+    m = out["snapshot"]["manifest_list"][0]
+    assert out["snapshot"]["version"] == "v2"
+    assert m["events_ingested"] == 0 and m["ingestion_size"] == 0 and m["storage_size"] == 0
+    assert m["manifest_path"] == "web/date=2023-01-02/manifest.json"
+    # fully parseable into the current model
+    from parseable_tpu.storage import ObjectStoreFormat
+
+    fmt = ObjectStoreFormat.from_json(out)
+    assert fmt.stats.lifetime_events == 120
+
+
+def test_v4_stream_type_defaults():
+    """v4->v5: missing stream_type -> Internal for pmeta, else UserDefined."""
+    from parseable_tpu.migration import migrate_stream_json
+
+    base = {
+        "version": "v4",
+        "stats": {
+            "current_stats": {"events": 1, "ingestion": 1, "storage": 1},
+            "lifetime_stats": {"events": 1, "ingestion": 1, "storage": 1},
+            "deleted_stats": {"events": 0, "ingestion": 0, "storage": 0},
+        },
+        "snapshot": {"version": "v2", "manifest_list": []},
+    }
+    assert migrate_stream_json(dict(base), stream_name="pmeta")["stream_type"] == "Internal"
+    assert migrate_stream_json(dict(base), stream_name="web")["stream_type"] == "UserDefined"
+
+
+def test_v5_log_source_enum_mapping():
+    """v5->v6: scalar log_source enum names map to format strings
+    (map_log_source_format); unknown -> json; missing -> default entry."""
+    from parseable_tpu.migration import migrate_stream_json
+
+    for enum_name, expect in (
+        ("OtelLogs", "otel-logs"),
+        ("OtelTraces", "otel-traces"),
+        ("OtelMetrics", "otel-metrics"),
+        ("Kinesis", "kinesis"),
+        ("Pmeta", "pmeta"),
+        ("Json", "json"),
+        ("SomethingElse", "json"),
+    ):
+        out = migrate_stream_json({"version": "v5", "log_source": enum_name})
+        assert out["log_source"] == [{"log_source_format": expect, "fields": []}], enum_name
+    out = migrate_stream_json({"version": "v5"})
+    assert out["log_source"] == [{"log_source_format": "json", "fields": []}]
+
+
+def test_v6_telemetry_type_derivation():
+    """v6->v7: telemetry_type derives from the migrated log source."""
+    from parseable_tpu.migration import migrate_stream_json
+
+    for src, expect in (
+        ("OtelTraces", "traces"),
+        ("OtelMetrics", "metrics"),
+        ("OtelLogs", "logs"),
+        ("Json", "logs"),
+    ):
+        out = migrate_stream_json({"version": "v6", "log_source": src})
+        assert out["telemetry_type"] == expect, src
+    # already-v7 documents keep their explicit telemetry_type
+    out = migrate_stream_json(
+        {"version": "v7", "telemetry_type": "traces", "log_source": [
+            {"log_source_format": "json", "fields": []}
+        ]}
+    )
+    assert out["telemetry_type"] == "traces"
+
+
+def test_old_bucket_layout_end_to_end(tmp_path):
+    """A bucket written by an old deployment (v1 stream.json under the
+    per-node ingestor filename) boots, migrates in place, and serves
+    queries."""
+    import json as _json
+
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.migration import run_migrations
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    old_doc = {
+        "version": "v3",
+        "objectstore-format": "v3",
+        "stats": {"events": 10, "ingestion": 100, "storage": 50},
+        "snapshot": {"version": "v1", "manifest_list": []},
+        "log_source": "OtelLogs",
+    }
+    # per-node ingestor filename variant (modal/mod.rs node files)
+    p.storage.put_object(
+        "legacy/.stream/ingestor.0ldn0de123.stream.json", _json.dumps(old_doc).encode()
+    )
+    upgraded = run_migrations(p)
+    assert upgraded >= 1
+    raw = _json.loads(
+        p.storage.get_object("legacy/.stream/ingestor.0ldn0de123.stream.json")
+    )
+    assert raw["version"] == "v7"
+    assert raw["telemetry_type"] == "logs"
+    assert raw["log_source"][0]["log_source_format"] == "otel-logs"
+    fmt = p.metastore.get_stream_json("legacy", node_id="0ldn0de123")
+    assert fmt.stats.events == 10
