@@ -291,6 +291,149 @@ def _eval_function(e: S.FunctionCall, table: pa.Table) -> Any:
         arr = _arr(evaluate(e.args[0], table), table)
         sub = evaluate(e.args[1], table)
         return pc.add(pc.find_substring(arr, str(sub)), 1)
+    # --- DataFusion-parity scalar surface (dashboards/alerts use these;
+    # the reference gets them from DataFusion's function library) ---------
+    if name in ("substr", "substring"):
+        arr = _arr(evaluate(e.args[0], table), table)
+        start = int(evaluate(e.args[1], table)) - 1  # SQL is 1-based
+        if len(e.args) > 2:
+            length = int(evaluate(e.args[2], table))
+            return pc.utf8_slice_codeunits(arr, max(start, 0), max(start, 0) + length)
+        return pc.utf8_slice_codeunits(arr, max(start, 0))
+    if name == "replace":
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pc.replace_substring(
+            arr, str(evaluate(e.args[1], table)), str(evaluate(e.args[2], table))
+        )
+    if name == "concat":
+        parts = [
+            pc.cast(_arr(evaluate(a, table), table), pa.string()) for a in e.args
+        ]
+        # SQL concat skips NULLs (unlike ||): substitute empty strings
+        parts = [pc.fill_null(x, "") for x in parts]
+        return pc.binary_join_element_wise(*parts, "")
+    if name == "concat_ws":
+        sep = str(evaluate(e.args[0], table))
+        parts = [
+            pc.fill_null(pc.cast(_arr(evaluate(a, table), table), pa.string()), "")
+            for a in e.args[1:]
+        ]
+        return pc.binary_join_element_wise(*parts, sep)
+    if name == "split_part":
+        import numpy as np
+
+        arr = _arr(evaluate(e.args[0], table), table)
+        sep = str(evaluate(e.args[1], table))
+        idx = int(evaluate(e.args[2], table))
+        # SQL split_part returns '' past the last part (list_element would
+        # raise); slice the wanted element per row via list offsets
+        split = pc.list_slice(pc.split_pattern(arr, sep), start=idx - 1, stop=idx)
+        if isinstance(split, pa.ChunkedArray):
+            split = split.combine_chunks()
+        offsets = np.asarray(split.offsets)
+        lens = np.diff(offsets)
+        flat = split.flatten()
+        take = np.where(lens > 0, offsets[:-1], 0)
+        vals = flat.take(pa.array(np.clip(take, 0, max(len(flat) - 1, 0))))
+        nulls = pc.is_null(arr).to_numpy(zero_copy_only=False)
+        out = pc.if_else(pa.array(lens > 0), vals, pa.scalar("", pa.string()))
+        return pc.if_else(pa.array(~nulls), out, pa.scalar(None, pa.string()))
+    if name in ("extract", "date_part"):
+        unit = str(evaluate(e.args[0], table)).lower()
+        arr = _arr(evaluate(e.args[1], table), table)
+        fns = {
+            "year": pc.year, "month": pc.month, "day": pc.day,
+            "hour": pc.hour, "minute": pc.minute, "second": pc.second,
+            "dow": pc.day_of_week, "doy": pc.day_of_year,
+            "week": pc.iso_week, "quarter": pc.quarter,
+            "millisecond": pc.millisecond,
+        }
+        if unit not in fns:
+            raise ExecError(f"unknown {name} unit {unit!r}")
+        return pc.cast(fns[unit](arr), pa.int64())
+    if name in ("char_length", "character_length"):
+        return pc.utf8_length(_arr(evaluate(e.args[0], table), table))
+    if name == "ltrim":
+        return pc.utf8_ltrim_whitespace(_arr(evaluate(e.args[0], table), table))
+    if name == "rtrim":
+        return pc.utf8_rtrim_whitespace(_arr(evaluate(e.args[0], table), table))
+    if name == "left":
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pc.utf8_slice_codeunits(arr, 0, int(evaluate(e.args[1], table)))
+    if name == "right":
+        arr = _arr(evaluate(e.args[0], table), table)
+        k = int(evaluate(e.args[1], table))
+        lens = pc.utf8_length(arr)
+        starts = pc.max_element_wise(pc.subtract(lens, k), 0)
+        # per-row start offsets: slice kernel wants scalars, so fall back
+        # to reverse+left+reverse (codeunit-safe for ASCII-dominated logs)
+        rev = pc.utf8_reverse(arr)
+        return pc.utf8_reverse(pc.utf8_slice_codeunits(rev, 0, k))
+    if name == "repeat":
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pc.binary_repeat(arr, int(evaluate(e.args[1], table)))
+    if name == "reverse":
+        return pc.utf8_reverse(_arr(evaluate(e.args[0], table), table))
+    if name in ("lpad", "rpad"):
+        arr = _arr(evaluate(e.args[0], table), table)
+        width = int(evaluate(e.args[1], table))
+        padchar = str(evaluate(e.args[2], table)) if len(e.args) > 2 else " "
+        fn = pc.utf8_lpad if name == "lpad" else pc.utf8_rpad
+        return fn(arr, width, padding=padchar)
+    if name == "starts_with":
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pc.starts_with(arr, str(evaluate(e.args[1], table)))
+    if name == "ends_with":
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pc.ends_with(arr, str(evaluate(e.args[1], table)))
+    if name == "contains":
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pc.match_substring(arr, str(evaluate(e.args[1], table)))
+    if name == "nullif":
+        a = _arr(evaluate(e.args[0], table), table)
+        b = evaluate(e.args[1], table)
+        b_arr = _arr(b, table)
+        eq = pc.fill_null(pc.equal(a, b_arr), False)
+        return pc.if_else(eq, pa.nulls(table.num_rows, a.type), a)
+    if name in ("greatest", "least"):
+        parts = [_arr(evaluate(a, table), table) for a in e.args]
+        fn = pc.max_element_wise if name == "greatest" else pc.min_element_wise
+        return fn(*parts)
+    if name in ("power", "pow"):
+        a = _arr(evaluate(e.args[0], table), table)
+        return pc.power(pc.cast(a, pa.float64()), float(evaluate(e.args[1], table)))
+    if name in ("sqrt", "exp", "ln", "log10", "sign", "sin", "cos", "tan"):
+        arr = pc.cast(_arr(evaluate(e.args[0], table), table), pa.float64())
+        fn = {
+            "sqrt": pc.sqrt, "exp": pc.exp, "ln": pc.ln, "log10": pc.log10,
+            "sign": pc.sign, "sin": pc.sin, "cos": pc.cos, "tan": pc.tan,
+        }[name]
+        return fn(arr)
+    if name == "log":
+        # log(x) = ln, log(base, x) = logb
+        if len(e.args) == 1:
+            return pc.ln(pc.cast(_arr(evaluate(e.args[0], table), table), pa.float64()))
+        base = float(evaluate(e.args[0], table))
+        arr = pc.cast(_arr(evaluate(e.args[1], table), table), pa.float64())
+        return pc.logb(arr, base)
+    if name == "mod":
+        a = _arr(evaluate(e.args[0], table), table)
+        b = evaluate(e.args[1], table)
+        return _eval_binary(S.BinaryOp("%", e.args[0], e.args[1]), table)
+    if name == "trunc":
+        return pc.trunc(pc.cast(_arr(evaluate(e.args[0], table), table), pa.float64()))
+    if name == "pi":
+        return math.pi
+    if name == "md5":
+        import hashlib as _hl
+
+        arr = _arr(evaluate(e.args[0], table), table)
+        return pa.array(
+            [
+                _hl.md5(v.encode()).hexdigest() if v is not None else None
+                for v in arr.to_pylist()
+            ]
+        )
     raise ExecError(f"unknown function {name}")
 
 

@@ -116,3 +116,68 @@ def test_parser_never_crashes_on_garbage():
             parse_sql(text)
         except SqlError:
             pass  # expected for garbage
+
+
+def test_scalar_function_surface():
+    """DataFusion-parity scalar functions (the reference gets these from
+    DataFusion's library; dashboards and alerts lean on them)."""
+    import pyarrow as pa
+
+    from parseable_tpu.query.executor import QueryExecutor
+    from parseable_tpu.query.planner import plan as build_plan
+    from parseable_tpu.query.sql import parse_sql
+
+    t = pa.table(
+        {
+            "s": ["hello world", "abc/def/ghi", None],
+            "n": [4.0, -9.0, 16.0],
+            "ts": pa.array(
+                [1714557600000, 1714561200000, None], pa.timestamp("ms")
+            ),
+        }
+    )
+
+    def run(sql):
+        lp = build_plan(parse_sql(sql))
+        return QueryExecutor(lp).execute(iter([t])).to_pylist()
+
+    rows = run(
+        "SELECT substr(s, 1, 5) a, replace(s, 'world', 'there') b, "
+        "split_part(s, '/', 2) c, reverse(left(s, 3)) d FROM t"
+    )
+    assert rows[0]["a"] == "hello" and rows[0]["b"] == "hello there"
+    assert rows[1]["c"] == "def" and rows[1]["d"] == "cba"
+    assert rows[2]["a"] is None
+
+    rows = run(
+        "SELECT concat(s, '!') a, concat_ws('-', 'x', s) b, "
+        "lpad(left(s, 2), 4, '.') c FROM t"
+    )
+    assert rows[0]["a"] == "hello world!"
+    assert rows[1]["b"] == "x-abc/def/ghi"
+    assert rows[0]["c"] == "..he"
+    assert rows[2]["a"] == "!"  # concat skips NULLs
+
+    rows = run(
+        "SELECT extract('hour', ts) h, date_part('year', ts) y, "
+        "extract('dow', ts) dow FROM t"
+    )
+    assert rows[0]["y"] == 2024 and isinstance(rows[0]["h"], int)
+    assert rows[2]["h"] is None
+
+    rows = run(
+        "SELECT sqrt(n) r, power(n, 2) p, greatest(n, 0) g, least(n, 0) l, "
+        "nullif(n, 4) z, sign(n) sg FROM t"
+    )
+    assert rows[0]["r"] == 2.0 and rows[0]["p"] == 16.0
+    assert rows[1]["g"] == 0.0 and rows[1]["l"] == -9.0
+    assert rows[0]["z"] is None and rows[2]["z"] == 16.0
+    assert rows[1]["sg"] == -1.0
+
+    rows = run("SELECT starts_with(s, 'hello') a, contains(s, 'def') b FROM t")
+    assert rows[0]["a"] is True and rows[1]["b"] is True
+
+    rows = run("SELECT md5(left(s, 5)) m FROM t")
+    import hashlib
+
+    assert rows[0]["m"] == hashlib.md5(b"hello").hexdigest()
