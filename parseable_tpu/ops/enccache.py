@@ -16,10 +16,13 @@ Entries can hold several VARIANTS per column ((kind, dtype) pairs): a
 numeric column group-by'd by one query stores its dict-codes variant next
 to the f32 one.
 
-File format (version PTEC1): magic, u32 header length, JSON header
-{num_rows, columns: {name: [variant,...]}} with per-variant buffer
-offsets, then raw little-endian buffers. Eviction is LRU-by-mtime over a
-byte budget (P_TPU_ENC_CACHE_BYTES, default 16 GiB).
+File format (version PTEC2): magic, u32 header length, JSON header
+{num_rows, block_rows, columns: {name: [variant,...]}} with per-variant
+buffer offsets, then raw little-endian buffers stored PADDED to
+block_rows (pow2) — the loader np.memmaps them straight into
+EncodedColumn values with zero copies, so a cold scan's host cost is
+page-cache reads + device_put. Eviction is LRU-by-mtime over a byte
+budget (P_TPU_ENC_CACHE_BYTES, default 16 GiB).
 """
 
 from __future__ import annotations
@@ -39,7 +42,7 @@ from parseable_tpu.ops.device import EncodedBatch, EncodedColumn, pow2_block
 
 logger = logging.getLogger(__name__)
 
-_MAGIC = b"PTEC1\n"
+_MAGIC = b"PTEC2\n"
 
 
 def _fname(source_id: bytes) -> str:
@@ -116,6 +119,7 @@ class EncodedBlockCache:
 
     def _put(self, source_id: bytes, enc: EncodedBatch) -> bool:
         n = enc.num_rows
+        block = enc.block_rows
         path = self.root / _fname(source_id)
         existing = self._read_header(path) if path.exists() else None
         columns: dict[str, list[dict]] = {}
@@ -130,7 +134,11 @@ class EncodedBlockCache:
             columns.setdefault(name, []).append(var)
 
         # carry over existing variants first (their buffers re-read once)
-        if existing is not None and existing["num_rows"] == n:
+        if (
+            existing is not None
+            and existing["num_rows"] == n
+            and existing["header"].get("block_rows") == block
+        ):
             hdr, payload_off = existing["header"], existing["payload_off"]
             with path.open("rb") as f:
                 for name, variants in hdr["columns"].items():
@@ -144,7 +152,7 @@ class EncodedBlockCache:
 
         changed = False
         for name, col in enc.columns.items():
-            if col.values is None or len(col.values) < n:
+            if col.values is None or len(col.values) < block:
                 continue  # stripped (hot-set) encodings can't be persisted
             key = (col.kind, str(col.values.dtype))
             have = {
@@ -163,7 +171,8 @@ class EncodedBlockCache:
             forced = col.kind == "dict" and any(
                 v is not None and not isinstance(v, str) for v in (col.dictionary or [])
             )
-            values = np.ascontiguousarray(col.values[:n])
+            # store PADDED to block_rows: the loader memmaps zero-copy
+            values = np.ascontiguousarray(col.values[:block])
             col_all_valid = bool(col.valid[:n].all()) if len(col.valid) >= n else True
             var: dict[str, Any] = {
                 "kind": col.kind,
@@ -177,7 +186,7 @@ class EncodedBlockCache:
             }
             bufs = [values.tobytes()]
             if not col_all_valid:
-                valid = np.ascontiguousarray(col.valid[:n])
+                valid = np.ascontiguousarray(col.valid[:block])
                 var["nbytes"].append(valid.nbytes)
                 bufs.append(valid.tobytes())
             add_variant(name, var, *bufs)
@@ -185,7 +194,9 @@ class EncodedBlockCache:
         if not changed:
             return False
 
-        header = json.dumps({"num_rows": n, "columns": columns}).encode()
+        header = json.dumps(
+            {"num_rows": n, "block_rows": block, "columns": columns}
+        ).encode()
         # unique tmp per writer: concurrent puts for the same source must
         # not truncate each other mid-write (last os.replace wins whole)
         tmp = path.with_name(f"{path.name}.{os.getpid()}.{threading.get_ident()}.tmp")
@@ -223,64 +234,65 @@ class EncodedBlockCache:
             return None
         hdr, payload_off = meta["header"], meta["payload_off"]
         n = hdr["num_rows"]
-        block = pow2_block(n)
+        block = hdr.get("block_rows") or pow2_block(n)
         cols: dict[str, EncodedColumn] = {}
         try:
-            with path.open("rb") as f:
-                for name in needed:
-                    variants = hdr["columns"].get(name)
-                    if not variants:
-                        self.misses += 1
-                        return None
-                    want_dict = name in dict_cols
-                    if want_dict:
-                        pick = next((v for v in variants if v["kind"] == "dict"), None)
-                    else:
-                        # prefer the natural (non-dict) variant; a string
-                        # column's dict variant also serves, but a FORCED
-                        # dict of a numeric column must not
-                        pick = next((v for v in variants if v["kind"] != "dict"), None)
-                        if pick is None:
-                            pick = next(
-                                (
-                                    v
-                                    for v in variants
-                                    if v["kind"] == "dict" and not v.get("forced")
-                                ),
-                                None,
-                            )
+            for name in needed:
+                variants = hdr["columns"].get(name)
+                if not variants:
+                    self.misses += 1
+                    return None
+                want_dict = name in dict_cols
+                if want_dict:
+                    pick = next((v for v in variants if v["kind"] == "dict"), None)
+                else:
+                    # prefer the natural (non-dict) variant; a string
+                    # column's dict variant also serves, but a FORCED
+                    # dict of a numeric column must not
+                    pick = next((v for v in variants if v["kind"] != "dict"), None)
                     if pick is None:
-                        self.misses += 1
-                        return None
-                    f.seek(payload_off + pick["offsets"][0])
-                    values = np.frombuffer(
-                        f.read(pick["nbytes"][0]), dtype=np.dtype(pick["dtype"])
+                        pick = next(
+                            (
+                                v
+                                for v in variants
+                                if v["kind"] == "dict" and not v.get("forced")
+                            ),
+                            None,
+                        )
+                if pick is None:
+                    self.misses += 1
+                    return None
+                dt = np.dtype(pick["dtype"])
+                # buffers are stored padded: memmap straight in, zero copies
+                values = np.memmap(
+                    path, dtype=dt, mode="r",
+                    offset=payload_off + pick["offsets"][0],
+                    shape=(pick["nbytes"][0] // dt.itemsize,),
+                )
+                dictionary = (
+                    json.loads(pick["dictionary"])
+                    if pick.get("dictionary") is not None
+                    else None
+                )
+                if pick["all_valid"]:
+                    valid = np.ones(block, dtype=bool)
+                    valid[n:] = False
+                else:
+                    valid = np.memmap(
+                        path, dtype=np.bool_, mode="r",
+                        offset=payload_off + pick["offsets"][1],
+                        shape=(pick["nbytes"][1],),
                     )
-                    dictionary = (
-                        json.loads(pick["dictionary"])
-                        if pick.get("dictionary") is not None
-                        else None
-                    )
-                    if pick["all_valid"]:
-                        valid = np.ones(block, dtype=bool)
-                        valid[n:] = False
-                    else:
-                        f.seek(payload_off + pick["offsets"][1])
-                        valid = np.frombuffer(f.read(pick["nbytes"][1]), dtype=bool)
-                        valid = _pad_bool(valid, block)
-                    fill = len(dictionary) - 1 if dictionary else 0
-                    padded = np.full(block, fill, dtype=values.dtype)
-                    padded[:n] = values
-                    cols[name] = EncodedColumn(
-                        name,
-                        pick["kind"],
-                        padded,
-                        valid,
-                        dictionary,
-                        all_valid=bool(pick["all_valid"]) and n == block,
-                        vmin=pick.get("vmin"),
-                        vmax=pick.get("vmax"),
-                    )
+                cols[name] = EncodedColumn(
+                    name,
+                    pick["kind"],
+                    values,
+                    valid,
+                    dictionary,
+                    all_valid=bool(pick["all_valid"]) and n == block,
+                    vmin=pick.get("vmin"),
+                    vmax=pick.get("vmax"),
+                )
         except Exception:
             logger.exception("encoded-cache read failed")
             return None
@@ -359,14 +371,6 @@ class EncodedBlockCache:
                     pass
                 if total <= self.budget:
                     break
-
-
-def _pad_bool(a: np.ndarray, n: int) -> np.ndarray:
-    if len(a) == n:
-        return a.copy()
-    out = np.zeros(n, dtype=bool)
-    out[: len(a)] = a
-    return out
 
 
 _GLOBAL: EncodedBlockCache | None = None

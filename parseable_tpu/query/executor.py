@@ -363,10 +363,8 @@ def _eval_function(e: S.FunctionCall, table: pa.Table) -> Any:
     if name == "right":
         arr = _arr(evaluate(e.args[0], table), table)
         k = int(evaluate(e.args[1], table))
-        lens = pc.utf8_length(arr)
-        starts = pc.max_element_wise(pc.subtract(lens, k), 0)
-        # per-row start offsets: slice kernel wants scalars, so fall back
-        # to reverse+left+reverse (codeunit-safe for ASCII-dominated logs)
+        # the slice kernel wants scalar offsets; reverse+left+reverse gives
+        # per-row tails in three vectorized kernels
         rev = pc.utf8_reverse(arr)
         return pc.utf8_reverse(pc.utf8_slice_codeunits(rev, 0, k))
     if name == "repeat":
