@@ -394,15 +394,30 @@ def main() -> None:
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
 
     if not tpu_available():
-        # the virtual-mesh distributed config runs without the chip: emit
-        # the unreachable marker first, then the one real measurable
-        # number last (the driver records the final line)
+        # the host-only lines still measure without the chip: emit the
+        # unreachable marker first, then OTel ingest + the virtual-mesh
+        # distributed number last (the driver records the final line)
         emit(
             "tpu_unreachable",
             0.0,
             0.0,
             {"note": "device probe timed out (tunnel down); TPU configs skipped"},
         )
+        workdir = tempfile.mkdtemp(prefix="ptpu-bench-")
+        try:
+            from parseable_tpu.config import Options, StorageOptions
+            from parseable_tpu.core import Parseable
+
+            opts = Options()
+            opts.local_staging_path = __import__("pathlib").Path(workdir) / "staging"
+            storage = StorageOptions(
+                backend="local-store", root=__import__("pathlib").Path(workdir) / "data"
+            )
+            bench_otel_ingest(Parseable(opts, storage))
+        except Exception as e:  # noqa: BLE001
+            print(f"# otel ingest bench failed: {e}", file=sys.stderr)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
         bench_distributed_subprocess(total_rows)
         return
 

@@ -310,6 +310,39 @@ async def readiness(request: web.Request) -> web.Response:
         return web.Response(status=503)
 
 
+@require(Action.METRICS)
+async def debug_profile(request: web.Request) -> web.Response:
+    """GET /api/v1/debug/profile?seconds=N[&format=top]: sample every
+    thread's Python stacks for a window and return collapsed flamegraph
+    stacks (reference: the opt-in hotpath sampling profiler feature)."""
+    state: ServerState = request.app["state"]
+    try:
+        seconds = float(request.query.get("seconds", "5"))
+    except ValueError:
+        return web.json_response({"error": "seconds must be a number"}, status=400)
+    if not 0 < seconds <= 60:
+        return web.json_response({"error": "seconds must be in (0, 60]"}, status=400)
+    from parseable_tpu.utils.profiler import profile_window
+
+    sampler = await asyncio.get_running_loop().run_in_executor(
+        state.workers, profile_window, seconds
+    )
+    if request.query.get("format") == "top":
+        return web.json_response(
+            {
+                "total_samples": sampler.total,
+                "top": [
+                    {"frame": f, "samples": c} for f, c in sampler.top_functions()
+                ],
+            }
+        )
+    return web.Response(
+        text=sampler.collapsed(),
+        content_type="text/plain",
+        headers={"X-Total-Samples": str(sampler.total)},
+    )
+
+
 @require(Action.GET_ABOUT)
 async def about(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
@@ -1600,6 +1633,7 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/liveness", liveness)
     r.add_get("/api/v1/readiness", readiness)
     r.add_get("/api/v1/about", about)
+    r.add_get("/api/v1/debug/profile", debug_profile)
     r.add_get("/api/v1/metrics", metrics_handler)
     r.add_get("/api/v1/login", login)
 

@@ -1,0 +1,126 @@
+"""Sampling profiler (reference: the opt-in `hotpath` cargo feature —
+`#[hotpath::measure]` on push/convert/ingest paths with CPU and alloc
+modes; Cargo.toml:100-106).
+
+A signal-free sampler: a daemon thread walks every thread's Python stack
+via sys._current_frames at a fixed interval and aggregates collapsed
+stacks (semicolon-joined frames -> sample counts, the flamegraph.pl
+format). Signal-based profiling (SIGPROF) would only see the main thread
+and fights JAX's signal handling; frame-walking sees the worker pools,
+sync loops, and query threads where the hot paths actually run.
+
+Activation: P_PROFILE=cpu starts sampling at import of the server (or
+call start() explicitly); GET /api/v1/debug/profile?seconds=N captures a
+window on demand and returns collapsed stacks.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+_EXCLUDE_THREADS = {"profiler-sampler"}
+
+
+class StackSampler:
+    def __init__(self, interval_ms: float = 10.0):
+        self.interval = max(1.0, interval_ms) / 1000.0
+        self.samples: Counter[str] = Counter()
+        self.total = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- control
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="profiler-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.samples.clear()
+            self.total = 0
+
+    # ------------------------------------------------------------- sampling
+
+    def _run(self) -> None:
+        names = {}
+        while not self._stop.wait(self.interval):
+            frames = sys._current_frames()
+            threads = {t.ident: t.name for t in threading.enumerate()}
+            with self._lock:
+                for ident, frame in frames.items():
+                    name = threads.get(ident, str(ident))
+                    if name in _EXCLUDE_THREADS:
+                        continue
+                    stack = []
+                    f = frame
+                    depth = 0
+                    while f is not None and depth < 64:
+                        code = f.f_code
+                        key = id(code)
+                        label = names.get(key)
+                        if label is None:
+                            fn = code.co_filename
+                            # trim to the interesting suffix
+                            idx = fn.rfind("parseable_tpu/")
+                            if idx >= 0:
+                                fn = fn[idx:]
+                            else:
+                                fn = fn.rsplit("/", 1)[-1]
+                            label = f"{fn}:{code.co_name}"
+                            names[key] = label
+                        stack.append(label)
+                        f = f.f_back
+                        depth += 1
+                    collapsed = f"{name};" + ";".join(reversed(stack))
+                    self.samples[collapsed] += 1
+                    self.total += 1
+
+    # -------------------------------------------------------------- output
+
+    def collapsed(self, limit: int | None = None) -> str:
+        """flamegraph.pl-compatible collapsed stacks, hottest first."""
+        with self._lock:
+            items = self.samples.most_common(limit)
+        return "\n".join(f"{stack} {count}" for stack, count in items)
+
+    def top_functions(self, limit: int = 25) -> list[tuple[str, int]]:
+        """Leaf-frame counts: where the samples actually landed."""
+        leaves: Counter[str] = Counter()
+        with self._lock:
+            for stack, count in self.samples.items():
+                leaves[stack.rsplit(";", 1)[-1]] += count
+        return leaves.most_common(limit)
+
+
+_GLOBAL: StackSampler | None = None
+
+
+def get_profiler() -> StackSampler:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = StackSampler()
+    return _GLOBAL
+
+
+def profile_window(seconds: float, interval_ms: float = 5.0) -> StackSampler:
+    """Capture a bounded window (the /debug/profile endpoint's helper)."""
+    s = StackSampler(interval_ms=interval_ms)
+    s.start()
+    time.sleep(max(0.05, min(seconds, 60.0)))
+    s.stop()
+    return s

@@ -206,3 +206,81 @@ def test_every_option_has_a_reader():
         if not _re.search(rf"\b{fld.name}\b", src):
             dead.append(fld.name)
     assert not dead, f"dead Options knobs: {dead}"
+
+
+def test_debug_profile_endpoint(tmp_path):
+    """Sampling profiler window via /api/v1/debug/profile (reference: the
+    hotpath feature's sampling profiler)."""
+    import asyncio
+    import base64
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.server.app import ServerState, build_app
+
+    auth = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+    async def scenario():
+        opts = Options()
+        opts.local_staging_path = tmp_path / "staging"
+        p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+        client = TestClient(TestServer(build_app(ServerState(p))))
+        await client.start_server()
+        # busy thread so samples land somewhere deterministic-ish
+        import threading
+
+        stop = threading.Event()
+
+        def burn():
+            while not stop.is_set():
+                sum(i * i for i in range(1000))
+
+        t = threading.Thread(target=burn, name="burner", daemon=True)
+        t.start()
+        try:
+            r = await client.get("/api/v1/debug/profile?seconds=0.3", headers=auth)
+            assert r.status == 200
+            body = await r.text()
+            assert int(r.headers["X-Total-Samples"]) > 0
+            assert ";" in body  # collapsed stacks
+            r2 = await client.get(
+                "/api/v1/debug/profile?seconds=0.2&format=top", headers=auth
+            )
+            top = await r2.json()
+            assert top["total_samples"] > 0 and top["top"]
+            # bad input -> 400; unauthenticated -> 401
+            r3 = await client.get("/api/v1/debug/profile?seconds=abc", headers=auth)
+            assert r3.status == 400
+            r4 = await client.get("/api/v1/debug/profile")
+            assert r4.status == 401
+        finally:
+            stop.set()
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_stack_sampler_sees_worker_threads():
+    import threading
+    import time
+
+    from parseable_tpu.utils.profiler import StackSampler
+
+    stop = threading.Event()
+
+    def hot_function_xyz():
+        while not stop.is_set():
+            sum(i for i in range(500))
+
+    t = threading.Thread(target=hot_function_xyz, name="hotworker", daemon=True)
+    t.start()
+    s = StackSampler(interval_ms=2)
+    s.start()
+    time.sleep(0.3)
+    s.stop()
+    stop.set()
+    assert s.total > 10
+    assert any("hot_function_xyz" in stack for stack in s.samples)
+    assert any("hotworker" in stack.split(";", 1)[0] for stack in s.samples)
