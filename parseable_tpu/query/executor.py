@@ -1090,36 +1090,53 @@ class QueryExecutor:
                 )
             return e
 
-        if getattr(self, "_having", None) is not None:
-            hmask = _arr(evaluate(rewrite_groups(self._having), interim), interim)
-            interim = interim.filter(hmask)
+        def project(interim: pa.Table) -> pa.Table:
+            if getattr(self, "_having", None) is not None:
+                hmask = _arr(evaluate(rewrite_groups(self._having), interim), interim)
+                interim = interim.filter(hmask)
 
-        items = [S.SelectItem(rewrite_groups(i.expr), i.alias) for i in rewritten]
-        if any(S.contains_window(i.expr) for i in items):
-            # windows over the aggregated output (one row per group):
-            # `rank() OVER (ORDER BY sum(b) DESC)` etc.
-            from parseable_tpu.query import window as W
+            items = [S.SelectItem(rewrite_groups(i.expr), i.alias) for i in rewritten]
+            if any(S.contains_window(i.expr) for i in items):
+                # windows over the aggregated output (one row per group):
+                # `rank() OVER (ORDER BY sum(b) DESC)` etc.
+                from parseable_tpu.query import window as W
 
-            windows: list[S.WindowCall] = []
-            for i in items:
-                windows.extend(W.window_calls(i.expr))
-            interim, mapping = W.attach_window_columns(interim, windows)
-            items = [S.SelectItem(W.rewrite_windows(i.expr, mapping), i.alias) for i in items]
+                windows: list[S.WindowCall] = []
+                for i in items:
+                    windows.extend(W.window_calls(i.expr))
+                interim, mapping = W.attach_window_columns(interim, windows)
+                items = [
+                    S.SelectItem(W.rewrite_windows(i.expr, mapping), i.alias)
+                    for i in items
+                ]
 
-        names, arrays = [], []
-        for item in items:
-            names.append(item.alias)
-            arrays.append(_arr(evaluate(item.expr, interim), interim))
-        result = pa.table(_dedup(names, arrays))
+            names, arrays = [], []
+            for item in items:
+                names.append(item.alias)
+                arrays.append(_arr(evaluate(item.expr, interim), interim))
+            return pa.table(_dedup(names, arrays))
+
+        from parseable_tpu.query.partials import decode_dictionary_columns
+
+        try:
+            result = project(interim)
+        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
+            # a kernel without dictionary support hit a dictionary-typed key
+            # column (high-cardinality interims keep string keys encoded):
+            # decode once and retry
+            result = project(decode_dictionary_columns(interim))
         result = self._order_limit(result)
-        return result
+        # dictionary keys stay encoded through group/merge/order-limit;
+        # the boundary decodes them so downstream consumers (union, joins,
+        # serializers) see plain columns — post-LIMIT this is rows-out work
+        return decode_dictionary_columns(result)
 
     # -- order / limit -------------------------------------------------------
 
-    def _sorted(self, table: pa.Table) -> pa.Table:
-        """ORDER BY sort (aux columns for expression keys, dropped after)."""
+    def _sort_keys(self, table: pa.Table) -> tuple[pa.Table, list[tuple[str, str]]]:
+        """Resolve ORDER BY keys (aux columns appended for expression keys)."""
         sel = self.plan.select
-        keys = []
+        keys: list[tuple[str, str]] = []
         aux_cols = 0
         for o in sel.order_by:
             name = S.expr_name(o.expr)
@@ -1138,14 +1155,51 @@ class QueryExecutor:
                 aux_cols += 1
                 table = table.append_column(aux, _arr(evaluate(o.expr, table), table))
                 keys.append((aux, "descending" if o.desc else "ascending"))
-        table = table.sort_by(keys)
+        return table, keys
+
+    @staticmethod
+    def _drop_aux(table: pa.Table) -> pa.Table:
         return table.select([c for c in table.column_names if not c.startswith("__sort")])
+
+    def _sorted(self, table: pa.Table) -> pa.Table:
+        """ORDER BY sort (aux columns for expression keys, dropped after)."""
+        table, keys = self._sort_keys(table)
+        try:
+            table = table.sort_by(keys)
+        except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
+            from parseable_tpu.query.partials import decode_dictionary_columns
+
+            table = decode_dictionary_columns(table).sort_by(keys)
+        return self._drop_aux(table)
 
     def _order_limit(self, table: pa.Table) -> pa.Table:
         sel = self.plan.select
-        if sel.order_by:
-            table = self._sorted(table)
         off = sel.offset or 0
+        if sel.order_by:
+            k = None if sel.limit is None else off + sel.limit
+            if k is not None and 0 < k and table.num_rows > max(k * 4, 1024):
+                # top-K selection instead of a full sort: a LIMIT over a
+                # million-group aggregate is a partial-select, not a sort
+                # (DataFusion's TopK operator; reference gets this from
+                # /root/reference/src/query/mod.rs DataFusion planner)
+                keyed, keys = self._sort_keys(table)
+                if any(
+                    pa.types.is_dictionary(keyed.column(name).type) for name, _ in keys
+                ):
+                    # select_k_unstable SEGFAULTS (not raises) on dictionary
+                    # sort keys (pyarrow 25) — decode before selecting
+                    from parseable_tpu.query.partials import decode_dictionary_columns
+
+                    keyed = decode_dictionary_columns(keyed)
+                try:
+                    idx = pc.select_k_unstable(
+                        keyed, options=pc.SelectKOptions(k=k, sort_keys=keys)
+                    )
+                    table = self._drop_aux(keyed.take(idx))
+                except (pa.ArrowNotImplementedError, pa.ArrowInvalid, pa.ArrowTypeError):
+                    table = self._sorted(table)
+            else:
+                table = self._sorted(table)
         if off:
             table = table.slice(off)
         if sel.limit is not None:

@@ -258,3 +258,50 @@ def test_vectorized_absorb_parity():
                 values.append(v)
             assert lut[i] == index[v], (v, lut[i], index[v])
     assert gd_fast.values == values
+
+
+def test_order_by_dict_key_with_limit_no_segfault():
+    """ORDER BY a group KEY (kept dictionary-typed through the interim by
+    the partials fast path) with LIMIT over >1024 groups: must decode
+    before top-K selection — pc.select_k_unstable SEGFAULTS on dictionary
+    sort keys (pyarrow 25), it does not raise."""
+    rng = np.random.default_rng(17)
+    n = 30_000
+    t = pa.table(
+        {
+            "user": pa.array([f"u{int(x):06d}" for x in rng.integers(0, 20_000, n)]),
+            "v": pa.array(rng.random(n)),
+        }
+    )
+    sql = "SELECT user, count(*) c, sum(v) s FROM t GROUP BY user ORDER BY user LIMIT 10"
+    cpu, tpu = run_both(sql, [t])
+    assert_rows_close(cpu, tpu)
+    # exact ordering check: the 10 smallest user ids
+    lp = build_plan(parse_sql(sql))
+    res = QueryExecutor(lp).execute(iter([t]))
+    users = res.column("user").to_pylist()
+    assert users == sorted(users)
+    assert len(users) == 10
+
+
+def test_order_by_agg_with_limit_topk_parity():
+    """ORDER BY aggregate DESC LIMIT over many groups takes the select_k
+    path; results must equal a full sort's head."""
+    rng = np.random.default_rng(19)
+    n = 50_000
+    t = pa.table(
+        {
+            "user": pa.array([f"u{int(x)}" for x in rng.integers(0, 30_000, n)]),
+            "v": pa.array(rng.random(n)),
+        }
+    )
+    topk = "SELECT user, sum(v) s FROM t GROUP BY user ORDER BY s DESC LIMIT 7"
+    full = "SELECT user, sum(v) s FROM t GROUP BY user ORDER BY s DESC"
+    lp = build_plan(parse_sql(topk))
+    got = QueryExecutor(lp).execute(iter([t])).to_pylist()
+    lp2 = build_plan(parse_sql(full))
+    want = QueryExecutor(lp2).execute(iter([t])).to_pylist()[:7]
+    assert [r["user"] for r in got] == [r["user"] for r in want]
+    assert all(
+        got[i]["s"] == pytest.approx(want[i]["s"], rel=1e-9) for i in range(7)
+    )
