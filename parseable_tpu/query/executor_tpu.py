@@ -1266,6 +1266,33 @@ class TpuQueryExecutor(QueryExecutor):
         # Python fold entirely — at G=32k the sparse path is ~80% of query
         # time (VERDICT Weak#5)
         if acc is not None and not agg.groups and not distinct_idx:
+            topk_req = self._device_topk_plan(rewritten) if sel.group_by else None
+            if (
+                topk_req is not None
+                and acc_groups >= self.TOPK_MIN_GROUPS
+                and topk_req[2] < acc_groups
+            ):
+                interim = None
+                try:
+                    tsi, tdesc, tk = topk_req
+                    arr_k, ids = self._run_topk_program(
+                        acc, tsi, tdesc, tk, n_all, n_sum, n_min,
+                        sum_idx, min_idx, max_idx, countcol_idx, specs,
+                    )
+                    interim = self._dense_interim(
+                        arr_k, acc_groups, key_specs, specs, n_all, n_sum,
+                        n_min, sum_idx, min_idx, max_idx, countcol_idx,
+                        group_ids=ids,
+                    )
+                except Exception:
+                    logger.exception(
+                        "device top-k gather failed; full readback fallback"
+                    )
+                if interim is not None:
+                    DEVICE_EXECUTE_TIME.labels("groupby").observe(
+                        _t.monotonic() - t_start
+                    )
+                    return self.finalize_from_interim(interim, rewritten)
             interim = self._dense_interim(
                 np.asarray(acc, np.float64), acc_groups, key_specs, specs,
                 n_all, n_sum, n_min, sum_idx, min_idx, max_idx, countcol_idx,
@@ -1292,16 +1319,26 @@ class TpuQueryExecutor(QueryExecutor):
         min_idx: list[int],
         max_idx: list[int],
         countcol_idx: list[int],
+        group_ids: np.ndarray | None = None,
     ) -> pa.Table:
         """Dense device accumulator -> interim table (__g/__agg columns),
         fully vectorized: key decode by divmod over capacities, aggregate
-        finalize by numpy masking. One readback, zero per-group Python."""
+        finalize by numpy masking. One readback, zero per-group Python.
+
+        With `group_ids`, `arr` is a device-side top-K GATHER (R, K) and
+        group_ids[j] is column j's global group index — the readback is
+        K-sized instead of G-sized (ORDER BY <agg> LIMIT pushdown)."""
         count = arr[0]
         per_agg_count = arr[1 : 1 + n_all]
         sums = arr[1 + n_all : 1 + n_all + n_sum]
         mins = arr[1 + n_all + n_sum : 1 + n_all + n_sum + n_min]
         maxs = arr[1 + n_all + n_sum + n_min :]
-        idxs = np.nonzero(count > 0)[0]
+        if group_ids is None:
+            idxs = np.nonzero(count > 0)[0]
+            sel_pos = idxs
+        else:
+            sel_pos = np.nonzero(count > 0)[0]  # positions into the K gather
+            idxs = group_ids[sel_pos]  # global ids, for key decode
 
         stacked_order = sum_idx + min_idx + max_idx + countcol_idx
         cols: dict[str, pa.Array] = {}
@@ -1322,27 +1359,133 @@ class TpuQueryExecutor(QueryExecutor):
                 )
         for si, spec in enumerate(specs):
             if spec.func == "count_star":
-                cols[f"__agg{si}"] = pa.array(count[idxs].astype(np.int64))
+                cols[f"__agg{si}"] = pa.array(count[sel_pos].astype(np.int64))
                 continue
             pos = stacked_order.index(si)
-            pac = per_agg_count[pos][idxs]
+            pac = per_agg_count[pos][sel_pos]
             seen = pac > 0
             if spec.func == "count":
                 cols[f"__agg{si}"] = pa.array(pac.astype(np.int64))
             elif spec.func in ("sum", "avg"):
-                v = sums[sum_idx.index(si)][idxs]
+                v = sums[sum_idx.index(si)][sel_pos]
                 if spec.func == "avg":
                     v = np.divide(v, pac, out=np.zeros_like(v), where=seen)
                 cols[f"__agg{si}"] = pa.array(v, mask=~seen)
             elif spec.func == "min":
-                v = mins[min_idx.index(si)][idxs]
+                v = mins[min_idx.index(si)][sel_pos]
                 cols[f"__agg{si}"] = pa.array(v, mask=~seen)
             elif spec.func == "max":
-                v = maxs[max_idx.index(si)][idxs]
+                v = maxs[max_idx.index(si)][sel_pos]
                 cols[f"__agg{si}"] = pa.array(v, mask=~seen)
         if not cols:
             return pa.table({"__dummy": pa.array([None] * len(idxs))})
         return pa.table(cols)
+
+    # --------------------------------------------- ORDER BY <agg> LIMIT K
+
+    TOPK_MIN_GROUPS = 1 << 13  # below this the full readback is cheap
+    TOPK_MAX_K = 4096
+
+    def _device_topk_plan(self, rewritten: list[S.SelectItem]) -> tuple | None:
+        """(spec_index, desc, k) when the query's ORDER BY/LIMIT can run as
+        a device top_k over the dense accumulator: single ORDER BY key that
+        resolves to one of the aggregates, LIMIT (+OFFSET) small, no HAVING
+        (DataFusion's TopK pushdown; reference planner gets it from
+        /root/reference/src/query/mod.rs:212-276)."""
+        sel = self.plan.select
+        if (
+            len(sel.order_by) != 1
+            or sel.limit is None
+            or getattr(self, "_having", None) is not None
+        ):
+            return None
+        if any(S.contains_window(i.expr) for i in sel.items):
+            # a window over the aggregate output (rank() OVER, percent-of-
+            # total) must see ALL groups, not the K gathered ones
+            return None
+        k = (sel.offset or 0) + sel.limit
+        if k <= 0 or k > self.TOPK_MAX_K:
+            return None
+        o = sel.order_by[0]
+        for item, ritem in zip(sel.items, rewritten):
+            if not (
+                isinstance(ritem.expr, S.Column) and ritem.expr.name.startswith("__agg")
+            ):
+                continue
+            alias_match = (
+                isinstance(o.expr, S.Column)
+                and o.expr.table is None
+                and ritem.alias == o.expr.name
+            )
+            if alias_match or repr(item.expr) == repr(o.expr):
+                return int(ritem.expr.name[5:]), o.desc, k
+        return None
+
+    def _run_topk_program(
+        self,
+        acc,
+        si: int,
+        desc: bool,
+        k: int,
+        n_all: int,
+        n_sum: int,
+        n_min: int,
+        sum_idx: list[int],
+        min_idx: list[int],
+        max_idx: list[int],
+        countcol_idx: list[int],
+        specs: list[AggSpec],
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Select the top-k groups by one aggregate ON DEVICE and read back
+        only the (R, k) gather + k group ids — the G-sized accumulator
+        never crosses the link (readback is the slow direction on a
+        tunneled chip: ~9 MB/s vs 750 MB/s in)."""
+        import jax
+        import jax.numpy as jnp
+
+        spec = specs[si]
+        stacked_order = sum_idx + min_idx + max_idx + countcol_idx
+        kind = spec.func
+        pac_row = (
+            1 + stacked_order.index(si) if kind != "count_star" else 0
+        )
+        if kind in ("sum", "avg"):
+            val_row = 1 + n_all + sum_idx.index(si)
+        elif kind == "min":
+            val_row = 1 + n_all + n_sum + min_idx.index(si)
+        elif kind == "max":
+            val_row = 1 + n_all + n_sum + n_min + max_idx.index(si)
+        else:  # count / count_star
+            val_row = pac_row
+        key = ("topk", acc.shape, kind, val_row, pac_row, desc, k)
+        program = _PROGRAM_CACHE.get(key)
+        if program is None:
+
+            def run(a):
+                count = a[0]
+                pacv = a[pac_row]
+                if kind == "avg":
+                    keyv = a[val_row] / jnp.maximum(pacv, 1.0)
+                else:
+                    keyv = a[val_row]
+                notnull = pacv > 0 if kind in ("sum", "avg", "min", "max") else count > 0
+                occupied = count > 0
+                ordered = jnp.where(
+                    occupied & notnull, keyv if desc else -keyv, -jnp.inf
+                )
+                # NULL-agg groups order after every real key (nulls-last,
+                # matching select_k/sort_by) but BEFORE empty slots: pin
+                # them just above -inf so they aren't displaced by empties
+                ordered = jnp.where(
+                    occupied & ~notnull, jnp.float32(-3.4028235e38), ordered
+                )
+                _, idx = jax.lax.top_k(ordered, k)
+                return a[:, idx], idx
+
+            program = jax.jit(run)
+            _PROGRAM_CACHE[key] = program
+        gathered, idx = program(acc)
+        return np.asarray(gathered, np.float64), np.asarray(idx)
 
     # ----------------------------------------------- high-card (block-local)
 
@@ -1450,8 +1593,14 @@ class TpuQueryExecutor(QueryExecutor):
             tuple(sorted(dev.keys())),
             num_groups,
         )
-        outs = program(dev, dev_luts, row_mask)
-        count, pac, sums, mins, maxs = (np.asarray(o, np.float64) for o in outs)
+        out = np.asarray(program(dev, dev_luts, row_mask), np.float64)
+        n_all = len(layout.stacked_cols)
+        n_sum, n_min = len(layout.sum_cols), len(layout.min_cols)
+        count = out[0]
+        pac = out[1 : 1 + n_all]
+        sums = out[1 + n_all : 1 + n_all + n_sum]
+        mins = out[1 + n_all + n_sum : 1 + n_all + n_sum + n_min]
+        maxs = out[1 + n_all + n_sum + n_min :]
         pt = self._partial_from_arrays(
             count, pac, sums, mins, maxs, keyinfo, specs,
             sum_idx, min_idx, max_idx, countcol_idx,
@@ -1585,7 +1734,11 @@ class TpuQueryExecutor(QueryExecutor):
                 sums = jax.lax.psum(sums, "data")
                 mins = jax.lax.pmin(mins, "data")
                 maxs = jax.lax.pmax(maxs, "data")
-            return count, pac, sums, mins, maxs
+            # ONE stacked output -> ONE device->host readback per block
+            # (each d2h call pays 100-500ms latency on a tunneled chip)
+            return jnp.concatenate(
+                [count[None, :], pac, sums, mins, maxs], axis=0
+            )
 
         if mesh is not None:
             from jax import shard_map
@@ -1593,8 +1746,7 @@ class TpuQueryExecutor(QueryExecutor):
 
             dev_spec = {k: P("data") for k in dev_keys}
             in_specs = (dev_spec, tuple(P() for _ in lut_shapes), P("data"))
-            out_specs = (P(), P(), P(), P(), P())
-            body = shard_map(fold, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+            body = shard_map(fold, mesh=mesh, in_specs=in_specs, out_specs=P())
         else:
             body = fold
 
