@@ -435,17 +435,57 @@ def main() -> None:
         build_dataset(p, "bench", total_rows)
         print(f"# dataset: {total_rows} rows built+cataloged in {time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
+        # characterize the link once so cold numbers are interpretable
+        # (tunneled dev chips have wildly asymmetric transfer profiles)
+        try:
+            import jax
+            import numpy as _np
+
+            x = _np.random.rand(16 << 18).astype(_np.float32)  # 16 MB
+            jax.device_put(x[:1024]).block_until_ready()
+            t1 = time.perf_counter()
+            dev = jax.device_put(x)
+            dev.block_until_ready()
+            h2d = x.nbytes / (time.perf_counter() - t1)
+            small = jax.device_put(_np.ones(64_000, _np.float32))
+            small.block_until_ready()
+            t1 = time.perf_counter()
+            _np.asarray(small)
+            d2h_lat = time.perf_counter() - t1
+            print(
+                f"# link: h2d {h2d/1e6:.0f} MB/s (16MB put), d2h 256KB in {d2h_lat*1e3:.0f}ms",
+                file=sys.stderr,
+            )
+            emit(
+                "link_h2d_bytes_per_sec",
+                h2d,
+                1.0,
+                {"d2h_256k_secs": round(d2h_lat, 3), "note": "link characterization"},
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"# link characterization failed: {e}", file=sys.stderr)
+
         # measure + EMIT each config as it completes (a killed run still
         # records whatever finished); the north-star config runs last so
         # its line stays the final one when everything completes
         def measure_and_emit(name: str, sql: str, stream: str = "bench") -> None:
+            from parseable_tpu.ops.enccache import get_enccache
+            from parseable_tpu.query import executor_tpu as ET
+
             cpu_t, rows, cpu_rows = best_of(p, stream, "cpu", sql, max(1, repeats - 1))
             # compile first (one-time XLA cost), THEN measure cold: the cold
             # number is the data path (parquet read + encode + transfer +
             # compute, overlapped by the prefetcher), not compilation
             run_query(p, stream, "tpu", sql)
+            # let write-behind land: cold must measure the disk-cache path,
+            # not a race with the enccache writer
+            ec = get_enccache(p.options)
+            if ec is not None:
+                ec.wait_idle()
             clear_hot_state()
+            adaptive_before = ET.ADAPTIVE_CPU_BLOCKS[0]
             cold_t, _, _ = run_query(p, stream, "tpu", sql)
+            cold_adaptive = ET.ADAPTIVE_CPU_BLOCKS[0] - adaptive_before
             warm_t, _, tpu_rows = best_of(p, stream, "tpu", sql, repeats)
             if not rows_match(cpu_rows, tpu_rows):
                 print(f"# WARNING: {name} results differ!", file=sys.stderr)
@@ -461,15 +501,16 @@ def main() -> None:
                 if name == "topk_multicol"
                 else f"{name}_scan_rows_per_sec_tpu"
             )
-            emit(
-                metric,
-                rows / warm_t,
-                cpu_t / warm_t,
-                {
-                    "cold_rows_per_sec": round(rows / cold_t, 1),
-                    "cold_vs_baseline": round(cpu_t / cold_t, 3),
-                },
-            )
+            extra = {
+                "cold_rows_per_sec": round(rows / cold_t, 1),
+                "cold_vs_baseline": round(cpu_t / cold_t, 3),
+            }
+            if cold_adaptive:
+                # the measured link made shipping a losing trade for some
+                # cold blocks: they aggregated host-side while the device
+                # warmed in the background (ops/link.py)
+                extra["cold_adaptive_cpu_blocks"] = cold_adaptive
+            emit(metric, rows / warm_t, cpu_t / warm_t, extra)
 
         for name, sql in CONFIGS.items():
             if name != "topk_multicol":

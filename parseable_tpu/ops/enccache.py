@@ -116,7 +116,27 @@ class EncodedBlockCache:
     def _writer_loop(self) -> None:
         while True:
             source_id, snap = self._queue.get()
-            self.put(source_id, snap)
+            try:
+                self.put(source_id, snap)
+            finally:
+                self._queue.task_done()
+
+    def wait_idle(self, timeout: float = 60.0) -> None:
+        """Block until queued write-behinds have landed (benchmarks use
+        this so a 'cold' run measures the disk-cache path, not a race
+        with the writer)."""
+        import time as _t
+
+        q = self._queue
+        if q is None:
+            return
+        deadline = _t.monotonic() + timeout
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                left = deadline - _t.monotonic()
+                if left <= 0:
+                    return
+                q.all_tasks_done.wait(left)
 
     def _put(self, source_id: bytes, enc: EncodedBatch) -> bool:
         n = enc.num_rows

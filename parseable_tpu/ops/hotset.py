@@ -70,6 +70,12 @@ class DeviceHotSet:
             self._entries[key] = entry
             self._bytes += entry.nbytes
 
+    def contains(self, key: tuple) -> bool:
+        """Peek without touching LRU order or hit/miss counters (the
+        adaptive dispatcher asks before deciding where a block runs)."""
+        with self._lock:
+            return key in self._entries
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

@@ -1,0 +1,214 @@
+"""Measured link profile: adaptive host/device dispatch.
+
+The reference trusts DataFusion to keep scans on the CPU that owns the
+data (/root/reference/src/query/mod.rs); a TPU engine instead has to
+DECIDE whether a cold block is worth shipping: on a healthy PCIe/ICI
+deployment host->device runs at GB/s and the accelerator always wins, but
+on a degraded or tunneled link (measured here: ~750 MB/s h2d batched,
+40-90 ms per-put latency, ~9 MB/s d2h) a cold scan can lose to just
+aggregating on the host. The engine records every real transfer into
+EWMAs and routes each non-resident block by estimated cost:
+
+    ship_cost(bytes)   = h2d latency + bytes / h2d bandwidth
+    read_cost(bytes)   = d2h latency + bytes / d2h bandwidth
+    cpu_cost(rows)     = rows / measured CPU aggregation rate
+
+Blocks that lose the estimate aggregate on the CPU *and* optionally warm
+the device hot set in the background, so the next query runs device-warm
+either way. Defaults are optimistic (healthy-link numbers), so the first
+observations are what teach a bad link — never the other way round.
+
+Profiles persist per staging dir (JSON) so short-lived processes (bench
+subprocesses, CLI one-offs) inherit the measured numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# optimistic defaults: a healthy PCIe gen3 x16-ish link
+_DEFAULTS = {
+    "h2d_bw": 8e9,  # bytes/sec
+    "h2d_lat": 0.002,  # sec per put
+    "d2h_bw": 8e9,
+    "d2h_lat": 0.002,
+    "cpu_rows_per_sec": 2.0e7,
+}
+
+_SMALL = 256 * 1024  # below this a transfer mostly measures latency
+_ALPHA = 0.3  # EWMA weight for new samples
+
+
+class LinkProfile:
+    def __init__(self, path: Path | None = None):
+        self._lock = threading.Lock()
+        self._v = dict(_DEFAULTS)
+        self._path = path
+        self._dirty = False
+        self._last_save = 0.0
+        if path is not None:
+            try:
+                if path.exists():
+                    stored = json.loads(path.read_text())
+                    self._v.update(
+                        {k: float(stored[k]) for k in _DEFAULTS if k in stored}
+                    )
+            except Exception:
+                logger.debug("link profile load failed", exc_info=True)
+
+    # ------------------------------------------------------------- recording
+
+    def _ewma(self, key: str, value: float) -> None:
+        self._v[key] = (1 - _ALPHA) * self._v[key] + _ALPHA * value
+
+    def _record_dir(self, lat_key: str, bw_key: str, nbytes: int, secs: float) -> None:
+        with self._lock:
+            if nbytes < _SMALL:
+                self._ewma(lat_key, secs)
+            else:
+                # subtract the latency estimate, but never let a transfer
+                # faster than it fabricate bandwidth: floor at secs/4
+                # (inflation bounded to 4x actual)
+                eff = nbytes / max(secs - self._v[lat_key], secs / 4)
+                self._ewma(bw_key, eff)
+            self._dirty = True
+        self._maybe_save()
+
+    def record_h2d(self, nbytes: int, secs: float) -> None:
+        if secs > 0:
+            self._record_dir("h2d_lat", "h2d_bw", nbytes, secs)
+
+    def record_d2h(self, nbytes: int, secs: float) -> None:
+        if secs > 0:
+            self._record_dir("d2h_lat", "d2h_bw", nbytes, secs)
+
+    def record_cpu_agg(self, rows: int, secs: float) -> None:
+        if secs <= 0 or rows < 10_000:
+            return
+        with self._lock:
+            self._ewma("cpu_rows_per_sec", rows / secs)
+            self._dirty = True
+        self._maybe_save()
+
+    # ------------------------------------------------------------- estimates
+
+    def ship_cost(self, nbytes: int) -> float:
+        v = self._v
+        return v["h2d_lat"] + nbytes / v["h2d_bw"]
+
+    def read_cost(self, nbytes: int) -> float:
+        v = self._v
+        return v["d2h_lat"] + nbytes / v["d2h_bw"]
+
+    def cpu_cost(self, rows: int) -> float:
+        return rows / self._v["cpu_rows_per_sec"]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._v)
+
+    def attach_path(self, path: Path) -> None:
+        """Adopt a persistence path without dropping in-memory learning
+        (current-session measurements outrank a stored profile)."""
+        with self._lock:
+            self._path = path
+            self._dirty = True
+        self._maybe_save()
+
+    # ----------------------------------------------------------- persistence
+
+    def _maybe_save(self) -> None:
+        if self._path is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if not self._dirty or now - self._last_save < 5.0:
+                return
+            self._dirty = False
+            self._last_save = now
+            data = json.dumps(self._v)
+        try:
+            self._path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self._path.with_suffix(f".{os.getpid()}.tmp")
+            tmp.write_text(data)
+            os.replace(tmp, self._path)
+        except OSError:
+            logger.debug("link profile save failed", exc_info=True)
+
+
+_GLOBAL: LinkProfile | None = None
+_GLOBAL_PATH: Path | None = None
+
+
+def get_link(options=None) -> LinkProfile:
+    """Process-wide profile, persisted under the staging dir when known.
+    A pathless profile that learned first (scan-path callers pass no
+    options) keeps its measurements when a path shows up later — it only
+    gains persistence."""
+    global _GLOBAL, _GLOBAL_PATH
+    path: Path | None = None
+    if options is not None and getattr(options, "local_staging_path", None) is not None:
+        path = Path(options.local_staging_path) / "link_profile.json"
+    if _GLOBAL is None:
+        _GLOBAL = LinkProfile(path)
+        _GLOBAL_PATH = path
+    elif path is not None and _GLOBAL_PATH is None:
+        _GLOBAL.attach_path(path)
+        _GLOBAL_PATH = path
+    elif path is not None and path != _GLOBAL_PATH:
+        # a different staging dir is a different deployment
+        _GLOBAL = LinkProfile(path)
+        _GLOBAL_PATH = path
+    return _GLOBAL
+
+
+# ------------------------------------------------------- background warming
+
+_WARM_QUEUE = None
+_WARM_THREAD: threading.Thread | None = None
+_WARM_PENDING: set = set()
+_WARM_LOCK = threading.Lock()
+
+
+def warm_async(key: tuple, fn) -> bool:
+    """Run `fn` (an encode+ship+hotset-put closure) on the warming thread.
+    Returns False when the key is already queued or the queue is full.
+    A wedged device hangs only this daemon thread — queries are unaffected
+    (the device-health gate routes them to the CPU engine)."""
+    import queue as _q
+
+    global _WARM_QUEUE, _WARM_THREAD
+    with _WARM_LOCK:
+        if key in _WARM_PENDING:
+            return False
+        if _WARM_QUEUE is None:
+            _WARM_QUEUE = _q.Queue(maxsize=64)
+
+            def loop():
+                while True:
+                    k, f = _WARM_QUEUE.get()
+                    try:
+                        f()
+                    except Exception:
+                        logger.debug("background warm failed", exc_info=True)
+                    finally:
+                        with _WARM_LOCK:
+                            _WARM_PENDING.discard(k)
+
+            _WARM_THREAD = threading.Thread(
+                target=loop, name="device-warmer", daemon=True
+            )
+            _WARM_THREAD.start()
+        try:
+            _WARM_QUEUE.put_nowait((key, fn))
+        except _q.Full:
+            return False
+        _WARM_PENDING.add(key)
+        return True

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import logging
 import re
+import time as _time
 from dataclasses import dataclass, field as dc_field
 from datetime import UTC, datetime
 from typing import Any, Callable, Iterator
@@ -639,6 +640,29 @@ class PlanLayout:
 # executors in one query lifetime) reuse the compiled XLA executable.
 _PROGRAM_CACHE: dict[tuple, Callable] = {}
 
+_TRANSFER_COUNT = [0]
+
+
+def _timed_readback(x) -> np.ndarray:
+    """Device->host readback with link-profile recording (the estimate
+    includes any remaining compute wait — a conservative bias on links
+    where d2h is the scarce direction)."""
+    if isinstance(x, np.ndarray):
+        return np.asarray(x, np.float64)
+    t0 = _time.perf_counter()
+    arr = np.asarray(x, np.float64)
+    try:
+        from parseable_tpu.ops.link import get_link
+
+        get_link().record_d2h(arr.size * 4, _time.perf_counter() - t0)
+    except Exception:
+        pass
+    return arr
+
+# blocks the adaptive dispatcher routed to the CPU because the measured
+# link made shipping a losing trade (observable in tests/metrics)
+ADAPTIVE_CPU_BLOCKS = [0]
+
 # how many programs were built with a mesh (shard_map psum path) — the
 # stable signal tests/bench use to assert distributed execution happened
 # (cache-key positions are an implementation detail); the second counter
@@ -975,7 +999,7 @@ class TpuQueryExecutor(QueryExecutor):
         def flush(acc_dev, num_groups: int) -> None:
             """ONE device->host readback per accumulator, folded into the
             sparse agg (distinct presence bitmaps decode alongside)."""
-            arr = np.asarray(acc_dev, np.float64)
+            arr = _timed_readback(acc_dev)
             state = DenseState(
                 capacities=tuple(ks.capacity for ks in key_specs),
                 num_groups=num_groups,
@@ -1104,9 +1128,77 @@ class TpuQueryExecutor(QueryExecutor):
             stacked_cols=[specs[i].arg.name for i in stacked_idx],
         )
 
+        # adaptive dispatch: per non-resident block, estimated ship (+
+        # local-mode readback) cost vs measured CPU aggregation cost
+        # (ops/link.py) — a degraded link must not make cold scans 10x
+        # slower than the host. Routed blocks still warm the device hot
+        # set in the background so the NEXT query runs warm.
+        import os
+
+        from parseable_tpu.ops.link import get_link, warm_async
+        from parseable_tpu.query.partials import (
+            partial_from_block,
+            specs_partializable,
+        )
+
+        adaptive = os.environ.get("P_TPU_ADAPTIVE", "1") != "0"
+        link = get_link(self.options)
+        needed = self.plan.needed_columns
+        ncols_est = len(needed) if needed is not None else 6
+        bytes_per_row = 4 * max(ncols_est, 1)
+        n_acc_rows = 1 + n_all + n_sum + len(min_idx) + len(max_idx)
+        hotset_obj = get_hotset()
+        partializable = bool(sel.group_by) and specs_partializable(specs)
+
+        def cpu_block(table: pa.Table) -> None:
+            """Aggregate one block on the host, into partials when the
+            specs allow (vectorized; a 1M-group block must not hit the
+            per-group Python aggregator)."""
+            t0 = _time.perf_counter()
+            t = self._bounds_filter(self._materialize(table))
+            rows_scanned = t.num_rows  # pre-filter: cpu_cost() is applied
+            mask = self._where_mask(t)  # to raw block rows
+            if partializable:
+                if mask is not None:
+                    t = t.filter(mask)
+                pt = partial_from_block(t, sel.group_by, specs)
+                if pt is not None:
+                    partials.append(pt)
+            else:
+                agg.update(t, mask)
+            link.record_cpu_agg(rows_scanned, _time.perf_counter() - t0)
+
         t_start = _t.monotonic()
         for table in blocks(tables):
             self._check_deadline()
+            # adaptive routing decides OUTSIDE the device-fallback try: the
+            # fallback handler re-aggregates the block, and a block that
+            # cpu_block already (even partially) folded must never reach it
+            meta0 = table.schema.metadata or {}
+            src0 = meta0.get(SOURCE_ID_META)
+            rows0 = int(meta0[STUB_META]) if STUB_META in meta0 else table.num_rows
+            if adaptive and rows0 >= (1 << 16) and not dkeys:
+                k0 = hot_key(src0, needed, dict_cols) if src0 is not None else None
+                if k0 is None or not hotset_obj.contains(k0):
+                    ship = link.ship_cost(rows0 * bytes_per_row)
+                    if local_mode:
+                        ship += link.read_cost(
+                            min(rows0, LOCAL_G_MAX) * n_acc_rows * 4
+                        )
+                    if ship > link.cpu_cost(rows0) * 1.15:
+                        ADAPTIVE_CPU_BLOCKS[0] += 1
+                        cpu_block(table)
+                        if k0 is not None:
+                            try:
+                                warm_async(
+                                    k0,
+                                    lambda t=table: self._encoded_block(
+                                        t, needed, dict_cols
+                                    ),
+                                )
+                            except Exception:
+                                logger.debug("warm enqueue failed", exc_info=True)
+                        continue
             try:
                 enc, dev = self._encoded_block(table, self.plan.needed_columns, dict_cols)
                 for i in stacked_idx:
@@ -1294,7 +1386,7 @@ class TpuQueryExecutor(QueryExecutor):
                     )
                     return self.finalize_from_interim(interim, rewritten)
             interim = self._dense_interim(
-                np.asarray(acc, np.float64), acc_groups, key_specs, specs,
+                _timed_readback(acc), acc_groups, key_specs, specs,
                 n_all, n_sum, n_min, sum_idx, min_idx, max_idx, countcol_idx,
             )
             DEVICE_EXECUTE_TIME.labels("groupby").observe(_t.monotonic() - t_start)
@@ -1593,7 +1685,7 @@ class TpuQueryExecutor(QueryExecutor):
             tuple(sorted(dev.keys())),
             num_groups,
         )
-        out = np.asarray(program(dev, dev_luts, row_mask), np.float64)
+        out = _timed_readback(program(dev, dev_luts, row_mask))
         n_all = len(layout.stacked_cols)
         n_sum, n_min = len(layout.sum_cols), len(layout.min_cols)
         count = out[0]
@@ -1851,7 +1943,7 @@ class TpuQueryExecutor(QueryExecutor):
         """Dense global accumulator -> partial table (used when switching to
         block-local mode mid-query: the dense epoch's results merge through
         the same vectorized group_by as the block partials)."""
-        arr = np.asarray(acc, np.float64)
+        arr = _timed_readback(acc)
         keyinfo: list[tuple] = []
         for ks in key_specs:
             if ks.kind == "dict":
@@ -2376,7 +2468,22 @@ def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
         # when the block enters the hot set)
         pack("__rowmask", enc.row_mask)
     payload = np.concatenate(bufs) if bufs else np.empty(0, np.uint8)
+    _TRANSFER_COUNT[0] += 1
+    sample = payload.nbytes >= (1 << 20) and (
+        _TRANSFER_COUNT[0] == 1 or _TRANSFER_COUNT[0] % 8 == 0
+    )
+    t0 = _time.perf_counter() if sample else 0.0
     dev_payload = jnp.asarray(payload)
+    if sample:
+        # block on 1-in-8 puts to keep the link profile honest without
+        # serializing the pipeline (puts are otherwise async)
+        try:
+            dev_payload.block_until_ready()
+            from parseable_tpu.ops.link import get_link
+
+            get_link().record_h2d(payload.nbytes, _time.perf_counter() - t0)
+        except Exception:
+            pass
     nbytes = payload.nbytes
     for key, dtype, count, o in parts:
         dev[key] = _bitcast_from_u8(

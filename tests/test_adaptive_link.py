@@ -1,0 +1,107 @@
+"""Adaptive link dispatch: non-resident blocks route to the CPU when the
+measured link makes shipping a losing trade, and warm the device hot set
+in the background (ops/link.py; the degraded-tunnel counterpart of the
+reference's data-local DataFusion execution,
+/root/reference/src/query/mod.rs)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.ops import link as L
+from parseable_tpu.ops.hotset import get_hotset
+from parseable_tpu.query import executor_tpu as ET
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+
+@pytest.fixture()
+def fresh_link(monkeypatch):
+    prof = L.LinkProfile()
+    monkeypatch.setattr(L, "get_link", lambda options=None: prof)
+    return prof
+
+
+def _table(n: int = 1 << 17, seed: int = 3) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "user": pa.array([f"u{int(x)}" for x in rng.integers(0, 64, n)]),
+            "v": pa.array(rng.integers(0, 100, n).astype(np.float64)),
+        }
+    )
+
+
+SQL = "SELECT user, count(*) c, sum(v) s FROM t GROUP BY user"
+
+
+def run_cpu(tables):
+    return QueryExecutor(build_plan(parse_sql(SQL))).execute(iter(tables)).to_pylist()
+
+
+def run_tpu(tables):
+    return (
+        ET.TpuQueryExecutor(build_plan(parse_sql(SQL))).execute(iter(tables)).to_pylist()
+    )
+
+
+def norm(rows):
+    return sorted((r["user"], r["c"], r["s"]) for r in rows)
+
+
+def test_slow_link_routes_blocks_to_cpu(fresh_link):
+    # teach the profile a terrible link: 1 MB/s both ways, 100ms latency
+    for _ in range(20):
+        fresh_link.record_h2d(1 << 20, 1.1)
+        fresh_link.record_d2h(1 << 20, 1.1)
+        fresh_link.record_cpu_agg(1_000_000, 0.05)
+    t = _table()
+    before = ET.ADAPTIVE_CPU_BLOCKS[0]
+    cpu, tpu = run_cpu([t]), run_tpu([t])
+    assert ET.ADAPTIVE_CPU_BLOCKS[0] > before, "block was not routed to CPU"
+    assert norm(cpu) == norm(tpu)
+
+
+def test_fast_link_keeps_blocks_on_device(fresh_link):
+    # defaults are optimistic (healthy link): the device path must be taken
+    t = _table(seed=5)
+    before = ET.ADAPTIVE_CPU_BLOCKS[0]
+    cpu, tpu = run_cpu([t]), run_tpu([t])
+    assert ET.ADAPTIVE_CPU_BLOCKS[0] == before
+    assert norm(cpu) == norm(tpu)
+
+
+def test_routed_block_warms_hotset_in_background(fresh_link):
+    for _ in range(20):
+        fresh_link.record_h2d(1 << 20, 1.1)
+        fresh_link.record_cpu_agg(1_000_000, 0.05)
+    src = b"adaptive-test-source-1"
+    real = _table(seed=7)
+    stub_free = real.replace_schema_metadata({ET.SOURCE_ID_META: src})
+    lp = build_plan(parse_sql(SQL))
+    ex = ET.TpuQueryExecutor(lp)
+    before = ET.ADAPTIVE_CPU_BLOCKS[0]
+    out = ex.execute(iter([stub_free]))
+    assert ET.ADAPTIVE_CPU_BLOCKS[0] > before
+    assert norm(out.to_pylist()) == norm(run_cpu([real]))
+    # the background warmer ships the block so the NEXT query is resident
+    key = ET.hot_key(src, lp.needed_columns, {"user"})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not get_hotset().contains(key):
+        time.sleep(0.1)
+    assert get_hotset().contains(key), "background warm did not land"
+
+
+def test_adaptive_off_env(fresh_link, monkeypatch):
+    monkeypatch.setenv("P_TPU_ADAPTIVE", "0")
+    for _ in range(20):
+        fresh_link.record_h2d(1 << 20, 1.1)
+    t = _table(seed=9)
+    before = ET.ADAPTIVE_CPU_BLOCKS[0]
+    run_tpu([t])
+    assert ET.ADAPTIVE_CPU_BLOCKS[0] == before
