@@ -40,6 +40,7 @@ _DEFAULTS = {
     "d2h_bw": 8e9,
     "d2h_lat": 0.002,
     "cpu_rows_per_sec": 2.0e7,
+    "cpu_filter_rows_per_sec": 4.0e7,
 }
 
 _SMALL = 256 * 1024  # below this a transfer mostly measures latency
@@ -90,10 +91,20 @@ class LinkProfile:
             self._record_dir("d2h_lat", "d2h_bw", nbytes, secs)
 
     def record_cpu_agg(self, rows: int, secs: float) -> None:
-        if secs <= 0 or rows < 10_000:
+        # floor matches the adaptive gate's routing minimum (1<<16): every
+        # routable block feeds back; smaller blocks measure fixed costs
+        if secs <= 0 or rows < (1 << 16):
             return
         with self._lock:
             self._ewma("cpu_rows_per_sec", rows / secs)
+            self._dirty = True
+        self._maybe_save()
+
+    def record_cpu_filter(self, rows: int, secs: float) -> None:
+        if secs <= 0 or rows < (1 << 16):
+            return
+        with self._lock:
+            self._ewma("cpu_filter_rows_per_sec", rows / secs)
             self._dirty = True
         self._maybe_save()
 
@@ -109,6 +120,11 @@ class LinkProfile:
 
     def cpu_cost(self, rows: int) -> float:
         return rows / self._v["cpu_rows_per_sec"]
+
+    def cpu_filter_cost(self, rows: int) -> float:
+        # filters (predicate eval + take) run faster than aggregation;
+        # pricing them with the aggregate rate would over-route to CPU
+        return rows / self._v["cpu_filter_rows_per_sec"]
 
     def snapshot(self) -> dict:
         with self._lock:

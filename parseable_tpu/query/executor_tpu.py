@@ -644,11 +644,19 @@ _TRANSFER_COUNT = [0]
 
 
 def _timed_readback(x) -> np.ndarray:
-    """Device->host readback with link-profile recording (the estimate
-    includes any remaining compute wait — a conservative bias on links
-    where d2h is the scarce direction)."""
+    """Device->host readback with link-profile recording. Pending compute
+    is waited out BEFORE the timer starts so the d2h sample measures pure
+    transfer — compute/compile waits folded in would poison the adaptive
+    cost model's latency EWMA."""
     if isinstance(x, np.ndarray):
         return np.asarray(x, np.float64)
+    try:
+        # wait for pending compute FIRST so the timing below is pure
+        # transfer — folding compile/compute waits into the d2h latency
+        # EWMA would poison the adaptive cost model
+        x.block_until_ready()
+    except Exception:
+        pass
     t0 = _time.perf_counter()
     arr = np.asarray(x, np.float64)
     try:
@@ -829,13 +837,43 @@ class TpuQueryExecutor(QueryExecutor):
 
         def filtered() -> Iterator[pa.Table]:
             # bounds filtering happens once, in the inner executor's loop
+            import os
+
+            from parseable_tpu.ops.link import get_link
             from parseable_tpu.query.executor import _arr, evaluate
 
+            adaptive = os.environ.get("P_TPU_ADAPTIVE", "1") != "0"
+            link = get_link(self.options)
+            hotset_obj = get_hotset()
             compiler = PredicateCompiler()
             for table in tables:
                 if sel.where is None:
                     yield table
                     continue
+                if adaptive:
+                    # readback here is a 1-byte-per-row filter mask
+                    route, k0, rows0 = self._adaptive_gate(
+                        table,
+                        mask_needed,
+                        set(),
+                        link,
+                        hotset_obj,
+                        lambda r: r,
+                        filter_workload=True,
+                    )
+                    if route:
+                        ADAPTIVE_CPU_BLOCKS[0] += 1
+                        t0 = _time.perf_counter()
+                        t = self._materialize(table)
+                        mask = _arr(evaluate(sel.where, t), t)
+                        out = t.filter(mask)
+                        # feed the measurement back so select-heavy loads
+                        # can correct a wrong routing estimate
+                        link.record_cpu_filter(rows0, _time.perf_counter() - t0)
+                        if k0 is not None:
+                            self._warm_block(k0, table, mask_needed, set())
+                        yield out
+                        continue
                 try:
                     enc, dev = self._encoded_block(table, mask_needed, set())
                     import jax.numpy as jnp
@@ -843,7 +881,11 @@ class TpuQueryExecutor(QueryExecutor):
                     luts = [jnp.asarray(l) for l in compiler.collect_luts(sel.where, enc)]
                     mask = compiler.trace(sel.where, enc, dev, luts)
                     mask_np = np.asarray(mask)[: enc.num_rows]
-                    yield table.filter(pa.array(mask_np))
+                    # materialize defensively: projection needs row values,
+                    # which a hot stub doesn't carry (selects don't receive
+                    # stubs today — session gates use_hot_stubs on
+                    # aggregates — but this branch must not depend on that)
+                    yield self._materialize(table).filter(pa.array(mask_np))
                 except UnsupportedOnDevice:
                     # evaluate against the captured (un-stripped) WHERE
                     mask = _arr(evaluate(sel.where, table), table)
@@ -862,6 +904,52 @@ class TpuQueryExecutor(QueryExecutor):
     # set by the session: re-reads a source when a stubbed block got evicted
     # between the provider's hot check and execution
     source_loader: Callable[[bytes], pa.Table] | None = None
+
+    def _adaptive_gate(
+        self,
+        table: pa.Table,
+        needed: set[str] | None,
+        dict_cols: set[str],
+        link,
+        hotset_obj,
+        read_bytes: Callable[[int], int],
+        filter_workload: bool = False,
+    ) -> tuple[bool, tuple | None, int]:
+        """Shared routing decision: (route_to_cpu, hot_key|None, rows).
+        Resident blocks and small blocks always take the device path;
+        otherwise estimated ship+readback cost is priced against the
+        measured host rate (ops/link.py) — the filter rate for predicate
+        workloads, the aggregation rate otherwise."""
+        meta = table.schema.metadata or {}
+        src = meta.get(SOURCE_ID_META)
+        rows0 = int(meta[STUB_META]) if STUB_META in meta else table.num_rows
+        if rows0 < (1 << 16):
+            return False, None, rows0
+        key = hot_key(src, needed, dict_cols) if src is not None else None
+        if key is not None and hotset_obj.contains(key):
+            return False, key, rows0
+        ncols = len(needed) if needed is not None else 6
+        ship = link.ship_cost(rows0 * 4 * max(ncols, 1))
+        rb = read_bytes(rows0)
+        if rb:  # a zero-byte readback pays no d2h latency either
+            ship += link.read_cost(rb)
+        cpu = (
+            link.cpu_filter_cost(rows0) if filter_workload else link.cpu_cost(rows0)
+        )
+        return ship > cpu * 1.15, key, rows0
+
+    def _warm_block(
+        self, key: tuple, table: pa.Table, needed: set[str] | None, dict_cols: set[str]
+    ) -> None:
+        """Ship a CPU-routed block into the hot set off the query path."""
+        from parseable_tpu.ops.link import warm_async
+
+        try:
+            warm_async(
+                key, lambda t=table: self._encoded_block(t, needed, dict_cols)
+            )
+        except Exception:
+            logger.debug("warm enqueue failed", exc_info=True)
 
     def _materialize(self, table: pa.Table) -> pa.Table:
         """Real rows for a table (loads the source when it's a hot stub)."""
@@ -1135,7 +1223,7 @@ class TpuQueryExecutor(QueryExecutor):
         # set in the background so the NEXT query runs warm.
         import os
 
-        from parseable_tpu.ops.link import get_link, warm_async
+        from parseable_tpu.ops.link import get_link
         from parseable_tpu.query.partials import (
             partial_from_block,
             specs_partializable,
@@ -1144,8 +1232,6 @@ class TpuQueryExecutor(QueryExecutor):
         adaptive = os.environ.get("P_TPU_ADAPTIVE", "1") != "0"
         link = get_link(self.options)
         needed = self.plan.needed_columns
-        ncols_est = len(needed) if needed is not None else 6
-        bytes_per_row = 4 * max(ncols_est, 1)
         n_acc_rows = 1 + n_all + n_sum + len(min_idx) + len(max_idx)
         hotset_obj = get_hotset()
         partializable = bool(sel.group_by) and specs_partializable(specs)
@@ -1174,31 +1260,27 @@ class TpuQueryExecutor(QueryExecutor):
             # adaptive routing decides OUTSIDE the device-fallback try: the
             # fallback handler re-aggregates the block, and a block that
             # cpu_block already (even partially) folded must never reach it
-            meta0 = table.schema.metadata or {}
-            src0 = meta0.get(SOURCE_ID_META)
-            rows0 = int(meta0[STUB_META]) if STUB_META in meta0 else table.num_rows
-            if adaptive and rows0 >= (1 << 16) and not dkeys:
-                k0 = hot_key(src0, needed, dict_cols) if src0 is not None else None
-                if k0 is None or not hotset_obj.contains(k0):
-                    ship = link.ship_cost(rows0 * bytes_per_row)
-                    if local_mode:
-                        ship += link.read_cost(
-                            min(rows0, LOCAL_G_MAX) * n_acc_rows * 4
-                        )
-                    if ship > link.cpu_cost(rows0) * 1.15:
-                        ADAPTIVE_CPU_BLOCKS[0] += 1
-                        cpu_block(table)
-                        if k0 is not None:
-                            try:
-                                warm_async(
-                                    k0,
-                                    lambda t=table: self._encoded_block(
-                                        t, needed, dict_cols
-                                    ),
-                                )
-                            except Exception:
-                                logger.debug("warm enqueue failed", exc_info=True)
-                        continue
+            if adaptive and not dkeys:
+                # two-phase (local) blocks read back a dense G-sized
+                # partial; the dense path reads back nothing per block
+                route, k0, _ = self._adaptive_gate(
+                    table,
+                    needed,
+                    dict_cols,
+                    link,
+                    hotset_obj,
+                    (
+                        (lambda r: min(r, LOCAL_G_MAX) * n_acc_rows * 4)
+                        if local_mode
+                        else (lambda r: 0)
+                    ),
+                )
+                if route:
+                    ADAPTIVE_CPU_BLOCKS[0] += 1
+                    cpu_block(table)
+                    if k0 is not None:
+                        self._warm_block(k0, table, needed, dict_cols)
+                    continue
             try:
                 enc, dev = self._encoded_block(table, self.plan.needed_columns, dict_cols)
                 for i in stacked_idx:

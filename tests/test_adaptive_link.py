@@ -105,3 +105,17 @@ def test_adaptive_off_env(fresh_link, monkeypatch):
     before = ET.ADAPTIVE_CPU_BLOCKS[0]
     run_tpu([t])
     assert ET.ADAPTIVE_CPU_BLOCKS[0] == before
+
+
+def test_slow_link_routes_select_filter_to_cpu(fresh_link):
+    for _ in range(20):
+        fresh_link.record_h2d(1 << 20, 1.1)
+        fresh_link.record_d2h(1 << 20, 1.1)
+        fresh_link.record_cpu_agg(1_000_000, 0.05)
+    t = _table(seed=11)
+    sql = "SELECT user, v FROM t WHERE v > 50.0"
+    before = ET.ADAPTIVE_CPU_BLOCKS[0]
+    cpu = QueryExecutor(build_plan(parse_sql(sql))).execute(iter([t])).to_pylist()
+    tpu = ET.TpuQueryExecutor(build_plan(parse_sql(sql))).execute(iter([t])).to_pylist()
+    assert ET.ADAPTIVE_CPU_BLOCKS[0] > before, "filter block not routed to CPU"
+    assert sorted(map(str, cpu)) == sorted(map(str, tpu))
