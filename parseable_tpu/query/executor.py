@@ -443,6 +443,7 @@ class AggSpec:
     func: str  # count | count_star | sum | min | max | avg | count_distinct
     arg: S.Expr | None
     out_name: str
+    param: float | None = None  # percentile for approx_percentile_cont
 
 
 def _collect_aggs(e: S.Expr, out: list[AggSpec], counter: list[int]) -> S.Expr:
@@ -457,9 +458,27 @@ def _collect_aggs(e: S.Expr, out: list[AggSpec], counter: list[int]) -> S.Expr:
             arg = e.args[0]
         if func == "approx_distinct":
             func = "count_distinct"
+        param: float | None = None
+        if func == "approx_percentile_cont":
+            func = "percentile"
+            if len(e.args) != 2 or not isinstance(e.args[1], S.Literal):
+                raise ExecError(
+                    "approx_percentile_cont takes (column, percentile-literal)"
+                )
+            pv = e.args[1].value
+            if not isinstance(pv, (int, float)) or isinstance(pv, bool):
+                raise ExecError("percentile must be a numeric literal")
+            param = float(pv)
+            if not 0.0 <= param <= 1.0:
+                raise ExecError("percentile must be between 0 and 1")
+        elif func == "approx_median":
+            func = "percentile"
+            if len(e.args) != 1:
+                raise ExecError("approx_median takes exactly one argument")
+            param = 0.5
         slot = f"__agg{counter[0]}"
         counter[0] += 1
-        out.append(AggSpec(func, arg, slot))
+        out.append(AggSpec(func, arg, slot, param=param))
         return S.Column(slot)
     if isinstance(e, S.BinaryOp):
         return S.BinaryOp(e.op, _collect_aggs(e.left, out, counter), _collect_aggs(e.right, out, counter))
@@ -494,6 +513,7 @@ class GroupState:
     maxs: list[Any]
     distincts: list[set]
     sumsqs: list[float]
+    sketches: list[Any]  # QuantileSketch | None per spec
 
 
 class HashAggregator:
@@ -517,6 +537,7 @@ class HashAggregator:
             maxs=[None] * n,
             distincts=[set() for _ in range(n)],
             sumsqs=[0.0] * n,
+            sketches=[None] * n,
         )
 
     def update(self, table: pa.Table, mask: pa.Array | None = None) -> None:
@@ -596,6 +617,76 @@ class HashAggregator:
                 elif spec.func == "count":
                     st.count[si] += gcols[f"__a{si}_count"][r]
 
+        # percentile sketches: one argsort over combined group codes gives
+        # contiguous per-group value slices; per-GROUP python only
+        pct_specs = [si for si, s in enumerate(self.specs) if s.func == "percentile"]
+        if pct_specs:
+            import numpy as np
+
+            from parseable_tpu.query.partials import (
+                _FastPathUnavailable,
+                _combine_codes,
+                _encode_key,
+            )
+            from parseable_tpu.query.sketch import QuantileSketch
+
+            combined: np.ndarray | None = None
+            if key_names:
+                try:
+                    codes_list, sizes = [], []
+                    for k in key_names:
+                        codes, d = _encode_key(tmp.column(k))
+                        codes_list.append(codes)
+                        sizes.append(len(d) + 1)
+                    combined = _combine_codes(codes_list, sizes)
+                except _FastPathUnavailable:
+                    # un-encodable key type or code-space overflow: factorize
+                    # row tuples in Python (rare; correctness over speed)
+                    tuples = list(
+                        zip(*[tmp.column(k).to_pylist() for k in key_names])
+                    )
+                    index: dict = {}
+                    combined = np.fromiter(
+                        (index.setdefault(tp, len(index)) for tp in tuples),
+                        np.int64,
+                        n,
+                    )
+            else:
+                combined = np.zeros(n, np.int64)
+            order = np.argsort(combined, kind="stable")
+            sorted_codes = combined[order]
+            starts = np.flatnonzero(
+                np.r_[True, sorted_codes[1:] != sorted_codes[:-1]]
+            )
+            bounds = np.r_[starts, n]
+            # one key tuple per GROUP (first row of each slice), never per row
+            first_rows = (
+                tmp.select(key_names)
+                .take(pa.array(order[starts]))
+                .to_pylist()
+                if key_names
+                else [{} for _ in starts]
+            )
+            for si in pct_specs:
+                col = tmp.column(f"__a{si}")
+                vals = np.asarray(
+                    pc.cast(col, pa.float64(), safe=False).to_numpy(
+                        zero_copy_only=False
+                    )
+                )
+                sorted_vals = vals[order]
+                for bi in range(len(starts)):
+                    s, e = bounds[bi], bounds[bi + 1]
+                    key = tuple(first_rows[bi][k] for k in key_names)
+                    st = self.groups.get(key)
+                    if st is None:
+                        st = self._new_state()
+                        self.groups[key] = st
+                    if st.sketches[si] is None:
+                        st.sketches[si] = QuantileSketch()
+                    st.sketches[si].update(sorted_vals[s:e])
+                    st.count[si] = st.sketches[si].count
+
         # exact distinct: unique (keys, value) combos per chunk -> host sets
         for si, spec in enumerate(self.specs):
             if spec.func != "count_distinct":
@@ -629,6 +720,12 @@ class HashAggregator:
                     b = getattr(st, attr)[si]
                     getattr(mine, attr)[si] = b if a is None else (a if b is None else fn(a, b))
                 mine.distincts[si] |= st.distincts[si]
+                if st.sketches[si] is not None:
+                    if mine.sketches[si] is None:
+                        mine.sketches[si] = st.sketches[si]
+                    else:
+                        mine.sketches[si].merge(st.sketches[si])
+                    mine.count[si] = mine.sketches[si].count
 
     def merge_raw(
         self,
@@ -681,6 +778,11 @@ class HashAggregator:
             var = (st.sumsqs[si] - st.sums[si] ** 2 / n) / (n - 1)
             var = max(0.0, var)  # guard f.p. negatives
             return math.sqrt(var) if spec.func == "stddev" else var
+        if spec.func == "percentile":
+            sk = st.sketches[si]
+            if sk is None:
+                return None
+            return sk.quantile(spec.param if spec.param is not None else 0.5)
         raise ExecError(f"unknown aggregate {spec.func}")
 
 

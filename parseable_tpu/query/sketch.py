@@ -1,0 +1,158 @@
+"""Mergeable quantile sketch for approx_percentile_cont / approx_median.
+
+The reference gets approximate percentiles from DataFusion's t-digest
+(`approx_percentile_cont`, /root/reference/src/query/mod.rs:212-276 —
+registered with the session's function set). This implementation keeps the
+same mergeable-state contract with a shape chosen for this engine:
+
+- EXACT below 1024 values per group: raw f64 values, quantiles via
+  np.quantile (linear interpolation — matches percentile_cont semantics).
+  Most real group-bys over log data have modest per-group counts, so most
+  results are exact, not approximate.
+- Log-scale histogram beyond: 1024 bins per sign over |v| in
+  [2^-40, 2^40) plus an exact-zero count — ~5.6% worst-case relative
+  error per value (2^(80/1024) per bin, interpolated), constant memory,
+  exact tracked min/max clamp the tails so p0/p100 are exact.
+- Merge is histogram addition (small sides fold in), so block partials
+  and distributed-tree merges compose associatively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SMALL = 1024  # raw values kept before folding into the histogram
+BINS = 1024
+LOG_LO = -40.0  # log2 of the smallest binned magnitude
+LOG_HI = 40.0
+_SCALE = BINS / (LOG_HI - LOG_LO)
+
+
+def _bin_of(mag_log2: np.ndarray) -> np.ndarray:
+    return np.clip(
+        ((mag_log2 - LOG_LO) * _SCALE).astype(np.int64), 0, BINS - 1
+    )
+
+
+def _bin_edges(idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    lo = idx / _SCALE + LOG_LO
+    return lo, lo + 1.0 / _SCALE
+
+
+class QuantileSketch:
+    __slots__ = ("small", "pos", "neg", "zeros", "vmin", "vmax", "count")
+
+    def __init__(self) -> None:
+        self.small: list[np.ndarray] | None = []  # None once folded
+        self.pos: np.ndarray | None = None  # f64 [BINS]
+        self.neg: np.ndarray | None = None
+        self.zeros = 0.0
+        self.vmin = np.inf
+        self.vmax = -np.inf
+        self.count = 0
+
+    # ------------------------------------------------------------------ build
+
+    def update(self, values: np.ndarray) -> None:
+        """Fold a block's non-null values (any float/int ndarray) in."""
+        v = np.asarray(values, np.float64)
+        v = v[~np.isnan(v)]
+        if len(v) == 0:
+            return
+        self.count += len(v)
+        self.vmin = min(self.vmin, float(v.min()))
+        self.vmax = max(self.vmax, float(v.max()))
+        if self.small is not None:
+            self.small.append(v)
+            if sum(len(a) for a in self.small) > SMALL:
+                self._fold()
+            return
+        self._bin(v)
+
+    def _fold(self) -> None:
+        vals = np.concatenate(self.small) if self.small else np.empty(0)
+        self.small = None
+        self.pos = np.zeros(BINS)
+        self.neg = np.zeros(BINS)
+        if len(vals):
+            self._bin(vals)
+
+    def _bin(self, v: np.ndarray) -> None:
+        zero = v == 0.0
+        self.zeros += float(zero.sum())
+        for sign, hist in ((1, self.pos), (-1, self.neg)):
+            sel = (v > 0) if sign > 0 else (v < 0)
+            if not sel.any():
+                continue
+            # clip BEFORE the int cast: +/-inf (natural in log data from
+            # casts/division) must land in the extreme bins, not wrap
+            # through undefined int64 conversion into bin 0
+            mags = np.clip(
+                np.log2(np.abs(v[sel])), LOG_LO, LOG_HI - 1e-9
+            )
+            np.add.at(hist, _bin_of(mags), 1.0)
+
+    # ------------------------------------------------------------------ merge
+
+    def merge(self, other: "QuantileSketch") -> None:
+        if other.count == 0:
+            return
+        self.count += other.count
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+        if self.small is not None and other.small is not None:
+            self.small.extend(other.small)
+            if sum(len(a) for a in self.small) > SMALL:
+                self._fold()
+            return
+        if self.small is not None:
+            self._fold()
+        if other.small is not None:
+            for a in other.small:
+                self._bin(a)  # bins + zero-counts other's raw values
+            return
+        self.pos += other.pos
+        self.neg += other.neg
+        self.zeros += other.zeros
+
+    # ---------------------------------------------------------------- queries
+
+    def quantile(self, p: float) -> float | None:
+        if self.count == 0:
+            return None
+        p = min(max(p, 0.0), 1.0)
+        if self.small is not None:
+            vals = (
+                np.concatenate(self.small) if self.small else np.empty(0)
+            )
+            if len(vals) == 0:
+                return None
+            return float(np.quantile(vals, p, method="linear"))
+        # histogram walk: negatives (descending magnitude), zeros, positives
+        target = p * (self.count - 1)
+        neg_counts = self.neg[::-1]  # most-negative first
+        blocks: list[tuple[float, int, int]] = []  # (count, sign, bin_idx)
+        for c, idx in zip(neg_counts, range(BINS - 1, -1, -1)):
+            if c:
+                blocks.append((float(c), -1, idx))
+        if self.zeros:
+            blocks.append((float(self.zeros), 0, 0))
+        for idx in range(BINS):
+            if self.pos[idx]:
+                blocks.append((float(self.pos[idx]), 1, idx))
+        acc = 0.0
+        for c, sign, idx in blocks:
+            if acc + c > target:
+                frac = (target - acc) / c
+                if sign == 0:
+                    return 0.0
+                lo, hi = _bin_edges(np.array([idx]))
+                lo_v, hi_v = 2.0 ** lo[0], 2.0 ** hi[0]
+                if sign < 0:
+                    # negative bins walk from -hi_v toward -lo_v
+                    val = -(hi_v - frac * (hi_v - lo_v))
+                else:
+                    val = lo_v + frac * (hi_v - lo_v)
+                return float(min(max(val, self.vmin), self.vmax))
+            acc += c
+        return self.vmax if self.vmax > -np.inf else None

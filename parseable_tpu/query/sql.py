@@ -323,7 +323,10 @@ def contains_subquery(e: Expr | None) -> bool:
     return False
 
 
-AGGREGATE_FUNCS = {"count", "sum", "min", "max", "avg", "approx_distinct", "count_distinct", "stddev", "var"}
+AGGREGATE_FUNCS = {
+    "count", "sum", "min", "max", "avg", "approx_distinct", "count_distinct",
+    "stddev", "var", "approx_percentile_cont", "approx_median",
+}
 
 # pure window functions (aggregate names also work windowed: sum(...) OVER)
 WINDOW_FUNCS = {

@@ -1,0 +1,147 @@
+"""approx_percentile_cont / approx_median (reference: DataFusion's
+t-digest-backed approx_percentile_cont registered by the session,
+/root/reference/src/query/mod.rs:212-276). Exact below 1024 values per
+group (raw-value mode), log-histogram approximation beyond."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.query import executor_tpu as ET
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sketch import QuantileSketch
+from parseable_tpu.query.sql import parse_sql
+
+
+def run(sql: str, tables: list[pa.Table], engine: str = "cpu"):
+    lp = build_plan(parse_sql(sql))
+    ex = QueryExecutor(lp) if engine == "cpu" else ET.TpuQueryExecutor(lp)
+    return ex.execute(iter(tables)).to_pylist()
+
+
+def test_small_groups_exact():
+    rng = np.random.default_rng(1)
+    vals = rng.random(500) * 100
+    t = pa.table({"g": pa.array(["a"] * 500), "v": pa.array(vals)})
+    out = run(
+        "SELECT g, approx_percentile_cont(v, 0.95) p, approx_median(v) m "
+        "FROM t GROUP BY g",
+        [t],
+    )
+    assert out[0]["p"] == pytest.approx(np.quantile(vals, 0.95), rel=1e-12)
+    assert out[0]["m"] == pytest.approx(np.quantile(vals, 0.5), rel=1e-12)
+
+
+def test_large_group_approx_accuracy():
+    rng = np.random.default_rng(2)
+    # lognormal latencies: the shape approx percentiles exist for
+    vals = np.exp(rng.normal(3.0, 1.2, 200_000))
+    t = pa.table({"v": pa.array(vals)})
+    out = run("SELECT approx_percentile_cont(v, 0.99) p FROM t", [t])
+    exact = np.quantile(vals, 0.99)
+    assert out[0]["p"] == pytest.approx(exact, rel=0.06)
+
+
+def test_multi_block_merge_matches_single_block():
+    rng = np.random.default_rng(3)
+    vals = rng.random(30_000) * 1000
+    t1 = pa.table({"v": pa.array(vals[:10_000])})
+    t2 = pa.table({"v": pa.array(vals[10_000:])})
+    whole = pa.table({"v": pa.array(vals)})
+    sql = "SELECT approx_percentile_cont(v, 0.5) p FROM t"
+    split = run(sql, [t1, t2])[0]["p"]
+    one = run(sql, [whole])[0]["p"]
+    exact = np.quantile(vals, 0.5)
+    assert split == pytest.approx(exact, rel=0.06)
+    assert one == pytest.approx(exact, rel=0.06)
+
+
+def test_group_by_percentile_with_nulls():
+    vals = [1.0, 2.0, 3.0, None, 100.0, 200.0, None, 300.0]
+    gs = ["a", "a", "a", "a", "b", "b", "b", "b"]
+    t = pa.table({"g": pa.array(gs), "v": pa.array(vals, pa.float64())})
+    out = run(
+        "SELECT g, approx_median(v) m FROM t GROUP BY g ORDER BY g", [t]
+    )
+    assert out[0]["m"] == pytest.approx(2.0)
+    assert out[1]["m"] == pytest.approx(200.0)
+
+
+def test_tpu_engine_falls_back_and_matches():
+    rng = np.random.default_rng(5)
+    n = 5_000
+    t = pa.table(
+        {
+            "g": pa.array([f"g{int(x)}" for x in rng.integers(0, 8, n)]),
+            "v": pa.array(rng.random(n) * 50),
+        }
+    )
+    sql = "SELECT g, approx_percentile_cont(v, 0.9) p FROM t GROUP BY g"
+    cpu = sorted((r["g"], round(r["p"], 9)) for r in run(sql, [t], "cpu"))
+    tpu = sorted((r["g"], round(r["p"], 9)) for r in run(sql, [t], "tpu"))
+    assert cpu == tpu
+
+
+def test_negative_and_zero_values():
+    vals = np.concatenate(
+        [-np.exp(np.linspace(0, 8, 2_000)), np.zeros(500), np.exp(np.linspace(0, 8, 2_000))]
+    )
+    sk = QuantileSketch()
+    sk.update(vals)
+    assert sk.small is None  # folded to histogram
+    for p in (0.05, 0.25, 0.5, 0.75, 0.95):
+        exact = np.quantile(vals, p)
+        got = sk.quantile(p)
+        tol = max(abs(exact) * 0.08, 0.5)
+        assert abs(got - exact) <= tol, (p, got, exact)
+
+
+def test_sketch_merge_small_into_hist():
+    rng = np.random.default_rng(7)
+    a, b = QuantileSketch(), QuantileSketch()
+    va = rng.random(5_000) * 10  # folds to histogram
+    vb = rng.random(200) * 10  # stays raw
+    a.update(va)
+    b.update(vb)
+    a.merge(b)
+    allv = np.concatenate([va, vb])
+    assert a.count == len(allv)
+    assert a.quantile(0.9) == pytest.approx(np.quantile(allv, 0.9), rel=0.08)
+
+
+def test_invalid_percentile_rejected():
+    t = pa.table({"v": pa.array([1.0])})
+    with pytest.raises(Exception, match="percentile"):
+        run("SELECT approx_percentile_cont(v, 1.5) FROM t", [t])
+
+
+def test_percentile_zero_returns_minimum():
+    t = pa.table({"v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+    out = run("SELECT approx_percentile_cont(v, 0.0) p FROM t", [t])
+    assert out[0]["p"] == pytest.approx(1.0)
+
+
+def test_infinite_values_rank_above_finite():
+    vals = np.concatenate([np.full(500, np.inf), np.linspace(10, 20, 2_000)])
+    sk = QuantileSketch()
+    sk.update(vals)
+    assert sk.small is None
+    # p50 of [2000 finite in 10..20, 500 inf] is ~16.2 (finite mass)
+    assert sk.quantile(0.5) == pytest.approx(np.quantile(vals, 0.5), rel=0.08)
+    # p95 lands in the inf mass: must come back at/above every finite value
+    assert sk.quantile(0.95) >= 20.0
+
+
+def test_approx_median_arity_enforced():
+    t = pa.table({"v": pa.array([1.0, 2.0])})
+    with pytest.raises(Exception, match="one argument"):
+        run("SELECT approx_median(v, 0.99) FROM t", [t])
+
+
+def test_non_numeric_percentile_rejected():
+    t = pa.table({"v": pa.array([1.0])})
+    with pytest.raises(Exception, match="numeric"):
+        run("SELECT approx_percentile_cont(v, 'p50') FROM t", [t])
