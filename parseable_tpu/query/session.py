@@ -29,6 +29,59 @@ from parseable_tpu.utils.timeutil import TimeRange
 logger = logging.getLogger(__name__)
 
 
+def _is_composite(select: S.Select) -> bool:
+    """Joins/CTEs/unions/subqueries need the multi-table planner (and full
+    materialization before streaming)."""
+    return bool(select.ctes or select.set_ops or select.joins) or any(
+        S.contains_subquery(x)
+        for x in [select.where, select.having, *(i.expr for i in select.items)]
+    )
+
+
+def _referenced_streams(select: S.Select) -> set[str]:
+    """Every physical stream the statement touches (CTE names excluded):
+    main table, joins, union branches, CTE bodies, subqueries."""
+    out: set[str] = set()
+    cte_names: set[str] = set()
+
+    def walk_expr(e) -> None:
+        if e is None:
+            return
+        if isinstance(e, S.Subquery):
+            walk(e.select)
+            return
+        for child in getattr(e, "__dict__", {}).values():
+            if isinstance(child, S.Expr):
+                walk_expr(child)
+            elif isinstance(child, list):
+                for c in child:
+                    if isinstance(c, S.Expr):
+                        walk_expr(c)
+                    elif isinstance(c, S.OrderItem):
+                        walk_expr(c.expr)
+                    elif isinstance(c, tuple):
+                        for cc in c:
+                            if isinstance(cc, S.Expr):
+                                walk_expr(cc)
+
+    def walk(s: S.Select) -> None:
+        for name, sub in s.ctes.items():
+            cte_names.add(name)
+            walk(sub)
+        if s.table:
+            out.add(s.table)
+        for j in s.joins:
+            out.add(j.table)
+            walk_expr(j.on)
+        for _, branch in s.set_ops:
+            walk(branch)
+        for x in [s.where, s.having, *(i.expr for i in s.items)]:
+            walk_expr(x)
+
+    walk(select)
+    return out - cte_names
+
+
 class QueryError(ValueError):
     pass
 
@@ -150,6 +203,8 @@ class QuerySession:
         t0: float | None = None,
     ) -> QueryResult:
         t0 = t0 if t0 is not None else _time.monotonic()
+        if select.explain:
+            return self._explain(select, start_time, end_time, allowed_streams, t0)
         if select.ctes:
             return self._query_with_ctes(select, start_time, end_time, allowed_streams, t0)
         if select.set_ops:
@@ -185,6 +240,103 @@ class QuerySession:
             }
         )
         return result
+
+    def _explain(
+        self,
+        select: S.Select,
+        start_time: str | None,
+        end_time: str | None,
+        allowed_streams: set[str] | None,
+        t0: float,
+    ) -> QueryResult:
+        """EXPLAIN [ANALYZE]: (plan_type, plan) rows — DataFusion's explain
+        shape (reference: src/query/mod.rs:212-276 exposes EXPLAIN through
+        the DataFusion session)."""
+        mode = select.explain
+        select.explain = None
+        # RBAC before anything renders: composite statements don't reach
+        # _plan_ast's per-stream check, so enforce over every referenced
+        # stream here (same contract as execution)
+        if allowed_streams is not None:
+            for stream in sorted(_referenced_streams(select)):
+                if stream not in allowed_streams:
+                    raise QueryError(f"unauthorized for stream {stream!r}")
+        plan_types = ["logical_plan"]
+        plans = [S.format_statement(select)]
+
+        if _is_composite(select):
+            plans.append(
+                "CompositeExec: joins/CTEs/unions/subqueries run through the "
+                "multi-table planner (query/multi.py); branch scans prune and "
+                "execute like single-stream plans"
+            )
+            plan_types.append("physical_plan")
+        else:
+            try:
+                lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
+                proj = (
+                    ", ".join(sorted(lp.needed_columns))
+                    if lp.needed_columns is not None
+                    else "*"
+                )
+                phys = [
+                    f"engine={self.engine}",
+                    f"scan: stream={lp.stream} projection=[{proj}] "
+                    f"time_bounds=[{lp.time_bounds.low}, {lp.time_bounds.high}]",
+                ]
+                if lp.is_aggregate:
+                    from parseable_tpu.query.partials import specs_partializable
+                    from parseable_tpu.query.executor import QueryExecutor
+
+                    agg, _, _ = QueryExecutor(lp).build_aggregator()
+                    if self.engine == "tpu":
+                        phys.append(
+                            "aggregate: device fused one-hot fold (dense pow2 "
+                            "group space; block-local two-phase past "
+                            "DENSE_G_MAX; link-adaptive CPU routing)"
+                        )
+                    elif specs_partializable(agg.specs):
+                        phys.append(
+                            "aggregate: two-phase partial/merge "
+                            "(dictionary-coded keys, single int64 group code)"
+                        )
+                    else:
+                        phys.append("aggregate: streaming hash aggregate")
+                    if select.order_by and select.limit is not None:
+                        phys.append(
+                            f"top-k: ORDER BY/LIMIT pushdown (k={ (select.offset or 0) + select.limit })"
+                        )
+                plan_types.append("physical_plan")
+                plans.append("\n".join(phys))
+            except QueryError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                plan_types.append("physical_plan")
+                plans.append(f"(plan unavailable: {e})")
+
+        if mode == "analyze":
+            res = self._query_ast(select, start_time, end_time, allowed_streams)
+            st = res.stats
+            plan_types.append("analyze")
+            parts = [f"rows_out={res.table.num_rows}"]
+            for k in (
+                "rows_scanned",
+                "files_total",
+                "files_pruned",
+                "bytes_scanned",
+                "elapsed_secs",
+                "engine",
+            ):
+                if st.get(k) is not None:  # composite paths carry no scan stats
+                    parts.append(f"{k}={st[k]}")
+            plans.append(" ".join(parts))
+
+        table = pa.table({"plan_type": plan_types, "plan": plans})
+        return QueryResult(
+            table,
+            ["plan_type", "plan"],
+            stats={"elapsed_secs": round(_time.monotonic() - t0, 6), "explain": mode},
+        )
 
     def _plan(
         self,
@@ -241,13 +393,10 @@ class QuerySession:
         the device path exists for aggregation."""
         t0 = _time.monotonic()
         select = S.parse_sql(sql_text)
-        if select.set_ops or select.ctes or select.joins or any(
-            S.contains_subquery(x)
-            for x in [select.where, select.having, *(i.expr for i in select.items)]
-        ):
+        if _is_composite(select) or select.explain:
             # set operations / CTEs / joins need the full result before the
-            # first row can stream; materialize through the normal path and
-            # emit the table as one chunk
+            # first row can stream (and EXPLAIN emits plan rows, never a
+            # scan); materialize through the normal path, one chunk out
             result = self._query_ast(select, start_time, end_time, allowed_streams, t0)
             return iter([result.table])
         lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)

@@ -11,8 +11,9 @@ alerts and benchmarks issue:
 
 with operators AND/OR/NOT, comparisons, arithmetic, IN, BETWEEN, LIKE/ILIKE,
 IS [NOT] NULL, CASE WHEN, CAST, and functions (count/sum/avg/min/max,
-count(distinct), approx_distinct, date_bin, date_trunc, to_timestamp,
-lower/upper/length/coalesce, ...).
+count(distinct), approx_distinct, approx_percentile_cont, approx_median,
+stddev/var, date_bin, date_trunc, to_timestamp, lower/upper/length/
+coalesce, ...). `EXPLAIN [ANALYZE]` prefixes any statement.
 """
 
 from __future__ import annotations
@@ -291,6 +292,94 @@ class Select:
     # WITH name AS (...) bindings, in declaration order; later CTEs (and
     # the main body) may reference earlier ones
     ctes: dict[str, "Select"] = field(default_factory=dict)
+    # EXPLAIN [ANALYZE] prefix: None | "plan" | "analyze" (top level only)
+    explain: str | None = None
+
+
+def format_statement(sel: "Select") -> str:
+    """Indented logical-plan rendering for EXPLAIN (shape follows
+    DataFusion's logical plan display the reference exposes through its
+    EXPLAIN support, /root/reference/src/query/mod.rs:212-276)."""
+    lines: list[str] = []
+
+    def emit(depth: int, text: str) -> None:
+        lines.append("  " * depth + text)
+
+    def fmt(s: "Select", depth: int) -> None:
+        for name, sub in s.ctes.items():
+            emit(depth, f"CTE: {name}")
+            fmt(sub, depth + 1)
+        if s.set_ops:
+            # hoisted ORDER BY/LIMIT apply to the union result: render
+            # them ABOVE the Union node
+            if s.limit is not None or s.offset:
+                emit(depth, f"Limit: {s.limit}" + (f" OFFSET {s.offset}" if s.offset else ""))
+                depth += 1
+            if s.order_by:
+                keys = ", ".join(
+                    expr_name(o.expr) + (" DESC" if o.desc else " ASC")
+                    for o in s.order_by
+                )
+                emit(depth, f"Sort: {keys}")
+                depth += 1
+            emit(depth, "Union" + ("" if all(a for a, _ in s.set_ops) else " (distinct fold)"))
+            base = _strip_set_ops(s)
+            fmt(base, depth + 1)
+            for _, branch in s.set_ops:
+                fmt(branch, depth + 1)
+            return
+        if s.limit is not None or s.offset:
+            lim = f"Limit: {s.limit}" + (f" OFFSET {s.offset}" if s.offset else "")
+            emit(depth, lim)
+            depth += 1
+        if s.order_by:
+            keys = ", ".join(
+                expr_name(o.expr) + (" DESC" if o.desc else " ASC") for o in s.order_by
+            )
+            emit(depth, f"Sort: {keys}")
+            depth += 1
+        proj = ", ".join(
+            expr_name(i.expr) + (f" AS {i.alias}" if i.alias else "") for i in s.items
+        )
+        emit(depth, ("Distinct " if s.distinct else "") + f"Projection: {proj}")
+        depth += 1
+        # HAVING filters the aggregate's OUTPUT: deeper means earlier, so
+        # it renders above Aggregate (DataFusion order)
+        if s.having is not None:
+            emit(depth, f"Having: {expr_name(s.having)}")
+            depth += 1
+        if s.group_by:
+            emit(
+                depth,
+                f"Aggregate: groupBy=[{', '.join(expr_name(g) for g in s.group_by)}]",
+            )
+            depth += 1
+        if s.where is not None:
+            emit(depth, f"Filter: {expr_name(s.where)}")
+            depth += 1
+        scan = f"TableScan: {s.table}" + (f" AS {s.table_alias}" if s.table_alias else "")
+        emit(depth, scan)
+        for j in s.joins:
+            emit(
+                depth + 1,
+                f"Join[{j.kind}]: {j.table}"
+                + (f" AS {j.alias}" if j.alias else "")
+                + (f" ON {expr_name(j.on)}" if j.on is not None else ""),
+            )
+
+    def _strip_set_ops(s: "Select") -> "Select":
+        import copy
+
+        out = copy.copy(s)
+        out.set_ops = []
+        out.ctes = {}
+        out.order_by = []
+        out.limit = None
+        out.offset = None
+        return out
+
+    fmt(sel, 0)
+    return "\n".join(lines)
 
 
 def contains_subquery(e: Expr | None) -> bool:
@@ -445,6 +534,15 @@ class Parser:
 
     # -- entry ---------------------------------------------------------------
     def parse(self) -> Select:
+        # EXPLAIN [ANALYZE] prefix ("explain" is contextual: a column named
+        # explain keeps working everywhere else)
+        explain: str | None = None
+        if self.peek().kind == "ident" and self.peek().value.lower() == "explain":
+            self.next()
+            explain = "plan"
+            if self.peek().kind == "ident" and self.peek().value.lower() == "analyze":
+                self.next()
+                explain = "analyze"
         # WITH name AS (SELECT ...)[, ...] — CTEs bind for the whole
         # statement; "with" is contextual (a column named "with" stays a
         # column everywhere else)
@@ -466,6 +564,7 @@ class Parser:
                     break
         sel = self._parse_set_expr()
         sel.ctes = ctes
+        sel.explain = explain
         self.accept_op(";")
         if self.peek().kind != "eof":
             raise SqlError(f"trailing tokens at {self.peek().pos}")

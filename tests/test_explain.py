@@ -1,0 +1,111 @@
+"""EXPLAIN [ANALYZE] (reference: DataFusion explain via the session,
+/root/reference/src/query/mod.rs:212-276)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query.session import QueryError, QuerySession
+
+
+@pytest.fixture()
+def loaded(parseable):
+    from datetime import datetime, timedelta
+
+    from parseable_tpu.event import Event
+
+    p = parseable
+    stream = p.create_stream_if_not_exists("logs")
+    rng = np.random.default_rng(5)
+    base = datetime(2024, 5, 1)
+    n = 5_000
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(
+                [base + timedelta(milliseconds=int(i)) for i in range(n)],
+                pa.timestamp("ms"),
+            ),
+            "host": pa.array([f"h{int(x)}" for x in rng.integers(0, 8, n)]),
+            "bytes": pa.array(rng.random(n) * 100),
+        }
+    )
+    for b in t.to_batches():
+        Event(
+            stream_name="logs", rb=b, origin_size=1, is_first_event=True,
+            parsed_timestamp=base,
+        ).process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+    return p
+
+
+def test_explain_plan_rows(loaded):
+    res = QuerySession(loaded, engine="cpu").query(
+        "EXPLAIN SELECT host, count(*) c FROM logs "
+        "WHERE bytes > 50 GROUP BY host ORDER BY c DESC LIMIT 3"
+    )
+    rows = {r["plan_type"]: r["plan"] for r in res.to_json_rows()}
+    assert "logical_plan" in rows and "physical_plan" in rows
+    lp = rows["logical_plan"]
+    assert "Limit: 3" in lp and "Sort: c DESC" in lp
+    assert "Aggregate: groupBy=[host]" in lp
+    assert "Filter:" in lp and "TableScan: logs" in lp
+    assert "stream=logs" in rows["physical_plan"]
+    assert "two-phase" in rows["physical_plan"]
+    assert "top-k" in rows["physical_plan"]
+
+
+def test_explain_does_not_execute(loaded):
+    res = QuerySession(loaded, engine="cpu").query("EXPLAIN SELECT host FROM logs")
+    assert "analyze" not in {r["plan_type"] for r in res.to_json_rows()}
+
+
+def test_explain_analyze_executes_and_reports(loaded):
+    res = QuerySession(loaded, engine="cpu").query(
+        "EXPLAIN ANALYZE SELECT host, count(*) c FROM logs GROUP BY host"
+    )
+    rows = {r["plan_type"]: r["plan"] for r in res.to_json_rows()}
+    assert "rows_out=8" in rows["analyze"]
+    assert "rows_scanned=5000" in rows["analyze"]
+
+
+def test_explain_unauthorized_stream_blocked(loaded):
+    with pytest.raises(QueryError, match="unauthorized"):
+        QuerySession(loaded, engine="cpu").query(
+            "EXPLAIN SELECT host FROM logs", allowed_streams={"other"}
+        )
+
+
+def test_explain_composite_join(loaded):
+    res = QuerySession(loaded, engine="cpu").query(
+        "EXPLAIN SELECT a.host FROM logs a JOIN logs b ON a.host = b.host"
+    )
+    rows = {r["plan_type"]: r["plan"] for r in res.to_json_rows()}
+    assert "Join[inner]: logs" in rows["logical_plan"]
+    assert "CompositeExec" in rows["physical_plan"]
+
+
+def test_explain_union_and_cte(loaded):
+    res = QuerySession(loaded, engine="cpu").query(
+        "EXPLAIN WITH h AS (SELECT host FROM logs) "
+        "SELECT host FROM h UNION ALL SELECT host FROM logs"
+    )
+    lp = {r["plan_type"]: r["plan"] for r in res.to_json_rows()}["logical_plan"]
+    assert "CTE: h" in lp and "Union" in lp
+
+
+def test_column_named_explain_still_works():
+    from parseable_tpu.query.executor import QueryExecutor
+    from parseable_tpu.query.planner import plan as build_plan
+    from parseable_tpu.query.sql import parse_sql
+
+    t = pa.table({"explain": pa.array([1, 2])})
+    out = (
+        QueryExecutor(build_plan(parse_sql("SELECT explain FROM t")))
+        .execute(iter([t]))
+        .to_pylist()
+    )
+    assert out == [{"explain": 1}, {"explain": 2}]
