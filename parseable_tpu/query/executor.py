@@ -705,11 +705,25 @@ class HashAggregator:
                     self.groups[key] = st
                 st.distincts[si].add(v)
 
+    @staticmethod
+    def _copy_state(st: GroupState) -> GroupState:
+        """Own copy of a donor's state: merge must never alias the source
+        (a twice-merged or reused donor would otherwise be mutated)."""
+        return GroupState(
+            count=list(st.count),
+            sums=list(st.sums),
+            mins=list(st.mins),
+            maxs=list(st.maxs),
+            distincts=[set(s) for s in st.distincts],
+            sumsqs=list(st.sumsqs),
+            sketches=[sk.copy() if sk is not None else None for sk in st.sketches],
+        )
+
     def merge(self, other: "HashAggregator") -> None:
         for key, st in other.groups.items():
             mine = self.groups.get(key)
             if mine is None:
-                self.groups[key] = st
+                self.groups[key] = self._copy_state(st)
                 continue
             for si, spec in enumerate(self.specs):
                 mine.count[si] += st.count[si]
@@ -722,7 +736,7 @@ class HashAggregator:
                 mine.distincts[si] |= st.distincts[si]
                 if st.sketches[si] is not None:
                     if mine.sketches[si] is None:
-                        mine.sketches[si] = st.sketches[si]
+                        mine.sketches[si] = st.sketches[si].copy()
                     else:
                         mine.sketches[si].merge(st.sketches[si])
                     mine.count[si] = mine.sketches[si].count
@@ -735,11 +749,15 @@ class HashAggregator:
         mins: list,
         maxs: list,
         distincts: dict[int, set] | None = None,
+        sumsqs: list[float] | None = None,
+        sketches: dict[int, Any] | None = None,
     ) -> None:
         """Merge one group's partials produced by a device kernel.
 
         `distincts` maps spec index -> set of observed values (decoded from
-        the device presence bitmap), so device blocks and CPU-fallback
+        the device presence bitmap); `sumsqs` carries stddev/var sum-of-
+        squares partials; `sketches` maps spec index -> QuantileSketch built
+        from the device histogram — so device blocks and CPU-fallback
         blocks merge exactly."""
         st = self.groups.get(key)
         if st is None:
@@ -748,6 +766,8 @@ class HashAggregator:
         for si in range(len(self.specs)):
             st.count[si] += counts[si]
             st.sums[si] += sums[si]
+            if sumsqs is not None:
+                st.sumsqs[si] += sumsqs[si]
             for attr, vals, fn in (("mins", mins, min), ("maxs", maxs, max)):
                 a = getattr(st, attr)[si]
                 b = vals[si]
@@ -755,6 +775,13 @@ class HashAggregator:
         if distincts:
             for si, vals_set in distincts.items():
                 st.distincts[si] |= vals_set
+        if sketches:
+            for si, sk in sketches.items():
+                if st.sketches[si] is None:
+                    st.sketches[si] = sk
+                else:
+                    st.sketches[si].merge(sk)
+                st.count[si] = st.sketches[si].count
 
     def finalize_value(self, st: GroupState, si: int) -> Any:
         spec = self.specs[si]

@@ -31,9 +31,12 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-# aggregate functions expressible in partial format (stddev/var/distinct
-# need extra state and take the classic HashAggregator path)
-PARTIALIZABLE_FUNCS = {"count_star", "count", "sum", "avg", "min", "max"}
+# aggregate functions expressible in partial format: stddev/var carry
+# (count, sum, sum-of-squares) columns; percentile/distinct need sketch /
+# set state and take the classic HashAggregator path
+PARTIALIZABLE_FUNCS = {
+    "count_star", "count", "sum", "avg", "min", "max", "stddev", "var",
+}
 
 _MAX_COMBINED = 1 << 62  # combined-code capacity guard
 
@@ -153,6 +156,9 @@ def _agg_plan(specs: list) -> list[tuple]:
         aggs.append((f"__a{si}", "count"))
         if spec.func in ("sum", "avg"):
             aggs.append((f"__a{si}", "sum"))
+        elif spec.func in ("stddev", "var"):
+            aggs.append((f"__a{si}", "sum"))
+            aggs.append((f"__asq{si}", "sum"))
         elif spec.func == "min":
             aggs.append((f"__a{si}", "min"))
         elif spec.func == "max":
@@ -168,6 +174,9 @@ def _partial_out(g: pa.Table, specs: list) -> dict[str, Any]:
         out[f"__pac{si}"] = pc.cast(g.column(f"__a{si}_count"), pa.float64())
         if spec.func in ("sum", "avg"):
             out[f"__sum{si}"] = pc.cast(g.column(f"__a{si}_sum"), pa.float64())
+        elif spec.func in ("stddev", "var"):
+            out[f"__sum{si}"] = pc.cast(g.column(f"__a{si}_sum"), pa.float64())
+            out[f"__sumsq{si}"] = pc.cast(g.column(f"__asq{si}_sum"), pa.float64())
         elif spec.func == "min":
             out[f"__min{si}"] = g.column(f"__a{si}_min")
         elif spec.func == "max":
@@ -186,6 +195,10 @@ def partial_from_block(table: pa.Table, group_exprs: list, specs: list) -> pa.Ta
     for si, spec in enumerate(specs):
         if spec.func != "count_star":
             agg_cols[f"__a{si}"] = _arr(evaluate(spec.arg, table), table)
+        if spec.func in ("stddev", "var"):
+            # float64 before squaring: int64 squares wrap silently
+            fv = pc.cast(agg_cols[f"__a{si}"], pa.float64(), safe=False)
+            agg_cols[f"__asq{si}"] = pc.multiply(fv, fv)
 
     try:
         codes_list, dicts, sizes = [], [], []
@@ -275,6 +288,9 @@ def _merge_aggs(specs: list) -> list[tuple]:
         aggs.append((f"__pac{si}", "sum"))
         if spec.func in ("sum", "avg"):
             aggs.append((f"__sum{si}", "sum"))
+        elif spec.func in ("stddev", "var"):
+            aggs.append((f"__sum{si}", "sum"))
+            aggs.append((f"__sumsq{si}", "sum"))
         elif spec.func == "min":
             aggs.append((f"__min{si}", "min"))
         elif spec.func == "max":
@@ -296,6 +312,28 @@ def _merge_out(g: pa.Table, specs: list) -> dict[str, Any]:
             seen = pc.greater(pacv, 0)
             val = pc.divide(s, pacv) if spec.func == "avg" else s
             cols[f"__agg{si}"] = pc.if_else(seen, val, pa.scalar(None, pa.float64()))
+        elif spec.func in ("stddev", "var"):
+            # sample variance (n-1 denominator, DataFusion semantics);
+            # numpy here: masked divides are awkward in pa.compute
+            n = np.asarray(pc.cast(pacv, pa.float64()).to_numpy(zero_copy_only=False))
+            s = np.asarray(
+                pc.cast(pc.fill_null(g.column(f"__sum{si}_sum"), 0.0), pa.float64())
+                .to_numpy(zero_copy_only=False)
+            )
+            sq = np.asarray(
+                pc.cast(pc.fill_null(g.column(f"__sumsq{si}_sum"), 0.0), pa.float64())
+                .to_numpy(zero_copy_only=False)
+            )
+            ok = n >= 2
+            var = np.divide(
+                sq - np.divide(s * s, n, out=np.zeros_like(s), where=ok),
+                n - 1,
+                out=np.zeros_like(s),
+                where=ok,
+            )
+            var = np.maximum(var, 0.0)  # guard f.p. negatives
+            val = np.sqrt(var) if spec.func == "stddev" else var
+            cols[f"__agg{si}"] = pa.array(val, mask=~ok)
         elif spec.func == "min":
             cols[f"__agg{si}"] = g.column(f"__min{si}_min")
         elif spec.func == "max":

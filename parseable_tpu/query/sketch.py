@@ -39,6 +39,13 @@ def _bin_edges(idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return lo, lo + 1.0 / _SCALE
 
 
+# Flat device-histogram layout (query/executor_tpu.py device percentiles):
+# [0, BINS) negative bins (indexed by |v| bin), [BINS, 2*BINS) positive
+# bins, [2*BINS] exact-zero count — one f32 row per group, mergeable by
+# addition and convertible to a QuantileSketch via `from_device_hist`.
+DEVICE_NB = 2 * BINS + 1
+
+
 class QuantileSketch:
     __slots__ = ("small", "pos", "neg", "zeros", "vmin", "vmax", "count")
 
@@ -92,6 +99,37 @@ class QuantileSketch:
             )
             np.add.at(hist, _bin_of(mags), 1.0)
 
+    @classmethod
+    def from_device_hist(
+        cls, hist: np.ndarray, vmin: float, vmax: float
+    ) -> "QuantileSketch":
+        """One group's device histogram row (DEVICE_NB layout) -> sketch in
+        histogram mode (device blocks always bin; exactness below SMALL is a
+        host-path property only)."""
+        sk = cls()
+        sk.small = None
+        sk.neg = np.asarray(hist[:BINS], np.float64).copy()
+        sk.pos = np.asarray(hist[BINS : 2 * BINS], np.float64).copy()
+        sk.zeros = float(hist[2 * BINS])
+        sk.count = int(round(float(hist.sum())))
+        if sk.count:
+            sk.vmin = float(vmin)
+            sk.vmax = float(vmax)
+        return sk
+
+    def copy(self) -> "QuantileSketch":
+        """Deep-enough copy: safe to merge into without mutating the source
+        (raw-value arrays are shared but never mutated in place)."""
+        sk = QuantileSketch()
+        sk.small = list(self.small) if self.small is not None else None
+        sk.pos = None if self.pos is None else self.pos.copy()
+        sk.neg = None if self.neg is None else self.neg.copy()
+        sk.zeros = self.zeros
+        sk.vmin = self.vmin
+        sk.vmax = self.vmax
+        sk.count = self.count
+        return sk
+
     # ------------------------------------------------------------------ merge
 
     def merge(self, other: "QuantileSketch") -> None:
@@ -129,6 +167,8 @@ class QuantileSketch:
                 return None
             return float(np.quantile(vals, p, method="linear"))
         # histogram walk: negatives (descending magnitude), zeros, positives
+        # (the vectorized device-readback twin is hist_quantile below —
+        # keep their interpolation semantics in lockstep)
         target = p * (self.count - 1)
         neg_counts = self.neg[::-1]  # most-negative first
         blocks: list[tuple[float, int, int]] = []  # (count, sign, bin_idx)
@@ -156,3 +196,56 @@ class QuantileSketch:
                 return float(min(max(val, self.vmin), self.vmax))
             acc += c
         return self.vmax if self.vmax > -np.inf else None
+
+
+def hist_quantile(
+    hists: np.ndarray,
+    vmins: np.ndarray,
+    vmaxs: np.ndarray,
+    p: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized quantiles over device histogram rows.
+
+    `hists` is (n, DEVICE_NB) in the device layout; returns (values f64[n],
+    valid bool[n]). Semantically identical to QuantileSketch.quantile on a
+    folded sketch: blocks walk in ascending value order (negatives by
+    descending magnitude, zeros, positives), linear interpolation inside the
+    landing bin, result clamped to the group's exact [vmin, vmax].
+    """
+    n = hists.shape[0]
+    p = min(max(float(p), 0.0), 1.0)
+    counts = hists.sum(axis=1)
+    valid = counts > 0
+    if not valid.any():
+        return np.zeros(n), valid
+    # ascending-value order: reversed neg bins | zeros | pos bins
+    ordered = np.concatenate(
+        [hists[:, BINS - 1 :: -1], hists[:, 2 * BINS : 2 * BINS + 1], hists[:, BINS : 2 * BINS]],
+        axis=1,
+    ).astype(np.float64)
+    cum = np.cumsum(ordered, axis=1)
+    target = p * (counts - 1.0)
+    # first ordered block where the cumulative count exceeds the target
+    j = np.argmax(cum > target[:, None], axis=1)
+    before = np.where(j > 0, np.take_along_axis(cum, np.maximum(j - 1, 0)[:, None], 1)[:, 0], 0.0)
+    c = np.take_along_axis(ordered, j[:, None], 1)[:, 0]
+    frac = np.divide(target - before, c, out=np.zeros(n), where=c > 0)
+    # map ordered index back to (sign, magnitude bin)
+    neg = j < BINS
+    zero = j == BINS
+    pos_bin = np.clip(j - BINS - 1, 0, BINS - 1)
+    neg_bin = np.clip(BINS - 1 - j, 0, BINS - 1)
+    idx = np.where(neg, neg_bin, pos_bin)
+    lo, hi = _bin_edges(idx)
+    lo_v, hi_v = 2.0**lo, 2.0**hi
+    val = np.where(
+        neg,
+        -(hi_v - frac * (hi_v - lo_v)),
+        lo_v + frac * (hi_v - lo_v),
+    )
+    val = np.where(zero, 0.0, val)
+    # groups whose cumulative never exceeds target (p == 1 edge): vmax
+    overrun = np.take_along_axis(cum, np.full((n, 1), ordered.shape[1] - 1), 1)[:, 0] <= target
+    val = np.where(overrun, vmaxs, val)
+    val = np.minimum(np.maximum(val, vmins), vmaxs)
+    return val, valid

@@ -70,7 +70,11 @@ def test_group_by_percentile_with_nulls():
     assert out[1]["m"] == pytest.approx(200.0)
 
 
-def test_tpu_engine_falls_back_and_matches():
+def test_tpu_engine_runs_on_device_within_sketch_error():
+    """The TPU engine executes percentiles on-device (round-4 VERDICT #3:
+    no more whole-query CPU fallback); device histograms always bin, so the
+    device answer agrees with the exact CPU answer to within the sketch's
+    documented per-value error, never exactly."""
     rng = np.random.default_rng(5)
     n = 5_000
     t = pa.table(
@@ -80,9 +84,11 @@ def test_tpu_engine_falls_back_and_matches():
         }
     )
     sql = "SELECT g, approx_percentile_cont(v, 0.9) p FROM t GROUP BY g"
-    cpu = sorted((r["g"], round(r["p"], 9)) for r in run(sql, [t], "cpu"))
-    tpu = sorted((r["g"], round(r["p"], 9)) for r in run(sql, [t], "tpu"))
-    assert cpu == tpu
+    cpu = sorted((r["g"], r["p"]) for r in run(sql, [t], "cpu"))
+    tpu = sorted((r["g"], r["p"]) for r in run(sql, [t], "tpu"))
+    assert [g for g, _ in cpu] == [g for g, _ in tpu]
+    for (_, a), (_, b) in zip(cpu, tpu):
+        assert b == pytest.approx(a, rel=0.06)
 
 
 def test_negative_and_zero_values():

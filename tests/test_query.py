@@ -307,8 +307,9 @@ def test_session_time_range_prunes(parseable):
 
 
 def test_stddev_var_aggregates(parseable):
-    """stddev/var (sample, n-1) on the CPU engine; TPU path falls back and
-    matches."""
+    """stddev/var (sample, n-1): exact on the CPU engine; the TPU path runs
+    on device (centered-M2 accumulation, round-4 VERDICT #3) and agrees to
+    f32 accuracy."""
     import statistics
 
     from parseable_tpu.event.json_format import JsonEvent
@@ -318,11 +319,11 @@ def test_stddev_var_aggregates(parseable):
     vals = [float(i * i % 17) for i in range(60)]
     ev = JsonEvent([{"v": v} for v in vals], "sd").into_event(s.metadata)
     ev.process(s, commit_schema=p.commit_schema)
-    for engine in ("cpu", "tpu"):
+    for engine, tol in (("cpu", 1e-6), ("tpu", 1e-4)):
         r = QuerySession(p, engine=engine).query("SELECT stddev(v) sd, var(v) vr FROM sd")
         row = r.to_json_rows()[0]
-        assert abs(row["sd"] - statistics.stdev(vals)) < 1e-6
-        assert abs(row["vr"] - statistics.variance(vals)) < 1e-6
+        assert abs(row["sd"] - statistics.stdev(vals)) < tol * max(1.0, statistics.stdev(vals))
+        assert abs(row["vr"] - statistics.variance(vals)) < tol * max(1.0, statistics.variance(vals))
 
 
 def test_legacy_prefix_listing_fallback(parseable):
