@@ -101,6 +101,58 @@ class Options:
     username: str = field(default_factory=lambda: _env("P_USERNAME", "admin"))
     password: str = field(default_factory=lambda: _env("P_PASSWORD", "admin"))
 
+    # --- TLS / security -------------------------------------------------------
+    # (reference: src/cli.rs:295-330; both cert and key set => serve https,
+    #  cli.rs:688-693 get_scheme)
+    tls_cert_path: Path | None = field(
+        default_factory=lambda: (Path(v) if (v := _env("P_TLS_CERT_PATH")) else None)
+    )
+    tls_key_path: Path | None = field(
+        default_factory=lambda: (Path(v) if (v := _env("P_TLS_KEY_PATH")) else None)
+    )
+    trusted_ca_certs_path: Path | None = field(
+        default_factory=lambda: (
+            Path(v) if (v := _env("P_TRUSTED_CA_CERTS_DIR")) else None
+        )
+    )
+    # allow invalid certs for INTRA-CLUSTER calls only (nodes dialing each
+    # other by IP; reference cli.rs:312-330 security note)
+    tls_skip_verify: bool = field(
+        default_factory=lambda: _env_bool("P_TLS_SKIP_VERIFY", False)
+    )
+
+    def get_scheme(self) -> str:
+        """https when both cert and key are configured (cli.rs:688-693)."""
+        return "https" if self.tls_cert_path and self.tls_key_path else "http"
+
+    def server_ssl_context(self):
+        """ssl.SSLContext for the aiohttp runner, or None for plain http."""
+        if not (self.tls_cert_path and self.tls_key_path):
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(str(self.tls_cert_path), str(self.tls_key_path))
+        return ctx
+
+    def client_ssl_context(self):
+        """ssl.SSLContext for intra-cluster client calls: trusts the
+        configured CA dir and honors P_TLS_SKIP_VERIFY."""
+        import ssl
+
+        ctx = ssl.create_default_context()
+        if self.trusted_ca_certs_path and self.trusted_ca_certs_path.is_dir():
+            for cert in sorted(self.trusted_ca_certs_path.glob("*")):
+                if cert.is_file():
+                    try:
+                        ctx.load_verify_locations(str(cert))
+                    except Exception:  # noqa: BLE001 - skip non-cert files
+                        pass
+        if self.tls_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
     # --- staging --------------------------------------------------------------
     local_staging_path: Path = field(
         default_factory=lambda: Path(_env("P_STAGING_DIR", "./staging"))
@@ -267,6 +319,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--address", default=None)
     p.add_argument("--staging-dir", default=None)
     p.add_argument("--query-engine", default=None, choices=["tpu", "cpu"])
+    p.add_argument("--tls-cert-path", default=None)
+    p.add_argument("--tls-key-path", default=None)
+    p.add_argument("--trusted-ca-certs-path", default=None)
+    p.add_argument("--tls-skip-verify", action="store_true", default=None)
     return p
 
 
@@ -287,6 +343,14 @@ def parse_cli(argv: list[str] | None = None) -> tuple[Options, StorageOptions]:
         opts.local_staging_path = Path(args.staging_dir)
     if args.query_engine:
         opts.query_engine = args.query_engine
+    if args.tls_cert_path:
+        opts.tls_cert_path = Path(args.tls_cert_path)
+    if args.tls_key_path:
+        opts.tls_key_path = Path(args.tls_key_path)
+    if args.trusted_ca_certs_path:
+        opts.trusted_ca_certs_path = Path(args.trusted_ca_certs_path)
+    if args.tls_skip_verify:
+        opts.tls_skip_verify = True
     storage = StorageOptions()
     if args.backend:
         storage.backend = args.backend

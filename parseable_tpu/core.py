@@ -138,7 +138,12 @@ class Parseable:
             address = self.options.ingestor_endpoint
         elif node_type in ("querier", "all") and self.options.querier_endpoint:
             address = self.options.querier_endpoint
-        domain = address if address.startswith(("http://", "https://")) else f"http://{address}"
+        scheme = self.options.get_scheme()
+        domain = (
+            address
+            if address.startswith(("http://", "https://"))
+            else f"{scheme}://{address}"
+        )
         self.metastore.put_node(
             {
                 "node_id": self.node_id,

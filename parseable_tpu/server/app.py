@@ -1760,14 +1760,24 @@ def run_server(opts: Options | None = None, storage: StorageOptions | None = Non
         state.stop()
 
     app.on_shutdown.append(on_shutdown)
+    # TLS: both cert+key configured => https (reference: cli.rs:302-330;
+    # modal/mod.rs:86-187 https branch of the server bootstrap)
+    ssl_ctx = p.options.server_ssl_context()
     logger.info(
-        "parseable-tpu %s starting in %s mode on %s (store: %s)",
+        "parseable-tpu %s starting in %s mode on %s://%s (store: %s)",
         __version__,
         p.options.mode.value,
+        p.options.get_scheme(),
         p.options.address,
         p.provider.get_endpoint(),
     )
-    web.run_app(app, host=host or "0.0.0.0", port=int(port or 8000), print=None)
+    web.run_app(
+        app,
+        host=host or "0.0.0.0",
+        port=int(port or 8000),
+        ssl_context=ssl_ctx,
+        print=None,
+    )
 
 
 def main(argv: list[str] | None = None) -> None:

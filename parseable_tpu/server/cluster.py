@@ -42,13 +42,25 @@ def _auth_header(p: Parseable) -> str:
     return "Basic " + base64.b64encode(cred).decode()
 
 
-def check_liveness(domain: str) -> bool:
+def _urlopen(req, timeout: float, p: Parseable | None = None):
+    """Intra-cluster urlopen: https peers get the cluster client context
+    (trusted-CA dir + P_TLS_SKIP_VERIFY for IP-dialed nodes — reference
+    cli.rs:312-330 security note). Plain-http requests pass no context."""
+    url = req.full_url if hasattr(req, "full_url") else str(req)
+    if url.startswith("https://") and p is not None:
+        return urllib.request.urlopen(
+            req, timeout=timeout, context=p.options.client_ssl_context()
+        )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def check_liveness(domain: str, p: Parseable | None = None) -> bool:
     cached = _dead_nodes.get(domain)
     if cached is not None and time.monotonic() - cached < DEAD_NODE_TTL:
         return False
     try:
         req = urllib.request.Request(f"{domain}/api/v1/liveness", method="GET")
-        with urllib.request.urlopen(req, timeout=LIVENESS_TIMEOUT) as resp:
+        with _urlopen(req, LIVENESS_TIMEOUT, p) as resp:
             ok = resp.status == 200
     except (urllib.error.URLError, OSError):
         ok = False
@@ -61,14 +73,14 @@ def check_liveness(domain: str) -> bool:
 
 def live_ingestors(p: Parseable) -> list[dict]:
     nodes = [n for n in p.metastore.list_nodes("ingestor") if n.get("node_id") != p.node_id]
-    return [n for n in nodes if check_liveness(n["domain_name"])]
+    return [n for n in nodes if check_liveness(n["domain_name"], p)]
 
 
 def _fetch_one(p: Parseable, domain: str, stream: str) -> list[pa.RecordBatch]:
     url = f"{domain}/api/v1/internal/staging/{stream}"
     req = urllib.request.Request(url, headers={"Authorization": _auth_header(p)})
     try:
-        with urllib.request.urlopen(req, timeout=STAGING_TIMEOUT) as resp:
+        with _urlopen(req, STAGING_TIMEOUT, p) as resp:
             if resp.status == 204:
                 return []
             data = resp.read()
@@ -113,7 +125,7 @@ def _http(p: Parseable, method: str, url: str, body: bytes | None = None, header
         req.add_header(k, v)
     if body is not None and "Content-Type" not in (headers or {}):
         req.add_header("Content-Type", "application/json")
-    return urllib.request.urlopen(req, timeout=timeout)
+    return _urlopen(req, timeout, p)
 
 
 def live_peers(p: Parseable, kinds: tuple[str, ...]) -> list[dict]:
@@ -124,7 +136,7 @@ def live_peers(p: Parseable, kinds: tuple[str, ...]) -> list[dict]:
         for n in p.metastore.list_nodes(kind)
         if n.get("node_id") != p.node_id
     ]
-    return [n for n in nodes if check_liveness(n["domain_name"])]
+    return [n for n in nodes if check_liveness(n["domain_name"], p)]
 
 
 def sync_with_ingestors(

@@ -201,9 +201,18 @@ def test_every_option_has_a_reader():
     for f in pathlib.Path("parseable_tpu").rglob("*.py"):
         if f.name != "config.py":
             src += f.read_text()
+    # fields consumed through an Options helper method: the field is live
+    # iff the wrapping method is called outside config.py
+    via_method = {
+        "tls_cert_path": "server_ssl_context",
+        "tls_key_path": "server_ssl_context",
+        "trusted_ca_certs_path": "client_ssl_context",
+        "tls_skip_verify": "client_ssl_context",
+    }
     dead = []
     for fld in dataclasses.fields(Options):
-        if not _re.search(rf"\b{fld.name}\b", src):
+        needle = via_method.get(fld.name, fld.name)
+        if not _re.search(rf"\b{needle}\b", src):
             dead.append(fld.name)
     assert not dead, f"dead Options knobs: {dead}"
 
