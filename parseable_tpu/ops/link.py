@@ -24,6 +24,7 @@ subprocesses, CLI one-offs) inherit the measured numbers.
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -54,13 +55,16 @@ class LinkProfile:
         self._path = path
         self._dirty = False
         self._last_save = 0.0
+        # what we last saw on disk: the merge-on-save baseline (keys that
+        # moved on disk since = another process's fresher measurements)
+        self._last_disk: dict = {}
         if path is not None:
             try:
                 if path.exists():
                     stored = json.loads(path.read_text())
-                    self._v.update(
-                        {k: float(stored[k]) for k in _DEFAULTS if k in stored}
-                    )
+                    loaded = {k: float(stored[k]) for k in _DEFAULTS if k in stored}
+                    self._v.update(loaded)
+                    self._last_disk = loaded
             except Exception:
                 logger.debug("link profile load failed", exc_info=True)
 
@@ -149,18 +153,63 @@ class LinkProfile:
                 return
             self._dirty = False
             self._last_save = now
-            data = json.dumps(self._v)
+        self._do_save()
+
+    def flush(self) -> None:
+        """Force a save, bypassing the 5s throttle (ADVICE r3 #4: a CLI
+        one-off or bench subprocess must not exit without persisting its
+        learned measurements). Registered atexit for the global profile;
+        errors are swallowed — exit paths must never raise."""
+        with self._lock:
+            if self._path is None or not self._dirty:
+                return
+            self._dirty = False
+            self._last_save = time.monotonic()
         try:
+            self._do_save()
+        except Exception:
+            logger.debug("link profile flush failed", exc_info=True)
+
+    def _do_save(self) -> None:
+        """Merge-on-save: keys another process moved on disk since our
+        last read/write average with ours instead of being clobbered
+        last-writer-wins; untouched keys take our (fresher) values."""
+        try:
+            merged = dict(self._v)
+            try:
+                stored = json.loads(self._path.read_text())
+                for k in _DEFAULTS:
+                    if k in stored:
+                        sv = float(stored[k])
+                        baseline = self._last_disk.get(k)
+                        if baseline is None or abs(sv - baseline) > 1e-12:
+                            merged[k] = 0.5 * (merged[k] + sv)
+            except (OSError, ValueError):
+                pass  # no/invalid file: write ours
             self._path.parent.mkdir(parents=True, exist_ok=True)
             tmp = self._path.with_suffix(f".{os.getpid()}.tmp")
-            tmp.write_text(data)
+            tmp.write_text(json.dumps(merged))
             os.replace(tmp, self._path)
+            with self._lock:
+                self._last_disk = dict(merged)
+                self._v.update(merged)
         except OSError:
             logger.debug("link profile save failed", exc_info=True)
 
 
 _GLOBAL: LinkProfile | None = None
 _GLOBAL_PATH: Path | None = None
+
+
+def _flush_at_exit() -> None:
+    try:
+        if _GLOBAL is not None:
+            _GLOBAL.flush()
+    except Exception:  # noqa: BLE001 - never raise during interpreter exit
+        pass
+
+
+atexit.register(_flush_at_exit)
 
 
 def get_link(options=None) -> LinkProfile:

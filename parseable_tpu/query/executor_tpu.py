@@ -1505,9 +1505,16 @@ class TpuQueryExecutor(QueryExecutor):
             specs allow (vectorized; a 1M-group block must not hit the
             per-group Python aggregator)."""
             t0 = _time.perf_counter()
+            # same row basis the gate prices on (raw block rows / stub
+            # meta, BEFORE the bounds filter) — recording post-bounds rows
+            # while pricing pre-bounds rows skews the EWMA under heavy
+            # time pruning and misroutes blocks (ADVICE r3 #2)
+            meta = table.schema.metadata or {}
+            rows_scanned = (
+                int(meta[STUB_META]) if STUB_META in meta else table.num_rows
+            )
             t = self._bounds_filter(self._materialize(table))
-            rows_scanned = t.num_rows  # pre-filter: cpu_cost() is applied
-            mask = self._where_mask(t)  # to raw block rows
+            mask = self._where_mask(t)
             if partializable:
                 if mask is not None:
                     t = t.filter(mask)

@@ -119,3 +119,46 @@ def test_slow_link_routes_select_filter_to_cpu(fresh_link):
     tpu = ET.TpuQueryExecutor(build_plan(parse_sql(sql))).execute(iter([t])).to_pylist()
     assert ET.ADAPTIVE_CPU_BLOCKS[0] > before, "filter block not routed to CPU"
     assert sorted(map(str, cpu)) == sorted(map(str, tpu))
+
+
+def test_link_profile_flush_bypasses_throttle(tmp_path):
+    """ADVICE r3 #4: short-lived processes (CLI one-offs, bench
+    subprocesses) must persist learned measurements at exit even inside
+    the 5s save-throttle window."""
+    from parseable_tpu.ops.link import LinkProfile
+
+    path = tmp_path / "link_profile.json"
+    prof = LinkProfile(path)
+    prof.record_h2d(1 << 20, 1.0)  # throttled: first save stamps _last_save
+    prof.record_h2d(1 << 20, 1.0)
+    prof.flush()
+    import json as _json
+
+    stored = _json.loads(path.read_text())
+    # the slow measurements made it to disk (EWMA moved off the default)
+    assert stored["h2d_bw"] == prof.snapshot()["h2d_bw"] < 8e9 * 0.6
+
+
+def test_link_profile_merge_on_save(tmp_path):
+    """Concurrent processes must not clobber each other last-writer-wins:
+    keys another process moved on disk average with ours."""
+    import json as _json
+
+    from parseable_tpu.ops.link import LinkProfile
+
+    path = tmp_path / "link_profile.json"
+    a = LinkProfile(path)
+    b = LinkProfile(path)  # loads the same (absent) baseline
+    for _ in range(30):
+        a.record_h2d(1 << 22, 4.0)  # ~1 MB/s: a learns a terrible link
+    a.flush()
+    a_bw = _json.loads(path.read_text())["h2d_bw"]
+    assert a_bw < 1e8
+    # b learned nothing about h2d but measured d2h; its save must not
+    # reset a's h2d learning back to the optimistic default
+    b.record_d2h(1 << 22, 2.0)
+    b.flush()
+    stored = _json.loads(path.read_text())
+    assert stored["h2d_bw"] <= 0.5 * (a_bw + 8e9) + 1e-6
+    assert stored["h2d_bw"] < 8e9 * 0.6  # nowhere near the default
+    assert stored["d2h_bw"] < 8e9  # b's own measurement persisted
