@@ -198,7 +198,35 @@ class HotTierManager:
             )
         return evicted
 
+    # internal-stream auto hot tier (reference: hottier.rs:70-71 size
+    # constants, :1667-1743 put_internal_stream_hot_tier +
+    # create_pstats_hot_tier): cluster-metadata and dataset-stats queries
+    # back every dashboard panel — they must stay off object storage
+    INTERNAL_PMETA_BYTES = 10 * 2**20  # 10 MiB (hottier.rs:71)
+    INTERNAL_PSTATS_BYTES = 10 * 2**30  # 10 GiB (hottier.rs:70 MIN_STREAM)
+
+    def ensure_internal_hot_tiers(self) -> None:
+        """Auto-budget the internal streams: pmeta always, pstats once the
+        stream exists in storage. Direct assignment (not set_budget): the
+        budget is an upper bound and reconcile's disk-usage guard already
+        protects small disks."""
+        with self._lock:
+            if "pmeta" not in self.budgets:
+                self.budgets["pmeta"] = self.INTERNAL_PMETA_BYTES
+        if "pstats" not in self.budgets:
+            try:
+                exists = bool(self.p.metastore.get_all_stream_jsons("pstats"))
+            except Exception:  # noqa: BLE001 - metastore miss = not yet
+                exists = False
+            if exists:
+                with self._lock:
+                    self.budgets.setdefault("pstats", self.INTERNAL_PSTATS_BYTES)
+
     def tick(self) -> None:
+        try:
+            self.ensure_internal_hot_tiers()
+        except Exception:
+            logger.exception("internal hot tier ensure failed")
         try:
             self.disk_usage_guard()
         except Exception:

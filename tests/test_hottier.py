@@ -101,3 +101,45 @@ def test_disk_usage_guard_evicts_oldest(tmp_path, monkeypatch):
     remaining = sorted(str(f.relative_to(mgr.base)) for f in mgr.base.rglob("*.parquet"))
     # the two oldest dates went first, across streams
     assert remaining == ["a/date=2024-05-03/x.data.parquet"]
+
+
+def test_internal_streams_auto_hot_tiered(parseable, tmp_path):
+    """pstats/pmeta auto-hot-tier (reference: hottier.rs:1667-1743): the
+    dataset-stats stream gets a budget without operator action the moment
+    it exists, and field-stats queries are served from the local tier even
+    when the object-store copy is gone."""
+    p = parseable
+    p.options.collect_dataset_stats = True
+    stream = p.create_stream_if_not_exists("statsy")
+    ev = JsonEvent(
+        [{"k": "x", "n": 1.0}, {"k": "y", "n": 2.0}], "statsy"
+    ).into_event(stream.metadata)
+    ev.process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+    # pstats rows land via the upload hook; sync them to storage too
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    mgr = HotTierManager(p, tmp_path / "ht")
+    p.hot_tier = mgr
+    assert mgr.get_budget("pstats") is None
+    mgr.tick()
+    assert mgr.get_budget("pmeta") == mgr.INTERNAL_PMETA_BYTES
+    assert mgr.get_budget("pstats") == mgr.INTERNAL_PSTATS_BYTES
+    assert mgr.used_bytes("pstats") > 0, "pstats parquet not tiered"
+
+    # the strong proof queries hit the tier: remove the object-store
+    # copies — the field-stats query must still answer from local disk
+    from pathlib import Path
+
+    data_root = Path(p.provider.get_endpoint())
+    deleted = 0
+    for f in (data_root / "pstats").rglob("*.parquet"):
+        f.unlink()
+        deleted += 1
+    assert deleted, "expected pstats parquet in the object store"
+    res = QuerySession(p, engine="cpu").query(
+        "SELECT count(*) c FROM pstats WHERE stream = 'statsy'", "1h", "now"
+    )
+    assert res.to_json_rows()[0]["c"] >= 2
