@@ -918,11 +918,12 @@ _PROGRAM_CACHE: dict[tuple, Callable] = {}
 _TRANSFER_COUNT = [0]
 
 
-def _timed_readback(x) -> np.ndarray:
+def _timed_readback(x, stats: dict | None = None) -> np.ndarray:
     """Device->host readback with link-profile recording. Pending compute
     is waited out BEFORE the timer starts so the d2h sample measures pure
     transfer — compute/compile waits folded in would poison the adaptive
-    cost model's latency EWMA."""
+    cost model's latency EWMA. `stats` (a route_stats dict) gets the f32
+    wire bytes added for EXPLAIN ANALYZE observability."""
     if isinstance(x, np.ndarray):
         return np.asarray(x, np.float64)
     try:
@@ -934,6 +935,8 @@ def _timed_readback(x) -> np.ndarray:
         pass
     t0 = _time.perf_counter()
     arr = np.asarray(x, np.float64)
+    if stats is not None:
+        stats["d2h_bytes"] += arr.size * 4
     try:
         from parseable_tpu.ops.link import get_link
 
@@ -1081,6 +1084,17 @@ class TpuQueryExecutor(QueryExecutor):
         super().__init__(plan)
         self.options = options or Options()
         self.mesh = resolve_mesh(self.options)
+        # per-query route observability (EXPLAIN ANALYZE surfaces this —
+        # VERDICT r3 #10): how every scanned block was dispatched, plus
+        # the transfer bytes each direction actually cost
+        self.route_stats: dict[str, int] = {
+            "device_warm": 0,  # hot-set resident: zero bytes shipped
+            "device_cold": 0,  # encoded + shipped this query
+            "cpu_adaptive": 0,  # link cost model routed to host
+            "cpu_fallback": 0,  # unsupported-on-device / error / budget
+            "h2d_bytes": 0,
+            "d2h_bytes": 0,
+        }
 
     # ------------------------------------------------------------------ main
 
@@ -1138,6 +1152,7 @@ class TpuQueryExecutor(QueryExecutor):
                     )
                     if route:
                         ADAPTIVE_CPU_BLOCKS[0] += 1
+                        self.route_stats["cpu_adaptive"] += 1
                         t0 = _time.perf_counter()
                         t = self._materialize(table)
                         mask = _arr(evaluate(sel.where, t), t)
@@ -1156,6 +1171,7 @@ class TpuQueryExecutor(QueryExecutor):
                     luts = [jnp.asarray(l) for l in compiler.collect_luts(sel.where, enc)]
                     mask = compiler.trace(sel.where, enc, dev, luts)
                     mask_np = np.asarray(mask)[: enc.num_rows]
+                    self.route_stats["d2h_bytes"] += enc.num_rows  # bool mask
                     # materialize defensively: projection needs row values,
                     # which a hot stub doesn't carry (selects don't receive
                     # stubs today — session gates use_hot_stubs on
@@ -1163,6 +1179,7 @@ class TpuQueryExecutor(QueryExecutor):
                     yield self._materialize(table).filter(pa.array(mask_np))
                 except UnsupportedOnDevice:
                     # evaluate against the captured (un-stripped) WHERE
+                    self.route_stats["cpu_fallback"] += 1
                     mask = _arr(evaluate(sel.where, table), table)
                     yield table.filter(mask)
 
@@ -1255,6 +1272,7 @@ class TpuQueryExecutor(QueryExecutor):
             key = hot_key(source, needed, dict_cols)
             entry = hotset.get(key)
             if entry is not None:
+                self.route_stats["device_warm"] += 1
                 return entry.meta, entry.dev
             from parseable_tpu.ops.enccache import get_enccache
 
@@ -1263,6 +1281,8 @@ class TpuQueryExecutor(QueryExecutor):
                 enc = enccache.get(source, needed, dict_cols)
                 if enc is not None:
                     dev, nbytes = _transfer(enc, self.mesh)
+                    self.route_stats["device_cold"] += 1
+                    self.route_stats["h2d_bytes"] += nbytes
                     _strip_host_values(enc)
                     hotset.put(key, HotEntry(dev=dev, meta=enc, nbytes=nbytes))
                     return enc, dev
@@ -1271,6 +1291,8 @@ class TpuQueryExecutor(QueryExecutor):
         if enc is None:
             raise UnsupportedOnDevice("unencodable column in batch")
         dev, nbytes = _transfer(enc, self.mesh)
+        self.route_stats["device_cold"] += 1
+        self.route_stats["h2d_bytes"] += nbytes
         if key is not None:
             if enccache is not None:
                 # snapshot-by-reference then persist off the query path
@@ -1349,7 +1371,7 @@ class TpuQueryExecutor(QueryExecutor):
             """ONE device->host readback per accumulator, folded into the
             sparse agg (distinct presence bitmaps and percentile histograms
             decode alongside)."""
-            arr = _timed_readback(acc_dev)
+            arr = _timed_readback(acc_dev, self.route_stats)
             dists = [
                 (si, dk, np.asarray(d).reshape(num_groups, dk.capacity))
                 for si, dk, d in zip(distinct_idx, dkeys, dacc)
@@ -1409,6 +1431,7 @@ class TpuQueryExecutor(QueryExecutor):
         def fold_pending_on_cpu() -> None:
             """Program build/trace failed: aggregate the buffered blocks'
             source tables on the CPU instead (never raises past here)."""
+            self.route_stats["cpu_fallback"] += len(pending)
             for x in pending:
                 t = self._bounds_filter(self._materialize(x[0]))
                 agg.update(t, self._where_mask(t))
@@ -1533,6 +1556,7 @@ class TpuQueryExecutor(QueryExecutor):
         for table in blocks(tables):
             self._check_deadline()
             if force_cpu_rest:
+                self.route_stats["cpu_fallback"] += 1
                 cpu_block(table)
                 continue
             # adaptive routing decides OUTSIDE the device-fallback try: the
@@ -1555,6 +1579,7 @@ class TpuQueryExecutor(QueryExecutor):
                 )
                 if route:
                     ADAPTIVE_CPU_BLOCKS[0] += 1
+                    self.route_stats["cpu_adaptive"] += 1
                     cpu_block(table)
                     if k0 is not None:
                         self._warm_block(k0, table, needed, dict_cols)
@@ -1698,10 +1723,12 @@ class TpuQueryExecutor(QueryExecutor):
                     dispatch_pending()
             except UnsupportedOnDevice as e:
                 logger.debug("batch on CPU (%s)", e)
+                self.route_stats["cpu_fallback"] += 1
                 t = self._bounds_filter(self._materialize(table))
                 agg.update(t, self._where_mask(t))
             except Exception:
                 logger.exception("device aggregation failed for a batch; CPU fallback")
+                self.route_stats["cpu_fallback"] += 1
                 t = self._bounds_filter(self._materialize(table))
                 agg.update(t, self._where_mask(t))
 
@@ -1764,8 +1791,8 @@ class TpuQueryExecutor(QueryExecutor):
                 for si, h in zip(pct_idx, pacc)
             ]
             interim = self._dense_interim(
-                _timed_readback(acc), acc_groups, key_specs, specs, lay,
-                pcts=pcts,
+                _timed_readback(acc, self.route_stats), acc_groups, key_specs,
+                specs, lay, pcts=pcts,
             )
             DEVICE_EXECUTE_TIME.labels("groupby").observe(_t.monotonic() - t_start)
             if interim.num_rows == 0 and not sel.group_by:
@@ -1988,6 +2015,7 @@ class TpuQueryExecutor(QueryExecutor):
             program = jax.jit(run)
             _PROGRAM_CACHE[key] = program
         gathered, idx = program(acc)
+        self.route_stats["d2h_bytes"] += gathered.size * 4 + idx.size * 4
         return np.asarray(gathered, np.float64), np.asarray(idx)
 
     # ----------------------------------------------- high-card (block-local)
@@ -2093,7 +2121,7 @@ class TpuQueryExecutor(QueryExecutor):
             tuple(sorted(dev.keys())),
             num_groups,
         )
-        out = _timed_readback(program(dev, dev_luts, row_mask))
+        out = _timed_readback(program(dev, dev_luts, row_mask), self.route_stats)
         pt = self._partial_from_arrays(
             out, lay, keyinfo, specs, composite_vals=composite_vals,
         )
@@ -2339,7 +2367,7 @@ class TpuQueryExecutor(QueryExecutor):
         """Dense global accumulator -> partial table (used when switching to
         block-local mode mid-query: the dense epoch's results merge through
         the same vectorized group_by as the block partials)."""
-        arr = _timed_readback(acc)
+        arr = _timed_readback(acc, self.route_stats)
         keyinfo: list[tuple] = []
         for ks in key_specs:
             if ks.kind == "dict":
@@ -2362,15 +2390,19 @@ class TpuQueryExecutor(QueryExecutor):
 
         total = num_groups * DEVICE_NB
         if self.mesh is not None or total <= (1 << 20):
-            return np.asarray(_timed_readback(h)).reshape(num_groups, DEVICE_NB)
+            return np.asarray(
+                _timed_readback(h, self.route_stats)
+            ).reshape(num_groups, DEVICE_NB)
         mat = h.reshape(num_groups, DEVICE_NB)
         colsum = np.asarray(jnp.sum(mat, axis=0))  # NB-sized, ~8 KB
         active = np.nonzero(colsum > 0)[0]
         if len(active) * 2 >= DEVICE_NB:
-            return np.asarray(_timed_readback(h)).reshape(num_groups, DEVICE_NB)
+            return np.asarray(
+                _timed_readback(h, self.route_stats)
+            ).reshape(num_groups, DEVICE_NB)
         out = np.zeros((num_groups, DEVICE_NB))
         if len(active):
-            gathered = _timed_readback(mat[:, jnp.asarray(active)])
+            gathered = _timed_readback(mat[:, jnp.asarray(active)], self.route_stats)
             out[:, active] = gathered.reshape(num_groups, len(active))
         return out
 

@@ -330,6 +330,23 @@ class QuerySession:
                 if st.get(k) is not None:  # composite paths carry no scan stats
                     parts.append(f"{k}={st[k]}")
             plans.append(" ".join(parts))
+            routes = st.get("device_routes")
+            if routes is not None:
+                # adaptive dispatch, observable without a profiler
+                # (VERDICT r3 #10): where each block ran and what the
+                # link actually carried, plus the measured link profile
+                # the routing decisions priced against
+                plan_types.append("device_routes")
+                plans.append(
+                    " ".join(f"{k}={v}" for k, v in sorted(routes.items()))
+                )
+                from parseable_tpu.ops.link import get_link
+
+                snap = get_link(self.p.options).snapshot()
+                plan_types.append("link_profile")
+                plans.append(
+                    " ".join(f"{k}={v:.4g}" for k, v in sorted(snap.items()))
+                )
 
         table = pa.table({"plan_type": plan_types, "plan": plans})
         return QueryResult(
@@ -763,6 +780,11 @@ class QuerySession:
             tables = scan.tables()
         table = executor.execute(tables)
         stats = {"engine_fallback": "device unhealthy"} if fallback else {}
+        routes = getattr(executor, "route_stats", None)
+        if routes is not None:
+            # adaptive-dispatch observability (EXPLAIN ANALYZE surfaces
+            # this): per-block route decisions + actual transfer bytes
+            stats["device_routes"] = dict(routes)
         return QueryResult(table, table.column_names, stats)
 
     @staticmethod
