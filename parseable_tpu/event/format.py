@@ -412,6 +412,21 @@ def prepare_and_decode_fast(
         union_keys.update(rec)
     if len(union_keys) != len(tbl.column_names):
         return None
+    return fast_columns_from_table(tbl, stored_schema, infer_timestamp, records)
+
+
+def fast_columns_from_table(
+    tbl: pa.Table,
+    stored_schema: dict[str, pa.Field] | None,
+    infer_timestamp: bool = True,
+    records: list[dict[str, Any]] | None = None,
+) -> tuple[pa.RecordBatch, pa.Schema] | None:
+    """Column-normalization half of the fast path, shared with the native
+    ingest lane (server/ingest_utils.py): the table there comes from
+    pyarrow's JSON reader over natively-flattened NDJSON, so `records` is
+    None — record-dependent guards are replaced by reader-level facts (a
+    bool mixed into a numeric column makes read_json raise rather than
+    coerce)."""
     import pyarrow.compute as pc
 
     stored = stored_schema or {}
@@ -434,13 +449,24 @@ def prepare_and_decode_fast(
         elif pa.types.is_integer(t) or pa.types.is_floating(t):
             # pyarrow treats Python bool as numeric: a bool mixed into a
             # numeric column would silently become 1.0/0.0 here, while the
-            # slow path types the column string — decline instead
-            if any(isinstance(rec.get(raw_name), bool) for rec in records):
+            # slow path types the column string — decline instead (read_json
+            # sources can't mix: the reader raises on bool-in-number)
+            if records is not None and any(
+                isinstance(rec.get(raw_name), bool) for rec in records
+            ):
                 return None
             target = pa.float64()
         elif pa.types.is_string(t) or pa.types.is_large_string(t):
             target = pa.string()
         elif pa.types.is_timestamp(t):
+            # read_json eagerly parses ISO-looking strings into timestamps
+            # regardless of field name; the slow path only infers time for
+            # time-ish names — decline the mismatch instead of committing
+            if records is None and not (
+                _is_timestampy(name)
+                or (stored.get(name) is not None and pa.types.is_timestamp(stored[name].type))
+            ):
+                return None
             target = pa.timestamp("ms")
         else:
             return None

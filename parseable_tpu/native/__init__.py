@@ -60,12 +60,55 @@ def _load() -> ctypes.CDLL | None:
     lib.ptpu_hll_serialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptpu_hll_deserialize.restype = ctypes.c_int
     lib.ptpu_hll_deserialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_flatten_ndjson.restype = ctypes.c_int
+    lib.ptpu_flatten_ndjson.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ptpu_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def flatten_ndjson(payload: bytes, max_depth: int, separator: str = "_") -> tuple[bytes, int] | None:
+    """Native parse+flatten of a JSON ingest payload straight to NDJSON
+    (fastpath.cpp ptpu_flatten_ndjson). Returns (ndjson_bytes, nrows), or
+    None when the payload needs the exact Python flatten path (arrays,
+    sparse/duplicate keys, over-depth nesting, nonstandard tokens, no
+    native library) — the caller falls back with identical semantics.
+    Malformed JSON also returns None so the Python json.loads produces
+    the user-facing parse error."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_uint64()
+    nrows = ctypes.c_uint64()
+    rc = lib.ptpu_flatten_ndjson(
+        payload,
+        len(payload),
+        max_depth,
+        separator.encode(),
+        ctypes.byref(out),
+        ctypes.byref(out_len),
+        ctypes.byref(nrows),
+    )
+    if rc != 0:
+        return None
+    try:
+        data = ctypes.string_at(out.value, out_len.value)
+    finally:
+        lib.ptpu_free(out)
+    return data, int(nrows.value)
 
 
 def xxh64(data: bytes, seed: int = 0) -> int:

@@ -212,3 +212,220 @@ int ptpu_hll_deserialize(void* ptr, const uint8_t* data, uint64_t len) {
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------- JSON flatten (ingest)
+//
+// ptpu_flatten_ndjson: parse an ingest payload (JSON object or array of
+// objects) and emit the FLATTENED records as NDJSON, one line per record,
+// nested-object keys joined with `sep` — the wire format pyarrow's C++
+// JSON reader consumes directly, so the Python ingest hot loop
+// (utils/flatten.py generic_flattening + flatten + dict building, ~75% of
+// ingest time) never materializes Python dicts on this path.
+//
+// CONSERVATIVE by design: any shape whose flatten semantics involve more
+// than dotted-key collapsing returns PTPU_FJ_FALLBACK and the caller runs
+// the exact Python path. That covers: any array value (cross-product /
+// columnar-array semantics), depth over the configured limit, records
+// whose key sets differ (the Python fast path declines those too),
+// duplicate flattened keys (dict last-wins is position-dependent),
+// non-object records, nonstandard tokens (NaN/Infinity — Python's json
+// accepts them), and empty records.
+
+extern "C" {
+
+enum { PTPU_FJ_OK = 0, PTPU_FJ_FALLBACK = 1, PTPU_FJ_INVALID = 2 };
+
+}  // extern "C"
+
+#include <string>
+#include <vector>
+#include <algorithm>
+#include <cstdlib>
+
+namespace {
+
+struct FlattenCtx {
+    const char* p;
+    const char* end;
+    int max_depth;
+    const char* sep;
+    size_t seplen;
+    std::string out;              // NDJSON result
+    std::string row;              // current record
+    std::vector<std::string> cur_keys;
+    std::vector<std::string> first_keys;  // sorted key set of record 0
+    uint64_t nrows = 0;
+    int rc = PTPU_FJ_OK;
+
+    bool fail(int code) { rc = code; return false; }
+
+    void skip_ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+    }
+
+    // span of a JSON string INCLUDING quotes; escapes preserved verbatim
+    bool string_span(const char*& s0, const char*& s1) {
+        if (p >= end || *p != '"') return fail(PTPU_FJ_INVALID);
+        s0 = p++;
+        while (p < end) {
+            if (*p == '\\') { p += 2; continue; }
+            if (*p == '"') { s1 = ++p; return true; }
+            p++;
+        }
+        return fail(PTPU_FJ_INVALID);
+    }
+
+    // span of a scalar value (string/number/true/false/null), verbatim
+    bool scalar_span(const char*& v0, const char*& v1) {
+        if (p >= end) return fail(PTPU_FJ_INVALID);
+        char c = *p;
+        if (c == '"') return string_span(v0, v1);
+        if (c == 't' || c == 'f' || c == 'n') {
+            const char* kw = c == 't' ? "true" : (c == 'f' ? "false" : "null");
+            size_t n = std::strlen(kw);
+            if ((size_t)(end - p) < n || std::strncmp(p, kw, n) != 0)
+                return fail(PTPU_FJ_FALLBACK);  // NaN, etc.: Python decides
+            v0 = p; p += n; v1 = p;
+            return true;
+        }
+        if (c == '-' || (c >= '0' && c <= '9')) {
+            v0 = p;
+            if (*p == '-') p++;
+            if (p < end && (*p == 'I' || *p == 'N'))
+                return fail(PTPU_FJ_FALLBACK);  // -Infinity / NaN
+            while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' ||
+                               *p == 'e' || *p == 'E' || *p == '+' || *p == '-'))
+                p++;
+            v1 = p;
+            return v1 > v0 ? true : fail(PTPU_FJ_INVALID);
+        }
+        if (c == 'N' || c == 'I') return fail(PTPU_FJ_FALLBACK);
+        return fail(PTPU_FJ_INVALID);
+    }
+
+    // flatten one object's members into `row`; prefix is the raw (escaped)
+    // joined key text, without quotes
+    bool flatten_obj(std::string& prefix, int depth) {
+        if (depth > max_depth) return fail(PTPU_FJ_FALLBACK);
+        if (p >= end || *p != '{') return fail(PTPU_FJ_INVALID);
+        p++;
+        skip_ws();
+        if (p < end && *p == '}') { p++; return true; }
+        while (true) {
+            skip_ws();
+            const char* k0; const char* k1;
+            if (!string_span(k0, k1)) return false;
+            skip_ws();
+            if (p >= end || *p != ':') return fail(PTPU_FJ_INVALID);
+            p++;
+            skip_ws();
+            size_t plen = prefix.size();
+            if (plen) prefix.append(sep, seplen);
+            prefix.append(k0 + 1, (size_t)(k1 - k0) - 2);
+            if (p < end && *p == '{') {
+                if (!flatten_obj(prefix, depth + 1)) return false;
+            } else if (p < end && *p == '[') {
+                return fail(PTPU_FJ_FALLBACK);  // array semantics: Python
+            } else {
+                const char* v0; const char* v1;
+                if (!scalar_span(v0, v1)) return false;
+                if (row.size() > 1) row += ',';
+                row += '"';
+                row.append(prefix);
+                row += '"';
+                row += ':';
+                row.append(v0, (size_t)(v1 - v0));
+                cur_keys.emplace_back(prefix);
+            }
+            prefix.resize(plen);
+            skip_ws();
+            if (p < end && *p == ',') { p++; continue; }
+            if (p < end && *p == '}') { p++; return true; }
+            return fail(PTPU_FJ_INVALID);
+        }
+    }
+
+    bool record() {
+        skip_ws();
+        if (p >= end || *p != '{')
+            return fail(PTPU_FJ_FALLBACK);  // non-object element
+        row.clear();
+        row += '{';
+        cur_keys.clear();
+        std::string prefix;
+        if (!flatten_obj(prefix, 1)) return false;
+        if (cur_keys.empty()) return fail(PTPU_FJ_FALLBACK);
+        std::sort(cur_keys.begin(), cur_keys.end());
+        for (size_t i = 1; i < cur_keys.size(); i++)
+            if (cur_keys[i] == cur_keys[i - 1])
+                return fail(PTPU_FJ_FALLBACK);  // duplicate flattened key
+        if (nrows == 0) {
+            first_keys = cur_keys;
+        } else if (cur_keys != first_keys) {
+            return fail(PTPU_FJ_FALLBACK);  // sparse keys: Python declines too
+        }
+        row += '}';
+        row += '\n';
+        out += row;
+        nrows++;
+        return true;
+    }
+
+    bool run() {
+        skip_ws();
+        if (p >= end) return fail(PTPU_FJ_INVALID);
+        if (*p == '[') {
+            p++;
+            skip_ws();
+            if (p < end && *p == ']') { p++; }
+            else {
+                while (true) {
+                    if (!record()) return false;
+                    skip_ws();
+                    if (p < end && *p == ',') { p++; continue; }
+                    if (p < end && *p == ']') { p++; break; }
+                    return fail(PTPU_FJ_INVALID);
+                }
+            }
+        } else if (*p == '{') {
+            if (!record()) return false;
+        } else {
+            return fail(PTPU_FJ_FALLBACK);
+        }
+        skip_ws();
+        if (p != end) return fail(PTPU_FJ_INVALID);
+        return true;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns PTPU_FJ_OK and malloc'd NDJSON in *out (free with ptpu_free),
+// PTPU_FJ_FALLBACK when the payload needs the exact Python path, or
+// PTPU_FJ_INVALID for malformed JSON (caller surfaces the parse error
+// through the Python path's own json.loads for a consistent message).
+int ptpu_flatten_ndjson(const char* in, uint64_t len, int max_depth,
+                        const char* sep, char** out, uint64_t* out_len,
+                        uint64_t* nrows) {
+    FlattenCtx ctx;
+    ctx.p = in;
+    ctx.end = in + len;
+    ctx.max_depth = max_depth;
+    ctx.sep = sep;
+    ctx.seplen = std::strlen(sep);
+    ctx.out.reserve((size_t)(len + len / 4));
+    if (!ctx.run()) return ctx.rc;
+    char* buf = (char*)std::malloc(ctx.out.size());
+    if (!buf) return PTPU_FJ_FALLBACK;
+    std::memcpy(buf, ctx.out.data(), ctx.out.size());
+    *out = buf;
+    *out_len = ctx.out.size();
+    *nrows = ctx.nrows;
+    return PTPU_FJ_OK;
+}
+
+void ptpu_free(void* ptr) { std::free(ptr); }
+
+}  // extern "C"

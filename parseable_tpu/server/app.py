@@ -440,16 +440,19 @@ async def _do_ingest(
     body = await request.read()
     if len(body) > state.p.options.max_event_payload_bytes:
         return web.json_response({"error": "payload too large"}, status=413)
-    try:
-        payload = json.loads(body)
-    except json.JSONDecodeError as e:
-        return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+    # json.loads is deferred: the native ingest lane parses the raw bytes
+    # in C++ and the Python dict tree never materializes on clean payloads
+    payload = None
 
     # tenant suspension/quota (reference: tenants/mod.rs:31-160; header
     # extraction utils/mod.rs:123) — the lookup hits the metastore, so it
     # runs on the worker pool, never the event loop
     tenant = request.headers.get("X-P-Tenant")
     if tenant:
+        try:
+            payload = json.loads(body)
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
         approx_rows = len(payload) if isinstance(payload, list) else 1
         rejection = await asyncio.get_running_loop().run_in_executor(
             state.workers, state.tenants.check_ingest, tenant, approx_rows
@@ -473,6 +476,7 @@ async def _do_ingest(
             custom_fields,
             origin_size=len(body),
             log_source_name=log_source_name,
+            raw_body=body,
         )
 
     try:

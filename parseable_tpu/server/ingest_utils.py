@@ -101,19 +101,106 @@ def flatten_and_push_logs(
     custom_fields: dict[str, str] | None = None,
     origin_size: int = 0,
     log_source_name: str | None = None,
+    raw_body: bytes | None = None,
 ) -> int:
     """Parse+flatten by source, then push into staging. Returns row count.
 
     `log_source_name` carries the raw X-P-Log-Source value: names matching a
     known format (event/known_schema.py) get regex field extraction applied
     to each record's raw line (reference: KNOWN_SCHEMA_LIST
-    extract_from_inline_log, ingest.rs:114-122)."""
+    extract_from_inline_log, ingest.rs:114-122).
+
+    `raw_body` (the undecoded HTTP payload) enables the native ingest lane:
+    C++ parse+flatten straight to NDJSON -> pyarrow JSON reader -> columnar
+    batch, with Python dicts never materializing. `payload` may then be
+    None — it parses lazily only if the native lane declines."""
     from parseable_tpu.utils.telemetry import TRACER
 
     with TRACER.span("ingest", stream=stream_name, source=log_source.value):
         return _flatten_and_push(
-            p, stream_name, payload, log_source, custom_fields, origin_size, log_source_name
+            p, stream_name, payload, log_source, custom_fields, origin_size,
+            log_source_name, raw_body,
         )
+
+
+def _parse_payload(payload: Any, raw_body: bytes | None) -> Any:
+    if payload is not None or raw_body is None:
+        return payload
+    try:
+        return json.loads(raw_body)
+    except json.JSONDecodeError as e:
+        raise IngestError(f"invalid JSON: {e}") from e
+
+
+def ingest_native_fast(
+    p: Parseable,
+    stream_name: str,
+    raw_body: bytes,
+    log_source: LogSource,
+    custom_fields: dict[str, str] | None,
+) -> int | None:
+    """Native ingest lane (VERDICT r4 #7: the flatten hot loop was ~75% of
+    ingest time): fastpath.cpp parses the payload and emits flattened
+    NDJSON, pyarrow's C++ JSON reader builds the columns, and the shared
+    fast-path normalization types them — per-record Python never runs.
+
+    Returns the row count, or None whenever ANY stage prefers the exact
+    Python semantics (arrays, sparse/duplicate keys, depth, mixed types,
+    partial timestamp parses, static/partitioned streams) — behavior is
+    identical either way because every decline falls through."""
+    import io
+    from datetime import UTC, datetime
+
+    import pyarrow as pa
+    import pyarrow.json as pj
+
+    from parseable_tpu import native
+    from parseable_tpu.event import Event
+    from parseable_tpu.event.format import SchemaVersion, fast_columns_from_table
+    from parseable_tpu.utils.arrowutil import add_parseable_fields
+
+    stream = p.get_stream(stream_name)
+    meta = stream.metadata
+    if (
+        meta.time_partition is not None
+        or meta.custom_partition is not None
+        or meta.static_schema_flag
+        or meta.schema_version != SchemaVersion.V1
+    ):
+        return None
+    # C++ depth N == python-level N+1 (scalars sit one level below the
+    # deepest dict), so the native limit is max_flatten_level - 1 exactly
+    r = native.flatten_ndjson(raw_body, p.options.event_flatten_level - 1)
+    if r is None:
+        return None
+    ndjson, nrows = r
+    if nrows == 0:
+        return 0
+    try:
+        tbl = pj.read_json(io.BytesIO(ndjson))
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return None  # reader-level type conflict: Python path decides
+    if len(tbl.column_names) > p.options.dataset_fields_allowed_limit:
+        raise IngestError(
+            f"fields ({len(tbl.column_names)}) exceed dataset limit "
+            f"({p.options.dataset_fields_allowed_limit})"
+        )
+    fast = fast_columns_from_table(tbl, meta.schema or None, meta.infer_timestamp)
+    if fast is None:
+        return None
+    batch, _schema = fast
+    batch = add_parseable_fields(batch, datetime.now(UTC), custom_fields or {})
+    ev = Event(
+        stream_name=stream_name,
+        rb=batch,
+        origin_format="json",
+        origin_size=len(raw_body),
+        is_first_event=not meta.schema,
+        log_source=log_source,
+        stream_type=meta.stream_type,
+    )
+    ev.process(stream, livetail=LIVETAIL.process, commit_schema=p.commit_schema)
+    return batch.num_rows
 
 
 def _flatten_and_push(
@@ -124,9 +211,23 @@ def _flatten_and_push(
     custom_fields: dict[str, str] | None = None,
     origin_size: int = 0,
     log_source_name: str | None = None,
+    raw_body: bytes | None = None,
 ) -> int:
     stream = p.get_stream(stream_name)
     meta = stream.metadata
+
+    plain_json = log_source == LogSource.JSON or (
+        log_source == LogSource.CUSTOM and not log_source_name
+    )
+    if not plain_json and log_source == LogSource.CUSTOM and log_source_name:
+        from parseable_tpu.event.known_schema import KNOWN_FORMATS
+
+        plain_json = log_source_name not in KNOWN_FORMATS
+    if raw_body is not None and plain_json:
+        count = ingest_native_fast(p, stream_name, raw_body, log_source, custom_fields)
+        if count is not None:
+            return count
+    payload = _parse_payload(payload, raw_body)
 
     if log_source == LogSource.OTEL_LOGS:
         rows = flatten_otel_logs(payload)
