@@ -32,7 +32,13 @@ import numpy as np
 import pyarrow as pa
 
 
-def build_dataset(p, stream_name: str, total_rows: int, profile: str = "default") -> None:
+def build_dataset(
+    p,
+    stream_name: str,
+    total_rows: int,
+    profile: str = "default",
+    sync_every: int | None = None,
+) -> None:
     """Synthesize an access-log stream through the real pipeline.
 
     Profiles (VERDICT r2 "de-rig the benchmark"):
@@ -122,6 +128,13 @@ def build_dataset(p, stream_name: str, total_rows: int, profile: str = "default"
             ev.process(stream, commit_schema=p.commit_schema)
         written += n
         minute += 1
+        if sync_every and minute % sync_every == 0:
+            # large builds: convert + upload as we go so staging arrows
+            # (uncompressed, ~3x the parquet bytes) never accumulate —
+            # the backdated minute buckets all count as past, so a plain
+            # local_sync finishes and compacts everything written so far
+            p.local_sync(shutdown=True)
+            p.sync_all_streams()
     p.local_sync(shutdown=True)
     p.sync_all_streams()
 
