@@ -70,6 +70,15 @@ def _load() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
     ]
+    lib.ptpu_otel_logs_ndjson.restype = ctypes.c_int
+    lib.ptpu_otel_logs_ndjson.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.ptpu_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
@@ -106,6 +115,42 @@ def flatten_ndjson(payload: bytes, max_depth: int, separator: str = "_") -> tupl
         return None
     try:
         data = ctypes.string_at(out.value, out_len.value)
+    finally:
+        lib.ptpu_free(out)
+    return data, int(nrows.value)
+
+
+def otel_logs_ndjson(payload: bytes, ts_as_ms: bool = True) -> tuple[bytes, int] | None:
+    """Native OTLP-JSON logs flatten straight to NDJSON (fastpath.cpp
+    ptpu_otel_logs_ndjson). Returns (ndjson_bytes, nrows), or None when
+    the payload needs the exact Python flattener (nested AnyValues,
+    escaped keys, duplicate flattened keys, bool/fractional timestamps,
+    no native library) — the caller falls back with identical semantics.
+    Malformed JSON also returns None so the Python json.loads produces
+    the user-facing parse error.
+
+    ts_as_ms: emit time fields as integer epoch-milliseconds (for streams
+    that infer timestamps — the caller casts int64 -> timestamp(ms)
+    without string parsing); False emits RFC3339-microseconds strings,
+    matching the Python flattener's wire values verbatim."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_uint64()
+    nrows = ctypes.c_uint64()
+    rc = lib.ptpu_otel_logs_ndjson(
+        payload,
+        len(payload),
+        1 if ts_as_ms else 0,
+        ctypes.byref(out),
+        ctypes.byref(out_len),
+        ctypes.byref(nrows),
+    )
+    if rc != 0:
+        return None
+    try:
+        data = ctypes.string_at(out.value, out_len.value) if out_len.value else b""
     finally:
         lib.ptpu_free(out)
     return data, int(nrows.value)

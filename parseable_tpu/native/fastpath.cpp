@@ -289,15 +289,31 @@ struct FlattenCtx {
             return true;
         }
         if (c == '-' || (c >= '0' && c <= '9')) {
+            // strict JSON number grammar: the token is re-emitted verbatim,
+            // so lax scanning (e.g. leading-zero "00") would ingest
+            // malformed JSON instead of erroring via Python's json.loads
             v0 = p;
             if (*p == '-') p++;
             if (p < end && (*p == 'I' || *p == 'N'))
                 return fail(PTPU_FJ_FALLBACK);  // -Infinity / NaN
-            while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' ||
-                               *p == 'e' || *p == 'E' || *p == '+' || *p == '-'))
+            if (p >= end || *p < '0' || *p > '9') return fail(PTPU_FJ_INVALID);
+            if (*p == '0') p++;
+            else while (p < end && *p >= '0' && *p <= '9') p++;
+            if (p < end && *p == '.') {
                 p++;
+                const char* d0 = p;
+                while (p < end && *p >= '0' && *p <= '9') p++;
+                if (p == d0) return fail(PTPU_FJ_INVALID);
+            }
+            if (p < end && (*p == 'e' || *p == 'E')) {
+                p++;
+                if (p < end && (*p == '+' || *p == '-')) p++;
+                const char* d0 = p;
+                while (p < end && *p >= '0' && *p <= '9') p++;
+                if (p == d0) return fail(PTPU_FJ_INVALID);
+            }
             v1 = p;
-            return v1 > v0 ? true : fail(PTPU_FJ_INVALID);
+            return true;
         }
         if (c == 'N' || c == 'I') return fail(PTPU_FJ_FALLBACK);
         return fail(PTPU_FJ_INVALID);
@@ -427,5 +443,770 @@ int ptpu_flatten_ndjson(const char* in, uint64_t len, int max_depth,
 }
 
 void ptpu_free(void* ptr) { std::free(ptr); }
+
+}  // extern "C"
+
+// ---------------------------------------------------- OTel logs flatten lane
+//
+// ptpu_otel_logs_ndjson: parse an OTLP-JSON logs payload and emit the rows
+// flatten_otel_logs (otel/logs.py, reference src/otel/logs.rs:298) would
+// build, as NDJSON for pyarrow's reader — resource/scope attrs prefixed,
+// severity enriched, timeUnixNano formatted RFC3339-microseconds. The
+// per-record Python structure walk was ~14x slower than the plain-JSON
+// lane (VERDICT r4 #3); this keeps OTel ingest native end-to-end.
+//
+// CONSERVATIVE like the JSON lane: any shape whose Python semantics go
+// beyond verbatim scalar transfer (nested AnyValues, bool timestamps,
+// fractional ints, duplicate flattened keys, escaped keys, non-object
+// records) returns FALLBACK and the exact Python path runs instead.
+
+#include <string_view>
+
+// anonymous namespace: internal linkage so the compiler can inline across
+// these helpers inside the -fPIC shared object (a named namespace leaves
+// them interposable, which blocked inlining and cost ~6x on the hot walk)
+namespace {
+namespace otelj {
+
+enum { OK = PTPU_FJ_OK, FB = PTPU_FJ_FALLBACK, INV = PTPU_FJ_INVALID };
+
+struct Span {
+    const char* b = nullptr;
+    const char* e = nullptr;
+    bool present() const { return b != nullptr; }
+    size_t len() const { return (size_t)(e - b); }
+    std::string_view view() const { return std::string_view(b, len()); }
+};
+
+// token kinds by first byte of a value span
+enum Kind { K_STR, K_NUM, K_OBJ, K_ARR, K_TRUE, K_FALSE, K_NULL, K_BAD };
+
+static Kind kind_of(const Span& v) {
+    if (!v.present() || v.len() == 0) return K_BAD;
+    switch (*v.b) {
+        case '"': return K_STR;
+        case '{': return K_OBJ;
+        case '[': return K_ARR;
+        case 't': return K_TRUE;
+        case 'f': return K_FALSE;
+        case 'n': return K_NULL;
+        default: return K_NUM;
+    }
+}
+
+// string span content (inside the quotes, escapes preserved)
+static Span str_content(const Span& s) { return {s.b + 1, s.e - 1}; }
+
+struct Cur {
+    const char* p;
+    const char* end;
+    int rc = OK;
+
+    bool fail(int c) { rc = c; return false; }
+
+    inline void ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+    }
+
+    // memchr-based string scan: most payload bytes live inside strings,
+    // and the vectorized closing-quote search is ~5x the byte loop
+    inline bool str_span(Span& s) {
+        if (p >= end || *p != '"') return fail(INV);
+        s.b = p++;
+        while (true) {
+            const char* q = (const char*)std::memchr(p, '"', (size_t)(end - p));
+            if (q == nullptr) return fail(INV);
+            // a quote preceded by an odd number of backslashes is escaped
+            const char* r = q;
+            while (r > p && r[-1] == '\\') r--;
+            if (((size_t)(q - r) & 1) == 0) {
+                s.e = p = q + 1;
+                return true;
+            }
+            p = q + 1;
+        }
+    }
+
+    bool skip_value(int depth) {
+        if (depth > 48) return fail(FB);
+        ws();
+        if (p >= end) return fail(INV);
+        char c = *p;
+        if (c == '"') { Span s; return str_span(s); }
+        if (c == '{') {
+            p++;
+            ws();
+            if (p < end && *p == '}') { p++; return true; }
+            while (true) {
+                ws();
+                Span k;
+                if (!str_span(k)) return false;
+                ws();
+                if (p >= end || *p != ':') return fail(INV);
+                p++;
+                if (!skip_value(depth + 1)) return false;
+                ws();
+                if (p < end && *p == ',') { p++; continue; }
+                if (p < end && *p == '}') { p++; return true; }
+                return fail(INV);
+            }
+        }
+        if (c == '[') {
+            p++;
+            ws();
+            if (p < end && *p == ']') { p++; return true; }
+            while (true) {
+                if (!skip_value(depth + 1)) return false;
+                ws();
+                if (p < end && *p == ',') { p++; continue; }
+                if (p < end && *p == ']') { p++; return true; }
+                return fail(INV);
+            }
+        }
+        if (c == 't' || c == 'f' || c == 'n') {
+            const char* kw = c == 't' ? "true" : (c == 'f' ? "false" : "null");
+            size_t n = std::strlen(kw);
+            if ((size_t)(end - p) < n || std::strncmp(p, kw, n) != 0) return fail(FB);
+            p += n;
+            return true;
+        }
+        if (c == '-' || (c >= '0' && c <= '9')) {
+            // strict JSON number grammar: tokens are re-emitted verbatim
+            // and re-parsed (parse_i64), so a lax scan would let malformed
+            // input (e.g. leading-zero "00") ingest instead of erroring
+            // through the Python json.loads path
+            if (*p == '-') p++;
+            if (p < end && (*p == 'I' || *p == 'N')) return fail(FB);
+            if (p >= end || *p < '0' || *p > '9') return fail(INV);
+            if (*p == '0') p++;
+            else while (p < end && *p >= '0' && *p <= '9') p++;
+            if (p < end && *p == '.') {
+                p++;
+                const char* d0 = p;
+                while (p < end && *p >= '0' && *p <= '9') p++;
+                if (p == d0) return fail(INV);
+            }
+            if (p < end && (*p == 'e' || *p == 'E')) {
+                p++;
+                if (p < end && (*p == '+' || *p == '-')) p++;
+                const char* d0 = p;
+                while (p < end && *p >= '0' && *p <= '9') p++;
+                if (p == d0) return fail(INV);
+            }
+            return true;
+        }
+        if (c == 'N' || c == 'I') return fail(FB);
+        return fail(INV);
+    }
+
+    bool value_span(Span& v, int depth) {
+        ws();
+        v.b = p;
+        if (!skip_value(depth)) return false;
+        v.e = p;
+        return true;
+    }
+};
+
+struct Member {
+    Span key;  // content, no quotes, escapes preserved
+    Span val;
+};
+
+// Parse the object at the cursor into member (key, value-span) pairs.
+// Duplicate keys (byte-exact) and escaped keys fall back: Python's
+// json.loads collapses dupes last-wins and unescapes keys — per-payload
+// rarities not worth replicating.
+static bool collect(Cur& c, std::vector<Member>& out, int depth) {
+    out.clear();
+    c.ws();
+    if (c.p >= c.end || *c.p != '{') return c.fail(FB);
+    c.p++;
+    c.ws();
+    if (c.p < c.end && *c.p == '}') { c.p++; return true; }
+    while (true) {
+        c.ws();
+        Span k;
+        if (!c.str_span(k)) return false;
+        Span kc = str_content(k);
+        if (kc.view().find('\\') != std::string_view::npos) return c.fail(FB);
+        c.ws();
+        if (c.p >= c.end || *c.p != ':') return c.fail(INV);
+        c.p++;
+        Span v;
+        if (!c.value_span(v, depth + 1)) return false;
+        for (const auto& m : out)
+            if (m.key.view() == kc.view()) return c.fail(FB);
+        out.push_back({kc, v});
+        c.ws();
+        if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+        if (c.p < c.end && *c.p == '}') { c.p++; return true; }
+        return c.fail(INV);
+    }
+}
+
+static Span find(const std::vector<Member>& ms, std::string_view key) {
+    for (const auto& m : ms)
+        if (m.key.view() == key) return m.val;
+    return Span{};
+}
+
+// ---- scalar parsing helpers ------------------------------------------------
+
+static bool parse_i64(std::string_view s, long long& out) {
+    if (s.empty() || s.size() > 20) return false;
+    size_t i = 0;
+    bool neg = false;
+    if (s[0] == '+' || s[0] == '-') { neg = s[0] == '-'; i = 1; }
+    if (i >= s.size()) return false;
+    unsigned long long acc = 0;
+    for (; i < s.size(); i++) {
+        if (s[i] < '0' || s[i] > '9') return false;
+        unsigned d = (unsigned)(s[i] - '0');
+        if (acc > (0xFFFFFFFFFFFFFFFFULL - d) / 10) return false;
+        acc = acc * 10 + d;
+    }
+    if (neg) {
+        if (acc > 9223372036854775808ULL) return false;
+        out = acc == 9223372036854775808ULL ? INT64_MIN : -(long long)acc;
+    } else {
+        if (acc > 9223372036854775807ULL) return false;
+        out = (long long)acc;
+    }
+    return true;
+}
+
+// number token integer-valued? (no '.', 'e', 'E')
+static bool num_is_integer(std::string_view s) {
+    return s.find('.') == std::string_view::npos &&
+           s.find('e') == std::string_view::npos &&
+           s.find('E') == std::string_view::npos;
+}
+
+// strict JSON number grammar (what we re-emit unquoted must stay valid)
+static bool is_json_number(std::string_view s) {
+    size_t i = 0, n = s.size();
+    if (i < n && s[i] == '-') i++;
+    if (i >= n) return false;
+    if (s[i] == '0') { i++; }
+    else if (s[i] >= '1' && s[i] <= '9') { while (i < n && s[i] >= '0' && s[i] <= '9') i++; }
+    else return false;
+    if (i < n && s[i] == '.') {
+        i++;
+        size_t d0 = i;
+        while (i < n && s[i] >= '0' && s[i] <= '9') i++;
+        if (i == d0) return false;
+    }
+    if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+        i++;
+        if (i < n && (s[i] == '+' || s[i] == '-')) i++;
+        size_t d0 = i;
+        while (i < n && s[i] >= '0' && s[i] <= '9') i++;
+        if (i == d0) return false;
+    }
+    return i == n;
+}
+
+// is this JSON number token numerically zero? (sign/.../exponent cannot
+// make a nonzero mantissa zero, so only mantissa digits matter)
+static inline bool num_is_zero(std::string_view s) {
+    for (size_t i = 0; i < s.size(); i++) {
+        char c = s[i];
+        if (c >= '1' && c <= '9') return false;
+        if (c == 'e' || c == 'E') return true;  // mantissa was all zeros
+    }
+    return true;
+}
+
+// Python truthiness of a scalar token: non-empty string, nonzero number,
+// `true`. Returns -1 when the shape needs the Python path (nested).
+static inline int truthy(const Span& v) {
+    switch (kind_of(v)) {
+        case K_STR: return str_content(v).len() > 0;
+        case K_NUM: return num_is_zero(v.view()) ? 0 : 1;
+        case K_TRUE: return 1;
+        case K_FALSE: case K_NULL: return 0;
+        default: return -1;
+    }
+}
+
+// hand-rolled integer append (snprintf cost ~300ns/call dominated the walk)
+static inline void append_i64(std::string& out, long long v) {
+    char buf[24];
+    char* e = buf + 24;
+    char* q = e;
+    bool neg = v < 0;
+    unsigned long long u = neg ? (unsigned long long)(-(v + 1)) + 1 : (unsigned long long)v;
+    do { *--q = (char)('0' + u % 10); u /= 10; } while (u);
+    if (neg) *--q = '-';
+    out.append(q, (size_t)(e - q));
+}
+
+static inline void append_padded(char*& w, unsigned v, int width) {
+    for (int i = width - 1; i >= 0; i--) { w[i] = (char)('0' + v % 10); v /= 10; }
+    w += width;
+}
+
+// ---- RFC3339 (microseconds, Z) --------------------------------------------
+
+static long long floordiv(long long a, long long b) {
+    long long q = a / b;
+    if ((a % b) != 0 && ((a < 0) != (b < 0))) q--;
+    return q;
+}
+
+static bool fmt_rfc3339_us(long long ns, std::string& out) {
+    long long us = floordiv(ns, 1000);
+    long long days = floordiv(us, 86400000000LL);
+    long long rem = us - days * 86400000000LL;
+    // civil_from_days (Howard Hinnant's public-domain algorithm)
+    long long z = days + 719468;
+    long long era = (z >= 0 ? z : z - 146096) / 146097;
+    unsigned doe = (unsigned)(z - era * 146097);
+    unsigned yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+    long long y = (long long)yoe + era * 400;
+    unsigned doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+    unsigned mp = (5 * doy + 2) / 153;
+    unsigned d = doy - (153 * mp + 2) / 5 + 1;
+    unsigned m = mp < 10 ? mp + 3 : mp - 9;
+    y += (m <= 2);
+    if (y < 0 || y > 9999) return false;  // numpy prints these differently
+    unsigned hh = (unsigned)(rem / 3600000000LL);
+    rem %= 3600000000LL;
+    unsigned mm = (unsigned)(rem / 60000000LL);
+    rem %= 60000000LL;
+    unsigned ss = (unsigned)(rem / 1000000LL);
+    unsigned micro = (unsigned)(rem % 1000000LL);
+    char buf[36];
+    char* w = buf;
+    *w++ = '"';
+    append_padded(w, (unsigned)y, 4);
+    *w++ = '-';
+    append_padded(w, m, 2);
+    *w++ = '-';
+    append_padded(w, d, 2);
+    *w++ = 'T';
+    append_padded(w, hh, 2);
+    *w++ = ':';
+    append_padded(w, mm, 2);
+    *w++ = ':';
+    append_padded(w, ss, 2);
+    *w++ = '.';
+    append_padded(w, micro, 6);
+    *w++ = 'Z';
+    *w++ = '"';
+    out.append(buf, (size_t)(w - buf));
+    return true;
+}
+
+// ---- severity table --------------------------------------------------------
+
+static const char* SEVERITY_TEXT[25] = {
+    "SEVERITY_NUMBER_UNSPECIFIED",
+    "SEVERITY_NUMBER_TRACE", "SEVERITY_NUMBER_TRACE2", "SEVERITY_NUMBER_TRACE3",
+    "SEVERITY_NUMBER_TRACE4",
+    "SEVERITY_NUMBER_DEBUG", "SEVERITY_NUMBER_DEBUG2", "SEVERITY_NUMBER_DEBUG3",
+    "SEVERITY_NUMBER_DEBUG4",
+    "SEVERITY_NUMBER_INFO", "SEVERITY_NUMBER_INFO2", "SEVERITY_NUMBER_INFO3",
+    "SEVERITY_NUMBER_INFO4",
+    "SEVERITY_NUMBER_WARN", "SEVERITY_NUMBER_WARN2", "SEVERITY_NUMBER_WARN3",
+    "SEVERITY_NUMBER_WARN4",
+    "SEVERITY_NUMBER_ERROR", "SEVERITY_NUMBER_ERROR2", "SEVERITY_NUMBER_ERROR3",
+    "SEVERITY_NUMBER_ERROR4",
+    "SEVERITY_NUMBER_FATAL", "SEVERITY_NUMBER_FATAL2", "SEVERITY_NUMBER_FATAL3",
+    "SEVERITY_NUMBER_FATAL4",
+};
+
+// ---- row assembly ----------------------------------------------------------
+
+struct Builder {
+    std::string out;       // NDJSON
+    std::string row;       // current row body (no braces)
+    std::string base;      // per-scope-group shared fields fragment
+    std::vector<std::string> base_keys;
+    std::vector<std::string_view> base_row_keys;  // validated, per group
+    std::vector<std::string_view> row_keys;       // for dup detection
+    std::vector<Member> ms_a, ms_b, ms_c, ms_d;  // reused member buffers
+    uint64_t nrows = 0;
+    int rc = OK;
+    bool ts_as_ms = false;
+
+    bool fail(int c) { rc = c; return false; }
+
+    static void kv_open(std::string& frag, std::string_view prefix, std::string_view key) {
+        if (!frag.empty()) frag += ',';
+        frag += '"';
+        frag.append(prefix);
+        frag.append(key);
+        frag += "\":";
+    }
+
+    // AnyValue -> appended token. true on success; on nested/odd shapes
+    // sets rc=FB and returns false.
+    bool anyvalue(const Span& v, std::string& frag) {
+        switch (kind_of(v)) {
+            case K_STR: case K_NUM: case K_TRUE: case K_FALSE:
+                frag.append(v.view());
+                return true;
+            case K_NULL:
+                frag += "null";
+                return true;
+            case K_OBJ: {
+                Cur c{v.b, v.e};
+                if (!collect(c, ms_d, 0)) return fail(c.rc);
+                if (ms_d.size() != 1) return fail(FB);
+                std::string_view k = ms_d[0].key.view();
+                Span inner = ms_d[0].val;
+                if (k == "stringValue" || k == "bytesValue") {
+                    Kind ik = kind_of(inner);
+                    if (ik == K_OBJ || ik == K_ARR || ik == K_BAD) return fail(FB);
+                    if (ik == K_NULL) { frag += "null"; return true; }
+                    frag.append(inner.view());
+                    return true;
+                }
+                if (k == "intValue") {
+                    long long iv;
+                    if (kind_of(inner) == K_STR) {
+                        if (!parse_i64(str_content(inner).view(), iv)) return fail(FB);
+                    } else if (kind_of(inner) == K_NUM) {
+                        if (!num_is_integer(inner.view())) return fail(FB);
+                        if (!parse_i64(inner.view(), iv)) return fail(FB);
+                    } else {
+                        return fail(FB);
+                    }
+                    append_i64(frag, iv);
+                    return true;
+                }
+                if (k == "doubleValue") {
+                    if (kind_of(inner) == K_NUM) { frag.append(inner.view()); return true; }
+                    if (kind_of(inner) == K_STR && is_json_number(str_content(inner).view())) {
+                        frag.append(str_content(inner).view());
+                        return true;
+                    }
+                    return fail(FB);
+                }
+                if (k == "boolValue") {
+                    Kind ik = kind_of(inner);
+                    if (ik == K_TRUE || ik == K_FALSE) { frag.append(inner.view()); return true; }
+                    return fail(FB);
+                }
+                return fail(FB);  // arrayValue / kvlistValue / unknown
+            }
+            default:
+                return fail(FB);  // array or bad token
+        }
+    }
+
+    // attributes array -> fields appended to frag, emitted keys recorded
+    bool attributes(const Span& attrs, std::string_view prefix, std::string& frag,
+                    std::vector<std::string>* keys_out) {
+        Kind k = kind_of(attrs);
+        if (!attrs.present() || k == K_NULL) return true;
+        if (k != K_ARR) return fail(FB);
+        Cur c{attrs.b, attrs.e};
+        c.p++;  // '['
+        c.ws();
+        if (c.p < c.end && *c.p == ']') return true;
+        while (true) {
+            c.ws();
+            if (c.p >= c.end || *c.p != '{') return fail(FB);
+            if (!collect(c, ms_c, 0)) return fail(c.rc);
+            Span key = find(ms_c, "key");
+            std::string_view key_sv;
+            if (key.present()) {
+                if (kind_of(key) != K_STR) return fail(FB);
+                key_sv = str_content(key).view();
+            }
+            kv_open(frag, prefix, key_sv);
+            Span val = find(ms_c, "value");
+            if (!val.present()) { frag += "null"; }
+            else if (!anyvalue(val, frag)) return false;
+            if (keys_out != nullptr) {
+                std::string full(prefix);
+                full.append(key_sv);
+                keys_out->push_back(std::move(full));
+            } else {
+                // record attrs: span-backed views are stable for the row
+                if (!push_key_checked(key_sv)) return false;
+            }
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (c.p < c.end && *c.p == ']') return true;
+            return fail(INV);
+        }
+    }
+
+    // truthy scalar -> emit verbatim under `name`; nested -> FB
+    bool emit_if_truthy(const Span& v, std::string_view name, std::string& frag,
+                        std::vector<std::string>* keys_out) {
+        if (!v.present()) return true;
+        int t = truthy(v);
+        if (t < 0) return fail(FB);
+        if (t == 0) return true;
+        if (keys_out != nullptr) keys_out->emplace_back(name);
+        else if (!push_key_checked(name)) return false;
+        kv_open(frag, "", name);
+        frag.append(v.view());
+        return true;
+    }
+
+    // timeUnixNano / observedTimeUnixNano -> RFC3339 string or null; when
+    // ts_as_ms is set (the stream infers timestamps, so the column stages
+    // as timestamp(ms) either way) emit floor(ns/1e6) as an integer — the
+    // wrapper casts int64 -> timestamp(ms) without any string parsing,
+    // which was the pipeline's hottest stage
+    bool emit_time(const Span& v, std::string_view name) {
+        kv_open(row, "", name);
+        row_keys.push_back(name);
+        Kind k = kind_of(v);
+        if (!v.present() || k == K_NULL) { row += "null"; return true; }
+        long long ns;
+        if (k == K_NUM) {
+            if (!num_is_integer(v.view())) return fail(FB);
+            if (!parse_i64(v.view(), ns)) return fail(FB);  // bigint: Python path
+            if (ns == 0) { row += "null"; return true; }
+        } else if (k == K_STR) {
+            std::string_view s = str_content(v).view();
+            if (s.empty() || s == "0") { row += "null"; return true; }
+            bool has_digit = false;
+            for (char ch : s) {
+                if (ch >= '0' && ch <= '9') has_digit = true;
+                if ((unsigned char)ch >= 0x80)
+                    return fail(FB);  // int() accepts unicode digits
+            }
+            if (!parse_i64(s, ns)) {
+                // int(s) raises -> None; but digit-bearing oddities
+                // ("1_0", " 5", bigints) can still parse in Python
+                if (has_digit) return fail(FB);
+                row += "null";
+                return true;
+            }
+        } else {
+            return fail(FB);  // bool: int(True)=1 quirk, Python path
+        }
+        if (ts_as_ms) {
+            append_i64(row, floordiv(ns, 1000000LL));
+            return true;
+        }
+        if (!fmt_rfc3339_us(ns, row)) return fail(FB);
+        return true;
+    }
+
+    // Duplicate-key strategy (dict last-wins is position-dependent, so any
+    // dup falls back): base keys are validated pairwise once per scope
+    // group — they cannot collide with the fixed record field names (the
+    // resource_/scope_ prefixes and schema_url are disjoint from them) —
+    // and per record only attribute keys and the late fixed fields
+    // (dropped count, flags, trace_id, span_id) are checked against the
+    // keys already emitted.
+    bool scope_group(const Span& resource, const std::vector<Member>& scope_log) {
+        base.clear();
+        base_keys.clear();
+        // resource fields
+        if (resource.present()) {
+            Kind rk = kind_of(resource);
+            if (rk == K_OBJ) {
+                Cur c{resource.b, resource.e};
+                if (!collect(c, ms_b, 0)) return fail(c.rc);
+                if (!attributes(find(ms_b, "attributes"), "resource_", base, &base_keys))
+                    return false;
+                Span dropped = find(ms_b, "droppedAttributesCount");
+                if (dropped.present()) {  // `in` check: emitted even when 0/null
+                    Kind dk = kind_of(dropped);
+                    if (dk == K_OBJ || dk == K_ARR || dk == K_BAD) return fail(FB);
+                    kv_open(base, "", "resource_dropped_attributes_count");
+                    base.append(dropped.view());
+                    base_keys.emplace_back("resource_dropped_attributes_count");
+                }
+            } else if (truthy(resource) != 0) {
+                return fail(FB);  // truthy non-dict: Python raises
+            }
+        }
+        // scope fields
+        Span scope = find(scope_log, "scope");
+        if (scope.present()) {
+            Kind sk = kind_of(scope);
+            if (sk == K_OBJ) {
+                Cur c{scope.b, scope.e};
+                if (!collect(c, ms_b, 0)) return fail(c.rc);
+                if (!emit_if_truthy(find(ms_b, "name"), "scope_name", base, &base_keys))
+                    return false;
+                if (!emit_if_truthy(find(ms_b, "version"), "scope_version", base, &base_keys))
+                    return false;
+                if (!attributes(find(ms_b, "attributes"), "scope_", base, &base_keys))
+                    return false;
+            } else if (truthy(scope) != 0) {
+                return fail(FB);
+            }
+        }
+        if (!emit_if_truthy(find(scope_log, "schemaUrl"), "schema_url", base, &base_keys))
+            return false;
+        std::vector<std::string> sorted_keys(base_keys);
+        std::sort(sorted_keys.begin(), sorted_keys.end());
+        for (size_t i = 1; i < sorted_keys.size(); i++)
+            if (sorted_keys[i] == sorted_keys[i - 1]) return fail(FB);
+        // per-record key list starts as the (validated) base keys
+        base_row_keys.clear();
+        for (const auto& k : base_keys) base_row_keys.push_back(k);
+        return true;
+    }
+
+    bool push_key_checked(std::string_view k) {
+        for (const auto& seen : row_keys)
+            if (seen == k) return fail(FB);
+        row_keys.push_back(k);
+        return true;
+    }
+
+    bool log_record(const std::vector<Member>& rec) {
+        row.clear();
+        row_keys.assign(base_row_keys.begin(), base_row_keys.end());
+        row.append(base);
+        if (!emit_time(find(rec, "timeUnixNano"), "time_unix_nano")) return false;
+        if (!emit_time(find(rec, "observedTimeUnixNano"), "observed_time_unix_nano"))
+            return false;
+        // severity
+        Span sev_num = find(rec, "severityNumber");
+        Span sev_text = find(rec, "severityText");
+        if (sev_num.present() && kind_of(sev_num) != K_NULL) {
+            long long sv;
+            Kind sk = kind_of(sev_num);
+            if (sk == K_NUM) {
+                if (!num_is_integer(sev_num.view()) || !parse_i64(sev_num.view(), sv))
+                    return fail(FB);
+            } else if (sk == K_STR) {
+                if (!parse_i64(str_content(sev_num).view(), sv)) return fail(FB);
+            } else {
+                return fail(FB);
+            }
+            kv_open(row, "", "severity_number");
+            append_i64(row, sv);
+            row_keys.push_back("severity_number");
+            kv_open(row, "", "severity_text");
+            row_keys.push_back("severity_text");
+            int t = sev_text.present() ? truthy(sev_text) : 0;
+            if (t < 0) return fail(FB);
+            if (t == 1 && kind_of(sev_text) == K_STR) {
+                row.append(sev_text.view());
+            } else if (t == 1) {
+                return fail(FB);  // truthy non-string severityText
+            } else if (sv >= 0 && sv <= 24) {
+                row += '"';
+                row += SEVERITY_TEXT[sv];
+                row += '"';
+            } else {
+                row += '"';
+                append_i64(row, sv);
+                row += '"';
+            }
+        } else if (!emit_if_truthy(sev_text, "severity_text", row, nullptr)) {
+            return false;
+        }
+        // body (always present in the row, null when absent)
+        kv_open(row, "", "body");
+        row_keys.push_back("body");
+        Span body = find(rec, "body");
+        if (!body.present()) row += "null";
+        else if (!anyvalue(body, row)) return false;
+        // record attributes (unprefixed)
+        if (!attributes(find(rec, "attributes"), "", row, nullptr)) return false;
+        // droppedAttributesCount: truthy check
+        Span dropped = find(rec, "droppedAttributesCount");
+        if (dropped.present()) {
+            int t = truthy(dropped);
+            if (t < 0) return fail(FB);
+            if (t == 1) {
+                if (!push_key_checked("log_record_dropped_attributes_count")) return false;
+                kv_open(row, "", "log_record_dropped_attributes_count");
+                row.append(dropped.view());
+            }
+        }
+        // flags: `is not None` check
+        Span flags = find(rec, "flags");
+        if (flags.present() && kind_of(flags) != K_NULL) {
+            Kind fk = kind_of(flags);
+            if (fk == K_OBJ || fk == K_ARR || fk == K_BAD) return fail(FB);
+            if (!push_key_checked("flags")) return false;
+            kv_open(row, "", "flags");
+            row.append(flags.view());
+        }
+        if (!emit_if_truthy(find(rec, "traceId"), "trace_id", row, nullptr)) return false;
+        if (!emit_if_truthy(find(rec, "spanId"), "span_id", row, nullptr)) return false;
+        out += '{';
+        out += row;
+        out += "}\n";
+        nrows++;
+        return true;
+    }
+
+    // iterate an array member whose elements are objects, calling fn(members)
+    template <typename Fn>
+    bool each_object(const Span& arr, std::vector<Member>& buf, Fn fn) {
+        Kind k = kind_of(arr);
+        if (!arr.present() || k == K_NULL) return true;
+        if (k != K_ARR) return fail(FB);
+        Cur c{arr.b, arr.e};
+        c.p++;
+        c.ws();
+        if (c.p < c.end && *c.p == ']') return true;
+        while (true) {
+            c.ws();
+            if (c.p >= c.end || *c.p != '{') return fail(FB);
+            if (!collect(c, buf, 0)) return fail(c.rc);
+            if (!fn(buf)) return false;
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (c.p < c.end && *c.p == ']') return true;
+            return fail(INV);
+        }
+    }
+
+    bool run(const char* in, uint64_t len) {
+        Cur c{in, in + len};
+        std::vector<Member> top;
+        if (!collect(c, top, 0)) return fail(c.rc);
+        c.ws();
+        if (c.p != c.end) return fail(INV);
+        Span rls = find(top, "resourceLogs");
+        std::vector<Member> rl_ms;
+        return each_object(rls, rl_ms, [&](const std::vector<Member>& rl) {
+            Span resource = find(rl, "resource");
+            Span scope_logs = find(rl, "scopeLogs");
+            std::vector<Member> sl_buf;
+            return each_object(scope_logs, sl_buf, [&](const std::vector<Member>& sl) {
+                if (!scope_group(resource, sl)) return false;
+                Span records = find(sl, "logRecords");
+                std::vector<Member> rec_buf;
+                return each_object(records, rec_buf, [&](const std::vector<Member>& rec) {
+                    return log_record(rec);
+                });
+            });
+        });
+    }
+};
+
+}  // namespace otelj
+}  // anonymous namespace
+
+extern "C" {
+
+// Returns PTPU_FJ_OK with malloc'd NDJSON in *out (free with ptpu_free),
+// PTPU_FJ_FALLBACK when the payload needs the exact Python flattener, or
+// PTPU_FJ_INVALID for malformed JSON (caller falls back either way; the
+// Python json.loads then produces the user-facing error).
+int ptpu_otel_logs_ndjson(const char* in, uint64_t len, int ts_as_ms,
+                          char** out, uint64_t* out_len, uint64_t* nrows) {
+    otelj::Builder b;
+    b.ts_as_ms = ts_as_ms != 0;
+    b.out.reserve((size_t)(len + len / 4));
+    if (!b.run(in, len)) return b.rc == otelj::OK ? PTPU_FJ_FALLBACK : b.rc;
+    char* buf = (char*)std::malloc(b.out.size());
+    if (buf == nullptr && b.out.size() > 0) return PTPU_FJ_FALLBACK;
+    std::memcpy(buf, b.out.data(), b.out.size());
+    *out = buf;
+    *out_len = b.out.size();
+    *nrows = b.nrows;
+    return PTPU_FJ_OK;
+}
 
 }  // extern "C"

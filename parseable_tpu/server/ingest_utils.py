@@ -148,25 +148,11 @@ def ingest_native_fast(
     Python semantics (arrays, sparse/duplicate keys, depth, mixed types,
     partial timestamp parses, static/partitioned streams) — behavior is
     identical either way because every decline falls through."""
-    import io
-    from datetime import UTC, datetime
-
-    import pyarrow as pa
-    import pyarrow.json as pj
-
     from parseable_tpu import native
-    from parseable_tpu.event import Event
-    from parseable_tpu.event.format import SchemaVersion, fast_columns_from_table
-    from parseable_tpu.utils.arrowutil import add_parseable_fields
 
     stream = p.get_stream(stream_name)
     meta = stream.metadata
-    if (
-        meta.time_partition is not None
-        or meta.custom_partition is not None
-        or meta.static_schema_flag
-        or meta.schema_version != SchemaVersion.V1
-    ):
+    if not _native_lane_eligible(meta):
         return None
     # C++ depth N == python-level N+1 (scalars sit one level below the
     # deepest dict), so the native limit is max_flatten_level - 1 exactly
@@ -176,10 +162,61 @@ def ingest_native_fast(
     ndjson, nrows = r
     if nrows == 0:
         return 0
+    return _ndjson_to_event(
+        p, stream, ndjson, len(raw_body), log_source, custom_fields
+    )
+
+
+def _native_lane_eligible(meta) -> bool:
+    from parseable_tpu.event.format import SchemaVersion
+
+    return (
+        meta.time_partition is None
+        and meta.custom_partition is None
+        and not meta.static_schema_flag
+        and meta.schema_version == SchemaVersion.V1
+    )
+
+
+def _ndjson_to_event(
+    p: Parseable,
+    stream,
+    ndjson: bytes,
+    origin_size: int,
+    log_source: LogSource,
+    custom_fields: dict[str, str] | None,
+    cast_ts_ms: tuple[str, ...] = (),
+) -> int | None:
+    """Shared tail of the native lanes: pyarrow's C++ JSON reader builds
+    the columns from natively-flattened NDJSON and the shared fast-path
+    normalization types them — per-record Python never runs. Returns None
+    when the reader or the normalizer prefers the exact Python path."""
+    import io
+    from datetime import UTC, datetime
+
+    import pyarrow as pa
+    import pyarrow.json as pj
+
+    from parseable_tpu.event import Event
+    from parseable_tpu.event.format import fast_columns_from_table
+    from parseable_tpu.utils.arrowutil import add_parseable_fields
+
+    meta = stream.metadata
     try:
         tbl = pj.read_json(io.BytesIO(ndjson))
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
         return None  # reader-level type conflict: Python path decides
+    for name in cast_ts_ms:
+        # native lanes emit these as integer epoch-ms; the int64 ->
+        # timestamp(ms) cast is value-preserving and parse-free
+        if name in tbl.column_names:
+            col = tbl.column(name)
+            if pa.types.is_integer(col.type):
+                tbl = tbl.set_column(
+                    tbl.column_names.index(name),
+                    name,
+                    col.cast(pa.int64()).cast(pa.timestamp("ms")),
+                )
     if len(tbl.column_names) > p.options.dataset_fields_allowed_limit:
         raise IngestError(
             f"fields ({len(tbl.column_names)}) exceed dataset limit "
@@ -191,16 +228,54 @@ def ingest_native_fast(
     batch, _schema = fast
     batch = add_parseable_fields(batch, datetime.now(UTC), custom_fields or {})
     ev = Event(
-        stream_name=stream_name,
+        stream_name=stream.name,
         rb=batch,
         origin_format="json",
-        origin_size=len(raw_body),
+        origin_size=origin_size,
         is_first_event=not meta.schema,
         log_source=log_source,
         stream_type=meta.stream_type,
     )
     ev.process(stream, livetail=LIVETAIL.process, commit_schema=p.commit_schema)
     return batch.num_rows
+
+
+def ingest_otel_native_fast(
+    p: Parseable,
+    stream_name: str,
+    raw_body: bytes,
+    custom_fields: dict[str, str] | None,
+) -> int | None:
+    """Native OTel-logs lane (VERDICT r4 #3: the protobuf-JSON structure
+    walk kept OTel ingest ~14x behind the plain-JSON lane): fastpath.cpp
+    walks resourceLogs/scopeLogs/logRecords and emits the flattened rows
+    as NDJSON with timestamps already RFC3339-formatted; the shared
+    NDJSON tail columnarizes. Reference: src/otel/logs.rs:298.
+
+    Returns the row count, or None whenever any stage prefers the exact
+    Python flattener — behavior is identical because every decline falls
+    through to flatten_otel_logs."""
+    from parseable_tpu import native
+
+    stream = p.get_stream(stream_name)
+    meta = stream.metadata
+    if not _native_lane_eligible(meta):
+        return None
+    # with timestamp inference on, the time columns stage as timestamp(ms)
+    # either way — so C++ emits integer epoch-ms and we cast, skipping the
+    # RFC3339 format + string-parse round trip entirely
+    ts_as_ms = bool(meta.infer_timestamp)
+    r = native.otel_logs_ndjson(raw_body, ts_as_ms=ts_as_ms)
+    if r is None:
+        return None
+    ndjson, nrows = r
+    if nrows == 0:
+        return 0
+    cast_ts = ("time_unix_nano", "observed_time_unix_nano") if ts_as_ms else ()
+    return _ndjson_to_event(
+        p, stream, ndjson, len(raw_body), LogSource.OTEL_LOGS, custom_fields,
+        cast_ts_ms=cast_ts,
+    )
 
 
 def _flatten_and_push(
@@ -225,6 +300,10 @@ def _flatten_and_push(
         plain_json = log_source_name not in KNOWN_FORMATS
     if raw_body is not None and plain_json:
         count = ingest_native_fast(p, stream_name, raw_body, log_source, custom_fields)
+        if count is not None:
+            return count
+    if raw_body is not None and log_source == LogSource.OTEL_LOGS:
+        count = ingest_otel_native_fast(p, stream_name, raw_body, custom_fields)
         if count is not None:
             return count
     payload = _parse_payload(payload, raw_body)

@@ -1,0 +1,444 @@
+"""Native OTel-logs ingest lane (VERDICT r4 #3): C++ walk of
+resourceLogs/scopeLogs/logRecords -> flattened NDJSON -> pyarrow reader.
+Every test is differential — the native lane must stage EXACTLY what
+flatten_otel_logs + the dict pipeline stages, and every decline must fall
+through with identical semantics. Reference: src/otel/logs.rs:298."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pyarrow as pa
+
+from parseable_tpu.config import Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.event.format import LogSource
+from parseable_tpu.native import native_available, otel_logs_ndjson
+from parseable_tpu.server.ingest_utils import (
+    flatten_and_push_logs,
+    ingest_otel_native_fast,
+)
+
+
+def mk(tmp_path, tag):
+    opts = Options()
+    opts.local_staging_path = tmp_path / f"staging-{tag}"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / f"data-{tag}"))
+    p.create_stream_if_not_exists("s")
+    return p
+
+
+def staged(p):
+    batches = p.streams.get("s").staging_batches()
+    if not batches:
+        return None
+    return pa.Table.from_batches(batches).drop_columns(["p_timestamp"])
+
+
+def roundtrip(tmp_path, payload, tag=""):
+    body = json.dumps(payload).encode()
+    pn, pp = mk(tmp_path, f"n{tag}"), mk(tmp_path, f"p{tag}")
+    cn = flatten_and_push_logs(pn, "s", None, LogSource.OTEL_LOGS, {}, raw_body=body)
+    cp = flatten_and_push_logs(pp, "s", json.loads(body), LogSource.OTEL_LOGS, {})
+    assert cn == cp, f"row counts differ: native {cn} vs python {cp}"
+    return staged(pn), staged(pp)
+
+
+def assert_identical(tmp_path, payload, tag=""):
+    tn, tp = roundtrip(tmp_path, payload, tag)
+    if tp is None:
+        assert tn is None
+        return
+    assert tn.schema.equals(tp.schema), f"\n{tn.schema}\nvs\n{tp.schema}"
+    assert tn.equals(tp), (
+        f"\n{tn.to_pylist()[:3]}\nvs\n{tp.to_pylist()[:3]}"
+    )
+
+
+def lr(payload):
+    """Wrap logRecords into a canonical single-scope payload."""
+    return {"resourceLogs": [{"scopeLogs": [{"logRecords": payload}]}]}
+
+
+def test_native_library_exports_otel():
+    assert native_available()
+    assert otel_logs_ndjson(json.dumps(lr([{"body": {"intValue": "1"}}])).encode()) is not None
+
+
+def test_standard_payload(tmp_path):
+    assert_identical(
+        tmp_path,
+        {
+            "resourceLogs": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {"key": "service.name", "value": {"stringValue": f"svc{g}"}}
+                        ],
+                        "droppedAttributesCount": 0,
+                    },
+                    "scopeLogs": [
+                        {
+                            "scope": {"name": "app", "version": "1.2"},
+                            "schemaUrl": "https://opentelemetry.io/schemas/1.21.0",
+                            "logRecords": [
+                                {
+                                    "timeUnixNano": str(1714521600_000000000 + i * 1_000_000),
+                                    "observedTimeUnixNano": str(
+                                        1714521600_500000000 + i * 1_000_000
+                                    ),
+                                    "severityNumber": 9 + (i % 4),
+                                    "body": {"stringValue": f"request {i} completed"},
+                                    "attributes": [
+                                        {
+                                            "key": "http.status_code",
+                                            "value": {"intValue": str(200 + i % 4)},
+                                        },
+                                        {"key": "http.method", "value": {"stringValue": "GET"}},
+                                    ],
+                                    "traceId": f"{i:032x}",
+                                    "spanId": f"{i:016x}",
+                                }
+                                for i in range(20)
+                            ],
+                        }
+                    ],
+                }
+                for g in range(3)
+            ]
+        },
+    )
+
+
+def test_severity_variants(tmp_path):
+    assert_identical(
+        tmp_path,
+        lr(
+            [
+                {"severityNumber": 0, "body": {"stringValue": "a"}},
+                {"severityNumber": 24, "body": {"stringValue": "b"}},
+                {"severityNumber": 99, "body": {"stringValue": "out of table"}},
+                {"severityNumber": 9, "severityText": "custom", "body": {"stringValue": "c"}},
+                {"severityText": "TEXTONLY", "body": {"stringValue": "d"}},
+                {"severityText": "", "body": {"stringValue": "falsy text omitted"}},
+                {"body": {"stringValue": "no severity"}},
+            ]
+        ),
+    )
+
+
+def test_timestamp_variants(tmp_path):
+    assert_identical(
+        tmp_path,
+        lr(
+            [
+                {"timeUnixNano": "1714521600123456789"},
+                {"timeUnixNano": "0"},  # sentinel -> null
+                {"timeUnixNano": ""},
+                {"timeUnixNano": 0},
+                {"timeUnixNano": 1714521600123456789},
+                {"timeUnixNano": "999"},  # sub-microsecond -> floors to epoch us
+                {"timeUnixNano": "-1000"},  # pre-1970 floor division
+                {"observedTimeUnixNano": "1714521600000000000"},
+                {},
+            ]
+        ),
+    )
+
+
+def test_attribute_prefixes_and_dropped_counts(tmp_path):
+    assert_identical(
+        tmp_path,
+        {
+            "resourceLogs": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {"key": "host", "value": {"stringValue": "h1"}},
+                            {"key": "port", "value": {"intValue": "8080"}},
+                        ],
+                        "droppedAttributesCount": 3,
+                    },
+                    "scopeLogs": [
+                        {
+                            "scope": {
+                                "name": "lib",
+                                "attributes": [
+                                    {"key": "ver", "value": {"doubleValue": 2.5}}
+                                ],
+                            },
+                            "logRecords": [
+                                {
+                                    "attributes": [
+                                        {"key": "ok", "value": {"boolValue": True}},
+                                        {"key": "bytes", "value": {"intValue": 512}},
+                                    ],
+                                    "droppedAttributesCount": 0,  # falsy -> omitted
+                                    "flags": 0,  # not-None -> kept
+                                },
+                                {"droppedAttributesCount": 7, "flags": 1},
+                            ],
+                        }
+                    ],
+                }
+            ]
+        },
+    )
+
+
+def test_ids_truthiness(tmp_path):
+    assert_identical(
+        tmp_path,
+        lr(
+            [
+                {"traceId": "abc", "spanId": "def"},
+                {"traceId": "", "spanId": ""},  # falsy -> omitted
+                {},
+            ]
+        ),
+    )
+
+
+def test_unicode_bodies_and_keys(tmp_path):
+    assert_identical(
+        tmp_path,
+        lr(
+            [
+                {
+                    "body": {"stringValue": 'quote " backslash \\ é 漢字'},
+                    "attributes": [{"key": "ключ", "value": {"stringValue": "значение"}}],
+                }
+            ]
+        ),
+    )
+
+
+def test_fallback_shapes_still_ingest(tmp_path):
+    """Shapes the native lane declines must fall through to the Python
+    flattener with identical results."""
+    shapes = [
+        # nested AnyValues -> JSON-text conversion only Python does
+        lr([{"body": {"kvlistValue": {"values": [{"key": "a", "value": {"intValue": "1"}}]}}}]),
+        lr([{"body": {"arrayValue": {"values": [{"stringValue": "x"}]}}}]),
+        # record attr colliding with a base field (dict last-wins)
+        {
+            "resourceLogs": [
+                {
+                    "resource": {
+                        "attributes": [{"key": "k", "value": {"stringValue": "res"}}]
+                    },
+                    "scopeLogs": [
+                        {
+                            "logRecords": [
+                                {
+                                    "attributes": [
+                                        {"key": "resource_k", "value": {"stringValue": "rec"}}
+                                    ]
+                                }
+                            ]
+                        }
+                    ],
+                }
+            ]
+        },
+        # bool timestamp (int(True) == 1 quirk)
+        lr([{"timeUnixNano": True}]),
+        # fractional severity (int() truncation)
+        lr([{"severityNumber": 9.7}]),
+        # duplicate attr keys within one record
+        lr(
+            [
+                {
+                    "attributes": [
+                        {"key": "x", "value": {"intValue": "1"}},
+                        {"key": "x", "value": {"intValue": "2"}},
+                    ]
+                }
+            ]
+        ),
+    ]
+    for i, payload in enumerate(shapes):
+        tn, tp = roundtrip(tmp_path, payload, tag=f"fb{i}")
+        if tp is None:
+            assert tn is None, payload
+            continue
+        assert tn.schema.equals(tp.schema), payload
+        assert tn.num_rows == tp.num_rows, payload
+
+
+def test_empty_payloads(tmp_path):
+    assert_identical(tmp_path, {"resourceLogs": []}, tag="e1")
+    assert_identical(tmp_path, {}, tag="e2")
+    assert_identical(tmp_path, lr([]), tag="e3")
+
+
+def test_mixed_type_columns_fall_back(tmp_path):
+    """body string in one record, number in another: read_json raises on
+    the mixed column, the lane declines, and the Python path types it."""
+    tn, tp = roundtrip(
+        tmp_path,
+        lr([{"body": {"stringValue": "s"}}, {"body": {"doubleValue": 1.5}}]),
+        tag="mx",
+    )
+    assert tn.schema.equals(tp.schema)
+    assert tn.sort_by("body").equals(tp.sort_by("body"))
+
+
+def _fuzz_record(rng: random.Random) -> dict:
+    rec: dict = {}
+    if rng.random() < 0.8:
+        rec["timeUnixNano"] = rng.choice(
+            [
+                str(rng.randrange(0, 2**62)),
+                rng.randrange(0, 2**53),
+                "0",
+                0,
+                "",
+                str(-rng.randrange(1, 10**12)),
+            ]
+        )
+    if rng.random() < 0.3:
+        rec["observedTimeUnixNano"] = str(rng.randrange(0, 2**61))
+    if rng.random() < 0.6:
+        rec["severityNumber"] = rng.randrange(0, 30)
+    if rng.random() < 0.3:
+        rec["severityText"] = rng.choice(["WARN", "", "custom"])
+    body_kind = rng.random()
+    if body_kind < 0.5:
+        rec["body"] = {"stringValue": f"msg {rng.randrange(100)}"}
+    elif body_kind < 0.7:
+        rec["body"] = {"intValue": str(rng.randrange(-(10**12), 10**12))}
+    elif body_kind < 0.8:
+        rec["body"] = {"kvlistValue": {"values": [{"key": "n", "value": {"intValue": "1"}}]}}
+    n_attrs = rng.randrange(0, 4)
+    if n_attrs:
+        rec["attributes"] = [
+            {
+                "key": f"attr{j}",
+                "value": rng.choice(
+                    [
+                        {"stringValue": f"v{rng.randrange(10)}"},
+                        {"intValue": str(rng.randrange(1000))},
+                        {"doubleValue": rng.random() * 100},
+                        {"boolValue": rng.random() < 0.5},
+                    ]
+                ),
+            }
+            for j in range(n_attrs)
+        ]
+    if rng.random() < 0.2:
+        rec["droppedAttributesCount"] = rng.randrange(0, 3)
+    if rng.random() < 0.2:
+        rec["flags"] = rng.randrange(0, 2)
+    if rng.random() < 0.3:
+        rec["traceId"] = rng.choice([f"{rng.randrange(2**32):032x}", ""])
+    return rec
+
+
+def test_differential_fuzz(tmp_path):
+    """Random OTLP payloads through both lanes: native must either match
+    the Python flattener exactly or decline (counts always equal)."""
+    rng = random.Random(1234)
+    for trial in range(40):
+        payload = {
+            "resourceLogs": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {"key": "service.name", "value": {"stringValue": f"svc{g}"}}
+                        ]
+                    }
+                    if rng.random() < 0.8
+                    else {},
+                    "scopeLogs": [
+                        {
+                            "scope": {"name": f"scope{s}"} if rng.random() < 0.7 else {},
+                            "logRecords": [
+                                _fuzz_record(rng) for _ in range(rng.randrange(1, 6))
+                            ],
+                        }
+                        for s in range(rng.randrange(1, 3))
+                    ],
+                }
+                for g in range(rng.randrange(1, 3))
+            ]
+        }
+        body = json.dumps(payload).encode()
+        pn, pp = mk(tmp_path, f"fzn{trial}"), mk(tmp_path, f"fzp{trial}")
+        cn = flatten_and_push_logs(pn, "s", None, LogSource.OTEL_LOGS, {}, raw_body=body)
+        cp = flatten_and_push_logs(pp, "s", json.loads(body), LogSource.OTEL_LOGS, {})
+        assert cn == cp, f"trial {trial}: counts {cn} vs {cp}"
+        tn, tp = staged(pn), staged(pp)
+        if tp is None:
+            assert tn is None
+            continue
+        assert tn.schema.equals(tp.schema), f"trial {trial}:\n{tn.schema}\nvs\n{tp.schema}"
+        order = [
+            (c, "ascending") for c in tn.column_names if not pa.types.is_null(tn.schema.field(c).type)
+        ]
+        assert tn.sort_by(order).equals(tp.sort_by(order)), f"trial {trial}"
+
+
+def test_rfc3339_string_branch(tmp_path):
+    """infer_timestamp=False streams stage the time columns as RFC3339
+    STRINGS — the C++ formatter (fmt_rfc3339_us) must match the Python
+    numpy-datetime formatting byte for byte, including pre-1970 floors."""
+    body = json.dumps(
+        lr(
+            [
+                {"timeUnixNano": "1714521600123456789", "body": {"stringValue": "a"}},
+                {"timeUnixNano": "999", "body": {"stringValue": "floors to epoch"}},
+                {"timeUnixNano": "-1", "body": {"stringValue": "pre-1970"}},
+                {"timeUnixNano": "-86400000000001", "body": {"stringValue": "pre-1970 day"}},
+                {"observedTimeUnixNano": 1714521600999999999, "body": {"stringValue": "b"}},
+            ]
+        )
+    ).encode()
+    pn, pp = mk(tmp_path, "rfn"), mk(tmp_path, "rfp")
+    for p in (pn, pp):
+        p.streams.get("s").metadata.infer_timestamp = False
+    cn = flatten_and_push_logs(pn, "s", None, LogSource.OTEL_LOGS, {}, raw_body=body)
+    cp = flatten_and_push_logs(pp, "s", json.loads(body), LogSource.OTEL_LOGS, {})
+    assert cn == cp
+    tn, tp = staged(pn), staged(pp)
+    assert pa.types.is_string(tn.schema.field("time_unix_nano").type)
+    assert tn.schema.equals(tp.schema)
+    assert tn.equals(tp), f"\n{tn.to_pylist()}\nvs\n{tp.to_pylist()}"
+
+
+def test_malformed_json_rejected_not_ingested(tmp_path):
+    """Leading-zero numbers are invalid JSON: the native lane must decline
+    so the Python json.loads raises — never silently ingest."""
+    import pytest
+
+    from parseable_tpu.server.ingest_utils import IngestError
+
+    p = mk(tmp_path, "mal")
+    bad = b'{"resourceLogs":[{"scopeLogs":[{"logRecords":[{"timeUnixNano": 00, "body":{"stringValue":"x"}}]}]}]}'
+    with pytest.raises(IngestError, match="invalid JSON"):
+        flatten_and_push_logs(p, "s", None, LogSource.OTEL_LOGS, {}, raw_body=bad)
+    assert staged(p) is None
+
+
+def test_unicode_digit_timestamp_falls_back(tmp_path):
+    """int('١٢٣') parses in Python; the native lane must decline rather
+    than stage null where the Python path stages a timestamp."""
+    payload = lr([{"timeUnixNano": "١٢٣", "body": {"stringValue": "x"}}])
+    body = json.dumps(payload, ensure_ascii=False).encode()
+    pn, pp = mk(tmp_path, "udn"), mk(tmp_path, "udp")
+    cn = flatten_and_push_logs(pn, "s", None, LogSource.OTEL_LOGS, {}, raw_body=body)
+    cp = flatten_and_push_logs(pp, "s", json.loads(body), LogSource.OTEL_LOGS, {})
+    assert cn == cp
+    tn, tp = staged(pn), staged(pp)
+    assert tn.schema.equals(tp.schema)
+    assert tn.equals(tp)
+    assert tp.column("time_unix_nano").to_pylist()[0] is not None
+
+
+def test_direct_gate_still_works(tmp_path):
+    """ingest_otel_native_fast returns None for static-schema streams."""
+    p = mk(tmp_path, "gate")
+    p.streams.get("s").metadata.static_schema_flag = True
+    body = json.dumps(lr([{"body": {"stringValue": "x"}}])).encode()
+    assert ingest_otel_native_fast(p, "s", body, {}) is None
