@@ -395,6 +395,59 @@ def bench_config1(p, with_tpu: bool) -> None:
     )
 
 
+def bench_scale_subprocess(with_tpu: bool) -> None:
+    """Config 4 at 100GB-logical scale over the persistent .benchwork
+    dataset (scripts/bench_scale.py; VERDICT r4 #2). Runs only when the
+    dataset has been built (scripts/build_benchwork.py); the TPU engine
+    uses the real chip when reachable, else a virtual 8-device CPU mesh —
+    either way the full tiering (hot set under eviction pressure +
+    enccache) is the thing under test. BENCH_SCALE=0 skips; the timeout
+    (BENCH_SCALE_TIMEOUT, default 2700s) bounds the driver's bench run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    if os.environ.get("BENCH_SCALE", "1") == "0":
+        return
+    if not os.path.exists(os.path.join(here, ".benchwork", "meta.json")):
+        print("# scale bench: no .benchwork dataset (scripts/build_benchwork.py)", file=sys.stderr)
+        return
+    if with_tpu:
+        # IN-PROCESS on the real chip: libtpu holds an exclusive device
+        # lock, so a --real subprocess could never initialize while this
+        # process owns the chip
+        try:
+            sys.path.insert(0, os.path.join(here, "scripts"))
+            import bench_scale
+
+            bench_scale.main(real=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"# scale bench failed: {e}", file=sys.stderr)
+        return
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "scripts/bench_scale.py"],
+            capture_output=True,
+            text=True,
+            timeout=int(os.environ.get("BENCH_SCALE_TIMEOUT", "2700")),
+            env=env,
+            cwd=here,
+        )
+        for line in out.stdout.strip().splitlines():
+            print(f"# scale: {line}", file=sys.stderr)
+        lines = out.stdout.strip().splitlines()
+        if out.returncode != 0 or not lines:
+            print(
+                f"# scale bench rc={out.returncode}; stderr: {out.stderr[-2000:]}",
+                file=sys.stderr,
+            )
+            return
+        last = json.loads(lines[-1])
+        if last.get("metric"):
+            print(json.dumps(last), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"# scale bench failed: {e}", file=sys.stderr)
+
+
 def bench_json_ingest(p) -> None:
     """End-to-end HTTP JSON ingest line with an honest absolute yardstick
     (VERDICT r3 #7): vs_baseline is measured against the raw pyarrow C++
@@ -608,6 +661,7 @@ def main() -> None:
             bench_otel_ingest(pb)
             bench_json_ingest(pb)
             bench_config1(pb, with_tpu=False)
+            bench_scale_subprocess(with_tpu=False)
         except Exception as e:  # noqa: BLE001
             print(f"# ingest bench failed: {e}", file=sys.stderr)
         finally:
@@ -713,6 +767,7 @@ def main() -> None:
         bench_otel_ingest(p)
         bench_json_ingest(p)
         bench_config1(p, with_tpu=True)
+        bench_scale_subprocess(with_tpu=True)
 
         # high-cardinality profile (VERDICT r2 "de-rig"): same configs 3-4
         # over ~10k hosts / ~100k paths / ~50k-unique-per-block messages —

@@ -45,6 +45,7 @@ class DeviceHotSet:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get(self, key: tuple) -> HotEntry | None:
         with self._lock:
@@ -67,6 +68,7 @@ class DeviceHotSet:
             while self._bytes + entry.nbytes > self.budget and self._entries:
                 _, evicted = self._entries.popitem(last=False)
                 self._bytes -= evicted.nbytes
+                self.evictions += 1
             self._entries[key] = entry
             self._bytes += entry.nbytes
 

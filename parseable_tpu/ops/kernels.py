@@ -168,11 +168,19 @@ def fused_groupby_block(
             additive = (adds[0], adds[1 : 1 + n_all], adds[1 + n_all :])
 
     n_rows = group_ids.shape[0]
+    # the one-hot dot is the MXU's fast path; every other backend (the
+    # virtual CPU mesh, the dryrun) lacks a systolic array and pays the
+    # full (N, G) materialization — scatter wins there beyond tiny shapes
+    max_onehot = (
+        MATMUL_MAX_ONEHOT_ELEMS
+        if jax.default_backend() == "tpu"
+        else min(MATMUL_MAX_ONEHOT_ELEMS, 1 << 22)
+    )
     if additive is not None:
         count, per_agg_count, sums = additive
     elif (
         num_groups <= MATMUL_MAX_GROUPS
-        and n_rows * num_groups <= MATMUL_MAX_ONEHOT_ELEMS
+        and n_rows * num_groups <= max_onehot
     ):
         # Split-precision one-hot reduction: the 0/1 rows (count + per-agg
         # counts) ride a bf16 x bf16 -> f32 MXU dot — 0 and 1 are exactly

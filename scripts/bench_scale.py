@@ -1,0 +1,227 @@
+"""100GB-class scale bench over the persistent .benchwork dataset.
+
+VERDICT r4 #2: config 4 is specified at 100 GB but had only run at 8-32M
+row smoke scale, which never stresses the tiering (hot-set eviction under
+budget pressure, enccache hit rates, sustained host decode). This runs
+the north-star query over the FULL persistent dataset (700M rows ~= 150GB
+logical NDJSON, built by scripts/build_benchwork.py) and reports, per
+engine:
+
+- cpu:       full streaming scan through the CPU engine;
+- tpu first: compile + live-cold (parquet decode -> encode -> ship, with
+             enccache write-behind populating);
+- tpu cache-cold: hot set cleared, blocks reload via the enccache
+             (zero-copy memmap) — the restart-recovery path;
+- tpu warm:  whatever the 8 GiB HBM budget keeps resident (at ~11 GB
+             encoded, eviction pressure is the point: the hot set churns
+             and the run measures steady-state re-ship cost);
+
+plus the tiering counters that prove the machinery engaged (hot-set
+evictions, enccache hits/misses, per-route block counts).
+
+`run_battery` is the shared measurement protocol — scripts/hw_validate.py
+runs the same battery over its config list so the published numbers can
+never drift between the two harnesses.
+
+When the real chip is unreachable (tunnel down) the TPU engine runs on a
+virtual 8-device CPU mesh — same executor, same tiering, CPU "HBM".
+Reference: src/hottier.rs:281-432; BASELINE.json config 4.
+
+Usage: python scripts/bench_scale.py [--real] [--max-minutes N]
+Emits one JSON line per measurement; the last line is the summary the
+caller (bench.py) forwards. bench.py calls main() IN-PROCESS when the
+real chip is up (libtpu holds an exclusive device lock, so a --real
+subprocess could never initialize while the parent owns the chip) and as
+a subprocess for the virtual-mesh case (which needs its own XLA flags).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+WORK = REPO / ".benchwork"
+
+SQL = (
+    "SELECT path, host, count(*) AS c, sum(bytes) AS s FROM bench "
+    "GROUP BY path, host ORDER BY s DESC LIMIT 10"
+)
+
+
+def rows_close(a: list, b: list) -> bool:
+    """Exact on keys/counts; 1e-4 relative on floats (device sums are f32
+    per block — same tolerance the test suite and bench.py use)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if abs(va - vb) > 1e-4 * max(1.0, abs(va)):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def run_battery(p, sess_cpu, sess, sql: str, rows_total: int, emit, label: str) -> dict:
+    """The measurement protocol: cpu -> tpu first (compile + live cold) ->
+    enccache settle -> hot-set clear -> cache cold -> warm, with tiering
+    counters deltas. Returns the summary dict (also emitted per stage)."""
+    from parseable_tpu.ops.enccache import get_enccache
+    from parseable_tpu.ops.hotset import get_hotset
+
+    ec = get_enccache(p.options)
+    hs = get_hotset()
+
+    def run(s) -> tuple[float, list, dict]:
+        t0 = time.perf_counter()
+        res = s.query(sql)
+        dt = time.perf_counter() - t0
+        rows = sorted(
+            (tuple(r.values()) for r in res.to_json_rows()),
+            key=lambda t: tuple(str(v) for v in t),
+        )
+        return dt, rows, res.stats
+
+    cpu_t, cpu_rows, _ = run(sess_cpu)
+    emit("cpu", config=label, secs=round(cpu_t, 2), rows_per_sec=round(rows_total / cpu_t))
+
+    first_t, tpu_rows, stats1 = run(sess)
+    emit(
+        "tpu_first",
+        config=label,
+        secs=round(first_t, 2),
+        rows_per_sec=round(rows_total / first_t),
+        note="compile + live cold (decode/encode/ship + enccache write-behind)",
+        routes=stats1.get("device_routes"),
+    )
+    if ec is not None:
+        ec.wait_idle()
+
+    hs.clear()
+    ev0, h0, m0 = hs.evictions, (ec.hits if ec else 0), (ec.misses if ec else 0)
+    cold_t, rows2, stats2 = run(sess)
+    emit(
+        "tpu_cache_cold",
+        config=label,
+        secs=round(cold_t, 2),
+        rows_per_sec=round(rows_total / cold_t),
+        enccache_hits=(ec.hits - h0) if ec else None,
+        enccache_misses=(ec.misses - m0) if ec else None,
+        hotset_evictions=hs.evictions - ev0,
+        routes=stats2.get("device_routes"),
+    )
+
+    ev0 = hs.evictions
+    warm_t, rows3, stats3 = run(sess)
+    emit(
+        "tpu_warm",
+        config=label,
+        secs=round(warm_t, 2),
+        rows_per_sec=round(rows_total / warm_t),
+        hotset_resident_gb=round(hs.resident_bytes / 2**30, 2),
+        hotset_evictions=hs.evictions - ev0,
+        routes=stats3.get("device_routes"),
+    )
+
+    match = (
+        rows_close(cpu_rows, tpu_rows)
+        and rows_close(cpu_rows, rows2)
+        and rows_close(cpu_rows, rows3)
+    )
+    if not match:
+        emit("mismatch", config=label, cpu=cpu_rows[:2], tpu=tpu_rows[:2])
+    return {
+        "rows": rows_total,
+        "cpu_secs": round(cpu_t, 2),
+        "first_run_secs": round(first_t, 2),
+        "cache_cold_secs": round(cold_t, 2),
+        "cache_cold_vs_cpu": round(cpu_t / cold_t, 3),
+        "warm_secs": round(warm_t, 2),
+        "warm_vs_cpu": round(cpu_t / warm_t, 3),
+        "rows_per_sec_warm": round(rows_total / warm_t, 1),
+        "hotset_evictions": hs.evictions,
+        "hotset_resident_gb": round(hs.resident_bytes / 2**30, 2),
+        "enccache_hits": ec.hits if ec else None,
+        "enccache_misses": ec.misses if ec else None,
+        "results_match": bool(match),
+    }
+
+
+def main(real: bool = False, max_minutes: int = 0) -> None:
+    meta_path = WORK / "meta.json"
+    if not meta_path.exists():
+        print(json.dumps({"error": "no .benchwork dataset"}))
+        sys.exit(1)
+    meta = json.loads(meta_path.read_text())
+
+    if not real:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if not real:
+        jax.config.update("jax_platforms", "cpu")
+
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.query.session import QuerySession
+
+    opts = Options()
+    opts.local_staging_path = WORK / "staging"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=WORK / "data"))
+
+    sql = SQL
+    rows = meta["rows"]
+    if max_minutes:
+        # dataset minutes start 2024-05-01T00:00, 1M rows per minute
+        sql = SQL.replace(
+            "FROM bench ",
+            "FROM bench WHERE p_timestamp < '2024-05-01T"
+            f"{max_minutes // 60:02d}:{max_minutes % 60:02d}:00' ",
+        )
+        rows = min(rows, max_minutes * 1_000_000)
+
+    def emit(kind: str, **kw) -> None:
+        print(json.dumps({"kind": kind, **kw}), flush=True)
+
+    sess_cpu = QuerySession(p, engine="cpu")
+    sess = QuerySession(p, engine="tpu")
+    result = run_battery(p, sess_cpu, sess, sql, rows, emit, "scale_topk")
+    summary = {
+        "metric": "scale_topk_multicol_rows_per_sec",
+        "value": result["rows_per_sec_warm"],
+        "unit": "rows/s",
+        "vs_baseline": result["warm_vs_cpu"],
+        "logical_gb": meta.get("logical_gb"),
+        "disk_gb": round(meta.get("disk_bytes", 0) / 1e9, 1),
+        "devices": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+        "note": "config 4 at 100GB-logical scale through the tiering "
+        "(hot set under eviction pressure + enccache)",
+        **result,
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--real", action="store_true", help="use the real chip")
+    ap.add_argument(
+        "--max-minutes",
+        type=int,
+        default=0,
+        help="bound the scan to the first N minute-partitions (0 = full)",
+    )
+    args = ap.parse_args()
+    main(real=args.real, max_minutes=args.max_minutes)

@@ -2,7 +2,7 @@
 # Poll the TPU; run the validation battery the moment it answers.
 cd /root/repo
 for i in $(seq 1 200); do
-  if timeout 600 python scripts/hw_validate.py >> scripts/hw_watch.log 2>&1; then
+  if timeout 5400 python scripts/hw_validate.py >> scripts/hw_watch.log 2>&1; then
     echo "VALIDATION COMPLETE at $(date -u)" >> scripts/hw_watch.log
     exit 0
   fi
