@@ -43,7 +43,10 @@ from parseable_tpu.ops.device import EncodedBatch, EncodedColumn, pow2_block
 
 logger = logging.getLogger(__name__)
 
-_MAGIC = b"PTEC2\n"
+# PTEC3: time columns are int32 ms relative to a per-batch day-aligned
+# origin (header `time_origin_ms`); PTEC2 entries (canonical seconds) are
+# stale and unlink on sight
+_MAGIC = b"PTEC3\n"
 
 
 def _fname(source_id: bytes) -> str:
@@ -62,10 +65,21 @@ class EncodedBlockCache:
         self._writer: threading.Thread | None = None
         self.hits = 0
         self.misses = 0
-        # stale tmp files from a previous crash/kill are dead weight
+        # stale tmp files from a previous crash/kill are dead weight, and
+        # pre-PTEC3 entries are dead bytes against the budget. Cleanup
+        # happens HERE (once, at open) rather than in _read_header: an
+        # unlink on the read path would race a concurrent writer's
+        # os.replace and could delete a freshly written valid entry.
         try:
             for stale in self.root.glob("*.tmp"):
                 stale.unlink(missing_ok=True)
+            for f in self.root.glob("*.enc"):
+                try:
+                    with f.open("rb") as fh:
+                        if fh.read(len(_MAGIC)) != _MAGIC:
+                            f.unlink(missing_ok=True)
+                except OSError:
+                    continue
         except OSError:
             pass
 
@@ -100,6 +114,7 @@ class EncodedBlockCache:
             block_rows=enc.block_rows,
             columns=snap_cols,
             row_mask=enc.row_mask,
+            time_origin_ms=enc.time_origin_ms,
         )
         with self._lock:
             if self._queue is None:
@@ -159,6 +174,7 @@ class EncodedBlockCache:
             existing is not None
             and existing["num_rows"] == n
             and existing["header"].get("block_rows") == block
+            and existing["header"].get("time_origin_ms") == enc.time_origin_ms
         ):
             hdr, payload_off = existing["header"], existing["payload_off"]
             with path.open("rb") as f:
@@ -216,7 +232,12 @@ class EncodedBlockCache:
             return False
 
         header = json.dumps(
-            {"num_rows": n, "block_rows": block, "columns": columns}
+            {
+                "num_rows": n,
+                "block_rows": block,
+                "time_origin_ms": enc.time_origin_ms,
+                "columns": columns,
+            }
         ).encode()
         # unique tmp per writer: concurrent puts for the same source must
         # not truncate each other mid-write (last os.replace wins whole)
@@ -339,7 +360,11 @@ class EncodedBlockCache:
         mask = np.zeros(block, dtype=bool)
         mask[:n] = True
         return EncodedBatch(
-            num_rows=n, block_rows=block, columns=cols, row_mask=mask
+            num_rows=n,
+            block_rows=block,
+            columns=cols,
+            row_mask=mask,
+            time_origin_ms=int(hdr.get("time_origin_ms", 0)),
         )
 
     def can_serve(

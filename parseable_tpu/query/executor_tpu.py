@@ -42,7 +42,7 @@ import logging
 import re
 import time as _time
 from dataclasses import dataclass, field as dc_field
-from datetime import UTC, datetime
+from datetime import UTC, datetime, timedelta
 from typing import Any, Callable, Iterator
 
 import numpy as np
@@ -51,8 +51,6 @@ import pyarrow as pa
 from parseable_tpu.config import Options
 from parseable_tpu.ops import kernels
 from parseable_tpu.ops.device import (
-    CANON_TIME_ORIGIN_MS,
-    CANON_TIME_UNIT_MS,
     EncodedBatch,
     EncodedColumn,
     encode_table,
@@ -283,9 +281,11 @@ def classify_group_expr(e: S.Expr) -> KeySpec:
             raise UnsupportedOnDevice("date_bin with explicit origin")
         ms = _interval_ms(e.args[0])
         col = e.args[1]
-        if ms and ms % CANON_TIME_UNIT_MS == 0 and isinstance(col, S.Column):
+        # any >=1ms bin maps exactly; the upper bound keeps the device-side
+        # shift (origin % bin_ms + rel) inside int32
+        if ms and ms <= (1 << 30) and isinstance(col, S.Column):
             return KeySpec("timebin", col.name, e, bin_ms=ms)
-        raise UnsupportedOnDevice("sub-second date_bin")
+        raise UnsupportedOnDevice("sub-millisecond or >12-day date_bin")
     if isinstance(e, S.FunctionCall) and e.name == "date_trunc" and len(e.args) == 2:
         unit = e.args[0].value if isinstance(e.args[0], S.Literal) else None
         col = e.args[1]
@@ -330,6 +330,11 @@ class PredicateCompiler:
                 col, op, lit = self._cmp_parts(e, enc)
                 if col.kind == "dict":
                     out.append(self._dict_lut(enc, col, op, lit))
+                elif col.kind == "time":
+                    # per-block rel-ms literal as a runtime scalar: rides
+                    # the LUT channel so one compiled program serves every
+                    # block regardless of its time origin
+                    out.append(self._time_lit(enc, op, lit))
                 return
             if e.op in ("like", "ilike", "not_like", "not_ilike"):
                 col = self._column_of(e.left, enc)
@@ -458,7 +463,10 @@ class PredicateCompiler:
             lut = next(luts)
             mask = lut[_as_index(values)]
         elif col.kind == "time":
-            mask = _num_cmp(values, op, self._time_threshold(op, lit))
+            # values are exact int32 ms rel to the block origin, so every
+            # comparison op (incl. =, !=, <=, > and sub-second literals)
+            # is exact — no more second-floor fallbacks
+            mask = _num_cmp(values, op, next(luts)[0])
         elif col.kind in ("num", "bool"):
             if not isinstance(lit, (int, float, bool)):
                 raise UnsupportedOnDevice("numeric compared to non-numeric literal")
@@ -468,26 +476,30 @@ class PredicateCompiler:
         return jnp.logical_and(mask, valid)
 
     @staticmethod
-    def _time_threshold(op: str, lit: Any) -> int:
-        """Integer-second threshold for floored-second row values.
+    def _time_lit(enc: EncodedBatch, op: str, lit: Any) -> np.ndarray:
+        """Literal as block-relative int32 ms, shipped as a runtime scalar.
 
-        Only `<` and `>=` are exactly representable: for integer n,
-        floor(x) >= n ⟺ x >= n and floor(x) < n ⟺ x < n. The complements
-        (`>`, `<=`), equality, and sub-second literals cannot distinguish
-        rows inside the boundary second — those fall back to the CPU path.
-        """
+        Sub-ms literals FLOOR to ms — matching the CPU engine, whose
+        comparisons coerce the literal to the (ms) column type via
+        pa.scalar(..., type=t) (executor.py _coerce/_bounds_filter); the
+        two engines must agree row-for-row, and device rows are
+        ms-quantized anyway (encode declines columns with sub-ms residue).
+
+        Out-of-range literals clamp to just inside int32: encoded rel
+        values are bounded by TIME_REL_SPAN (< 2^30), so a clamped bound
+        compares uniformly true/false against every row — exactly the
+        semantics of a literal beyond the block's representable window —
+        and can never equal a live value."""
+        del op  # same floor for every comparison op (CPU-engine parity)
         if isinstance(lit, str):
             lit_dt = parse_rfc3339(lit)
         elif isinstance(lit, datetime):
             lit_dt = lit if lit.tzinfo else lit.replace(tzinfo=UTC)
         else:
             raise UnsupportedOnDevice("timestamp compared to non-time literal")
-        lit_ms = int(lit_dt.timestamp() * 1000)
-        if op not in ("<", ">="):
-            raise UnsupportedOnDevice(f"timestamp {op} needs ms precision")
-        if lit_ms % CANON_TIME_UNIT_MS:
-            raise UnsupportedOnDevice("sub-second time literal")
-        return (lit_ms - CANON_TIME_ORIGIN_MS) // CANON_TIME_UNIT_MS
+        rel = _dt_to_us(lit_dt) // 1000 - enc.time_origin_ms
+        rel = max(-(2**31) + 2, min(2**31 - 2, rel))
+        return np.asarray([rel], dtype=np.int32)
 
     def _in_list(self, e: S.InList, enc: EncodedBatch, dev, luts):
         import jax.numpy as jnp
@@ -596,6 +608,15 @@ def _as_index(a):
     import jax.numpy as jnp
 
     return a if a.dtype == jnp.int32 else a.astype(jnp.int32)
+
+
+_EPOCH_UTC = datetime(1970, 1, 1, tzinfo=UTC)
+
+
+def _dt_to_us(dt: datetime) -> int:
+    """Exact integer epoch-microseconds (float .timestamp() wobbles at
+    2024-era magnitudes; datetime precision is exactly us)."""
+    return (dt - _EPOCH_UTC) // timedelta(microseconds=1)
 
 
 def _num_cmp(values, op: str, threshold):
@@ -1416,11 +1437,6 @@ class TpuQueryExecutor(QueryExecutor):
             if buf:
                 yield _concat_tables(buf)
 
-        # Validate the device representation of the query's time bounds up
-        # front: raising here (before the table iterator is touched) lets
-        # execute() fall back to a clean whole-query CPU run.
-        self._bounds_seconds()
-
         # Blocks with identical shape signatures batch into one dispatch of
         # up to GROUP_N unrolled folds — per-dispatch latency dominates on
         # tunneled backends, so 8 blocks per round trip is an 8x cut.
@@ -1692,6 +1708,15 @@ class TpuQueryExecutor(QueryExecutor):
                     dacc = [new_flat(acc_groups * c) for c in dcaps]
                     pacc = [new_flat(acc_groups * DEVICE_NB) for _ in pct_idx]
 
+                # per-block time scalars (bin shift/offset + bounds) append
+                # after the predicate LUTs; the fold consumes them from the
+                # tail so one compiled program serves every block origin
+                luts = luts + self._time_args(
+                    enc,
+                    key_specs,
+                    tuple(ks.origin_rel or 0 for ks in key_specs),
+                    self._bounds_ms(),
+                )
                 kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
                 sig = (
                     (enc.block_rows, kinds, "__rowmask" in dev),
@@ -2053,8 +2078,8 @@ class TpuQueryExecutor(QueryExecutor):
             else:
                 if col.vmin is None or col.vmax is None:
                     raise UnsupportedOnDevice("time-bin key over all-null column")
-                lo_bin = (col.vmin * CANON_TIME_UNIT_MS + CANON_TIME_ORIGIN_MS) // ks.bin_ms
-                hi_bin = (col.vmax * CANON_TIME_UNIT_MS + CANON_TIME_ORIGIN_MS) // ks.bin_ms
+                lo_bin = (enc.time_origin_ms + col.vmin) // ks.bin_ms
+                hi_bin = (enc.time_origin_ms + col.vmax) // ks.bin_ms
                 span = int(hi_bin - lo_bin + 1)
                 cap = _pow2(max(2, span))
                 if cap > LOCAL_G_MAX:
@@ -2078,7 +2103,6 @@ class TpuQueryExecutor(QueryExecutor):
         else:
             put_rep = jnp.asarray
             put_row = jnp.asarray
-        dev_luts = tuple(put_rep(l) for l in luts)
         row_mask = dev.get("__rowmask", dev["__ones"])
 
         composite_vals: np.ndarray | None = None
@@ -2087,17 +2111,13 @@ class TpuQueryExecutor(QueryExecutor):
             # combos can't exceed its rows: compact (c0..ck) tuples with one
             # np.unique and fold on dense pair codes instead
             comp = None
-            origin_units = CANON_TIME_ORIGIN_MS // CANON_TIME_UNIT_MS
             for ks, cap, origin in zip(key_specs, caps, origins):
                 vals = self._host_codes(enc, dev, ks.column)
                 if ks.kind == "dict":
                     codes = np.minimum(vals.astype(np.int64), cap - 1)
                 else:
-                    bin_units = max(1, ks.bin_ms // CANON_TIME_UNIT_MS)
-                    base_units = origin * bin_units - origin_units
-                    codes = np.clip(
-                        (vals.astype(np.int64) - base_units) // bin_units, 0, cap - 1
-                    )
+                    abs_ms = vals.astype(np.int64) + enc.time_origin_ms
+                    codes = np.clip(abs_ms // ks.bin_ms - origin, 0, cap - 1)
                 comp = codes if comp is None else comp * cap + codes
             uniq, inv = np.unique(comp, return_inverse=True)
             num_groups = _pow2(max(2, len(uniq)))
@@ -2109,15 +2129,21 @@ class TpuQueryExecutor(QueryExecutor):
             dev = dict(dev)
             dev["__pairkey"] = put_row(inv.astype(np.int32))
 
+        if composite_vals is None:
+            key_sig = tuple((ks.kind, ks.column, ks.bin_ms) for ks in key_specs)
+            full_luts = luts + self._time_args(enc, key_specs, origins, self._bounds_ms())
+        else:
+            key_sig = (("pair", "__pairkey", 0),)
+            full_luts = luts + self._time_args(enc, [], (), self._bounds_ms())
+        dev_luts = tuple(put_rep(l) for l in full_luts)
+
         program = self._get_local_program(
             enc,
             tuple(caps),
             tuple(origins),
-            tuple((ks.kind, ks.column, ks.bin_ms) for ks in key_specs)
-            if composite_vals is None
-            else (("pair", "__pairkey", 0),),
+            key_sig,
             layout,
-            tuple(l.shape for l in luts),
+            tuple(l.shape for l in full_luts),
             tuple(sorted(dev.keys())),
             num_groups,
         )
@@ -2157,14 +2183,15 @@ class TpuQueryExecutor(QueryExecutor):
         if mesh is not None and enc.block_rows % n_data:
             mesh = None
         kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
-        bounds_s = self._bounds_seconds()
+        bounds_ms = self._bounds_ms()
         key = (
             "local",
             _expr_fingerprint(self.plan.select.where),
-            bounds_s,
+            (bounds_ms[0] is not None, bounds_ms[1] is not None),
             key_sig,
             caps,
-            origins,
+            # origins deliberately NOT in the key: the block's bin offset
+            # ships as a runtime scalar, so one program serves every block
             num_groups,
             tuple(layout.stacked_cols),
             tuple(layout.sum_cols),
@@ -2187,21 +2214,29 @@ class TpuQueryExecutor(QueryExecutor):
 
         sel_where = self.plan.select.where
         compiler = PredicateCompiler()
-        origin_units = CANON_TIME_ORIGIN_MS // CANON_TIME_UNIT_MS
+        n_timebin = sum(1 for k in key_sig if k[0] == "timebin")
+        n_bounds = sum(1 for b in bounds_ms if b is not None)
+        n_time_args = 2 * n_timebin + n_bounds
 
         from parseable_tpu import DEFAULT_TIMESTAMP_KEY
 
         def fold(dev: dict, luts: tuple, row_mask):
             local_rows = row_mask.shape[0]
-            mask = compiler.trace(sel_where, enc, dev, list(luts))
+            # per-block time scalars ride the tail of the luts tuple
+            # (_time_args layout); trace consumes the head
+            extra = list(luts[len(luts) - n_time_args :]) if n_time_args else []
+            mask = compiler.trace(
+                sel_where, enc, dev, list(luts[: len(luts) - n_time_args])
+            )
             mask = jnp.logical_and(mask, row_mask)
-            if bounds_s != (None, None) and DEFAULT_TIMESTAMP_KEY in enc.columns:
+            if n_bounds and DEFAULT_TIMESTAMP_KEY in enc.columns:
                 ts = dev[DEFAULT_TIMESTAMP_KEY]
-                lo, hi = bounds_s
-                if lo is not None:
-                    mask = jnp.logical_and(mask, ts >= jnp.int32(lo))
-                if hi is not None:
-                    mask = jnp.logical_and(mask, ts < jnp.int32(hi))
+                bi = 2 * n_timebin
+                if bounds_ms[0] is not None:
+                    mask = jnp.logical_and(mask, ts >= extra[bi][0])
+                    bi += 1
+                if bounds_ms[1] is not None:
+                    mask = jnp.logical_and(mask, ts < extra[bi][0])
                 mask = jnp.logical_and(mask, dev[f"{DEFAULT_TIMESTAMP_KEY}__valid"])
             if key_sig and key_sig[0][0] == "pair":
                 # host-compacted composite codes (multi-key high cardinality)
@@ -2209,14 +2244,15 @@ class TpuQueryExecutor(QueryExecutor):
             else:
                 ids = None
                 stride = 1
-                for (kind, column, bin_ms), cap, origin in zip(key_sig, caps, origins):
+                ti = 0
+                for (kind, column, bin_ms), cap in zip(key_sig, caps):
                     if kind == "dict":
                         codes = jnp.minimum(dev[column], cap - 1)
                     else:
-                        bin_units = max(1, bin_ms // CANON_TIME_UNIT_MS)
-                        base_units = origin * bin_units - origin_units
+                        shift, k_off = extra[ti][0], extra[ti + 1][0]
+                        ti += 2
                         codes = jnp.clip(
-                            (dev[column] - jnp.int32(base_units)) // jnp.int32(bin_units),
+                            (dev[column] + shift) // jnp.int32(bin_ms) + k_off,
                             0,
                             cap - 1,
                         )
@@ -2505,10 +2541,10 @@ class TpuQueryExecutor(QueryExecutor):
         # groups-major layout (group * Vcap + code) makes each shard's
         # window contiguous, so P("groups") on the flat dim is exact
         kinds = tuple(sorted((n, c.kind) for n, c in enc.columns.items()))
-        bounds_s = self._bounds_seconds()
+        bounds_ms = self._bounds_ms()
         key = (
             _expr_fingerprint(self.plan.select.where),
-            bounds_s,
+            (bounds_ms[0] is not None, bounds_ms[1] is not None),
             tuple(S.expr_name(ks.expr) for ks in layout.key_specs),
             tuple(layout.stacked_cols),
             tuple(layout.sum_cols),
@@ -2517,7 +2553,8 @@ class TpuQueryExecutor(QueryExecutor):
             enc.block_rows,
             kinds,
             layout.caps,
-            layout.origins,
+            # origins deliberately NOT in the key: bin offsets ship as
+            # runtime scalars, so origin epoch changes reuse the program
             lut_shapes,
             remap_shapes,
             num_groups,
@@ -2546,7 +2583,9 @@ class TpuQueryExecutor(QueryExecutor):
             KeySpec(ks.kind, ks.column, ks.expr, ks.bin_ms, ks.gdict, cap, orig)
             for ks, cap, orig in zip(layout.key_specs, layout.caps, layout.origins)
         ]
-        origin_units = CANON_TIME_ORIGIN_MS // CANON_TIME_UNIT_MS
+        n_timebin = sum(1 for ks in key_specs if ks.kind == "timebin")
+        n_bounds = sum(1 for b in bounds_ms if b is not None)
+        n_time_args = 2 * n_timebin + n_bounds
 
         from parseable_tpu import DEFAULT_TIMESTAMP_KEY
 
@@ -2554,15 +2593,21 @@ class TpuQueryExecutor(QueryExecutor):
             # row count as seen by this trace: the full block single-chip,
             # or this device's shard under shard_map
             local_rows = row_mask.shape[0]
-            mask = compiler.trace(sel_where, enc, dev, list(luts))
+            # per-block time scalars ride the tail of the luts tuple
+            # (_time_args layout); trace consumes the head
+            extra = list(luts[len(luts) - n_time_args :]) if n_time_args else []
+            mask = compiler.trace(
+                sel_where, enc, dev, list(luts[: len(luts) - n_time_args])
+            )
             mask = jnp.logical_and(mask, row_mask)
-            if bounds_s != (None, None) and DEFAULT_TIMESTAMP_KEY in enc.columns:
+            if n_bounds and DEFAULT_TIMESTAMP_KEY in enc.columns:
                 ts = dev[DEFAULT_TIMESTAMP_KEY]
-                lo, hi = bounds_s
-                if lo is not None:
-                    mask = jnp.logical_and(mask, ts >= jnp.int32(lo))
-                if hi is not None:
-                    mask = jnp.logical_and(mask, ts < jnp.int32(hi))
+                bi = 2 * n_timebin
+                if bounds_ms[0] is not None:
+                    mask = jnp.logical_and(mask, ts >= extra[bi][0])
+                    bi += 1
+                if bounds_ms[1] is not None:
+                    mask = jnp.logical_and(mask, ts < extra[bi][0])
                 mask = jnp.logical_and(mask, dev[f"{DEFAULT_TIMESTAMP_KEY}__valid"])
             if not key_specs:
                 ids = jnp.zeros(local_rows, dtype=jnp.int32)
@@ -2570,17 +2615,17 @@ class TpuQueryExecutor(QueryExecutor):
                 ids = None
                 stride = 1
                 ri = 0
+                ti = 0
                 for ks in key_specs:
                     cap = ks.capacity
                     if ks.kind == "dict":
                         codes = jnp.minimum(remaps[ri][_as_index(dev[ks.column])], cap - 1)
                         ri += 1
                     else:
-                        bin_units = max(1, ks.bin_ms // CANON_TIME_UNIT_MS)
-                        origin_bin = ks.origin_rel or 0
-                        base_units = origin_bin * bin_units - origin_units
+                        shift, k_off = extra[ti][0], extra[ti + 1][0]
+                        ti += 2
                         codes = jnp.clip(
-                            (dev[ks.column] - jnp.int32(base_units)) // jnp.int32(bin_units),
+                            (dev[ks.column] + shift) // jnp.int32(ks.bin_ms) + k_off,
                             0,
                             cap - 1,
                         )
@@ -2754,20 +2799,52 @@ class TpuQueryExecutor(QueryExecutor):
 
     # ------------------------------------------------------------- internals
 
-    def _bounds_seconds(self) -> tuple[int | None, int | None]:
-        """Time bounds as canonical int32 seconds; raises when not
-        second-aligned (the CPU path then enforces them exactly)."""
+    def _bounds_ms(self) -> tuple[int | None, int | None]:
+        """API time bounds as absolute epoch ms, FLOORED for sub-ms bounds
+        — the CPU engine's _bounds_filter coerces through
+        pa.scalar(..., type=timestamp('ms')) the same way, and engine
+        parity is the contract."""
         tb = self.plan.time_bounds
         out = []
         for b in (tb.low, tb.high):
             if b is None:
                 out.append(None)
                 continue
-            ms = int(b.timestamp() * 1000)
-            if ms % CANON_TIME_UNIT_MS:
-                raise UnsupportedOnDevice("sub-second time bound")
-            out.append((ms - CANON_TIME_ORIGIN_MS) // CANON_TIME_UNIT_MS)
+            bb = b if b.tzinfo else b.replace(tzinfo=UTC)
+            out.append(_dt_to_us(bb) // 1000)
         return tuple(out)
+
+    @staticmethod
+    def _time_args(
+        enc: EncodedBatch,
+        key_specs: list[KeySpec],
+        origins: tuple | list,
+        bounds_ms: tuple[int | None, int | None],
+    ) -> list[np.ndarray]:
+        """Per-block time scalars appended after the predicate LUTs, in a
+        fixed layout both the host builder and the traced fold share:
+        [per-timebin-key (shift, K)...,  bounds lo?,  bounds hi?].
+
+        shift = origin % bin (so (rel + shift) // bin is the global bin
+        index minus origin//bin) and K = origin//bin - scan_lo_bin (the
+        block's bin offset inside the scan's group window, bounded by the
+        group capacity). Bounds clamp like predicate literals."""
+        out: list[np.ndarray] = []
+        for ks, origin_bin in zip(key_specs, origins):
+            if ks.kind != "timebin":
+                continue
+            shift = enc.time_origin_ms % ks.bin_ms
+            k_off = enc.time_origin_ms // ks.bin_ms - int(origin_bin)
+            if not (-(2**31) < k_off < 2**31):
+                raise UnsupportedOnDevice("block outside the scan's bin window")
+            out.append(np.asarray([shift], dtype=np.int32))
+            out.append(np.asarray([k_off], dtype=np.int32))
+        for b in bounds_ms:
+            if b is not None:
+                rel = b - enc.time_origin_ms
+                rel = max(-(2**31) + 2, min(2**31 - 2, rel))
+                out.append(np.asarray([rel], dtype=np.int32))
+        return out
 
     def _required_layout(self, ks: KeySpec, enc: EncodedBatch) -> tuple[int, int]:
         """(origin, capacity) this key needs for the incoming batch. A change
@@ -2783,8 +2860,8 @@ class TpuQueryExecutor(QueryExecutor):
             raise UnsupportedOnDevice(f"time column {ks.column} missing")
         if col.vmin is None or col.vmax is None:
             return ks.origin_rel or 0, max(ks.capacity, 2)
-        lo_bin = (col.vmin * CANON_TIME_UNIT_MS + CANON_TIME_ORIGIN_MS) // ks.bin_ms
-        hi_bin = (col.vmax * CANON_TIME_UNIT_MS + CANON_TIME_ORIGIN_MS) // ks.bin_ms
+        lo_bin = (enc.time_origin_ms + col.vmin) // ks.bin_ms
+        hi_bin = (enc.time_origin_ms + col.vmax) // ks.bin_ms
         if ks.origin_rel is None and self.plan.scan_time_hint is not None:
             # pre-size from the scan's manifest time range: one capacity
             # epoch, one flush, one readback for the whole query

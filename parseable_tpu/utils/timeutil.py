@@ -15,6 +15,8 @@ _DURATION_UNITS = {
     "ns": 1e-9,
     "us": 1e-6,
     "ms": 1e-3,
+    "millisecond": 1e-3,
+    "milliseconds": 1e-3,
     "s": 1.0,
     "sec": 1.0,
     "secs": 1.0,

@@ -1,0 +1,227 @@
+"""Millisecond device-time semantics (VERDICT r4 #10).
+
+Timestamps encode as int32 ms relative to a per-batch day-aligned origin,
+so every comparison op (including =, !=, <=, > and sub-second literals),
+sub-second BETWEEN, and ms-granularity date_bin run ON DEVICE with exact
+semantics — no more second-floor fallbacks. Each test cross-checks the
+TPU executor against the CPU engine AND asserts the device path actually
+ran (no cpu_fallback). Reference: src/utils/time.rs:68-169."""
+
+from __future__ import annotations
+
+from datetime import UTC, datetime, timedelta
+
+import pyarrow as pa
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+BASE = datetime(2024, 5, 1, 10, 0)
+
+
+def ms_table(n=4000):
+    """Timestamps at 250ms spacing: sub-second structure everywhere."""
+    ts = [BASE + timedelta(milliseconds=250 * i) for i in range(n)]
+    return pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "status": pa.array([200.0 if i % 3 else 500.0 for i in range(n)]),
+            "bytes": pa.array([float(i % 1000) for i in range(n)]),
+        }
+    )
+
+
+def run_both(sql, tables):
+    lp = build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp).execute(iter([t for t in tables]))
+    lp2 = build_plan(parse_sql(sql))
+    ex = TpuQueryExecutor(lp2)
+    tpu = ex.execute(iter([t for t in tables]))
+    assert ex.route_stats["cpu_fallback"] == 0, (
+        f"device path fell back: {ex.route_stats}"
+    )
+    return cpu, tpu
+
+
+def as_sorted(t: pa.Table):
+    cols = sorted(t.column_names)
+    rows = sorted(
+        (tuple(r[c] for c in cols) for r in t.to_pylist()),
+        key=lambda x: tuple(str(v) for v in x),
+    )
+    return rows
+
+
+def assert_match(sql, tables):
+    cpu, tpu = run_both(sql, tables)
+    rc, rt = as_sorted(cpu), as_sorted(tpu)
+    assert len(rc) == len(rt), f"{sql}: {len(rc)} vs {len(rt)} rows"
+    for a, b in zip(rc, rt):
+        for va, vb in zip(a, b):
+            if isinstance(va, float) and isinstance(vb, float):
+                assert abs(va - vb) <= 1e-4 * max(1.0, abs(va)), (sql, a, b)
+            else:
+                assert va == vb, (sql, a, b)
+
+
+def test_equality_at_ms_precision():
+    assert_match(
+        "SELECT count(*) AS c FROM t WHERE "
+        "p_timestamp = '2024-05-01T10:00:01.250Z'",
+        [ms_table()],
+    )
+
+
+def test_sub_second_between():
+    assert_match(
+        "SELECT status, count(*) AS c FROM t WHERE p_timestamp BETWEEN "
+        "'2024-05-01T10:00:00.500Z' AND '2024-05-01T10:00:05.750Z' "
+        "GROUP BY status",
+        [ms_table()],
+    )
+
+
+def test_gt_and_le_exact():
+    for op, lit in (
+        (">", "'2024-05-01T10:00:02.250Z'"),
+        ("<=", "'2024-05-01T10:00:02.250Z'"),
+        ("!=", "'2024-05-01T10:00:00.000Z'"),
+        (">=", "'2024-05-01T10:00:02.001Z'"),
+        ("<", "'2024-05-01T10:03:20.999Z'"),
+    ):
+        assert_match(
+            f"SELECT count(*) AS c FROM t WHERE p_timestamp {op} {lit}",
+            [ms_table()],
+        )
+
+
+def test_subsecond_date_bin_on_device():
+    assert_match(
+        "SELECT date_bin(interval '250 milliseconds', p_timestamp) AS b, "
+        "count(*) AS c, sum(bytes) AS s FROM t "
+        "WHERE p_timestamp < '2024-05-01T10:00:10Z' GROUP BY b",
+        [ms_table()],
+    )
+
+
+def test_one_second_date_bin_groups_subsecond_rows():
+    assert_match(
+        "SELECT date_bin(interval '1 second', p_timestamp) AS b, "
+        "count(*) AS c FROM t GROUP BY b",
+        [ms_table()],
+    )
+
+
+def test_multi_block_different_days():
+    """Blocks from different days have different per-batch origins; the
+    runtime bin-offset scalars must line their group spaces up exactly."""
+    t1 = ms_table(2000)
+    ts2 = [BASE + timedelta(days=3, milliseconds=500 * i) for i in range(2000)]
+    t2 = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts2, pa.timestamp("ms")),
+            "status": pa.array([200.0 if i % 2 else 404.0 for i in range(2000)]),
+            "bytes": pa.array([float(i) for i in range(2000)]),
+        }
+    )
+    assert_match(
+        "SELECT date_bin(interval '1 hour', p_timestamp) AS b, "
+        "count(*) AS c, sum(bytes) AS s FROM t GROUP BY b",
+        [t1, t2],
+    )
+    assert_match(
+        "SELECT count(*) AS c FROM t WHERE "
+        "p_timestamp > '2024-05-04T10:00:00.250Z'",
+        [t1, t2],
+    )
+
+
+def test_sub_millisecond_literals_stay_exact():
+    """Device values are ms-quantized; a us-precision literal must adjust
+    per op (never match on =, floor/ceil on inequalities) exactly like the
+    CPU engine's full-precision comparison."""
+    for op in ("=", "!=", "<", "<=", ">", ">="):
+        assert_match(
+            f"SELECT count(*) AS c FROM t WHERE p_timestamp {op} "
+            "'2024-05-01T10:00:01.250500Z'",
+            [ms_table(2000)],
+        )
+
+
+def test_us_source_column_with_residue_falls_back():
+    """A timestamp[us] column with true sub-ms values must not silently
+    floor on device — encode declines and the CPU engine answers."""
+    from parseable_tpu.query.executor import QueryExecutor as CPU
+
+    ts = [BASE + timedelta(microseconds=400 + 1000 * i) for i in range(1000)]
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("us")),
+            "bytes": pa.array([float(i) for i in range(1000)]),
+        }
+    )
+    sql = (
+        "SELECT count(*) AS c FROM t WHERE "
+        "p_timestamp < '2024-05-01T10:00:00.000500Z'"
+    )
+    lp = build_plan(parse_sql(sql))
+    cpu = CPU(lp).execute(iter([t]))
+    lp2 = build_plan(parse_sql(sql))
+    ex = TpuQueryExecutor(lp2)
+    tpu = ex.execute(iter([t]))
+    assert cpu.to_pylist() == tpu.to_pylist()
+
+
+def test_pre_origin_literal_clamps():
+    """Literals far outside the block's window clamp without wrapping."""
+    for lit in ("'1969-01-01T00:00:00Z'", "'2200-01-01T00:00:00Z'"):
+        assert_match(
+            f"SELECT count(*) AS c FROM t WHERE p_timestamp > {lit}",
+            [ms_table(1000)],
+        )
+        assert_match(
+            f"SELECT count(*) AS c FROM t WHERE p_timestamp = {lit}",
+            [ms_table(1000)],
+        )
+
+
+def test_nulls_in_time_column():
+    ts = [BASE + timedelta(milliseconds=100 * i) for i in range(999)] + [None]
+    t = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(ts, pa.timestamp("ms")),
+            "bytes": pa.array([float(i) for i in range(1000)]),
+        }
+    )
+    assert_match(
+        "SELECT count(*) AS c FROM t WHERE "
+        "p_timestamp >= '2024-05-01T10:00:00.100Z'",
+        [t],
+    )
+
+
+def test_enccache_roundtrip_preserves_origin(tmp_path):
+    """PTEC3 persists the per-batch time origin; a reloaded block must
+    produce identical ms-exact results."""
+    import numpy as np
+
+    from parseable_tpu.ops.device import encode_table
+    from parseable_tpu.ops.enccache import EncodedBlockCache
+
+    t = ms_table(512)
+    enc = encode_table(t, {DEFAULT_TIMESTAMP_KEY, "bytes"})
+    assert enc is not None
+    assert enc.time_origin_ms % 86_400_000 == 0
+    cache = EncodedBlockCache(tmp_path)
+    assert cache.put(b"src1", enc)
+    cache.wait_idle()
+    back = cache.get(b"src1", {DEFAULT_TIMESTAMP_KEY, "bytes"}, set())
+    assert back is not None
+    assert back.time_origin_ms == enc.time_origin_ms
+    np.testing.assert_array_equal(
+        back.columns[DEFAULT_TIMESTAMP_KEY].values[:512],
+        enc.columns[DEFAULT_TIMESTAMP_KEY].values[:512],
+    )
