@@ -51,6 +51,14 @@ def _load() -> ctypes.CDLL | None:
         ctypes.c_void_p,
         ctypes.c_uint64,
     ]
+    lib.ptpu_hll_idx_rank_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
     lib.ptpu_hll_merge.restype = ctypes.c_int
     lib.ptpu_hll_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.ptpu_hll_estimate.restype = ctypes.c_double
@@ -154,6 +162,35 @@ def otel_logs_ndjson(payload: bytes, ts_as_ms: bool = True) -> tuple[bytes, int]
     finally:
         lib.ptpu_free(out)
     return data, int(nrows.value)
+
+
+def hll_idx_rank_batch(
+    buf: bytes | bytearray, offsets: np.ndarray, p: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Batched HLL (index, rank) over length-prefixed strings: one FFI
+    crossing for a whole dictionary (ops/hll_sketch.py cold-block LUTs).
+    offsets: uint64[n+1]. Returns (idx int32[n], rank int32[n]) or None
+    when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    idx = np.empty(n, dtype=np.int32)
+    rank = np.empty(n, dtype=np.int32)
+    if n:
+        lib.ptpu_hll_idx_rank_batch(
+            (ctypes.c_char * len(buf)).from_buffer(
+                buf if isinstance(buf, bytearray) else bytearray(buf)
+            ),
+            np.ascontiguousarray(offsets, dtype=np.uint64).ctypes.data_as(
+                ctypes.c_void_p
+            ),
+            n,
+            p,
+            idx.ctypes.data_as(ctypes.c_void_p),
+            rank.ctypes.data_as(ctypes.c_void_p),
+        )
+    return idx, rank
 
 
 def xxh64(data: bytes, seed: int = 0) -> int:

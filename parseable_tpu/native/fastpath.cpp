@@ -196,6 +196,21 @@ double ptpu_hll_estimate(const void* ptr) {
     return e;
 }
 
+// Batch (index, rank) computation for the query engine's approx_distinct
+// register sketch (ops/hll_sketch.py): one FFI crossing hashes a whole
+// dictionary instead of a ctypes call per value.
+void ptpu_hll_idx_rank_batch(const uint8_t* buf, const uint64_t* offsets,
+                             uint64_t n, uint32_t p, int32_t* idx_out,
+                             int32_t* rank_out) {
+    for (uint64_t i = 0; i < n; i++) {
+        uint64_t h = ptpu_xxh64(buf + offsets[i], offsets[i + 1] - offsets[i], 0);
+        idx_out[i] = (int32_t)(h >> (64 - p));
+        uint64_t rest = h << p;
+        rank_out[i] = rest == 0 ? (int32_t)(64 - p + 1)
+                                : (int32_t)(__builtin_clzll(rest) + 1);
+    }
+}
+
 // serialize registers for cross-process merge (field stats upload)
 uint64_t ptpu_hll_bytes(const void* ptr) { return ((const Hll*)ptr)->m; }
 

@@ -1,0 +1,135 @@
+"""Shared HyperLogLog register sketch for approx_distinct (VERDICT r4 #5).
+
+One definition serves BOTH engines, so their estimates are bit-identical:
+
+- CPU engine: registers_add folds (unique) values into a [M] uint8
+  register file per group;
+- TPU engine: per-block dictionary values hash ONCE on host into
+  (index, rank) LUTs; on device the update is a single segment_max over
+  `group_id * M + idx_lut[codes]` with value `rank_lut[codes]` — the same
+  flat mergeable shape as the distinct presence bitmaps, pmax-merged
+  across the mesh data axis.
+
+Registers merge by elementwise max (associative/commutative/idempotent),
+so device partials, CPU-fallback partials, and distributed shards all
+combine exactly. The estimator is the standard bias-corrected HLL with
+linear counting for the small range (same scheme as the native field-
+stats sketch, fastpath.cpp ptpu_hll_estimate; reference:
+src/storage/field_stats.rs:545-734 and DataFusion's approx_distinct).
+
+Hash input is str(value).encode() — deterministic across engines and
+column types (the arrow->python values both engines see are identical
+objects).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable
+
+import numpy as np
+
+from parseable_tpu import native
+
+HLL_P = 12  # 4096 registers: ~1.6% standard error, 4 KB/group dense
+HLL_M = 1 << HLL_P
+
+
+def value_hash(v: Any) -> int:
+    return native.xxh64(str(v).encode())
+
+
+def hash_to_idx_rank(h: int) -> tuple[int, int]:
+    """Register index = top P bits; rank = leading-zeros(+1) of the rest."""
+    idx = h >> (64 - HLL_P)
+    rest = (h << HLL_P) & 0xFFFFFFFFFFFFFFFF
+    # clz(rest) + 1 for a 64-bit value; all-zero rest saturates at 64-P+1
+    rank = (64 - rest.bit_length() + 1) if rest else (64 - HLL_P + 1)
+    return idx, rank
+
+
+def luts_for_dictionary(dictionary: list) -> tuple[np.ndarray, np.ndarray]:
+    """Per-block LUTs for dict-encoded columns: (idx int32[N], rank
+    int32[N]). The trailing null slot (and any None) gets rank 0 — a
+    no-op against zero-initialized registers.
+
+    Batched through ONE native FFI call (ptpu_hll_idx_rank_batch): a
+    per-value ctypes hash would cost ~1us x dictionary size on exactly
+    the high-cardinality cold blocks this sketch exists for."""
+    n = len(dictionary)
+    buf = bytearray()
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    none_pos: list[int] = []
+    for i, v in enumerate(dictionary):
+        if v is None:
+            none_pos.append(i)
+        else:
+            buf.extend(str(v).encode())
+        offsets[i + 1] = len(buf)
+    r = native.hll_idx_rank_batch(buf, offsets, HLL_P)
+    if r is not None:
+        idx, rank = r
+    else:
+        idx = np.zeros(n, dtype=np.int32)
+        rank = np.zeros(n, dtype=np.int32)
+        for i, v in enumerate(dictionary):
+            if v is None:
+                continue
+            ix, rk = hash_to_idx_rank(value_hash(v))
+            idx[i] = ix
+            rank[i] = rk
+        return idx, rank
+    for i in none_pos:  # zero-length slots hashed garbage-free but mask anyway
+        idx[i] = 0
+        rank[i] = 0
+    return idx, rank
+
+
+def registers_add(regs: np.ndarray | None, values: Iterable[Any]) -> np.ndarray:
+    """Fold values into a [M] uint8 register file (CPU engine)."""
+    if regs is None:
+        regs = np.zeros(HLL_M, dtype=np.uint8)
+    for v in values:
+        if v is None:
+            continue
+        ix, rk = hash_to_idx_rank(value_hash(v))
+        if rk > regs[ix]:
+            regs[ix] = rk
+    return regs
+
+
+def merge_registers(a: np.ndarray | None, b: np.ndarray | None) -> np.ndarray | None:
+    """Elementwise max. COPIES on the single-sided paths: the result may
+    be mutated by registers_add, and aliasing a donor aggregator's array
+    would corrupt it (merge-twice / merge-then-update)."""
+    if a is None:
+        return None if b is None else b.copy()
+    if b is None:
+        return a.copy()
+    return np.maximum(a, b)
+
+
+_ALPHA = 0.7213 / (1.0 + 1.079 / HLL_M)
+
+
+def estimate(regs: np.ndarray) -> float:
+    """Bias-corrected estimate with linear counting for the small range."""
+    regs = np.asarray(regs, dtype=np.float64)
+    s = np.power(2.0, -regs).sum()
+    e = _ALPHA * HLL_M * HLL_M / s
+    zeros = int((regs == 0).sum())
+    if e <= 2.5 * HLL_M and zeros > 0:
+        return HLL_M * math.log(HLL_M / zeros)
+    return float(e)
+
+
+def estimate_many(regs: np.ndarray) -> np.ndarray:
+    """Vectorized estimate over [G, M] register files."""
+    regs = np.asarray(regs, dtype=np.float64)
+    s = np.power(2.0, -regs).sum(axis=1)
+    e = _ALPHA * HLL_M * HLL_M / s
+    zeros = (regs == 0).sum(axis=1)
+    small = (e <= 2.5 * HLL_M) & (zeros > 0)
+    with np.errstate(divide="ignore"):
+        lc = HLL_M * np.log(HLL_M / np.maximum(zeros, 1))
+    return np.where(small, lc, e)

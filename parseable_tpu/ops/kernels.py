@@ -56,9 +56,10 @@ def masked_distinct_bitmap(
     num_values: int,
 ) -> jnp.ndarray:
     """Exact per-group distinct of a dict-encoded column: presence matrix
-    [num_groups, num_values] (works while G*V stays device-sized; high-
-    cardinality distinct falls back to the CPU engine until the HLL sketch
-    kernel lands)."""
+    [num_groups, num_values] (works while G*V stays device-sized;
+    approx_distinct instead maxes HLL ranks into a fixed [G, HLL_M]
+    register file — ops/hll_sketch.py — so high-cardinality distinct
+    stays on device)."""
     flat = group_ids * num_values + jnp.minimum(value_codes, num_values - 1)
     present = jax.ops.segment_max(
         mask.astype(jnp.float32), flat, num_segments=num_groups * num_values

@@ -456,8 +456,9 @@ def _collect_aggs(e: S.Expr, out: list[AggSpec], counter: list[int]) -> S.Expr:
             func = "count_star"
         elif e.args:
             arg = e.args[0]
-        if func == "approx_distinct":
-            func = "count_distinct"
+        # approx_distinct keeps its own func: HLL register estimate
+        # (ops/hll_sketch.py) in both engines — device-native and
+        # mesh-mergeable where exact distinct would blow the bitmap budget
         param: float | None = None
         if func == "approx_percentile_cont":
             func = "percentile"
@@ -514,6 +515,7 @@ class GroupState:
     distincts: list[set]
     sumsqs: list[float]
     sketches: list[Any]  # QuantileSketch | None per spec
+    hlls: list[Any]  # approx_distinct uint8[HLL_M] registers | None per spec
 
 
 class HashAggregator:
@@ -538,6 +540,7 @@ class HashAggregator:
             distincts=[set() for _ in range(n)],
             sumsqs=[0.0] * n,
             sketches=[None] * n,
+            hlls=[None] * n,
         )
 
     def update(self, table: pa.Table, mask: pa.Array | None = None) -> None:
@@ -687,13 +690,19 @@ class HashAggregator:
                     st.sketches[si].update(sorted_vals[s:e])
                     st.count[si] = st.sketches[si].count
 
-        # exact distinct: unique (keys, value) combos per chunk -> host sets
+        # distinct: unique (keys, value) combos per chunk -> host sets
+        # (exact) or HLL registers (approx_distinct; hashing the uniques
+        # is equivalent to hashing every row)
         for si, spec in enumerate(self.specs):
-            if spec.func != "count_distinct":
+            if spec.func not in ("count_distinct", "approx_distinct"):
                 continue
             sel = key_names + [f"__a{si}"]
             uniq = tmp.select(sel).group_by(sel, use_threads=False).aggregate([])
             ucols = {name: uniq.column(name).to_pylist() for name in uniq.column_names}
+            approx = spec.func == "approx_distinct"
+            if approx:
+                from parseable_tpu.ops.hll_sketch import registers_add
+
             for r in range(len(uniq)):
                 key = tuple(ucols[k][r] for k in key_names)
                 v = ucols[f"__a{si}"][r]
@@ -703,7 +712,10 @@ class HashAggregator:
                 if st is None:
                     st = self._new_state()
                     self.groups[key] = st
-                st.distincts[si].add(v)
+                if approx:
+                    st.hlls[si] = registers_add(st.hlls[si], (v,))
+                else:
+                    st.distincts[si].add(v)
 
     @staticmethod
     def _copy_state(st: GroupState) -> GroupState:
@@ -717,6 +729,7 @@ class HashAggregator:
             distincts=[set(s) for s in st.distincts],
             sumsqs=list(st.sumsqs),
             sketches=[sk.copy() if sk is not None else None for sk in st.sketches],
+            hlls=[h.copy() if h is not None else None for h in st.hlls],
         )
 
     def merge(self, other: "HashAggregator") -> None:
@@ -734,6 +747,12 @@ class HashAggregator:
                     b = getattr(st, attr)[si]
                     getattr(mine, attr)[si] = b if a is None else (a if b is None else fn(a, b))
                 mine.distincts[si] |= st.distincts[si]
+                if st.hlls[si] is not None:
+                    from parseable_tpu.ops.hll_sketch import merge_registers
+
+                    # merge_registers copies on the None path: registers_add
+                    # mutates in place and the donor must stay untouched
+                    mine.hlls[si] = merge_registers(mine.hlls[si], st.hlls[si])
                 if st.sketches[si] is not None:
                     if mine.sketches[si] is None:
                         mine.sketches[si] = st.sketches[si].copy()
@@ -751,6 +770,7 @@ class HashAggregator:
         distincts: dict[int, set] | None = None,
         sumsqs: list[float] | None = None,
         sketches: dict[int, Any] | None = None,
+        hlls: dict[int, Any] | None = None,
     ) -> None:
         """Merge one group's partials produced by a device kernel.
 
@@ -775,6 +795,11 @@ class HashAggregator:
         if distincts:
             for si, vals_set in distincts.items():
                 st.distincts[si] |= vals_set
+        if hlls:
+            from parseable_tpu.ops.hll_sketch import merge_registers
+
+            for si, regs in hlls.items():
+                st.hlls[si] = merge_registers(st.hlls[si], regs)
         if sketches:
             for si, sk in sketches.items():
                 if st.sketches[si] is None:
@@ -797,6 +822,12 @@ class HashAggregator:
             return st.maxs[si]
         if spec.func == "count_distinct":
             return len(st.distincts[si])
+        if spec.func == "approx_distinct":
+            if st.hlls[si] is None:
+                return 0
+            from parseable_tpu.ops.hll_sketch import estimate
+
+            return int(round(estimate(st.hlls[si])))
         if spec.func in ("stddev", "var"):
             # sample variance (n-1 denominator, DataFusion semantics)
             n = st.count[si]

@@ -24,8 +24,8 @@ kernel sustains >10 G rows/s, so per-query host<->device traffic — not
 FLOPs — is the budget.
 
 Anything the device path can't express (nested types, aggregates over
-expressions or timestamps, count_distinct, sub-second time predicates,
-timestamp equality, date_bin with custom origin or sub-second bins) falls
+expressions, date_bin with custom origin or sub-millisecond bins, exact
+distinct beyond the bitmap budget) falls
 back to the CPU executor — whole-query when detected at plan time, per-table
 otherwise — merging into the same aggregator, so results stay complete and
 exact.
@@ -785,7 +785,10 @@ class AccLayout:
                 countcol_idx.append(i)
             elif spec.func == "percentile":
                 pct_idx.append(i)
-            elif spec.func == "count_distinct":
+            elif spec.func in ("count_distinct", "approx_distinct"):
+                # both ride the flat [G * cap] segment_max machinery:
+                # exact as presence bitmaps over the global dictionary,
+                # approx as HLL register files (cap = HLL_M, value = rank)
                 distinct_idx.append(i)
             else:
                 raise UnsupportedOnDevice(f"aggregate {spec.func}")
@@ -813,6 +816,9 @@ class PlanLayout:
     stacked_cols: list[str]
     distinct_cols: list[str] = dc_field(default_factory=list)
     distinct_caps: tuple[int, ...] = ()
+    # True per distinct col when it is an approx_distinct HLL register
+    # file (dremap = [2, N] idx/rank LUT; update value = rank, not 1)
+    distinct_sketch: tuple[bool, ...] = ()
     sq_cols: list[str] = dc_field(default_factory=list)  # stddev/var inputs
     pct_cols: list[str] = dc_field(default_factory=list)  # percentile inputs
     cnt_cols: list[str] = dc_field(default_factory=list)  # count(col) inputs
@@ -1349,10 +1355,21 @@ class TpuQueryExecutor(QueryExecutor):
         # (masked_distinct_bitmap design, ops/kernels.py). Exact — flush
         # decodes present codes back to values and merges them into the
         # same sets CPU-fallback blocks fill, so mixed paths stay correct.
+        # approx_distinct(y) instead maxes HLL RANKS into a fixed [G,
+        # HLL_M] register file (ops/hll_sketch.py): per-block dictionary
+        # values hash once on host into (idx, rank) LUTs, no global
+        # dictionary ever materializes, and high-cardinality distinct
+        # stays on device end-to-end (VERDICT r4 #5).
+        from parseable_tpu.ops.hll_sketch import HLL_M
+
         dkeys = [
             KeySpec("dict", specs[i].arg.name, specs[i].arg, gdict=GlobalDict())
             for i in distinct_idx
         ]
+        dk_sketch = [specs[i].func == "approx_distinct" for i in distinct_idx]
+        for dk, sk in zip(dkeys, dk_sketch):
+            if sk:
+                dk.capacity = HLL_M
 
         compiler = PredicateCompiler()
         dict_cols = {ks.column for ks in key_specs if ks.kind == "dict"}
@@ -1468,6 +1485,7 @@ class TpuQueryExecutor(QueryExecutor):
                 stacked_cols=[specs[i].arg.name for i in stacked_idx],
                 distinct_cols=[dk.column for dk in dkeys],
                 distinct_caps=tuple(dk.capacity for dk in dkeys),
+                distinct_sketch=tuple(dk_sketch),
                 sq_cols=[specs[i].arg.name for i in sq_idx],
                 pct_cols=[specs[i].arg.name for i in pct_idx],
                 cnt_cols=[specs[i].arg.name for i in countcol_idx],
@@ -1623,29 +1641,44 @@ class TpuQueryExecutor(QueryExecutor):
                 if any(r is None and ks.kind == "dict" for r, ks in zip(remaps, key_specs)):
                     raise UnsupportedOnDevice("group key column missing from batch")
                 dremaps_np = []
-                for dk in dkeys:
+                for dk, sk in zip(dkeys, dk_sketch):
                     col = enc.columns.get(dk.column)
                     if col is None or col.kind != "dict":
                         raise UnsupportedOnDevice(f"distinct column {dk.column} not dict-encoded")
-                    dremaps_np.append(dk.gdict.absorb(col.dictionary))
+                    if sk:
+                        # HLL (idx, rank) LUT over THIS block's dictionary:
+                        # no global dictionary grows, cached per batch
+                        dremaps_np.append(self._hll_lut(enc, col))
+                    else:
+                        dremaps_np.append(dk.gdict.absorb(col.dictionary))
 
                 layouts = [self._required_layout(ks, enc) for ks in key_specs]
                 caps = tuple(c for _, c in layouts)
                 origins = tuple(o for o, _ in layouts)
-                dlayouts = [self._required_layout(dk, enc) for dk in dkeys]
+                dlayouts = [
+                    (0, HLL_M) if sk else self._required_layout(dk, enc)
+                    for dk, sk in zip(dkeys, dk_sketch)
+                ]
                 dcaps = tuple(c for _, c in dlayouts)
                 new_groups = 1
                 for c in caps:
                     new_groups *= c
                 new_groups = max(new_groups, 1)
                 # presence bitmaps are device-resident [G, Vcap] f32 each —
-                # bound the footprint, else fall back (exact) to the CPU
-                if any(new_groups * c > (1 << 24) for c in dcaps):
-                    # caps only grow (gdict.absorb is monotonic): no later
-                    # block can fit either, so stop paying encode+transfer
+                # bound the footprint, else fall back (exact) to the CPU.
+                # HLL register files have a FIXED cap (HLL_M) so they get a
+                # larger budget (1<<27 slots = 512 MB f32 -> G up to 32k):
+                # group count, not value cardinality, is their only axis
+                if any(
+                    new_groups * c > ((1 << 27) if sk else (1 << 24))
+                    for c, sk in zip(dcaps, dk_sketch)
+                ):
+                    # caps only grow (gdict.absorb is monotonic; the group
+                    # space only widens): no later block can fit either,
+                    # so stop paying encode+transfer
                     force_cpu_rest = True
                     raise UnsupportedOnDevice(
-                        "distinct bitmap exceeds device budget (G*V too large)"
+                        "distinct state exceeds device budget (G*V too large)"
                     )
                 # percentile histograms are [G, DEVICE_NB] f32 each; past
                 # the footprint budget the whole scan aggregates host-side
@@ -2155,6 +2188,25 @@ class TpuQueryExecutor(QueryExecutor):
             partials.append(pt)
 
     @staticmethod
+    def _hll_lut(enc: EncodedBatch, col: EncodedColumn) -> np.ndarray:
+        """[2, N] (idx, rank) HLL LUT over the block's dictionary, cached
+        on the batch (lifetime == dictionary lifetime) so hot-set-resident
+        blocks hash their values exactly once."""
+        cache = getattr(enc, "lut_cache", None)
+        if cache is None:
+            cache = {}
+            enc.lut_cache = cache
+        key = ("__hll", col.name, len(col.dictionary))
+        hit = cache.get(key)
+        if hit is None:
+            from parseable_tpu.ops.hll_sketch import luts_for_dictionary
+
+            idx, rank = luts_for_dictionary(col.dictionary)
+            hit = np.stack([idx, rank]).astype(np.int32)
+            cache[key] = hit
+        return hit
+
+    @staticmethod
     def _host_codes(enc: EncodedBatch, dev: dict, column: str) -> np.ndarray:
         """A column's encoded codes on host: the encode-time array when it
         still exists, else a readback (hot-set entries strip host copies)."""
@@ -2563,6 +2615,7 @@ class TpuQueryExecutor(QueryExecutor):
             dev_keys,
             tuple(layout.distinct_cols),
             layout.distinct_caps,
+            layout.distinct_sketch,
             dremap_shapes,
             shard_groups,
             tuple(layout.sq_cols),
@@ -2665,14 +2718,24 @@ class TpuQueryExecutor(QueryExecutor):
                 dev, layout, ids, mask, pac, sums, kernel_groups
             )
             adds = jnp.concatenate([count[None, :], pac, sums], axis=0)
-            # distinct presence: OR (max) each (group, value-code) bit
+            # distinct presence: OR (max) each (group, value-code) bit;
+            # approx_distinct maxes HLL RANKS into the register slot the
+            # value's hash selects (same flat shape, same pmax merge)
             dacc_new = []
+            sketch_flags = layout.distinct_sketch or (False,) * len(layout.distinct_cols)
             for di, (dcol, dcap) in enumerate(zip(layout.distinct_cols, layout.distinct_caps)):
-                codes = jnp.minimum(dremaps[di][_as_index(dev[dcol])], dcap - 1)
                 dm = jnp.logical_and(mask, dev[f"{dcol}__valid"])
+                if sketch_flags[di]:
+                    lut = dremaps[di]
+                    raw = _as_index(dev[dcol])
+                    codes = jnp.minimum(lut[0][raw], dcap - 1)
+                    val = jnp.where(dm, lut[1][raw].astype(jnp.float32), 0.0)
+                else:
+                    codes = jnp.minimum(dremaps[di][_as_index(dev[dcol])], dcap - 1)
+                    val = dm.astype(jnp.float32)
                 flat = ids * jnp.int32(dcap) + codes
                 upd = jax.ops.segment_max(
-                    dm.astype(jnp.float32), flat, num_segments=kernel_groups * dcap
+                    val, flat, num_segments=kernel_groups * dcap
                 )
                 if mesh is not None:
                     upd = jax.lax.pmax(upd, "data")
@@ -2923,8 +2986,9 @@ class TpuQueryExecutor(QueryExecutor):
             for si, spec in enumerate(specs):
                 if spec.func == "count_star":
                     counts.append(int(arr[0][flat]))
-                elif spec.func in ("count_distinct", "percentile"):
-                    # finalized from the merged value sets / sketches
+                elif spec.func in ("count_distinct", "approx_distinct", "percentile"):
+                    # finalized from the merged value sets / registers /
+                    # sketches
                     counts.append(0)
                 else:
                     counts.append(int(arr[lay.pac_row(si)][flat]))
@@ -2958,11 +3022,17 @@ class TpuQueryExecutor(QueryExecutor):
                 else:
                     maxs_l.append(None)
             distincts = None
+            hlls = None
             if dists:
                 distincts = {}
                 for si, dk, presence in dists:
-                    codes = np.nonzero(presence[flat][: len(dk.gdict)] > 0)[0]
-                    distincts[si] = {dk.gdict.values[c] for c in codes}
+                    if specs[si].func == "approx_distinct":
+                        if hlls is None:
+                            hlls = {}
+                        hlls[si] = presence[flat].astype(np.uint8)
+                    else:
+                        codes = np.nonzero(presence[flat][: len(dk.gdict)] > 0)[0]
+                        distincts[si] = {dk.gdict.values[c] for c in codes}
             sketches = None
             if pcts:
                 sketches = {}
@@ -2978,7 +3048,7 @@ class TpuQueryExecutor(QueryExecutor):
                     sketches = None
             agg.merge_raw(
                 tuple(key_parts), counts, sums_l, mins_l, maxs_l, distincts,
-                sumsqs=sumsqs_l, sketches=sketches,
+                sumsqs=sumsqs_l, sketches=sketches, hlls=hlls,
             )
 
 

@@ -62,6 +62,8 @@ AGGS = [
     ("a", "count(*)"), ("a", "count(lat)"), ("a", "sum(lat)"), ("a", "avg(lat)"),
     ("a", "min(lat)"), ("a", "max(lat)"), ("a", "sum(status)"),
     ("a", "count(distinct host)"), ("a", "count(distinct path)"),
+    # bit-identical across engines: both build the same HLL registers
+    ("a", "approx_distinct(host)"), ("a", "approx_distinct(path)"),
     ("s", "stddev(lat)"), ("s", "var(lat)"), ("s", "stddev(status)"),
     ("p", "approx_percentile_cont(lat, 0.9)"),
     ("p", "approx_percentile_cont(lat, 0.5)"),
@@ -75,6 +77,11 @@ FILTERS = [
     "status >= 300 AND lat < 80", "status = 500 OR status = 404",
     "p_timestamp >= '2024-05-01T10:30:00Z'",
     "p_timestamp < '2024-05-01T11:00:00Z'",
+    # ms-exact device time (no second-floor fallbacks): every op at any
+    # precision must agree with the CPU engine
+    "p_timestamp > '2024-05-01T10:30:00.250Z'",
+    "p_timestamp <= '2024-05-01T10:45:30.500Z'",
+    "p_timestamp = '2024-05-01T10:30:05Z'",
     "NOT (host = 'h1')",
 ]
 # HAVING only over COUNTS: they are exact on both engines, so threshold

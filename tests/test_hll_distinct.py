@@ -1,0 +1,151 @@
+"""approx_distinct: device HLL register sketch (VERDICT r4 #5).
+
+Both engines share ops/hll_sketch.py (same hash, same registers, same
+estimator), so their estimates must be BIT-IDENTICAL — not merely close.
+High-cardinality distinct stays on the device path end-to-end (no
+cpu_fallback), with registers pmax-merged across the virtual mesh.
+Reference: src/storage/field_stats.rs:545-734 (HLL), DataFusion
+approx_distinct semantics."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from parseable_tpu.query.executor import QueryExecutor
+from parseable_tpu.query.executor_tpu import TpuQueryExecutor
+from parseable_tpu.query.planner import plan as build_plan
+from parseable_tpu.query.sql import parse_sql
+
+
+def table_with_uniques(n_rows: int, n_unique: int, seed=0, groups=("a", "b")):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "g": pa.array(rng.choice(list(groups), n_rows).tolist()),
+            "v": pa.array([f"val{i}" for i in rng.integers(0, n_unique, n_rows)]),
+        }
+    )
+
+
+def run_engines(sql, tables):
+    lp = build_plan(parse_sql(sql))
+    cpu = QueryExecutor(lp).execute(iter(list(tables)))
+    lp2 = build_plan(parse_sql(sql))
+    ex = TpuQueryExecutor(lp2)
+    tpu = ex.execute(iter(list(tables)))
+    return cpu, tpu, ex
+
+
+def test_engines_bit_identical():
+    t = table_with_uniques(100_000, 20_000)
+    cpu, tpu, ex = run_engines(
+        "SELECT g, approx_distinct(v) AS d FROM t GROUP BY g", [t]
+    )
+    assert ex.route_stats["cpu_fallback"] == 0, ex.route_stats
+    rc = sorted(cpu.to_pylist(), key=lambda r: r["g"])
+    rt = sorted(tpu.to_pylist(), key=lambda r: r["g"])
+    assert rc == rt  # same registers -> same estimate, exactly
+
+
+def test_error_bound_at_1m_distinct():
+    """>=1M distinct values through the DEVICE path: the G x V presence
+    bitmap could never hold this (2 groups x 1M values), the HLL register
+    file does — and the estimate lands within the ~1.6% standard error
+    envelope (assert 5% = ~3 sigma)."""
+    n = 1 << 21
+    rng = np.random.default_rng(7)
+    t = pa.table(
+        {
+            "g": pa.array(rng.choice(["x", "y"], n).tolist()),
+            "v": pa.array([f"u{i}" for i in range(n)]),  # all rows unique
+        }
+    )
+    exact_per_group = {}
+    gl = t.column("g").to_pylist()
+    for g in ("x", "y"):
+        exact_per_group[g] = sum(1 for x in gl if x == g)
+    cpu, tpu, ex = run_engines(
+        "SELECT g, approx_distinct(v) AS d FROM t GROUP BY g", [t]
+    )
+    assert ex.route_stats["cpu_fallback"] == 0, ex.route_stats
+    rows = {r["g"]: r["d"] for r in tpu.to_pylist()}
+    for g, exact in exact_per_group.items():
+        err = abs(rows[g] - exact) / exact
+        assert err < 0.05, f"group {g}: est {rows[g]} vs exact {exact} ({err:.2%})"
+    assert cpu.to_pylist() != [] and sorted(
+        cpu.to_pylist(), key=lambda r: r["g"]
+    ) == sorted(tpu.to_pylist(), key=lambda r: r["g"])
+
+
+def test_multi_block_register_merge():
+    """Registers must max-merge across blocks: two blocks sharing values
+    estimate the union, not the sum."""
+    t1 = table_with_uniques(50_000, 30_000, seed=1)
+    t2 = table_with_uniques(50_000, 30_000, seed=2)  # same value space
+    cpu, tpu, ex = run_engines(
+        "SELECT approx_distinct(v) AS d FROM t", [t1, t2]
+    )
+    assert cpu.to_pylist() == tpu.to_pylist()
+    d = tpu.to_pylist()[0]["d"]
+    assert 25_000 < d < 35_000  # union ~30k, never ~60k
+
+
+def test_mixed_with_other_aggregates():
+    t = table_with_uniques(80_000, 10_000, seed=3)
+    t = t.append_column("x", pa.array(np.arange(80_000, dtype=np.float64)))
+    cpu, tpu, ex = run_engines(
+        "SELECT g, approx_distinct(v) AS d, count(*) AS c, sum(x) AS s "
+        "FROM t GROUP BY g",
+        [t],
+    )
+    rc = sorted(cpu.to_pylist(), key=lambda r: r["g"])
+    rt = sorted(tpu.to_pylist(), key=lambda r: r["g"])
+    for a, b in zip(rc, rt):
+        assert a["d"] == b["d"] and a["c"] == b["c"]
+        assert abs(a["s"] - b["s"]) <= 1e-4 * max(1.0, abs(a["s"]))
+
+
+def test_exact_count_distinct_unchanged():
+    """count(distinct) stays EXACT (bitmap or CPU) — approx_distinct is
+    the opt-in sketch."""
+    t = table_with_uniques(20_000, 500, seed=4)
+    cpu, tpu, _ = run_engines(
+        "SELECT g, count(distinct v) AS d FROM t GROUP BY g", [t]
+    )
+    assert sorted(cpu.to_pylist(), key=lambda r: r["g"]) == sorted(
+        tpu.to_pylist(), key=lambda r: r["g"]
+    )
+    # exact answer, independently verified
+    import collections
+
+    seen = collections.defaultdict(set)
+    for g, v in zip(t.column("g").to_pylist(), t.column("v").to_pylist()):
+        seen[g].add(v)
+    got = {r["g"]: r["d"] for r in cpu.to_pylist()}
+    assert got == {g: len(s) for g, s in seen.items()}
+
+
+def test_sketch_module_properties():
+    from parseable_tpu.ops.hll_sketch import (
+        HLL_M,
+        estimate,
+        estimate_many,
+        merge_registers,
+        registers_add,
+    )
+
+    r1 = registers_add(None, (f"a{i}" for i in range(10_000)))
+    r2 = registers_add(None, (f"a{i}" for i in range(5_000, 15_000)))
+    m = merge_registers(r1, r2)
+    e1, em = estimate(r1), estimate(m)
+    assert abs(e1 - 10_000) / 10_000 < 0.05
+    assert abs(em - 15_000) / 15_000 < 0.05
+    # merge is idempotent and commutative
+    assert np.array_equal(merge_registers(m, r1), m)
+    assert np.array_equal(merge_registers(r2, r1), m)
+    # vectorized estimator agrees with the scalar one
+    both = np.stack([r1, m])
+    ve = estimate_many(both)
+    assert abs(ve[0] - e1) < 1e-6 and abs(ve[1] - em) < 1e-6
+    assert both.shape[1] == HLL_M
