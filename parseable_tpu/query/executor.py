@@ -796,10 +796,16 @@ class HashAggregator:
             for si, vals_set in distincts.items():
                 st.distincts[si] |= vals_set
         if hlls:
-            from parseable_tpu.ops.hll_sketch import merge_registers
+            import numpy as np
 
+            # merge_raw takes OWNERSHIP of the register arrays (its only
+            # callers hand over freshly materialized device readbacks), so
+            # the None-sided path adopts without the defensive copy
             for si, regs in hlls.items():
-                st.hlls[si] = merge_registers(st.hlls[si], regs)
+                if st.hlls[si] is None:
+                    st.hlls[si] = regs
+                else:
+                    np.maximum(st.hlls[si], regs, out=st.hlls[si])
         if sketches:
             for si, sk in sketches.items():
                 if st.sketches[si] is None:

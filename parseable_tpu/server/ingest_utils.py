@@ -191,7 +191,6 @@ def _ndjson_to_event(
     the columns from natively-flattened NDJSON and the shared fast-path
     normalization types them — per-record Python never runs. Returns None
     when the reader or the normalizer prefers the exact Python path."""
-    import io
     from datetime import UTC, datetime
 
     import pyarrow as pa
@@ -203,7 +202,8 @@ def _ndjson_to_event(
 
     meta = stream.metadata
     try:
-        tbl = pj.read_json(io.BytesIO(ndjson))
+        # BufferReader wraps the bytes zero-copy (BytesIO copies them)
+        tbl = pj.read_json(pa.BufferReader(ndjson))
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
         return None  # reader-level type conflict: Python path decides
     for name in cast_ts_ms:

@@ -40,10 +40,16 @@ def add_parseable_fields(
     custom_fields: dict[str, str] | None = None,
 ) -> pa.RecordBatch:
     """Prepend p_timestamp + constant custom columns (sorted by name)."""
+    import numpy as np
+
     n = batch.num_rows
     names: list[str] = [DEFAULT_TIMESTAMP_KEY]
+    # constant fill through numpy, not a Python datetime list: this runs
+    # per ingest batch and the per-object conversion was ~30% of the
+    # native lane's residual overhead
+    ts_ms = np.int64(p_timestamp.timestamp() * 1000)
     arrays: list[pa.Array] = [
-        pa.array([p_timestamp] * n, type=pa.timestamp("ms"))
+        pa.array(np.full(n, ts_ms, dtype="datetime64[ms]"), type=pa.timestamp("ms"))
     ]
     for key in sorted(custom_fields or {}):
         if key == DEFAULT_TIMESTAMP_KEY:
