@@ -45,7 +45,18 @@ def _env(name: str, default: str = "") -> str:
 
 @dataclass
 class KafkaConfig:
-    """P_KAFKA_* env parity (reference: connectors/kafka/config.rs)."""
+    """P_KAFKA_* env parity (reference: connectors/kafka/config.rs).
+
+    Auth modes (SecurityConfig :740-1050): PLAINTEXT, SSL (mutual TLS —
+    CA required, client cert+key for mTLS), SASL PLAIN/SCRAM, and
+    SASL/OAUTHBEARER with two providers — `oidc` (librdkafka's built-in
+    token-endpoint handler; Google Managed Kafka's local auth server
+    speaks it) and `aws-msk` (MSK IAM: a SigV4-presigned
+    kafka-cluster:Connect URL as the bearer token, refreshed through the
+    consumer's oauth callback). Provider resolution precedence matches
+    the reference: explicit P_KAFKA_OAUTH_PROVIDER, else an OIDC token
+    endpoint implies oidc, else a resolvable AWS region implies aws-msk.
+    """
 
     bootstrap_servers: str = field(default_factory=lambda: _env("P_KAFKA_BOOTSTRAP_SERVERS"))
     topics: list[str] = field(
@@ -59,11 +70,60 @@ class KafkaConfig:
     sasl_mechanism: str = field(default_factory=lambda: _env("P_KAFKA_SASL_MECHANISM"))
     sasl_username: str = field(default_factory=lambda: _env("P_KAFKA_SASL_USERNAME"))
     sasl_password: str = field(default_factory=lambda: _env("P_KAFKA_SASL_PASSWORD"))
+    # SSL material (reference ssl_* options)
+    ssl_ca_location: str = field(default_factory=lambda: _env("P_KAFKA_SSL_CA_LOCATION"))
+    ssl_certificate_location: str = field(
+        default_factory=lambda: _env("P_KAFKA_SSL_CERTIFICATE_LOCATION")
+    )
+    ssl_key_location: str = field(default_factory=lambda: _env("P_KAFKA_SSL_KEY_LOCATION"))
+    # SASL/OAUTHBEARER provider configuration (:511-552)
+    oauth_provider: str = field(default_factory=lambda: _env("P_KAFKA_OAUTH_PROVIDER"))
+    oauth_token_endpoint_url: str = field(
+        default_factory=lambda: _env("P_KAFKA_OAUTH_TOKEN_ENDPOINT_URL")
+    )
+    oauth_client_id: str = field(default_factory=lambda: _env("P_KAFKA_OAUTH_CLIENT_ID"))
+    oauth_client_secret: str = field(
+        default_factory=lambda: _env("P_KAFKA_OAUTH_CLIENT_SECRET")
+    )
+    aws_region: str = field(default_factory=lambda: _env("P_KAFKA_AWS_REGION"))
+    # librdkafka statistics emission -> Prometheus bridge (metrics.rs)
+    statistics_interval_ms: int = field(
+        default_factory=lambda: int(_env("P_KAFKA_STATISTICS_INTERVAL_MS", "0"))
+    )
     # buffer tuning (reference BufferConfig: 10k records / 10s chunks)
     buffer_size: int = field(default_factory=lambda: int(_env("P_KAFKA_BUFFER_SIZE", "10000")))
     buffer_timeout_secs: float = field(
         default_factory=lambda: float(_env("P_KAFKA_BUFFER_TIMEOUT", "10"))
     )
+
+    def resolved_aws_region(self) -> str | None:
+        """Explicit flag, then AWS_REGION / AWS_DEFAULT_REGION — each
+        trimmed and skipped when empty (config.rs:901-920)."""
+        for cand in (
+            self.aws_region,
+            os.environ.get("AWS_REGION", ""),
+            os.environ.get("AWS_DEFAULT_REGION", ""),
+        ):
+            cand = (cand or "").strip()
+            if cand:
+                return cand
+        return None
+
+    def resolved_oauth_provider(self) -> str | None:
+        """Explicit provider wins, else an OIDC endpoint implies oidc,
+        else a resolvable region implies aws-msk (config.rs:875-895)."""
+        p = self.oauth_provider.strip().lower().replace("_", "-")
+        if p in ("aws-msk", "aws"):
+            return "aws-msk"
+        if p == "oidc":
+            return "oidc"
+        if p:
+            raise ValueError(f"unknown OAuth provider {self.oauth_provider!r}")
+        if self.oauth_token_endpoint_url.strip():
+            return "oidc"
+        if self.resolved_aws_region() is not None:
+            return "aws-msk"
+        return None
 
     def validate(self) -> None:
         if not self.bootstrap_servers:
@@ -72,8 +132,36 @@ class KafkaConfig:
             raise ValueError("P_KAFKA_TOPICS is required")
         if self.security_protocol not in ("PLAINTEXT", "SSL", "SASL_PLAINTEXT", "SASL_SSL"):
             raise ValueError(f"unknown security protocol {self.security_protocol!r}")
-        if self.security_protocol.startswith("SASL") and not self.sasl_mechanism:
-            raise ValueError("SASL protocols need P_KAFKA_SASL_MECHANISM")
+        if self.security_protocol == "SSL":
+            # mutual TLS needs the full client material; SASL_SSL only
+            # server-authenticates so certs are optional there
+            if not self.ssl_ca_location:
+                raise ValueError("SSL requires P_KAFKA_SSL_CA_LOCATION")
+            if bool(self.ssl_certificate_location) != bool(self.ssl_key_location):
+                raise ValueError("SSL client cert and key must be provided together")
+        if self.security_protocol.startswith("SASL"):
+            if not self.sasl_mechanism:
+                raise ValueError("SASL protocols need P_KAFKA_SASL_MECHANISM")
+            if self.sasl_mechanism.upper() == "OAUTHBEARER":
+                provider = self.resolved_oauth_provider()
+                if provider is None:
+                    raise ValueError(
+                        "OAUTHBEARER needs P_KAFKA_OAUTH_PROVIDER, an OIDC "
+                        "token endpoint, or an AWS region"
+                    )
+                if provider == "oidc" and not self.oauth_token_endpoint_url.strip():
+                    raise ValueError(
+                        "oidc provider requires P_KAFKA_OAUTH_TOKEN_ENDPOINT_URL"
+                    )
+                if provider == "aws-msk" and self.resolved_aws_region() is None:
+                    raise ValueError(
+                        "aws-msk provider requires P_KAFKA_AWS_REGION or AWS_REGION"
+                    )
+            elif self.sasl_mechanism.upper() in ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512"):
+                if not self.sasl_username or not self.sasl_password:
+                    raise ValueError(
+                        f"{self.sasl_mechanism} requires username and password"
+                    )
 
     def librdkafka_conf(self) -> dict:
         conf = {
@@ -83,11 +171,176 @@ class KafkaConfig:
             "security.protocol": self.security_protocol.lower(),
             "enable.auto.commit": False,
         }
+        if self.ssl_ca_location:
+            conf["ssl.ca.location"] = self.ssl_ca_location
+        if self.ssl_certificate_location:
+            conf["ssl.certificate.location"] = self.ssl_certificate_location
+        if self.ssl_key_location:
+            conf["ssl.key.location"] = self.ssl_key_location
+        if self.statistics_interval_ms > 0:
+            conf["statistics.interval.ms"] = self.statistics_interval_ms
         if self.sasl_mechanism:
             conf["sasl.mechanism"] = self.sasl_mechanism
-            conf["sasl.username"] = self.sasl_username
-            conf["sasl.password"] = self.sasl_password
+            if self.sasl_mechanism.upper() == "OAUTHBEARER":
+                if self.resolved_oauth_provider() == "oidc":
+                    # librdkafka's built-in OIDC handler fetches/refreshes
+                    # tokens from the endpoint (config.rs:851-868)
+                    conf["sasl.oauthbearer.method"] = "oidc"
+                    conf["sasl.oauthbearer.token.endpoint.url"] = (
+                        self.oauth_token_endpoint_url
+                    )
+                    if self.oauth_client_id:
+                        conf["sasl.oauthbearer.client.id"] = self.oauth_client_id
+                    if self.oauth_client_secret:
+                        conf["sasl.oauthbearer.client.secret"] = self.oauth_client_secret
+                # aws-msk: token minted by the oauth callback instead
+                # (RdKafkaConsumer wires oauth_cb -> msk_iam_token)
+            else:
+                conf["sasl.username"] = self.sasl_username
+                conf["sasl.password"] = self.sasl_password
         return conf
+
+
+# -------------------------------------------------------------- MSK IAM token
+
+
+def msk_iam_token(
+    region: str,
+    access_key: str | None = None,
+    secret_key: str | None = None,
+    session_token: str | None = None,
+    now: float | None = None,
+) -> tuple[str, float]:
+    """AWS MSK IAM SASL/OAUTHBEARER token (the published signer scheme):
+    a SigV4 QUERY-presigned `kafka-cluster:Connect` URL against
+    kafka.{region}.amazonaws.com, User-Agent appended after signing, then
+    base64url-encoded without padding. Returns (token, expiry_epoch_secs)
+    — the shape librdkafka's oauth_cb wants. Credentials default to the
+    standard AWS_* environment variables."""
+    import base64
+    import datetime as _dt
+    import hashlib
+    import hmac as _hmac
+    from urllib.parse import quote
+
+    access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+    secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+    session_token = session_token or os.environ.get("AWS_SESSION_TOKEN") or None
+    if not access_key or not secret_key:
+        raise ValueError("MSK IAM needs AWS credentials (AWS_ACCESS_KEY_ID/...)")
+
+    host = f"kafka.{region}.amazonaws.com"
+    t = _dt.datetime.fromtimestamp(now, _dt.UTC) if now else _dt.datetime.now(_dt.UTC)
+    amz_date = t.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = t.strftime("%Y%m%d")
+    scope = f"{datestamp}/{region}/kafka-cluster/aws4_request"
+    expires = 900
+
+    query = {
+        "Action": "kafka-cluster:Connect",
+        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+        "X-Amz-Credential": f"{access_key}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    }
+    if session_token:
+        query["X-Amz-Security-Token"] = session_token
+
+    def enc(s: str) -> str:
+        return quote(s, safe="-._~")
+
+    canonical_query = "&".join(f"{enc(k)}={enc(v)}" for k, v in sorted(query.items()))
+    canonical_request = "\n".join(
+        [
+            "GET",
+            "/",
+            canonical_query,
+            f"host:{host}\n",
+            "host",
+            hashlib.sha256(b"").hexdigest(),
+        ]
+    )
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+
+    def hkey(key: bytes, msg: str) -> bytes:
+        return _hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = hkey(("AWS4" + secret_key).encode(), datestamp)
+    k = hkey(k, region)
+    k = hkey(k, "kafka-cluster")
+    k = hkey(k, "aws4_request")
+    signature = _hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+    url = f"https://{host}/?{canonical_query}&X-Amz-Signature={signature}"
+    url += f"&User-Agent={enc('parseable-tpu-msk-iam/1.0')}"
+    token = base64.urlsafe_b64encode(url.encode()).decode().rstrip("=")
+    return token, t.timestamp() + expires
+
+
+# ------------------------------------------------------ statistics -> metrics
+
+
+class KafkaStatsBridge:
+    """librdkafka statistics JSON (stats_cb) -> Prometheus gauges
+    (reference: connectors/kafka/metrics.rs — the full per-client,
+    per-broker, per-topic-partition statistics surface)."""
+
+    TOP = ("msg_cnt", "msg_size", "tx", "tx_bytes", "rx", "rx_bytes",
+           "txmsgs", "rxmsgs", "replyq", "metadata_cache_cnt")
+    BROKER = ("outbuf_cnt", "outbuf_msg_cnt", "waitresp_cnt", "tx", "rx",
+              "txerrs", "rxerrs", "connects", "disconnects")
+    PARTITION = ("consumer_lag", "consumer_lag_stored", "fetchq_cnt",
+                 "fetchq_size", "committed_offset", "lo_offset", "hi_offset",
+                 "app_offset", "stored_offset", "next_offset", "msgs_inflight")
+
+    def update(self, stats_json: str) -> None:
+        from parseable_tpu.utils.metrics import (
+            KAFKA_BROKER_STAT,
+            KAFKA_PARTITION_STAT,
+            KAFKA_STAT,
+        )
+
+        try:
+            stats = json.loads(stats_json)
+        except ValueError:
+            logger.warning("unparseable kafka statistics payload")
+            return
+        client = str(stats.get("client_id", ""))
+        for key in self.TOP:
+            v = stats.get(key)
+            if isinstance(v, (int, float)):
+                KAFKA_STAT.labels(client, key).set(v)
+        for bname, b in (stats.get("brokers") or {}).items():
+            if not isinstance(b, dict):
+                continue
+            KAFKA_BROKER_STAT.labels(client, bname, "state_up").set(
+                1 if b.get("state") == "UP" else 0
+            )
+            rtt = b.get("rtt") or {}
+            if isinstance(rtt, dict) and isinstance(rtt.get("avg"), (int, float)):
+                KAFKA_BROKER_STAT.labels(client, bname, "rtt_avg_us").set(rtt["avg"])
+            for key in self.BROKER:
+                v = b.get(key)
+                if isinstance(v, (int, float)):
+                    KAFKA_BROKER_STAT.labels(client, bname, key).set(v)
+        for tname, t in (stats.get("topics") or {}).items():
+            if not isinstance(t, dict):
+                continue
+            for pname, part in (t.get("partitions") or {}).items():
+                if not isinstance(part, dict) or pname == "-1":
+                    continue
+                for key in self.PARTITION:
+                    v = part.get(key)
+                    if isinstance(v, (int, float)):
+                        KAFKA_PARTITION_STAT.labels(client, tname, pname, key).set(v)
 
 
 # ------------------------------------------------------------- consumer model
@@ -113,14 +366,30 @@ class RdKafkaConsumer:
     close().
     """
 
-    def __init__(self, config: KafkaConfig):
+    def __init__(self, config: KafkaConfig, stats_bridge: "KafkaStatsBridge | None" = None):
         try:
             from confluent_kafka import Consumer
         except ImportError as e:
             raise ConnectorUnavailable(
                 "confluent-kafka is not installed; the Kafka connector is disabled"
             ) from e
-        self._consumer = Consumer(config.librdkafka_conf())
+        conf = dict(config.librdkafka_conf())
+        bridge = stats_bridge or KafkaStatsBridge()
+        if config.statistics_interval_ms > 0:
+            conf["stats_cb"] = bridge.update
+        if (
+            config.sasl_mechanism.upper() == "OAUTHBEARER"
+            and config.resolved_oauth_provider() == "aws-msk"
+        ):
+            region = config.resolved_aws_region()
+
+            def oauth_cb(_cfg_str):
+                token, expiry = msk_iam_token(region)
+                return token, expiry
+
+            conf["oauth_cb"] = oauth_cb
+        self._consumer = Consumer(conf)
+        self.stats_bridge = bridge
 
     def subscribe(self, topics: list[str], on_assign=None, on_revoke=None) -> None:
         kwargs = {}

@@ -48,13 +48,26 @@ def _uri_encode(s: str, encode_slash: bool) -> str:
 
 
 class SigV4Signer:
-    """AWS Signature Version 4 (the published signing algorithm)."""
+    """AWS Signature Version 4 (the published signing algorithm).
 
-    def __init__(self, access_key: str, secret_key: str, region: str, service: str = "s3"):
+    `session_token` (temporary credentials — STS, IMDS instance roles)
+    adds the signed x-amz-security-token header; `extra_headers` lets
+    object operations sign their x-amz-* headers (SSE-C, checksums) as
+    AWS requires."""
+
+    def __init__(
+        self,
+        access_key: str,
+        secret_key: str,
+        region: str,
+        service: str = "s3",
+        session_token: str | None = None,
+    ):
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
         self.service = service
+        self.session_token = session_token
 
     def sign(
         self,
@@ -64,6 +77,7 @@ class SigV4Signer:
         query: dict[str, str],
         payload_sha256: str,
         now: _dt.datetime | None = None,
+        extra_headers: dict[str, str] | None = None,
     ) -> dict[str, str]:
         now = now or _dt.datetime.now(_dt.UTC)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
@@ -76,6 +90,10 @@ class SigV4Signer:
             "x-amz-content-sha256": payload_sha256,
             "x-amz-date": amz_date,
         }
+        if self.session_token:
+            headers["x-amz-security-token"] = self.session_token
+        for k, v in (extra_headers or {}).items():
+            headers[k.lower()] = v
         signed_headers = ";".join(sorted(headers))
         canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
         canonical_request = "\n".join(
@@ -110,11 +128,109 @@ class SigV4Signer:
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
             f"SignedHeaders={signed_headers}, Signature={signature}"
         )
-        return {
-            "Authorization": auth,
-            "x-amz-date": amz_date,
-            "x-amz-content-sha256": payload_sha256,
-        }
+        out = {k: v for k, v in headers.items() if k != "host"}
+        out["Authorization"] = auth
+        return out
+
+
+def parse_ssec_key(spec: str) -> dict[str, str]:
+    """`SSE-C:AES256:<base64 key>` -> the customer-encryption headers
+    (reference: storage/s3.rs:174-230 SSECEncryptionKey). Only SSE-C with
+    AES256 exists, like the reference."""
+    import base64
+
+    parts = spec.split(":", 2)
+    if len(parts) != 3 or parts[0] != "SSE-C":
+        raise ValueError("Expected SSE-C:AES256:<base64_encryption_key>")
+    if parts[1] != "AES256":
+        raise ValueError("Invalid SSE algorithm. Following are supported: AES256")
+    try:
+        raw = base64.b64decode(parts[2], validate=True)
+    except Exception as e:
+        raise ValueError(f"invalid base64 encryption key: {e}") from e
+    md5_b64 = base64.b64encode(hashlib.md5(raw).digest()).decode()
+    return {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key": parts[2],
+        "x-amz-server-side-encryption-customer-key-MD5": md5_b64,
+    }
+
+
+class ImdsCredentials:
+    """EC2 instance-metadata credential chain (reference:
+    storage/s3.rs:152-168 imdsv1_fallback/metadata_endpoint): IMDSv2
+    session token -> role name -> temporary credentials, cached and
+    refreshed ahead of expiry. P_AWS_IMDSV1_FALLBACK permits tokenless
+    (v1) requests when the token endpoint is unavailable."""
+
+    def __init__(
+        self,
+        endpoint: str | None = None,
+        imdsv1_fallback: bool = False,
+        session=None,
+    ):
+        import requests
+
+        self.endpoint = (endpoint or "http://169.254.169.254").rstrip("/")
+        self.imdsv1_fallback = imdsv1_fallback
+        self._session = session or requests.Session()
+        self._creds: tuple[str, str, str | None] | None = None
+        self._expires: float = 0.0
+        self._lock = threading.Lock()
+
+    def _imds_headers(self) -> dict[str, str]:
+        try:
+            tok = self._session.put(
+                f"{self.endpoint}/latest/api/token",
+                headers={"X-aws-ec2-metadata-token-ttl-seconds": "21600"},
+                timeout=3,
+            )
+            if tok.status_code == 200:
+                return {"X-aws-ec2-metadata-token": tok.text}
+        except Exception:
+            pass
+        if not self.imdsv1_fallback:
+            raise ObjectStorageError(
+                "IMDSv2 token fetch failed and IMDSv1 fallback is disabled "
+                "(P_AWS_IMDSV1_FALLBACK)"
+            )
+        return {}
+
+    def get(self) -> tuple[str, str, str | None]:
+        """(access_key, secret_key, session_token), cached until 2 min
+        before the metadata-provided expiry."""
+        import time as _time
+
+        with self._lock:
+            if self._creds is not None and _time.time() < self._expires - 120:
+                return self._creds
+            headers = self._imds_headers()
+            base = f"{self.endpoint}/latest/meta-data/iam/security-credentials"
+            role = self._session.get(base, headers=headers, timeout=3)
+            if role.status_code != 200 or not role.text.strip():
+                raise ObjectStorageError("no IAM instance role in instance metadata")
+            doc = self._session.get(
+                f"{base}/{role.text.strip().splitlines()[0]}", headers=headers, timeout=3
+            )
+            if doc.status_code != 200:
+                raise ObjectStorageError("instance-role credential fetch failed")
+            body = doc.json()
+            self._creds = (
+                body["AccessKeyId"],
+                body["SecretAccessKey"],
+                body.get("Token"),
+            )
+            exp = body.get("Expiration")
+            if exp:
+                try:
+                    self._expires = _dt.datetime.fromisoformat(
+                        exp.replace("Z", "+00:00")
+                    ).timestamp()
+                except ValueError:
+                    self._expires = _time.time() + 3600
+            else:
+                self._expires = _time.time() + 3600
+            return self._creds
 
 
 class S3Storage(ObjectStorage):
@@ -133,6 +249,10 @@ class S3Storage(ObjectStorage):
         multipart_part_size: int = 25 * 1024 * 1024,
         download_chunk_bytes: int = 8 * 1024 * 1024,
         download_concurrency: int = 16,
+        ssec_encryption_key: str | None = None,
+        set_checksum: bool | None = None,
+        imdsv1_fallback: bool | None = None,
+        metadata_endpoint: str | None = None,
     ):
         import os
 
@@ -141,10 +261,38 @@ class S3Storage(ObjectStorage):
         self.bucket = bucket
         self.region = region or "us-east-1"
         self.endpoint = (endpoint or f"https://s3.{self.region}.amazonaws.com").rstrip("/")
+        ak = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        sk = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
         self.signer = SigV4Signer(
-            access_key or os.environ.get("AWS_ACCESS_KEY_ID", ""),
-            secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
-            self.region,
+            ak, sk, self.region,
+            session_token=os.environ.get("AWS_SESSION_TOKEN") or None,
+        )
+        # hardening options (reference storage/s3.rs:85-375 S3Config)
+        ssec = (
+            ssec_encryption_key
+            if ssec_encryption_key is not None
+            else os.environ.get("P_S3_SSEC_ENCRYPTION_KEY", "")
+        )
+        self.ssec_headers = parse_ssec_key(ssec) if ssec else None
+        self.set_checksum = (
+            set_checksum
+            if set_checksum is not None
+            else os.environ.get("P_S3_CHECKSUM", "").lower() in ("1", "true")
+        )
+        # no static credentials anywhere: the EC2 instance-metadata chain
+        # supplies (and refreshes) temporary role credentials
+        self._imds = (
+            ImdsCredentials(
+                endpoint=metadata_endpoint or os.environ.get("P_AWS_METADATA_ENDPOINT"),
+                imdsv1_fallback=(
+                    imdsv1_fallback
+                    if imdsv1_fallback is not None
+                    else os.environ.get("P_AWS_IMDSV1_FALLBACK", "").lower()
+                    in ("1", "true")
+                ),
+            )
+            if not ak and not sk
+            else None
         )
         self.multipart_threshold = multipart_threshold
         self.multipart_part_size = max(5 * 1024 * 1024, multipart_part_size)
@@ -169,7 +317,24 @@ class S3Storage(ObjectStorage):
         path = f"/{self.bucket}" + (f"/{key}" if key else "")
         payload = data or b""
         sha = hashlib.sha256(payload).hexdigest() if payload else _EMPTY_SHA256
-        signed = self.signer.sign(method, self._host, path, query, sha)
+        if self._imds is not None:
+            ak, sk, token = self._imds.get()
+            self.signer.access_key = ak
+            self.signer.secret_key = sk
+            self.signer.session_token = token
+        extra: dict[str, str] = {}
+        if self.ssec_headers is not None and key:
+            # customer-key encryption rides every object data op
+            extra.update(self.ssec_headers)
+        if self.set_checksum and method == "PUT" and payload:
+            import base64 as _b64
+
+            extra["x-amz-checksum-sha256"] = _b64.b64encode(
+                hashlib.sha256(payload).digest()
+            ).decode()
+        signed = self.signer.sign(
+            method, self._host, path, query, sha, extra_headers=extra or None
+        )
         if headers:
             signed.update(headers)
         url = self.endpoint + _uri_encode(path, False)

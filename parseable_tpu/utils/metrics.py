@@ -94,6 +94,21 @@ KAFKA_RECORDS_CONSUMED = _counter(
 KAFKA_FLUSHED_ROWS = _counter(
     "kafka_flushed_rows", "Kafka rows flushed into staging", ["topic"]
 )
+KAFKA_STAT = _gauge(
+    "kafka_stat",
+    "librdkafka top-level statistic (stats_cb bridge)",
+    ["client_id", "stat"],
+)
+KAFKA_BROKER_STAT = _gauge(
+    "kafka_broker_stat",
+    "librdkafka per-broker statistic (stats_cb bridge)",
+    ["client_id", "broker", "stat"],
+)
+KAFKA_PARTITION_STAT = _gauge(
+    "kafka_partition_stat",
+    "librdkafka per-topic-partition statistic (stats_cb bridge)",
+    ["client_id", "topic", "partition", "stat"],
+)
 KAFKA_REBALANCES = _counter(
     "kafka_rebalances", "Kafka consumer group rebalances", ["group"]
 )

@@ -26,6 +26,29 @@ def _xml(elem: ET.Element) -> bytes:
     return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(elem)
 
 
+def _decode_aws_chunked(body: bytes) -> bytes:
+    """Strip SigV4 streaming chunk framing:
+    `<hex-size>[;chunk-signature=...]\\r\\n<data>\\r\\n` repeated, a 0-size
+    terminator, then optional trailer lines (x-amz-trailer checksums)."""
+    out = b""
+    pos = 0
+    while pos < len(body):
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            break
+        header = body[pos:nl].split(b";")[0].strip()
+        try:
+            size = int(header or b"0", 16)
+        except ValueError:
+            break
+        pos = nl + 2
+        if size == 0:
+            break
+        out += body[pos : pos + size]
+        pos += size + 2  # data + CRLF
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     state: _State  # set by serve()
 
@@ -43,8 +66,31 @@ class _Handler(BaseHTTPRequestHandler):
         return bucket, key, q
 
     def _body(self) -> bytes:
-        n = int(self.headers.get("Content-Length", 0))
-        return self.rfile.read(n) if n else b""
+        """Read the request body the way real SDKs send it: plain
+        Content-Length, HTTP `Transfer-Encoding: chunked`, and the SigV4
+        streaming `aws-chunked` content encoding (the AWS C++ SDK uploads
+        with chunk signatures) — the wire shapes a Content-Length-only
+        reader silently drops."""
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            body = b""
+            while True:
+                line = self.rfile.readline()
+                size = int(line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    while self.rfile.readline().strip():
+                        pass  # trailers
+                    break
+                body += self.rfile.read(size)
+                self.rfile.read(2)  # CRLF
+        else:
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n else b""
+        sha = self.headers.get("x-amz-content-sha256", "") or ""
+        enc = self.headers.get("Content-Encoding", "") or ""
+        if sha.startswith("STREAMING-") or "aws-chunked" in enc:
+            body = _decode_aws_chunked(body)
+        return body
 
     def _send(
         self,
