@@ -50,7 +50,7 @@ class Compression(str, Enum):
 
     def to_parquet(self) -> str:
         """Map to a pyarrow parquet codec name."""
-        return {
+        codec = {
             Compression.UNCOMPRESSED: "none",
             Compression.SNAPPY: "snappy",
             Compression.GZIP: "gzip",
@@ -60,6 +60,32 @@ class Compression(str, Enum):
             Compression.LZ4_RAW: "lz4_raw",
             Compression.ZSTD: "zstd",
         }[self]
+        if codec == "lz4_raw" and not _lz4_raw_supported():
+            # pyarrow builds without the raw-frame codec fall back to the
+            # framed variant (same family, compatible readers)
+            return "lz4"
+        return codec
+
+
+_LZ4_RAW_SUPPORTED: bool | None = None
+
+
+def _lz4_raw_supported() -> bool:
+    global _LZ4_RAW_SUPPORTED
+    if _LZ4_RAW_SUPPORTED is None:
+        try:
+            import io
+
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(
+                pa.table({"a": [1]}), io.BytesIO(), compression="lz4_raw"
+            )
+            _LZ4_RAW_SUPPORTED = True
+        except Exception:  # noqa: BLE001 - any failure means "don't use it"
+            _LZ4_RAW_SUPPORTED = False
+    return _LZ4_RAW_SUPPORTED
 
 
 def _env(name: str, default: str | None = None) -> str | None:
@@ -236,6 +262,17 @@ class Options:
     mesh_shape: str = field(default_factory=lambda: _env("P_TPU_MESH", ""))
     # pad row blocks to this many rows before shipping to device (static shapes)
     device_block_rows: int = field(default_factory=lambda: _env_int("P_TPU_BLOCK_ROWS", 1 << 20))
+
+    # --- observability --------------------------------------------------------
+    # queries slower than this log a structured slow-query line with the
+    # per-stage breakdown and trace id; 0 disables
+    slow_query_ms: int = field(default_factory=lambda: _env_int("P_SLOW_QUERY_MS", 0))
+    # "cpu" starts the global stack sampler at server startup
+    # (utils/profiler.py; window captures via /api/v1/debug/profile)
+    profile_mode: str = field(default_factory=lambda: _env("P_PROFILE", "") or "")
+    # OTLP/HTTP span export endpoint (utils/telemetry.py); spans also land
+    # in the internal pmeta stream regardless
+    otlp_endpoint: str | None = field(default_factory=lambda: _env("P_OTLP_ENDPOINT"))
 
     # --- misc -----------------------------------------------------------------
     collect_dataset_stats: bool = field(

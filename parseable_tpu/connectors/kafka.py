@@ -157,10 +157,19 @@ class KafkaConfig:
                     raise ValueError(
                         "aws-msk provider requires P_KAFKA_AWS_REGION or AWS_REGION"
                     )
-            elif self.sasl_mechanism.upper() in ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512"):
+            elif self.sasl_mechanism.upper() in ("SCRAM-SHA-256", "SCRAM-SHA-512"):
+                # the SCRAM handshake needs client-side credentials up front
                 if not self.sasl_username or not self.sasl_password:
                     raise ValueError(
                         f"{self.sasl_mechanism} requires username and password"
+                    )
+            elif self.sasl_mechanism.upper() == "PLAIN":
+                # half-configured credentials are always a mistake; fully
+                # absent ones may arrive out-of-band (sidecar-injected
+                # config) so defer to the broker's auth error
+                if bool(self.sasl_username) != bool(self.sasl_password):
+                    raise ValueError(
+                        f"{self.sasl_mechanism} requires username and password together"
                     )
 
     def librdkafka_conf(self) -> dict:
@@ -288,10 +297,37 @@ def msk_iam_token(
 # ------------------------------------------------------ statistics -> metrics
 
 
+def prune_partition_stats(parts: list[tuple[str, int]]) -> int:
+    """Drop KAFKA_PARTITION_STAT label sets for revoked partitions so the
+    family doesn't grow unboundedly across group rebalances (a consumer
+    that cycled through many assignments would otherwise export a gauge
+    child per partition it ever owned, lag values frozen at revoke time).
+    Returns the number of children removed."""
+    from parseable_tpu.utils.metrics import KAFKA_PARTITION_STAT
+
+    revoked = {(t, str(p)) for t, p in parts}
+    removed = 0
+    # prometheus_client keys children by label-value tuples
+    # (client_id, topic, partition, stat)
+    for labels in list(KAFKA_PARTITION_STAT._metrics):
+        if (labels[1], labels[2]) in revoked:
+            try:
+                KAFKA_PARTITION_STAT.remove(*labels)
+                removed += 1
+            except KeyError:
+                pass
+    return removed
+
+
 class KafkaStatsBridge:
     """librdkafka statistics JSON (stats_cb) -> Prometheus gauges
     (reference: connectors/kafka/metrics.rs — the full per-client,
-    per-broker, per-topic-partition statistics surface)."""
+    per-broker, per-topic-partition statistics surface).
+
+    Tracks the broker/partition label sets each client reported last and
+    removes children that vanish from the stats payload (brokers leaving
+    the cluster, partitions reassigned between stats ticks), keeping the
+    KAFKA_*_STAT families bounded by the CURRENT topology."""
 
     TOP = ("msg_cnt", "msg_size", "tx", "tx_bytes", "rx", "rx_bytes",
            "txmsgs", "rxmsgs", "replyq", "metadata_cache_cnt")
@@ -300,6 +336,30 @@ class KafkaStatsBridge:
     PARTITION = ("consumer_lag", "consumer_lag_stored", "fetchq_cnt",
                  "fetchq_size", "committed_offset", "lo_offset", "hi_offset",
                  "app_offset", "stored_offset", "next_offset", "msgs_inflight")
+
+    def __init__(self):
+        self._seen_brokers: dict[str, set[str]] = {}
+        self._seen_partitions: dict[str, set[tuple[str, str]]] = {}
+
+    def _prune_stale(self, client: str, brokers: set[str], partitions: set[tuple[str, str]]) -> None:
+        from parseable_tpu.utils.metrics import KAFKA_BROKER_STAT, KAFKA_PARTITION_STAT
+
+        for bname in self._seen_brokers.get(client, set()) - brokers:
+            for labels in list(KAFKA_BROKER_STAT._metrics):
+                if labels[0] == client and labels[1] == bname:
+                    try:
+                        KAFKA_BROKER_STAT.remove(*labels)
+                    except KeyError:
+                        pass
+        for tp in self._seen_partitions.get(client, set()) - partitions:
+            for labels in list(KAFKA_PARTITION_STAT._metrics):
+                if labels[0] == client and (labels[1], labels[2]) == tp:
+                    try:
+                        KAFKA_PARTITION_STAT.remove(*labels)
+                    except KeyError:
+                        pass
+        self._seen_brokers[client] = brokers
+        self._seen_partitions[client] = partitions
 
     def update(self, stats_json: str) -> None:
         from parseable_tpu.utils.metrics import (
@@ -314,6 +374,8 @@ class KafkaStatsBridge:
             logger.warning("unparseable kafka statistics payload")
             return
         client = str(stats.get("client_id", ""))
+        brokers_seen: set[str] = set()
+        partitions_seen: set[tuple[str, str]] = set()
         for key in self.TOP:
             v = stats.get(key)
             if isinstance(v, (int, float)):
@@ -321,6 +383,7 @@ class KafkaStatsBridge:
         for bname, b in (stats.get("brokers") or {}).items():
             if not isinstance(b, dict):
                 continue
+            brokers_seen.add(bname)
             KAFKA_BROKER_STAT.labels(client, bname, "state_up").set(
                 1 if b.get("state") == "UP" else 0
             )
@@ -337,10 +400,12 @@ class KafkaStatsBridge:
             for pname, part in (t.get("partitions") or {}).items():
                 if not isinstance(part, dict) or pname == "-1":
                     continue
+                partitions_seen.add((tname, pname))
                 for key in self.PARTITION:
                     v = part.get(key)
                     if isinstance(v, (int, float)):
                         KAFKA_PARTITION_STAT.labels(client, tname, pname, key).set(v)
+        self._prune_stale(client, brokers_seen, partitions_seen)
 
 
 # ------------------------------------------------------------- consumer model
@@ -557,6 +622,9 @@ class KafkaSource:
             logger.info("kafka revoked: %s (flushing before handoff)", parts)
             self.processor.flush_partitions(parts)
             commit_partitions(parts, sync=True)
+            # the revoked partitions' gauges would otherwise linger with
+            # frozen values across every future reassignment
+            prune_partition_stats(parts)
 
         consumer.subscribe(self.config.topics, on_assign=on_assign, on_revoke=on_revoke)
         try:

@@ -291,6 +291,19 @@ class Parseable:
         files = stream.parquet_files()
         if not files:
             return uploaded
+        from parseable_tpu.utils.telemetry import TRACER
+
+        with TRACER.span(
+            "storage.sync",
+            stream=stream.name,
+            bytes=sum(f.stat().st_size for f in files),
+        ) as sp:
+            uploaded = self._upload_files(stream, files)
+            sp["files"] = len(uploaded)
+        return uploaded
+
+    def _upload_files(self, stream: Stream, files: list) -> list[str]:
+        uploaded: list[str] = []
         futures = []
         for f in files:
             key = stream.stream_relative_path(f)

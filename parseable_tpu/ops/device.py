@@ -277,3 +277,25 @@ def encode_table(
         row_mask=mask,
         time_origin_ms=origin,
     )
+
+
+def collect_device_gauges() -> None:
+    """Refresh per-device accelerator gauges at scrape time (the /metrics
+    handler calls this just before rendering; reference analogue: the
+    metrics layer polling allocator stats). Backends without memory_stats
+    (CPU PJRT) simply leave the gauge family empty."""
+    from parseable_tpu.utils.metrics import DEVICE_MEMORY_IN_USE
+
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend at all: nothing to report
+        return
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — per-device probe is best-effort
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            DEVICE_MEMORY_IN_USE.labels(str(d.id)).set(stats["bytes_in_use"])

@@ -65,7 +65,10 @@ def distributed_groupby(
     replicated (psum/pmin/pmax over ICI). jit-compiled once per
     (block, groups) shape bucket.
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
 
     @partial(
         shard_map,
@@ -108,7 +111,10 @@ def distributed_groupby_2d(
     trades an all-to-all for recompute-free masking, and the only collective
     is the psum over `data`.
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
 
     n_group_shards = mesh.shape["groups"]
 
@@ -168,7 +174,13 @@ def full_query_step(mesh: Mesh, num_groups: int):
         )
         return count, sums
 
-    from jax import shard_map
+    try:
+
+        from jax import shard_map
+
+    except ImportError:  # jax < 0.5 keeps it in experimental
+
+        from jax.experimental.shard_map import shard_map
 
     sharded = shard_map(
         lambda *a: tuple(

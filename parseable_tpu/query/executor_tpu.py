@@ -66,7 +66,12 @@ from parseable_tpu.query.planner import LogicalPlan
 from parseable_tpu.query.sketch import BINS as PCT_BINS
 from parseable_tpu.query.sketch import DEVICE_NB, LOG_HI, LOG_LO
 from parseable_tpu.query.sketch import _SCALE as PCT_SCALE
-from parseable_tpu.utils.metrics import DEVICE_BYTES_TO_DEVICE, DEVICE_EXECUTE_TIME
+from parseable_tpu.utils.metrics import (
+    DEVICE_BYTES_TO_DEVICE,
+    DEVICE_EXECUTE_TIME,
+    DEVICE_JIT_PROGRAMS,
+    DEVICE_TRANSFER_BYTES,
+)
 from parseable_tpu.utils.timeutil import parse_duration, parse_rfc3339
 
 logger = logging.getLogger(__name__)
@@ -2071,6 +2076,7 @@ class TpuQueryExecutor(QueryExecutor):
                 return a[:, idx], idx
 
             program = jax.jit(run)
+            DEVICE_JIT_PROGRAMS.inc()
             _PROGRAM_CACHE[key] = program
         gathered, idx = program(acc)
         self.route_stats["d2h_bytes"] += gathered.size * 4 + idx.size * 4
@@ -2351,7 +2357,10 @@ class TpuQueryExecutor(QueryExecutor):
             )
 
         if mesh is not None:
-            from jax import shard_map
+            try:
+                from jax import shard_map
+            except ImportError:  # jax < 0.5 keeps it in experimental
+                from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
 
             dev_spec = {k: P("data") for k in dev_keys}
@@ -2364,6 +2373,7 @@ class TpuQueryExecutor(QueryExecutor):
         if mesh is not None:
             global MESH_PROGRAMS_BUILT
             MESH_PROGRAMS_BUILT += 1
+        DEVICE_JIT_PROGRAMS.inc()
         _PROGRAM_CACHE[key] = prog
         return prog
 
@@ -2819,7 +2829,10 @@ class TpuQueryExecutor(QueryExecutor):
             return acc, dacc, pacc
 
         if mesh is not None:
-            from jax import shard_map
+            try:
+                from jax import shard_map
+            except ImportError:  # jax < 0.5 keeps it in experimental
+                from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
 
             n_remaps = sum(1 for s in remap_shapes if s is not None)
@@ -2857,6 +2870,7 @@ class TpuQueryExecutor(QueryExecutor):
             MESH_PROGRAMS_BUILT += 1
             if shard_groups > 1:
                 GROUP_SHARDED_PROGRAMS_BUILT += 1
+        DEVICE_JIT_PROGRAMS.inc()
         _PROGRAM_CACHE[key] = prog
         return prog
 
@@ -3117,6 +3131,7 @@ def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
             dev["__rowmask"] = put_row(enc.row_mask)
             nbytes += enc.row_mask.nbytes
         DEVICE_BYTES_TO_DEVICE.labels("scan").inc(nbytes)
+        DEVICE_TRANSFER_BYTES.inc(nbytes)
         return dev, nbytes
 
     parts: list[tuple[str, np.dtype, int, int]] = []  # key, dtype, count, offset
@@ -3165,6 +3180,7 @@ def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
             dev[f"{name}__valid"] = ones
     dev["__ones"] = ones
     DEVICE_BYTES_TO_DEVICE.labels("scan").inc(nbytes)
+    DEVICE_TRANSFER_BYTES.inc(nbytes)
     return dev, nbytes
 
 

@@ -162,6 +162,29 @@ class QueryResult:
         return record_batches_to_json(self.table.to_batches())
 
 
+class _TimedIter:
+    """Wraps a scan iterator, accumulating the wall time spent producing
+    blocks — the scan share of the EXPLAIN ANALYZE stage breakdown (the
+    executor pulls lazily, so scan and execute interleave; time inside
+    next() is scan/decode, the remainder is operator work)."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self.seconds = 0.0
+        self.blocks = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = _time.perf_counter()
+        try:
+            return next(self._it)
+        finally:
+            self.seconds += _time.perf_counter() - t0
+            self.blocks += 1
+
+
 class QuerySession:
     """One engine-backed session over a Parseable instance."""
 
@@ -190,9 +213,15 @@ class QuerySession:
         t0 = _time.monotonic()
         from parseable_tpu.utils.telemetry import TRACER
 
-        with TRACER.span("query", engine=self.engine):
+        with TRACER.span("query", engine=self.engine) as sp:
+            tp = _time.perf_counter()
             select = S.parse_sql(sql_text)
-            return self._query_ast(select, start_time, end_time, allowed_streams, t0)
+            self._parse_ms = round((_time.perf_counter() - tp) * 1000, 3)
+            self._sql_text = sql_text
+            result = self._query_ast(select, start_time, end_time, allowed_streams, t0)
+            sp["stream"] = ",".join(sorted(_referenced_streams(select))) or "?"
+            sp["rows"] = result.table.num_rows
+            return result
 
     def _query_ast(
         self,
@@ -218,7 +247,9 @@ class QuerySession:
         cte_tables = getattr(self, "_cte_tables", None)
         if cte_tables is not None and select.table in cte_tables:
             return self._query_cte_table(select, cte_tables[select.table], t0)
+        tplan = _time.perf_counter()
         lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
+        plan_ms = round((_time.perf_counter() - tplan) * 1000, 3)
 
         scan = StreamScan(
             self.p,
@@ -226,7 +257,9 @@ class QuerySession:
             hot_tier_dir=self._hot_dir(lp.stream),
             use_hot_stubs=self.engine == "tpu" and lp.is_aggregate,
         )
-        result = self._execute(lp, scan)
+        texec = _time.perf_counter()
+        result, timer = self._execute(lp, scan)
+        exec_s = _time.perf_counter() - texec
         elapsed = _time.monotonic() - t0
         QUERY_EXECUTE_TIME.labels(lp.stream).observe(elapsed)
         result.stats.update(
@@ -237,9 +270,39 @@ class QuerySession:
                 "files_pruned": scan.stats.files_pruned,
                 "bytes_scanned": scan.stats.bytes_scanned,
                 "rows_scanned": scan.stats.rows_scanned,
+                # EXPLAIN ANALYZE-style per-stage wall-time breakdown;
+                # scan = time inside the block iterator, execute = the rest
+                "stages": {
+                    "parse_ms": getattr(self, "_parse_ms", None),
+                    "plan_ms": plan_ms,
+                    "scan_ms": round(timer.seconds * 1000, 3),
+                    "execute_ms": round(max(exec_s - timer.seconds, 0.0) * 1000, 3),
+                    "total_ms": round(elapsed * 1000, 3),
+                },
             }
         )
+        self._maybe_log_slow(select, elapsed, result.stats)
         return result
+
+    def _maybe_log_slow(self, select: S.Select, elapsed: float, stats: dict) -> None:
+        """Slow-query log (gated by P_SLOW_QUERY_MS; 0 disables): one
+        structured warning with the statement, stage breakdown, and the
+        trace id so the full span tree is one /debug/spans call away."""
+        threshold = getattr(self.p.options, "slow_query_ms", 0)
+        if not threshold or elapsed * 1000 < threshold:
+            return
+        from parseable_tpu.utils.telemetry import current_trace_id
+
+        sql_text = getattr(self, "_sql_text", None) or S.format_statement(select)
+        logger.warning(
+            "slow query (%.0f ms > %d ms) trace_id=%s engine=%s stages=%s sql=%s",
+            elapsed * 1000,
+            threshold,
+            current_trace_id() or "-",
+            stats.get("engine", self.engine),
+            stats.get("stages"),
+            sql_text,
+        )
 
     def _explain(
         self,
@@ -330,6 +393,15 @@ class QuerySession:
                 if st.get(k) is not None:  # composite paths carry no scan stats
                     parts.append(f"{k}={st[k]}")
             plans.append(" ".join(parts))
+            stages = st.get("stages")
+            if stages:
+                # per-stage wall-time split (parse/plan/scan/execute)
+                plan_types.append("stage_timing")
+                plans.append(
+                    " ".join(
+                        f"{k}={v}" for k, v in stages.items() if v is not None
+                    )
+                )
             routes = st.get("device_routes")
             if routes is not None:
                 # adaptive dispatch, observable without a profiler
@@ -696,11 +768,13 @@ class QuerySession:
         table = executor.execute(iter([joined]))
         elapsed = _time.monotonic() - t0
         QUERY_EXECUTE_TIME.labels(",".join(sorted(streams))).observe(elapsed)
-        return QueryResult(
-            table,
-            table.column_names,
-            {"elapsed_secs": round(elapsed, 6), "engine": "cpu", "joined_streams": sorted(streams)},
-        )
+        stats = {
+            "elapsed_secs": round(elapsed, 6),
+            "engine": "cpu",
+            "joined_streams": sorted(streams),
+        }
+        self._maybe_log_slow(sel, elapsed, stats)
+        return QueryResult(table, table.column_names, stats)
 
     def _materialize_stream(
         self,
@@ -728,7 +802,8 @@ class QuerySession:
             else self.p.options.hot_tier_storage_path
         )
 
-    def _execute(self, lp: LogicalPlan, scan: StreamScan) -> QueryResult:
+    def _execute(self, lp: LogicalPlan, scan: StreamScan) -> tuple[QueryResult, _TimedIter]:
+        timer = _TimedIter(iter(()))
         # count(*) fast path off manifest row counts, only when every
         # overlapping file lies fully inside the time bounds
         if lp.count_star_only:
@@ -736,7 +811,7 @@ class QuerySession:
             if fast is not None:
                 name = lp.select.items[0].alias or "count(*)"
                 table = pa.table({name: pa.array([fast], pa.int64())})
-                return QueryResult(table, [name], {"fast_path": "manifest_count"})
+                return QueryResult(table, [name], {"fast_path": "manifest_count"}), timer
 
         use_tpu = self.engine == "tpu"
         fallback = False
@@ -774,10 +849,12 @@ class QuerySession:
             executor.source_loader = scan.read_source
             # overlap parquet read/decode with device compute; depth 3 keeps
             # the tunnel transfer (the cold-path floor) continuously fed
-            tables = prefetch_iter(scan.tables(), depth=3)
+            timer = _TimedIter(scan.tables())
+            tables = prefetch_iter(timer, depth=3)
         else:
             executor = QueryExecutor(lp)
-            tables = scan.tables()
+            timer = _TimedIter(scan.tables())
+            tables = timer
         table = executor.execute(tables)
         stats = {"engine_fallback": "device unhealthy"} if fallback else {}
         routes = getattr(executor, "route_stats", None)
@@ -785,7 +862,7 @@ class QuerySession:
             # adaptive-dispatch observability (EXPLAIN ANALYZE surfaces
             # this): per-block route decisions + actual transfer bytes
             stats["device_routes"] = dict(routes)
-        return QueryResult(table, table.column_names, stats)
+        return QueryResult(table, table.column_names, stats), timer
 
     @staticmethod
     def _set_scan_time_hint(lp: LogicalPlan, scan: StreamScan) -> None:

@@ -21,6 +21,7 @@ the analogue of the reference's rayon ingest pool (ingest.rs:60).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import logging
 import threading
@@ -43,6 +44,7 @@ from parseable_tpu.rbac import Action, RbacStore, bootstrap_admin, role_privileg
 from parseable_tpu.server.ingest_utils import IngestError, flatten_and_push_logs
 from parseable_tpu.storage import rfc3339_now
 from parseable_tpu.utils import metrics as prom
+from parseable_tpu.utils import telemetry
 from parseable_tpu.utils.timeutil import TimeParseError
 
 logger = logging.getLogger(__name__)
@@ -145,7 +147,11 @@ class ServerState:
                     w = threading.Thread(target=watch, name=f"{name}-watchdog", daemon=True)
                     w.start()
                     try:
-                        fn()
+                        # each tick is one trace: the flush/sync/storage
+                        # spans it produces share a trace_id and parent
+                        # correctly under /debug/spans + pmeta
+                        with telemetry.trace_context():
+                            fn()
                     except Exception:
                         # per-tick isolation: the loop itself never dies
                         # (reference: catch_unwind + respawn sync.rs:160-165)
@@ -156,6 +162,17 @@ class ServerState:
             t = threading.Thread(target=run, name=name, daemon=True)
             t.start()
             self._sync_threads.append(t)
+
+        # self-observability: spans -> internal pmeta stream (every mode;
+        # each node self-ingests its own telemetry), plus the opt-in CPU
+        # stack sampler (reference: the hotpath profiling feature)
+        telemetry.SPAN_SINK.attach(self.p)
+        loop(10, telemetry.SPAN_SINK.flush, "span-flush")
+        if self.p.options.profile_mode == "cpu":
+            from parseable_tpu.utils.profiler import get_profiler
+
+            get_profiler().start()
+            logger.info("P_PROFILE=cpu: global stack sampler started")
 
         if self.p.options.mode in (Mode.ALL, Mode.INGEST):
             loop(self.p.options.local_sync_interval_secs, self.p.local_sync, "local-sync")
@@ -194,11 +211,63 @@ class ServerState:
         self.shutting_down = True
         self._sync_stop.set()
         self.resources.stop()
+        # drain buffered spans into pmeta before the final staging flush so
+        # the last requests' telemetry survives shutdown, then detach (no
+        # further spans should buffer against a stopping instance)
+        telemetry.SPAN_SINK.flush()
+        telemetry.SPAN_SINK.detach()
+        if self.p.options.profile_mode == "cpu":
+            from parseable_tpu.utils.profiler import get_profiler
+
+            get_profiler().stop()
         self.p.shutdown()
         self.workers.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------- middleware
+
+
+def _run_traced(state: "ServerState", fn, *args):
+    """run_in_executor with the caller's contextvars carried into the worker
+    thread — the request's trace context must follow the work, or ingest/
+    query spans detach from their HTTP root (run_in_executor does not copy
+    context; task-level copying only covers coroutines)."""
+    ctx = contextvars.copy_context()
+    return asyncio.get_running_loop().run_in_executor(
+        state.workers, lambda: ctx.run(fn, *args)
+    )
+
+
+_TRACED_POST_PATHS = ("/api/v1/ingest", "/api/v1/query", "/api/v1/counts", "/v1/")
+
+
+def _should_trace(request: web.Request) -> bool:
+    if request.method != "POST":
+        return False
+    path = request.path
+    return path.startswith(_TRACED_POST_PATHS) or (
+        path.startswith("/api/v1/logstream/") and path.count("/") == 4
+    )
+
+
+@web.middleware
+async def trace_middleware(request: web.Request, handler):
+    """One trace per ingest/query request (reference: telemetry.rs tracing
+    layer around the actix handlers). Honors an incoming W3C `traceparent`
+    so spans parent under the caller's trace; the assigned trace id is
+    echoed back in X-P-Trace-Id for /api/v1/debug/spans lookups."""
+    if not _should_trace(request):
+        return await handler(request)
+    with telemetry.trace_context(request.headers.get("traceparent")) as trace_id:
+        with telemetry.TRACER.span(
+            "http.request", method=request.method, path=request.path
+        ) as sp:
+            resp = await handler(request)
+            sp["status_code"] = resp.status
+            if resp.status >= 500:
+                sp["status"] = "error"
+        resp.headers["X-P-Trace-Id"] = trace_id
+        return resp
 
 
 def _unauthorized(reason: str = "Unauthorized") -> web.Response:
@@ -365,8 +434,33 @@ async def about(request: web.Request) -> web.Response:
 async def metrics_handler(request: web.Request) -> web.Response:
     """Reference authorizes /metrics and /about with Action::Metrics and
     Action::GetAbout (server.rs:251,785) — without the guard any
-    single-stream INGEST user can read global volumes and stream names."""
-    return web.Response(body=prom.render(), content_type="text/plain")
+    single-stream INGEST user can read global volumes and stream names.
+
+    Content-Type must be prometheus_client.CONTENT_TYPE_LATEST (the
+    text-format version + charset parameters), not bare text/plain —
+    OpenMetrics-aware scrapers negotiate on it."""
+    from parseable_tpu.ops.device import collect_device_gauges
+
+    # refresh accelerator gauges at scrape time (live HBM usage)
+    collect_device_gauges()
+    return web.Response(
+        body=prom.render(), headers={"Content-Type": prom.CONTENT_TYPE_LATEST}
+    )
+
+
+@require(Action.METRICS)
+async def debug_spans(request: web.Request) -> web.Response:
+    """GET /api/v1/debug/spans[?trace_id=...&limit=N]: the most recent
+    finished spans from the in-memory ring — the low-latency view of what
+    also lands in the `pmeta` stream. Pair with the X-P-Trace-Id response
+    header to pull one request's full span tree."""
+    trace_id = request.query.get("trace_id")
+    try:
+        limit = int(request.query.get("limit", "1000"))
+    except ValueError:
+        return web.json_response({"error": "limit must be an integer"}, status=400)
+    spans = telemetry.recent_spans(trace_id, max(1, min(limit, telemetry.SPAN_RING_SIZE)))
+    return web.json_response({"count": len(spans), "spans": spans})
 
 
 async def login(request: web.Request) -> web.Response:
@@ -480,7 +574,7 @@ async def _do_ingest(
         )
 
     try:
-        count = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+        count = await _run_traced(state, work)
     except (IngestError, StreamError, EventError) as e:
         return web.json_response({"error": str(e)}, status=400)
     return web.json_response({"message": f"ingested {count} records"}, status=200)
@@ -513,7 +607,7 @@ async def query(request: web.Request) -> web.Response:
         return sess.query(sql, start, end, allowed_streams=allowed)
 
     try:
-        result = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+        result = await _run_traced(state, work)
     except QueryTimeout as e:
         return web.json_response({"error": str(e)}, status=504)
     except MemoryLimitExceeded as e:
@@ -653,7 +747,7 @@ async def counts(request: web.Request) -> web.Response:
         return out
 
     try:
-        records = await asyncio.get_running_loop().run_in_executor(state.workers, work)
+        records = await _run_traced(state, work)
     except (SqlError, QueryError, TimeParseError, StreamNotFound) as e:
         return web.json_response({"error": str(e)}, status=400)
     return web.json_response({"fields": ["startTime", "endTime", "count"], "records": records})
@@ -1628,7 +1722,10 @@ async def remove_node_handler(request: web.Request) -> web.Response:
 
 
 def build_app(state: ServerState) -> web.Application:
-    app = web.Application(middlewares=[auth_middleware], client_max_size=64 * 1024 * 1024)
+    app = web.Application(
+        middlewares=[trace_middleware, auth_middleware],
+        client_max_size=64 * 1024 * 1024,
+    )
     app["state"] = state
     mode = state.p.options.mode
     r = app.router
@@ -1638,6 +1735,7 @@ def build_app(state: ServerState) -> web.Application:
     r.add_get("/api/v1/readiness", readiness)
     r.add_get("/api/v1/about", about)
     r.add_get("/api/v1/debug/profile", debug_profile)
+    r.add_get("/api/v1/debug/spans", debug_spans)
     r.add_get("/api/v1/metrics", metrics_handler)
     r.add_get("/api/v1/login", login)
 
@@ -1742,6 +1840,9 @@ def build_app(state: ServerState) -> web.Application:
 def run_server(opts: Options | None = None, storage: StorageOptions | None = None) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(name)s %(message)s")
     p = Parseable(opts, storage)
+    if p.options.otlp_endpoint:
+        # Options may carry an endpoint the env didn't (programmatic boot)
+        telemetry.TRACER.endpoint = p.options.otlp_endpoint
     # deployment reconcile + metadata migrations before anything registers
     # (reference: main.rs:73-79 resolve_parseable_metadata + migration runs)
     from parseable_tpu.migration import resolve_parseable_metadata, run_migrations

@@ -116,11 +116,15 @@ def flatten_and_push_logs(
     None — it parses lazily only if the native lane declines."""
     from parseable_tpu.utils.telemetry import TRACER
 
-    with TRACER.span("ingest", stream=stream_name, source=log_source.value):
-        return _flatten_and_push(
+    with TRACER.span(
+        "ingest", stream=stream_name, source=log_source.value, bytes=origin_size
+    ) as sp:
+        count = _flatten_and_push(
             p, stream_name, payload, log_source, custom_fields, origin_size,
             log_source_name, raw_body,
         )
+        sp["rows"] = count
+        return count
 
 
 def _parse_payload(payload: Any, raw_body: bytes | None) -> Any:

@@ -122,11 +122,21 @@ class Writer:
 
     def finish_buckets(self, predicate=None) -> list[Path]:
         """Finish writers whose bucket key matches `predicate` (all if None)."""
-        done: list[Path] = []
-        for key in list(self.disk):
-            if predicate is None or predicate(key):
-                final = self.disk[key].finish()
-                if final is not None:
-                    done.append(final)
-                del self.disk[key]
-        return done
+        if not self.disk:
+            return []
+        from parseable_tpu.utils.telemetry import TRACER
+
+        with TRACER.span("staging.write") as sp:
+            done: list[Path] = []
+            rows = 0
+            for key in list(self.disk):
+                if predicate is None or predicate(key):
+                    w = self.disk[key]
+                    final = w.finish()
+                    if final is not None:
+                        done.append(final)
+                        rows += w.rows_written
+                    del self.disk[key]
+            sp["files"] = len(done)
+            sp["rows"] = rows
+            return done

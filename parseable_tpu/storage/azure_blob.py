@@ -28,7 +28,7 @@ from parseable_tpu.storage.object_storage import (
     ObjectMeta,
     ObjectStorage,
     ObjectStorageError,
-    _timed,
+    timed,
 )
 
 _API_VERSION = "2021-08-06"
@@ -133,21 +133,21 @@ class AzureBlobStorage(ObjectStorage):
     # -------------------------------------------------------------- trait ops
 
     def get_object(self, key: str) -> bytes:
-        with _timed(self.name, "GET"):
+        with timed(self.name, "GET"):
             return self._check(self._request("GET", key), key).content
 
     def put_object(self, key: str, data: bytes) -> None:
-        with _timed(self.name, "PUT"):
+        with timed(self.name, "PUT"):
             self._check(self._request("PUT", key, data=data), key)
 
     def delete_object(self, key: str) -> None:
-        with _timed(self.name, "DELETE"):
+        with timed(self.name, "DELETE"):
             resp = self._request("DELETE", key)
             if resp.status_code not in (200, 202, 204, 404):
                 self._check(resp, key)
 
     def head(self, key: str) -> ObjectMeta:
-        with _timed(self.name, "HEAD"):
+        with timed(self.name, "HEAD"):
             resp = self._request("HEAD", key)
             if resp.status_code == 404:
                 raise NoSuchKey(key)
@@ -157,7 +157,7 @@ class AzureBlobStorage(ObjectStorage):
             )
 
     def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             marker = None
             while True:
                 query = {"restype": "container", "comp": "list", "prefix": prefix}
@@ -176,7 +176,7 @@ class AzureBlobStorage(ObjectStorage):
                     break
 
     def list_dirs(self, prefix: str) -> list[str]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             p = prefix.rstrip("/") + "/" if prefix else ""
             query = {"restype": "container", "comp": "list", "prefix": p, "delimiter": "/"}
             root = ET.fromstring(self._check(self._request("GET", query=query)).text)
@@ -190,7 +190,7 @@ class AzureBlobStorage(ObjectStorage):
         if size <= self.multipart_threshold:
             self.put_object(key, path.read_bytes())
             return
-        with _timed(self.name, "PUT_BLOCKS"):
+        with timed(self.name, "PUT_BLOCKS"):
             block_ids: list[str] = []
             n_blocks = (size + self.block_size - 1) // self.block_size
 

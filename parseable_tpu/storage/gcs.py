@@ -35,7 +35,7 @@ from parseable_tpu.storage.object_storage import (
     ObjectMeta,
     ObjectStorage,
     ObjectStorageError,
-    _timed,
+    timed,
 )
 
 _METADATA_TOKEN_URL = (
@@ -166,12 +166,12 @@ class GcsStorage(ObjectStorage):
     # -------------------------------------------------------------- trait ops
 
     def get_object(self, key: str) -> bytes:
-        with _timed(self.name, "GET"):
+        with timed(self.name, "GET"):
             resp = self._request("GET", self._obj_url(key), params={"alt": "media"})
             return self._check(resp, key).content
 
     def put_object(self, key: str, data: bytes) -> None:
-        with _timed(self.name, "PUT"):
+        with timed(self.name, "PUT"):
             url = f"{self.endpoint}/upload/storage/v1/b/{quote(self.bucket, safe='')}/o"
             resp = self._request(
                 "POST",
@@ -183,20 +183,20 @@ class GcsStorage(ObjectStorage):
             self._check(resp, key)
 
     def delete_object(self, key: str) -> None:
-        with _timed(self.name, "DELETE"):
+        with timed(self.name, "DELETE"):
             resp = self._request("DELETE", self._obj_url(key))
             if resp.status_code not in (200, 204, 404):
                 self._check(resp, key)
 
     def head(self, key: str) -> ObjectMeta:
-        with _timed(self.name, "HEAD"):
+        with timed(self.name, "HEAD"):
             resp = self._request("GET", self._obj_url(key))
             self._check(resp, key)
             obj = resp.json()
             return ObjectMeta(key=key, size=int(obj.get("size", 0)), last_modified=0.0)
 
     def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             url = f"{self.endpoint}/storage/v1/b/{quote(self.bucket, safe='')}/o"
             token = None
             while True:
@@ -217,7 +217,7 @@ class GcsStorage(ObjectStorage):
                     break
 
     def list_dirs(self, prefix: str) -> list[str]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             p = prefix.rstrip("/") + "/" if prefix else ""
             url = f"{self.endpoint}/storage/v1/b/{quote(self.bucket, safe='')}/o"
             out: list[str] = []
@@ -246,7 +246,7 @@ class GcsStorage(ObjectStorage):
     def _upload_resumable(self, key: str, path: Path, size: int) -> None:
         """Resumable upload session: chunked PUTs with Content-Range; the
         server answers 308 until the final chunk lands (GCS's multipart)."""
-        with _timed(self.name, "PUT_MULTIPART"):
+        with timed(self.name, "PUT_MULTIPART"):
             url = f"{self.endpoint}/upload/storage/v1/b/{quote(self.bucket, safe='')}/o"
             resp = self._request(
                 "POST",
@@ -311,7 +311,7 @@ class GcsStorage(ObjectStorage):
     def delete_prefix(self, prefix: str) -> None:
         """GCS JSON API has no batch delete: fan per-key deletes over a
         small pool (the object_store crate does the same)."""
-        with _timed(self.name, "DELETE_PREFIX"):
+        with timed(self.name, "DELETE_PREFIX"):
             keys = [m.key for m in self.list_prefix(prefix)]
             if not keys:
                 return

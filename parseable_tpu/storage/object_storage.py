@@ -96,7 +96,7 @@ class ObjectStorage(ABC):
         if meta.size <= chunk:
             tmp.write_bytes(self.get_object(key))
         else:
-            with _timed(self.name, "GET_RANGED"):
+            with timed(self.name, "GET_RANGED"):
                 ranges = [
                     (o, min(o + chunk, meta.size) - 1) for o in range(0, meta.size, chunk)
                 ]
@@ -115,7 +115,7 @@ class ObjectStorage(ABC):
 
     def delete_prefix(self, prefix: str) -> None:
         """List-then-delete; backends with batch delete APIs override."""
-        with _timed(self.name, "DELETE_PREFIX"):
+        with timed(self.name, "DELETE_PREFIX"):
             for meta in list(self.list_prefix(prefix)):
                 self.delete_object(meta.key)
 
@@ -148,10 +148,28 @@ class ObjectStorageProvider(ABC):
     def get_endpoint(self) -> str: ...
 
 
-def _timed(backend: str, op: str):
-    """Record per-call latency into the Prometheus histogram
-    (reference: storage/metrics_layer.rs MetricLayer)."""
-    return STORAGE_REQUEST_TIME.labels(backend, op).time()
+@contextlib.contextmanager
+def timed(backend: str, op: str):
+    """Uniform storage-call instrumentation shared by every backend
+    (reference: storage/metrics_layer.rs MetricLayer): per-call latency into
+    STORAGE_REQUEST_TIME{backend,method}, plus — only inside an active trace
+    context (a traced HTTP request or a sync tick's root context) — a child
+    span, so per-call spans never fire on untraced hot paths."""
+    from parseable_tpu.utils import telemetry
+
+    if telemetry.current_trace_id() is not None:
+        with STORAGE_REQUEST_TIME.labels(backend, op).time():
+            with telemetry.TRACER.span(
+                f"storage.{op.lower()}", backend=backend, method=op
+            ):
+                yield
+    else:
+        with STORAGE_REQUEST_TIME.labels(backend, op).time():
+            yield
+
+
+# backwards-compatible alias (pre-tracing name used by older backends)
+_timed = timed
 
 
 class LocalFS(ObjectStorage):
@@ -171,14 +189,14 @@ class LocalFS(ObjectStorage):
         return p
 
     def get_object(self, key: str) -> bytes:
-        with _timed(self.name, "GET"):
+        with timed(self.name, "GET"):
             p = self._abs(key)
             if not p.is_file():
                 raise NoSuchKey(key)
             return p.read_bytes()
 
     def put_object(self, key: str, data: bytes) -> None:
-        with _timed(self.name, "PUT"):
+        with timed(self.name, "PUT"):
             p = self._abs(key)
             p.parent.mkdir(parents=True, exist_ok=True)
             tmp = p.with_name(p.name + ".tmp")
@@ -186,13 +204,13 @@ class LocalFS(ObjectStorage):
             os.replace(tmp, p)
 
     def delete_object(self, key: str) -> None:
-        with _timed(self.name, "DELETE"):
+        with timed(self.name, "DELETE"):
             p = self._abs(key)
             with contextlib.suppress(FileNotFoundError):
                 p.unlink()
 
     def head(self, key: str) -> ObjectMeta:
-        with _timed(self.name, "HEAD"):
+        with timed(self.name, "HEAD"):
             p = self._abs(key)
             if not p.is_file():
                 raise NoSuchKey(key)
@@ -200,7 +218,7 @@ class LocalFS(ObjectStorage):
             return ObjectMeta(key=key, size=st.st_size, last_modified=st.st_mtime)
 
     def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             base = self._abs(prefix) if prefix else self.root
             if not base.exists():
                 return
@@ -222,7 +240,7 @@ class LocalFS(ObjectStorage):
         return sorted(d.name for d in base.iterdir() if d.is_dir())
 
     def upload_file(self, key: str, path: Path) -> None:
-        with _timed(self.name, "PUT"):
+        with timed(self.name, "PUT"):
             dest = self._abs(key)
             dest.parent.mkdir(parents=True, exist_ok=True)
             tmp = dest.with_name(dest.name + ".tmp")
@@ -230,7 +248,7 @@ class LocalFS(ObjectStorage):
             os.replace(tmp, dest)
 
     def download_file(self, key: str, path: Path) -> None:
-        with _timed(self.name, "GET"):
+        with timed(self.name, "GET"):
             src = self._abs(key)
             if not src.is_file():
                 raise NoSuchKey(key)
@@ -240,7 +258,7 @@ class LocalFS(ObjectStorage):
             os.replace(tmp, path)
 
     def delete_prefix(self, prefix: str) -> None:
-        with _timed(self.name, "DELETE"):
+        with timed(self.name, "DELETE"):
             p = self._abs(prefix)
             if p.is_dir():
                 shutil.rmtree(p, ignore_errors=True)

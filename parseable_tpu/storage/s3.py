@@ -34,7 +34,7 @@ from parseable_tpu.storage.object_storage import (
     ObjectMeta,
     ObjectStorage,
     ObjectStorageError,
-    _timed,
+    timed,
 )
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
@@ -356,21 +356,21 @@ class S3Storage(ObjectStorage):
     # -------------------------------------------------------------- trait ops
 
     def get_object(self, key: str) -> bytes:
-        with _timed(self.name, "GET"):
+        with timed(self.name, "GET"):
             return self._check(self._request("GET", key), key).content
 
     def put_object(self, key: str, data: bytes) -> None:
-        with _timed(self.name, "PUT"):
+        with timed(self.name, "PUT"):
             self._check(self._request("PUT", key, data=data), key)
 
     def delete_object(self, key: str) -> None:
-        with _timed(self.name, "DELETE"):
+        with timed(self.name, "DELETE"):
             resp = self._request("DELETE", key)
             if resp.status_code not in (200, 204, 404):
                 self._check(resp, key)
 
     def head(self, key: str) -> ObjectMeta:
-        with _timed(self.name, "HEAD"):
+        with timed(self.name, "HEAD"):
             resp = self._request("HEAD", key)
             if resp.status_code == 404:
                 raise NoSuchKey(key)
@@ -379,7 +379,7 @@ class S3Storage(ObjectStorage):
             return ObjectMeta(key=key, size=size, last_modified=0.0)
 
     def list_prefix(self, prefix: str, recursive: bool = True) -> Iterator[ObjectMeta]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             token = None
             while True:
                 query = {"list-type": "2", "prefix": prefix}
@@ -403,7 +403,7 @@ class S3Storage(ObjectStorage):
                     break
 
     def list_dirs(self, prefix: str) -> list[str]:
-        with _timed(self.name, "LIST"):
+        with timed(self.name, "LIST"):
             p = prefix.rstrip("/") + "/" if prefix else ""
             query = {"list-type": "2", "prefix": p, "delimiter": "/"}
             root = ET.fromstring(self._check(self._request("GET", query=query)).text)
@@ -425,7 +425,7 @@ class S3Storage(ObjectStorage):
     def _upload_multipart(self, key: str, path: Path, size: int) -> None:
         """Multipart upload with concurrent parts + abort on failure
         (reference: object_storage.rs:111-227, s3.rs:716-813)."""
-        with _timed(self.name, "PUT_MULTIPART"):
+        with timed(self.name, "PUT_MULTIPART"):
             resp = self._check(self._request("POST", key, query={"uploads": ""}), key)
             upload_id = ET.fromstring(resp.text).find(f"{_NS}UploadId").text
             part_size = self.multipart_part_size
@@ -483,7 +483,7 @@ class S3Storage(ObjectStorage):
 
     def delete_prefix(self, prefix: str) -> None:
         """Batch DeleteObjects over a listed prefix."""
-        with _timed(self.name, "DELETE_PREFIX"):
+        with timed(self.name, "DELETE_PREFIX"):
             keys = [m.key for m in self.list_prefix(prefix)]
             for i in range(0, len(keys), 1000):
                 batch = keys[i : i + 1000]

@@ -243,8 +243,14 @@ class Stream:
 
     def prepare_parquet(self, shutdown: bool = False) -> list[Path]:
         """flush + convert (reference: streams.rs:569-604)."""
-        self.flush(forced=shutdown)
-        return self.convert_disk_files_to_parquet(shutdown)
+        from parseable_tpu.utils.telemetry import TRACER
+
+        with TRACER.span("staging.flush", stream=self.name) as sp:
+            self.flush(forced=shutdown)
+            outputs = self.convert_disk_files_to_parquet(shutdown)
+            sp["files"] = len(outputs)
+            sp["bytes"] = sum(f.stat().st_size for f in outputs if f.exists())
+            return outputs
 
     # --- upload path -------------------------------------------------------
 

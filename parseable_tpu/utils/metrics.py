@@ -6,7 +6,14 @@ names/labels are kept so dashboards scrape identically.
 
 from __future__ import annotations
 
-from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
+from prometheus_client import (
+    CONTENT_TYPE_LATEST,
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
 
 METRICS_NAMESPACE = "parseable"
 
@@ -70,6 +77,19 @@ DEVICE_EXECUTE_TIME = Histogram(
     registry=REGISTRY,
 )
 DEVICE_BYTES_TO_DEVICE = _counter("tpu_bytes_to_device", "Bytes shipped host->device", ["op"])
+# JAX accelerator health next to the execute-time histogram: live HBM usage
+# per local device (scrape-time collection, ops/device.py), cumulative
+# host->device transfer bytes, and XLA programs compiled (a jit cache miss
+# costs seconds — compile churn must be visible on a dashboard)
+DEVICE_MEMORY_IN_USE = _gauge(
+    "tpu_device_memory_in_use", "Accelerator memory in use (bytes)", ["device"]
+)
+DEVICE_TRANSFER_BYTES = _gauge(
+    "tpu_host_transfer_bytes", "Cumulative host->device transfer bytes", []
+)
+DEVICE_JIT_PROGRAMS = _gauge(
+    "tpu_jit_programs", "XLA programs compiled (jit cache misses)", []
+)
 
 # --- storage layer calls (reference: storage/metrics_layer.rs) ----------
 STORAGE_REQUEST_TIME = Histogram(

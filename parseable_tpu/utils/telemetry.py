@@ -1,28 +1,205 @@
-"""Self-telemetry: the server's own spans exported over OTLP/HTTP.
+"""Self-telemetry: request tracing + the server's own spans, self-ingested.
 
 Parity target (reference: src/telemetry.rs:55-149 init_tracing -> OTLP
-exporter): when P_OTLP_ENDPOINT is set, spans recorded around the hot
-paths (ingest, query, sync) batch in memory and POST to
-{endpoint}/v1/traces as OTLP JSON. Without an endpoint the tracer is a
-zero-cost no-op. No external SDK — the OTLP/HTTP JSON shape is small and
-this process's needs are a handful of span kinds.
+exporter): spans recorded around the hot paths (ingest, staging flush,
+object-store sync, query) batch in memory and POST to {endpoint}/v1/traces
+as OTLP JSON when P_OTLP_ENDPOINT is set. No external SDK — the OTLP/HTTP
+JSON shape is small and this process's needs are a handful of span kinds.
+
+Beyond OTLP export, this build dogfoods the lake itself:
+
+- A `contextvars`-based trace context (trace_id, current span_id) threads
+  one request through ingest -> staging flush -> object sync -> query.
+  HTTP ingress honors W3C `traceparent`; background sync ticks open their
+  own root context so their child spans correlate per tick.
+- Every finished span lands in a bounded in-memory ring (`recent_spans`,
+  served by GET /api/v1/debug/spans) and — when a `SpanSink` is attached —
+  is appended as a row to the internal `pmeta` stream, so
+  `SELECT name, avg(duration_ms) FROM pmeta GROUP BY name` runs through
+  the normal SQL path over the lake's own telemetry.
+
+Recording is a no-op (zero row/export cost) unless at least one consumer
+exists: an OTLP endpoint, an attached sink, or an active trace context.
 """
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import os
 import random
 import threading
 import time
-import urllib.request
+from collections import deque
 from contextlib import contextmanager
 
 logger = logging.getLogger(__name__)
 
 MAX_BUFFER = 2048
 EXPORT_BATCH = 256
+SPAN_RING_SIZE = 4096
+SINK_MAX_ROWS = 8192
+
+# (trace_id, current_span_id) for the executing logical request; span_id may
+# be None at the root of a fresh trace (first span then has no parent).
+_TRACE_CTX: contextvars.ContextVar[tuple[str, str | None] | None] = contextvars.ContextVar(
+    "p_trace_ctx", default=None
+)
+# set while the sink itself writes into pmeta: the write path must not spawn
+# spans of its own (unbounded self-observation recursion otherwise)
+_SUPPRESS: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "p_trace_suppress", default=False
+)
+
+
+def new_trace_id() -> str:
+    return f"{random.getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+def current_trace_id() -> str | None:
+    ctx = _TRACE_CTX.get()
+    return ctx[0] if ctx else None
+
+
+def current_span_id() -> str | None:
+    ctx = _TRACE_CTX.get()
+    return ctx[1] if ctx else None
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """W3C traceparent `00-<32x trace>-<16x span>-<2x flags>` ->
+    (trace_id, parent_span_id), or None when absent/malformed/all-zero."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1].lower(), parts[2].lower()
+    if version == "ff" or len(version) != 2:
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(version, 16)
+        t = int(trace_id, 16)
+        s = int(span_id, 16)
+    except ValueError:
+        return None
+    if t == 0 or s == 0:
+        return None
+    return trace_id, span_id
+
+
+@contextmanager
+def trace_context(traceparent: str | None = None):
+    """Root trace context for one logical request (HTTP request, sync tick).
+
+    Honors an incoming W3C traceparent (spans then parent under the remote
+    caller's span); otherwise starts a fresh trace. Yields the trace id."""
+    parsed = parse_traceparent(traceparent)
+    if parsed is not None:
+        trace_id, parent_span = parsed
+    else:
+        trace_id, parent_span = new_trace_id(), None
+    token = _TRACE_CTX.set((trace_id, parent_span))
+    try:
+        yield trace_id
+    finally:
+        _TRACE_CTX.reset(token)
+
+
+@contextmanager
+def suppress_tracing():
+    """Disable span recording in this context (pmeta self-writes)."""
+    token = _SUPPRESS.set(True)
+    try:
+        yield
+    finally:
+        _SUPPRESS.reset(token)
+
+
+class SpanSink:
+    """Buffers finished spans as rows for the internal `pmeta` stream.
+
+    The server attaches its Parseable instance at startup; `flush()` (a
+    background loop + shutdown hook) writes buffered rows through the normal
+    event pipeline, so the lake's own spans are queryable with its own SQL
+    (reference analogue: cluster metrics ingested into pmeta,
+    cluster/mod.rs:1623-1784). Detached (library/test use), rows are
+    dropped at record time at zero cost."""
+
+    def __init__(self):
+        self._p = None
+        self._rows: list[dict] = []
+        self._lock = threading.Lock()
+
+    @property
+    def attached(self) -> bool:
+        return self._p is not None
+
+    def attach(self, parseable) -> None:
+        self._p = parseable
+
+    def detach(self) -> None:
+        self._p = None
+        with self._lock:
+            self._rows.clear()
+
+    def record(self, row: dict) -> None:
+        if self._p is None:
+            return
+        with self._lock:
+            self._rows.append(row)
+            if len(self._rows) > SINK_MAX_ROWS:
+                del self._rows[: len(self._rows) - SINK_MAX_ROWS]
+
+    def flush(self) -> int:
+        """Write buffered span rows into the internal pmeta stream.
+        Returns the number of rows written."""
+        p = self._p
+        if p is None:
+            return 0
+        with self._lock:
+            rows, self._rows = self._rows, []
+        if not rows:
+            return 0
+        try:
+            from parseable_tpu import INTERNAL_STREAM_NAME
+            from parseable_tpu.event.json_format import JsonEvent
+
+            with suppress_tracing():
+                stream = p.create_stream_if_not_exists(
+                    INTERNAL_STREAM_NAME, stream_type="Internal"
+                )
+                ev = JsonEvent(rows, INTERNAL_STREAM_NAME).into_event(stream.metadata)
+                ev.process(stream, commit_schema=p.commit_schema)
+            return len(rows)
+        except Exception:
+            logger.exception("pmeta span flush failed; %d spans dropped", len(rows))
+            return 0
+
+
+SPAN_SINK = SpanSink()
+
+# last-N finished spans for GET /api/v1/debug/spans (deque appends are
+# GIL-atomic; readers snapshot with list())
+_SPAN_RING: deque[dict] = deque(maxlen=SPAN_RING_SIZE)
+
+
+def recent_spans(trace_id: str | None = None, limit: int = 1000) -> list[dict]:
+    spans = list(_SPAN_RING)
+    if trace_id:
+        spans = [s for s in spans if s["trace_id"] == trace_id]
+    return spans[-limit:]
+
+
+def clear_recent_spans() -> None:
+    _SPAN_RING.clear()
 
 
 class Tracer:
@@ -37,44 +214,90 @@ class Tracer:
     def enabled(self) -> bool:
         return self.endpoint is not None
 
+    def _recording(self) -> bool:
+        """Spans cost something only when a consumer exists: an OTLP
+        endpoint, an attached pmeta sink, or an active trace context
+        (debug/spans + parentage)."""
+        if _SUPPRESS.get():
+            return False
+        return (
+            self.endpoint is not None
+            or SPAN_SINK.attached
+            or _TRACE_CTX.get() is not None
+        )
+
     @contextmanager
     def span(self, name: str, **attrs):
-        """Record one span; no-op (zero allocation) when disabled."""
-        if not self.enabled:
-            yield
+        """Record one span; yields a mutable attr dict so callers can attach
+        values discovered mid-span (stream, rows, bytes, status_code).
+        No-op (zero allocation beyond the dict) when nothing consumes."""
+        if not self._recording():
+            yield attrs
             return
+        ctx = _TRACE_CTX.get()
+        if ctx is not None:
+            trace_id, parent_id = ctx
+        else:
+            # no ambient context: one trace per top-level operation — a
+            # process-wide id would collapse everything into a single
+            # unbounded trace
+            trace_id, parent_id = new_trace_id(), None
+        span_id = new_span_id()
+        token = _TRACE_CTX.set((trace_id, span_id))
         start_ns = time.time_ns()
         err = None
         try:
-            yield
+            yield attrs
         except BaseException as e:
             err = e
             raise
         finally:
             end_ns = time.time_ns()
-            span = {
-                # one trace per top-level operation — a process-wide id
-                # would collapse everything into a single unbounded trace
-                "traceId": f"{random.getrandbits(128):032x}",
-                "spanId": f"{random.getrandbits(64):016x}",
-                "name": name,
-                "kind": 1,  # SPAN_KIND_INTERNAL
-                "startTimeUnixNano": str(start_ns),
-                "endTimeUnixNano": str(end_ns),
-                "attributes": [
-                    {"key": k, "value": {"stringValue": str(v)}} for k, v in attrs.items()
-                ],
-                "status": {"code": 2 if err else 1},
-            }
-            with self._lock:
-                self._spans.append(span)
-                if len(self._spans) > MAX_BUFFER:
-                    del self._spans[: len(self._spans) - MAX_BUFFER]
-                should_flush = len(self._spans) >= EXPORT_BATCH
-            if should_flush and not self._flush_inflight.locked():
-                # export off the request path: a slow collector must never
-                # add latency to the ingest/query that tipped the batch
-                threading.Thread(target=self.flush, name="otlp-export", daemon=True).start()
+            _TRACE_CTX.reset(token)
+            self._finish(
+                name, trace_id, span_id, parent_id, start_ns, end_ns, err, attrs
+            )
+
+    def _finish(self, name, trace_id, span_id, parent_id, start_ns, end_ns, err, attrs):
+        row = {
+            "event_type": "span",
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_span_id": parent_id or "",
+            "name": name,
+            "stream": str(attrs.get("stream", "")),
+            "duration_ms": round((end_ns - start_ns) / 1e6, 3),
+            "bytes": int(attrs.get("bytes", 0) or 0),
+            "status": "error" if err else str(attrs.get("status", "ok")),
+            "ts": _rfc3339_ns(start_ns),
+        }
+        _SPAN_RING.append(row)
+        SPAN_SINK.record(row)
+        if not self.enabled:
+            return
+        span = {
+            "traceId": trace_id,
+            "spanId": span_id,
+            "name": name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}} for k, v in attrs.items()
+            ],
+            "status": {"code": 2 if err else 1},
+        }
+        if parent_id:
+            span["parentSpanId"] = parent_id
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > MAX_BUFFER:
+                del self._spans[: len(self._spans) - MAX_BUFFER]
+            should_flush = len(self._spans) >= EXPORT_BATCH
+        if should_flush and not self._flush_inflight.locked():
+            # export off the request path: a slow collector must never
+            # add latency to the ingest/query that tipped the batch
+            threading.Thread(target=self.flush, name="otlp-export", daemon=True).start()
 
     def flush(self) -> bool:
         """Export buffered spans (OTLP/HTTP JSON); failures drop the batch.
@@ -85,6 +308,8 @@ class Tracer:
             return self._flush_locked()
 
     def _flush_locked(self) -> bool:
+        import urllib.request
+
         with self._lock:
             batch, self._spans = self._spans, []
         if not batch:
@@ -118,6 +343,16 @@ class Tracer:
         except Exception as e:
             logger.debug("otlp export failed: %s", e)
             return False
+
+
+def _rfc3339_ns(ns: int) -> str:
+    from datetime import UTC, datetime
+
+    return (
+        datetime.fromtimestamp(ns / 1e9, UTC)
+        .isoformat(timespec="milliseconds")
+        .replace("+00:00", "Z")
+    )
 
 
 TRACER = Tracer()

@@ -1,0 +1,139 @@
+"""Observability smoke check: boot a server, ingest, query, scrape /metrics.
+
+Asserts the self-observability pipeline is actually wired end to end:
+
+- nonzero `parseable_query_execute_time` and
+  `parseable_storage_request_response_time` samples in a /metrics scrape
+  after one ingest + one query;
+- the ingest and query requests (sent with the same W3C `traceparent`)
+  produce spans sharing a trace_id with correct parentage;
+- `SELECT count(*) FROM pmeta` > 0 through the normal SQL path after the
+  span sink flushes.
+
+Runnable standalone (`python scripts/obs_smoke.py`) and from
+tests/test_observability.py as a `not slow` test.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+TRACEPARENT = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+def run_smoke(workdir: Path) -> dict:
+    """Drive the smoke flow in-process; returns a result summary dict.
+    Raises AssertionError on any broken link in the pipeline."""
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.server.app import ServerState, build_app
+    from parseable_tpu.utils import telemetry
+
+    opts = Options()
+    opts.local_staging_path = workdir / "staging"
+    opts.query_engine = "cpu"
+    p = Parseable(opts, StorageOptions(backend="local-store", root=workdir / "data"))
+    state = ServerState(p)
+    telemetry.SPAN_SINK.attach(p)
+
+    async def flow() -> dict:
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(build_app(state)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/api/v1/ingest",
+                json=[{"host": f"h{i % 3}", "status": 200} for i in range(50)],
+                headers={**AUTH, "X-P-Stream": "smoke", "traceparent": TRACEPARENT},
+            )
+            assert r.status == 200, await r.text()
+            ingest_trace = r.headers.get("X-P-Trace-Id")
+
+            # flush + object sync under one trace, like the sync loops do
+            with telemetry.trace_context():
+                p.local_sync(shutdown=True)
+                p.sync_all_streams()
+
+            r = await client.post(
+                "/api/v1/query",
+                json={"query": "SELECT host, count(*) c FROM smoke GROUP BY host"},
+                headers={**AUTH, "traceparent": TRACEPARENT},
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers.get("X-P-Trace-Id") == ingest_trace == "ab" * 16
+
+            # trace tree: ingest + query spans share the propagated trace id
+            r = await client.get(
+                f"/api/v1/debug/spans?trace_id={ingest_trace}", headers=AUTH
+            )
+            spans = (await r.json())["spans"]
+            names = {s["name"] for s in spans}
+            assert {"http.request", "ingest", "query"} <= names, names
+            by_name = {s["name"]: s for s in spans}
+            roots = [s for s in spans if s["name"] == "http.request"]
+            assert by_name["ingest"]["parent_span_id"] in {s["span_id"] for s in roots}
+            assert by_name["query"]["parent_span_id"] in {s["span_id"] for s in roots}
+
+            # pmeta self-ingest: spans queryable through the SQL engine
+            flushed = telemetry.SPAN_SINK.flush()
+            assert flushed > 0, "span sink flushed no rows"
+            p.local_sync(shutdown=True)
+            p.sync_all_streams()
+            r = await client.post(
+                "/api/v1/query",
+                json={"query": "SELECT count(*) c FROM pmeta"},
+                headers=AUTH,
+            )
+            assert r.status == 200, await r.text()
+            pmeta_rows = (await r.json())[0]["c"]
+            assert pmeta_rows > 0
+
+            # metrics scrape: the dead families must be alive
+            r = await client.get("/api/v1/metrics", headers=AUTH)
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain; version=")
+            text = await r.text()
+            nonzero = {}
+            for fam in (
+                "parseable_query_execute_time",
+                "parseable_storage_request_response_time",
+            ):
+                samples = [
+                    line
+                    for line in text.splitlines()
+                    if line.startswith(fam)
+                    and not line.startswith("#")
+                    and float(line.rsplit(" ", 1)[-1]) > 0
+                ]
+                assert samples, f"no nonzero {fam} samples after smoke flow"
+                nonzero[fam] = len(samples)
+            return {
+                "trace_id": ingest_trace,
+                "span_names": sorted(names),
+                "pmeta_rows": pmeta_rows,
+                "nonzero_samples": nonzero,
+            }
+        finally:
+            await client.close()
+            telemetry.SPAN_SINK.detach()
+
+    return asyncio.new_event_loop().run_until_complete(flow())
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="obs-smoke-") as d:
+        result = run_smoke(Path(d))
+    print("obs smoke OK:", result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,14 @@ file are too late — steer the platform through jax.config instead, before
 any backend initializes.
 """
 
+import datetime as _datetime
 import os
+
+# Python 3.10 compatibility (datetime.UTC is 3.11+): test modules may do
+# `from datetime import UTC` before importing parseable_tpu, so the alias
+# must exist before collection, not just at package import.
+if not hasattr(_datetime, "UTC"):
+    _datetime.UTC = _datetime.timezone.utc
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -17,6 +17,10 @@ import ssl
 import urllib.request
 
 import pytest
+
+pytest.importorskip(
+    "cryptography", reason="cert generation needs the cryptography package"
+)
 from aiohttp import web
 
 from parseable_tpu.config import Mode, Options, StorageOptions
