@@ -217,6 +217,35 @@ class Options:
     # pyarrow-compute fallback engine (the measured baseline).
     query_engine: str = field(default_factory=lambda: _env("P_QUERY_ENGINE", "tpu"))
 
+    # --- parallel scan pipeline (query/provider.py) ---------------------------
+    # concurrent manifest-file fetch+decode workers; parquet decode releases
+    # the GIL and object-store GETs are network-bound, so threads overlap well
+    scan_workers: int = field(
+        default_factory=lambda: _env_int("P_SCAN_WORKERS", min(8, os.cpu_count() or 1))
+    )
+    # cap on decoded-table bytes held between the pool and the consumer
+    scan_inflight_bytes: int = field(
+        default_factory=lambda: _env_int("P_SCAN_INFLIGHT_BYTES", 256 * 1024 * 1024)
+    )
+    # projected column-chunk range reads for remote parquet (footer via tail
+    # get_range, then only the projected columns' byte ranges); 0 disables
+    scan_range_reads: bool = field(
+        default_factory=lambda: _env_bool("P_SCAN_RANGE_READS", True)
+    )
+    # first tail read; footers larger than this cost one extra round trip
+    scan_footer_bytes: int = field(
+        default_factory=lambda: _env_int("P_SCAN_FOOTER_BYTES", 64 * 1024)
+    )
+    # adjacent column-chunk ranges closer than this merge into one GET
+    scan_range_coalesce_bytes: int = field(
+        default_factory=lambda: _env_int("P_SCAN_RANGE_COALESCE", 1024 * 1024)
+    )
+    # when projected chunks cover more than this fraction of the object,
+    # one whole-object GET beats several ranged round trips
+    scan_range_max_coverage: float = field(
+        default_factory=lambda: _env_float("P_SCAN_RANGE_COVERAGE", 0.8)
+    )
+
     # --- ingest ---------------------------------------------------------------
     # (reference: src/cli.rs:576-583 max payload; event flatten depth)
     max_event_payload_bytes: int = field(

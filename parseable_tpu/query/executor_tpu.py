@@ -32,8 +32,13 @@ exact.
 
 Precision: per-block reductions run in f32 (blocks <= 2^22 rows keep counts
 exact; sums carry ~1e-5 relative error vs the CPU engine's f64); cross-block
-accumulation is f64 on host. Device time comparisons support `<`/`>=` at
-second granularity exactly (see ops/device.py); `>`/`<=`/`=` fall back.
+accumulation is f64 on host. Device timestamps encode as exact int32
+milliseconds relative to the block origin (see ops/device.py), so EVERY
+comparison op — `<`, `>=`, `>`, `<=`, `=`, `!=`, including sub-second
+literals — evaluates exactly on device with no second-granularity fallback;
+sub-millisecond literals floor to ms, matching the CPU engine's coercion
+(the two engines agree row-for-row). Columns with sub-ms residue decline
+device encoding and take the CPU path instead.
 """
 
 from __future__ import annotations

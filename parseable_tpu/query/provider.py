@@ -11,16 +11,38 @@ The scan resolves, in order:
 
 Returns pyarrow Tables column-pruned to what the plan needs. All sources are
 adapted to the merged stream schema so mixed-schema files union cleanly.
+
+Object-store files flow through a shared parallel pipeline (the reference
+gets the equivalent from DataFusion's ParquetExec): a bounded worker pool
+(P_SCAN_WORKERS) fetches+decodes manifest files concurrently — Arrow's
+parquet decode releases the GIL and object-store GETs are network-bound —
+and yields tables to the consumer as they complete, holding at most
+P_SCAN_INFLIGHT_BYTES of decoded data ahead of it. Closing the consumer
+(LIMIT satisfied, timeout, error) cancels queued work and drains the pool:
+no leaked threads, no storage calls issued after close.
+
+On top of the pool, **projected column-chunk range reads**: for remote files
+on a backend with a real ranged GET, the footer is read via a tail
+`get_range` and only the byte ranges of the column chunks the plan projects
+are fetched (adjacent ranges coalesced), instead of the whole object. The
+whole-object GET remains for hot-tier files, `SELECT *`, backends whose
+`get_range` is the whole-object default, and projections that cover most of
+the file anyway.
 """
 
 from __future__ import annotations
 
+import contextvars
+import io
 import logging
+import queue as _queue
+import struct
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from datetime import UTC, datetime, timedelta
 from pathlib import Path
-from typing import Iterator
+from typing import Callable, Iterator
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -29,9 +51,16 @@ from parseable_tpu import DEFAULT_TIMESTAMP_KEY, LOCAL_SYNC_INTERVAL
 from parseable_tpu.catalog import ManifestFile, Snapshot
 from parseable_tpu.core import Parseable
 from parseable_tpu.query.planner import LogicalPlan, prune_file
-from parseable_tpu.utils.metrics import TOTAL_QUERY_BYTES_SCANNED_DATE
+from parseable_tpu.utils.metrics import (
+    SCAN_ERRORS,
+    SCAN_POOL_QUEUE_DEPTH,
+    SCAN_PROJECTION_BYTES_SAVED,
+    TOTAL_QUERY_BYTES_SCANNED_DATE,
+)
 
 logger = logging.getLogger(__name__)
+
+_PARQUET_MAGIC = b"PAR1"
 
 
 @dataclass
@@ -41,62 +70,203 @@ class ScanStats:
     bytes_scanned: int = 0
     rows_scanned: int = 0
     staging_batches: int = 0
+    # files dropped from the result set by read/decode failures — nonzero
+    # means the response is PARTIAL (surfaced in stats + a Prometheus counter)
+    scan_errors: int = 0
+    # bytes the projected range reads did not download vs whole-object GETs
+    bytes_saved_by_projection: int = 0
+    range_read_files: int = 0
 
 
-def prefetch_iter(source, depth: int = 2):
-    """Run `source` on a background thread, keeping `depth` items ready.
+# --------------------------------------------------------------------------
+# parallel fetch+decode pool
 
-    Overlaps parquet read/decode with device compute (SURVEY hard-parts:
-    "keep host->device transfer off the critical path"). Exceptions
-    propagate to the consumer. When the consumer stops early (LIMIT,
-    timeout, generator close), the worker notices the closed flag on its
-    next bounded put and exits — no leaked thread or buffered tables.
+
+class _InflightBudget:
+    """Bounds decoded bytes held between the pool and the consumer.
+
+    Workers acquire an estimate (the manifest file size) before fetching and
+    the consumer releases it when it takes the table. An item larger than the
+    whole cap is admitted alone (cap is a ceiling on *concurrent* holdings,
+    never a deadlock)."""
+
+    def __init__(self, cap: int):
+        self.cap = max(1, cap)
+        self._used = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, n: int, cancelled: threading.Event) -> bool:
+        n = min(n, self.cap)  # oversized items admit alone
+        with self._cond:
+            while self._used and self._used + n > self.cap:
+                if cancelled.is_set():
+                    return False
+                self._cond.wait(timeout=0.1)
+            if cancelled.is_set():
+                return False
+            self._used += n
+            return True
+
+    def release(self, n: int) -> None:
+        n = min(n, self.cap)
+        with self._cond:
+            self._used = max(0, self._used - n)
+            self._cond.notify_all()
+
+
+def scan_pool_iter(
+    items: list,
+    fetch: Callable,
+    *,
+    workers: int,
+    inflight_bytes: int,
+    size_of: Callable[[object], int],
+):
+    """Run `fetch(item)` over a bounded thread pool, yielding
+    `(item, result)` pairs **as they complete** (completion order, not
+    submission order — the engines merge blocks orderlessly, and head-of-line
+    blocking would idle the device behind one slow GET).
+
+    Contract (the tentpole's cancellation guarantees):
+    - closing the generator cancels not-yet-started tasks, so no storage
+      call is issued after close; tasks already mid-fetch finish and their
+      results are dropped;
+    - the pool is drained synchronously on close — no leaked threads;
+    - in-flight decoded bytes are bounded by `inflight_bytes` (estimated by
+      `size_of`); the trace context at construction is carried into every
+      worker so per-file spans parent correctly.
+
+    `fetch` errors propagate to the consumer (expected per-file read errors
+    are already converted to `None` results by the caller's fetch fn).
     """
-    import queue as _q
+    results: _queue.Queue = _queue.Queue()
+    cancelled = threading.Event()
+    budget = _InflightBudget(inflight_bytes)
 
-    q: _q.Queue = _q.Queue(maxsize=max(1, depth))
-    _END = object()
-    closed = threading.Event()
-
-    def worker():
+    def task(item):
+        # every code path MUST put exactly one record or the consumer hangs
         try:
-            for item in source:
-                while not closed.is_set():
-                    try:
-                        q.put(item, timeout=0.2)
-                        break
-                    except _q.Full:
-                        continue
-                if closed.is_set():
-                    return
-        except BaseException as e:  # propagate into the consumer
-            if not closed.is_set():
-                q.put((_END, e))
+            est = max(1, size_of(item))
+            if cancelled.is_set() or not budget.acquire(est, cancelled):
+                results.put((item, None, None, 0))
+                return
+        except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
+            results.put((item, None, e, 0))
             return
-        if not closed.is_set():
-            q.put((_END, None))
-
-    t = threading.Thread(target=worker, name="scan-prefetch", daemon=True)
-    t.start()
-
-    def gen():
         try:
-            while True:
-                item = q.get()
-                if isinstance(item, tuple) and len(item) == 2 and item[0] is _END:
-                    if item[1] is not None:
-                        raise item[1]
-                    return
-                yield item
-        finally:
-            closed.set()
-            while not q.empty():  # drop buffered tables promptly
-                try:
-                    q.get_nowait()
-                except _q.Empty:
-                    break
+            out = fetch(item)
+        except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
+            results.put((item, None, e, est))
+            return
+        results.put((item, out, None, est))
+        SCAN_POOL_QUEUE_DEPTH.set(results.qsize())
 
-    return gen()
+    pool = ThreadPoolExecutor(max_workers=max(1, workers), thread_name_prefix="scan")
+    futures = []
+    for item in items:
+        # each worker enters its own copy of the submitter's context so
+        # spans recorded during fetch/decode join the query's trace
+        ctx = contextvars.copy_context()
+        futures.append(pool.submit(ctx.run, task, item))
+
+    received = 0
+    try:
+        while received < len(items):
+            item, out, err, est = results.get()
+            received += 1
+            SCAN_POOL_QUEUE_DEPTH.set(results.qsize())
+            if est:
+                budget.release(est)
+            if err is not None:
+                raise err
+            if out is not None:
+                yield item, out
+    finally:
+        cancelled.set()
+        for fut in futures:
+            fut.cancel()
+        # synchronous drain: mid-fetch tasks finish, everything queued after
+        # the cancel flag exits before touching storage
+        pool.shutdown(wait=True)
+        SCAN_POOL_QUEUE_DEPTH.set(0)
+
+
+# --------------------------------------------------------------------------
+# projected column-chunk range reads
+
+
+class _RangeReadUncovered(Exception):
+    """A read landed outside the fetched ranges (page-index probe, metadata
+    the chunk map didn't predict) — the caller falls back to a full GET."""
+
+
+class _SparseFile:
+    """Seekable read-only file over fetched byte segments of a remote object.
+
+    pyarrow's ParquetFile drives it like any file: seek to the footer, then
+    seek/read each projected column chunk. Reads must land inside a fetched
+    segment; anything else raises `_RangeReadUncovered`."""
+
+    def __init__(self, size: int, segments: list[tuple[int, bytes]]):
+        self._size = size
+        self._segs = sorted(segments)
+        self._pos = 0
+        self.closed = False
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def close(self) -> None:
+        self.closed = True
+
+    def flush(self) -> None:
+        pass
+
+    def tell(self) -> int:
+        return self._pos
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        elif whence == 2:
+            self._pos = self._size + offset
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = self._size - self._pos
+        if n == 0:
+            return b""
+        for start, data in self._segs:
+            if start <= self._pos and self._pos + n <= start + len(data):
+                off = self._pos - start
+                self._pos += n
+                return data[off : off + n]
+        raise _RangeReadUncovered(f"read [{self._pos}, +{n}) outside fetched ranges")
+
+
+def coalesce_ranges(
+    ranges: list[tuple[int, int]], gap: int
+) -> list[tuple[int, int]]:
+    """Merge inclusive [start, end] ranges whose gap is <= `gap` bytes —
+    a handful of slightly-fat GETs beats many tiny round trips."""
+    if not ranges:
+        return []
+    out: list[list[int]] = []
+    for s, e in sorted(ranges):
+        if out and s <= out[-1][1] + 1 + gap:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
 
 
 class StreamScan:
@@ -118,6 +288,8 @@ class StreamScan:
         self._sources: dict[bytes, ManifestFile] = {}
         self._manifest_files: list[ManifestFile] | None = None
         self.stats = ScanStats()
+        # pool workers update the same ScanStats concurrently
+        self._stats_lock = threading.Lock()
 
     # ---------------------------------------------------------------- helpers
 
@@ -245,8 +417,26 @@ class StreamScan:
                     return False
         return True
 
-    def _read_parquet(self, f: ManifestFile) -> pa.Table | None:
-        """Read a manifest entry: hot tier first, else object store."""
+    # ---------------------------------------------------- parquet read paths
+
+    def _record_error(self) -> None:
+        with self._stats_lock:
+            self.stats.scan_errors += 1
+        SCAN_ERRORS.labels(self.plan.stream).inc()
+
+    def _read_parquet(
+        self, f: ManifestFile, use_threads: bool = True
+    ) -> pa.Table | None:
+        """Read a manifest entry: hot tier first, then projected range
+        reads, else a whole-object GET. Errors drop the file from the
+        results but are COUNTED (stats.scan_errors + Prometheus) so a
+        partial response is detectable, not silent.
+
+        `use_threads=False` when called from the scan pool: file-level
+        parallelism replaces Arrow's intra-file thread pool — stacking
+        both oversubscribes the host and measurably slows the cold path."""
+        from parseable_tpu.utils import telemetry
+
         local: Path | None = None
         if self.hot_tier_dir is not None:
             cand = self.hot_tier_dir / f.file_path
@@ -254,22 +444,152 @@ class StreamScan:
                 local = cand
         try:
             if local is None:
-                import io
-
-                data = self.p.storage.get_object(f.file_path)
-                self.stats.bytes_scanned += len(data)
+                try:
+                    table = self._read_projected_remote(f, use_threads)
+                    if table is not None:
+                        return table
+                except Exception:
+                    # any range-read surprise (uncovered read, footer probe
+                    # mismatch, flaky ranged GET) falls back to the full GET
+                    logger.debug(
+                        "range read fell back for %s", f.file_path, exc_info=True
+                    )
+                with telemetry.TRACER.span(
+                    "scan.fetch", file=f.file_path, stream=self.plan.stream
+                ) as sp:
+                    data = self.p.storage.get_object(f.file_path)
+                    sp["bytes"] = len(data)
+                with self._stats_lock:
+                    self.stats.bytes_scanned += len(data)
                 src = io.BytesIO(data)
             else:
-                self.stats.bytes_scanned += local.stat().st_size
+                with self._stats_lock:
+                    self.stats.bytes_scanned += local.stat().st_size
                 src = local
-            pf = pq.ParquetFile(src)
-            cols = self._columns_for_read(pf.schema_arrow.names)
-            table = pf.read(columns=cols)
-            self.stats.rows_scanned += table.num_rows
+            with telemetry.TRACER.span(
+                "scan.decode", file=f.file_path, stream=self.plan.stream
+            ):
+                pf = pq.ParquetFile(src)
+                cols = self._columns_for_read(pf.schema_arrow.names)
+                table = pf.read(columns=cols, use_threads=use_threads)
+            with self._stats_lock:
+                self.stats.rows_scanned += table.num_rows
             return table
         except Exception:
             logger.exception("failed reading parquet %s", f.file_path)
+            self._record_error()
             return None
+
+    def _read_projected_remote(
+        self, f: ManifestFile, use_threads: bool = True
+    ) -> pa.Table | None:
+        """Projected column-chunk range read; None means 'use the full GET'
+        (no projection, no real ranged backend, projection covers most of
+        the file, tiny file). Raises on surprises — caller falls back."""
+        from parseable_tpu.utils import telemetry
+
+        opts = getattr(self.p, "options", None)
+        if opts is None or not getattr(opts, "scan_range_reads", False):
+            return None
+        if self.plan.needed_columns is None:
+            return None
+        storage = self.p.storage
+        if not storage.supports_range_reads():
+            return None
+        size = f.file_size
+        # pyarrow's ParquetFile.open probes the file with one 64 KiB tail
+        # read regardless of the real footer size, so the fetched tail must
+        # cover at least that much or the sparse file can't serve the probe
+        footer_hint = max(64 * 1024, getattr(opts, "scan_footer_bytes", 64 * 1024))
+        if not size or size <= 2 * footer_hint:
+            return None  # tiny object: one GET is strictly cheaper
+
+        fetched = 0
+        table = None
+        try:
+            with telemetry.TRACER.span(
+                "scan.fetch", file=f.file_path, ranged=True, stream=self.plan.stream
+            ) as fetch_sp:
+                tail = storage.get_range(
+                    f.file_path, size - min(size, footer_hint), size - 1
+                )
+                fetched += len(tail)
+                if len(tail) < 8 or tail[-4:] != _PARQUET_MAGIC:
+                    raise ValueError(f"not a parquet object: {f.file_path}")
+                footer_total = struct.unpack("<I", tail[-8:-4])[0] + 8
+                if footer_total > size:
+                    raise ValueError(f"corrupt parquet footer length in {f.file_path}")
+                if footer_total > len(tail):
+                    more = storage.get_range(
+                        f.file_path, size - footer_total, size - len(tail) - 1
+                    )
+                    fetched += len(more)
+                    tail = more + tail
+                md = pq.read_metadata(io.BytesIO(tail[-footer_total:]))
+                cols = self._columns_for_read(md.schema.to_arrow_schema().names)
+                if cols is None:
+                    return None
+                colset = set(cols)
+                ranges: list[tuple[int, int]] = []
+                projected = 0
+                for rg in range(md.num_row_groups):
+                    group = md.row_group(rg)
+                    for ci in range(group.num_columns):
+                        chunk = group.column(ci)
+                        if chunk.path_in_schema.split(".", 1)[0] not in colset:
+                            continue
+                        start = chunk.data_page_offset
+                        dict_off = chunk.dictionary_page_offset
+                        if dict_off is not None and 0 <= dict_off < start:
+                            start = dict_off
+                        length = chunk.total_compressed_size
+                        if start < 0 or length <= 0 or start + length > size:
+                            raise ValueError(
+                                f"chunk range out of bounds in {f.file_path}"
+                            )
+                        ranges.append((start, start + length - 1))
+                        projected += length
+                if not ranges:
+                    return None  # zero physical columns projected (count-only)
+                max_cov = getattr(opts, "scan_range_max_coverage", 0.8)
+                if projected + footer_total >= max_cov * size:
+                    return None  # near-full coverage: one GET beats k round trips
+                gap = max(0, getattr(opts, "scan_range_coalesce_bytes", 1024 * 1024))
+                segments: list[tuple[int, bytes]] = []
+                for s, e in coalesce_ranges(ranges, gap):
+                    data = storage.get_range(f.file_path, s, e)
+                    if len(data) != e - s + 1:
+                        raise ValueError(f"short ranged GET on {f.file_path}")
+                    fetched += len(data)
+                    segments.append((s, data))
+                segments.append((size - len(tail), tail))
+                fetch_sp["bytes"] = fetched
+
+            with telemetry.TRACER.span(
+                "scan.decode",
+                file=f.file_path,
+                ranged=True,
+                bytes=fetched,
+                stream=self.plan.stream,
+            ):
+                table = pq.ParquetFile(_SparseFile(size, segments)).read(
+                    columns=cols, use_threads=use_threads
+                )
+            return table
+        finally:
+            # every byte actually pulled counts — including the footer probe
+            # when this path bails out to (or falls back on) the full GET
+            with self._stats_lock:
+                self.stats.bytes_scanned += fetched
+                if table is not None:
+                    saved = max(0, size - fetched)
+                    self.stats.bytes_saved_by_projection += saved
+                    self.stats.range_read_files += 1
+                    self.stats.rows_scanned += table.num_rows
+            if table is not None:
+                SCAN_PROJECTION_BYTES_SAVED.labels(self.plan.stream).inc(
+                    max(0, size - fetched)
+                )
 
     def staging_tables(self) -> Iterator[pa.Table]:
         """Staging-window data: this node's unconverted arrows + unuploaded
@@ -312,8 +632,14 @@ class StreamScan:
                 yield t
             except Exception:
                 logger.exception("failed reading staged parquet %s", f)
+                self._record_error()
 
     # ------------------------------------------------------------------ scan
+
+    def _stamp(self, table: pa.Table, source_id: bytes) -> pa.Table:
+        meta = dict(table.schema.metadata or {})
+        meta[b"ptpu_source_id"] = source_id
+        return table.replace_schema_metadata(meta)
 
     def tables(self) -> Iterator[pa.Table]:
         """All sources.
@@ -323,7 +649,21 @@ class StreamScan:
         id so their device encodings are query-independent and hot-set
         cacheable — the engines apply the row-level time filter themselves
         (host filter on CPU, device mask on TPU).
+
+        Hot-set / enccache stubs resolve synchronously before any I/O;
+        everything else goes through the parallel fetch+decode pool and
+        yields in completion order. The bytes-scanned gauge lands in a
+        `finally` so early exits (LIMIT, timeout, generator close) still
+        account for what was actually fetched.
         """
+        try:
+            yield from self._tables_inner()
+        finally:
+            TOTAL_QUERY_BYTES_SCANNED_DATE.labels(
+                datetime.now(UTC).date().isoformat()
+            ).inc(self.stats.bytes_scanned)
+
+    def _tables_inner(self) -> Iterator[pa.Table]:
         if self._within_staging_window():
             for t in self.staging_tables():
                 t = self._apply_time_filter(t)
@@ -345,6 +685,7 @@ class StreamScan:
             dict_cols = dict_group_columns(self.plan.select)
             key_fn = lambda sid: hot_key(sid, self.plan.needed_columns, dict_cols)
             make_stub_fn = make_stub
+        to_fetch: list[tuple[ManifestFile, bytes]] = []
         for f in self.manifest_files():
             # size + row count make the id content-sensitive: a rewritten
             # object at the same path must not serve a stale cached block
@@ -364,15 +705,34 @@ class StreamScan:
                     self.stats.rows_scanned += f.num_rows
                     yield make_stub_fn(source_id, f.num_rows)
                     continue
-            t = self._read_parquet(f)
-            if t is None or t.num_rows == 0:
-                continue
-            meta = dict(t.schema.metadata or {})
-            meta[b"ptpu_source_id"] = source_id
-            yield t.replace_schema_metadata(meta)
-        TOTAL_QUERY_BYTES_SCANNED_DATE.labels(datetime.now(UTC).date().isoformat()).inc(
-            self.stats.bytes_scanned
+            to_fetch.append((f, source_id))
+
+        opts = getattr(self.p, "options", None)
+        workers = min(len(to_fetch), max(1, getattr(opts, "scan_workers", 1)))
+        if workers <= 1:
+            for f, source_id in to_fetch:
+                t = self._read_parquet(f)
+                if t is None or t.num_rows == 0:
+                    continue
+                yield self._stamp(t, source_id)
+            return
+        inflight = max(1, getattr(opts, "scan_inflight_bytes", 256 * 1024 * 1024))
+        pooled = scan_pool_iter(
+            to_fetch,
+            lambda pair: self._read_parquet(pair[0], use_threads=False),
+            workers=workers,
+            inflight_bytes=inflight,
+            size_of=lambda pair: pair[0].file_size or 1,
         )
+        try:
+            for (f, source_id), t in pooled:
+                if t.num_rows == 0:
+                    continue
+                yield self._stamp(t, source_id)
+        finally:
+            # explicit, synchronous pool drain when the consumer closes us
+            # (a for-loop does not close its source generator on its own)
+            pooled.close()
 
     def read_source(self, source_id: bytes) -> pa.Table:
         """Re-read a stubbed source (hot-set eviction race / CPU fallback)."""
@@ -382,9 +742,7 @@ class StreamScan:
         t = self._read_parquet(f)
         if t is None:
             raise OSError(f"failed to re-read {f.file_path}")
-        meta = dict(t.schema.metadata or {})
-        meta[b"ptpu_source_id"] = source_id
-        return t.replace_schema_metadata(meta)
+        return self._stamp(t, source_id)
 
     def _apply_time_filter(self, table: pa.Table) -> pa.Table:
         tb = self.plan.time_bounds
@@ -400,4 +758,3 @@ class StreamScan:
             m2 = pc.less(col, pa.scalar(tb.high.replace(tzinfo=None), type=col.type))
             mask = m2 if mask is None else pc.and_(mask, m2)
         return table.filter(mask)
-

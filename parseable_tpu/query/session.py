@@ -184,6 +184,14 @@ class _TimedIter:
             self.seconds += _time.perf_counter() - t0
             self.blocks += 1
 
+    def close(self) -> None:
+        """Close the wrapped scan generator — cancels the parallel fetch
+        pool deterministically (LIMIT early-exit, timeout, error) instead
+        of waiting for GC to finalize the suspended generator."""
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+
 
 class QuerySession:
     """One engine-backed session over a Parseable instance."""
@@ -270,6 +278,9 @@ class QuerySession:
                 "files_pruned": scan.stats.files_pruned,
                 "bytes_scanned": scan.stats.bytes_scanned,
                 "rows_scanned": scan.stats.rows_scanned,
+                # nonzero = files dropped by read failures (partial result)
+                "scan_errors": scan.stats.scan_errors,
+                "bytes_saved_by_projection": scan.stats.bytes_saved_by_projection,
                 # EXPLAIN ANALYZE-style per-stage wall-time breakdown;
                 # scan = time inside the block iterator, execute = the rest
                 "stages": {
@@ -278,6 +289,7 @@ class QuerySession:
                     "scan_ms": round(timer.seconds * 1000, 3),
                     "execute_ms": round(max(exec_s - timer.seconds, 0.0) * 1000, 3),
                     "total_ms": round(elapsed * 1000, 3),
+                    "bytes_saved_by_projection": scan.stats.bytes_saved_by_projection,
                 },
             }
         )
@@ -387,6 +399,8 @@ class QuerySession:
                 "files_total",
                 "files_pruned",
                 "bytes_scanned",
+                "bytes_saved_by_projection",
+                "scan_errors",
                 "elapsed_secs",
                 "engine",
             ):
@@ -496,7 +510,17 @@ class QuerySession:
         lp.deadline = None
         scan = StreamScan(self.p, lp, hot_tier_dir=self._hot_dir(lp.stream))
         executor = QueryExecutor(lp)
-        return executor.execute_select_stream(scan.tables())
+        tables = scan.tables()
+
+        def streamed():
+            # explicit close so an abandoned HTTP export cancels the scan
+            # pool deterministically instead of waiting for GC
+            try:
+                yield from executor.execute_select_stream(tables)
+            finally:
+                tables.close()
+
+        return streamed()
 
     # ------------------------------------------------------- CTE / UNION
 
@@ -793,7 +817,11 @@ class QuerySession:
         if needed is not None:
             lp.needed_columns = needed | {DEFAULT_TIMESTAMP_KEY}
         scan = StreamScan(self.p, lp, hot_tier_dir=self._hot_dir(name))
-        return QueryExecutor(lp).execute(scan.tables())
+        tables = scan.tables()
+        try:
+            return QueryExecutor(lp).execute(tables)
+        finally:
+            tables.close()
 
     def _hot_dir(self, stream: str):
         return (
@@ -830,7 +858,6 @@ class QuerySession:
                 fallback = True
         if use_tpu:
             from parseable_tpu.query.executor_tpu import TpuQueryExecutor
-            from parseable_tpu.query.provider import prefetch_iter
 
             if (
                 lp.ts_artificial
@@ -847,15 +874,17 @@ class QuerySession:
             self._set_scan_time_hint(lp, scan)
             executor: QueryExecutor = TpuQueryExecutor(lp, self.p.options)
             executor.source_loader = scan.read_source
-            # overlap parquet read/decode with device compute; depth 3 keeps
-            # the tunnel transfer (the cold-path floor) continuously fed
-            timer = _TimedIter(scan.tables())
-            tables = prefetch_iter(timer, depth=3)
         else:
             executor = QueryExecutor(lp)
-            timer = _TimedIter(scan.tables())
-            tables = timer
-        table = executor.execute(tables)
+        # both engines consume the scan's parallel fetch+decode pipeline
+        # (provider.py): the pool overlaps object-store GETs and parquet
+        # decode with engine compute, bounded by P_SCAN_INFLIGHT_BYTES —
+        # this replaced the TPU path's single-worker depth-3 prefetcher
+        timer = _TimedIter(scan.tables())
+        try:
+            table = executor.execute(timer)
+        finally:
+            timer.close()
         stats = {"engine_fallback": "device unhealthy"} if fallback else {}
         routes = getattr(executor, "route_stats", None)
         if routes is not None:

@@ -216,8 +216,10 @@ class AzureBlobStorage(ObjectStorage):
             )
 
     def get_range(self, key: str, start: int, end: int) -> bytes:
-        """Ranged read primitive for the shared parallel download."""
-        resp = self._check(
-            self._request("GET", key, extra={"Range": f"bytes={start}-{end}"}), key
-        )
-        return resp.content
+        """Ranged read primitive for the shared parallel download and the
+        projected column-chunk scan."""
+        with timed(self.name, "GET_RANGE"):
+            resp = self._check(
+                self._request("GET", key, extra={"Range": f"bytes={start}-{end}"}), key
+            )
+            return resp.content

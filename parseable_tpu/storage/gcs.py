@@ -299,14 +299,16 @@ class GcsStorage(ObjectStorage):
     # ----------------------------------------------------------- download path
 
     def get_range(self, key: str, start: int, end: int) -> bytes:
-        """Ranged read primitive for the shared parallel download."""
-        resp = self._request(
-            "GET",
-            self._obj_url(key),
-            params={"alt": "media"},
-            headers={"Range": f"bytes={start}-{end}"},
-        )
-        return self._check(resp, key).content
+        """Ranged read primitive for the shared parallel download and the
+        projected column-chunk scan."""
+        with timed(self.name, "GET_RANGE"):
+            resp = self._request(
+                "GET",
+                self._obj_url(key),
+                params={"alt": "media"},
+                headers={"Range": f"bytes={start}-{end}"},
+            )
+            return self._check(resp, key).content
 
     def delete_prefix(self, prefix: str) -> None:
         """GCS JSON API has no batch delete: fan per-key deletes over a

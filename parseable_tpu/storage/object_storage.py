@@ -82,6 +82,13 @@ class ObjectStorage(ABC):
         ranged request; the default reads the whole object."""
         return self.get_object(key)[start : end + 1]
 
+    def supports_range_reads(self) -> bool:
+        """True when get_range is a real ranged request (an override), so a
+        caller fetching k small ranges pays k range-GETs, not k whole-object
+        downloads. The projected scan consults this before choosing the
+        column-chunk range-read path over one whole-object GET."""
+        return type(self).get_range is not ObjectStorage.get_range
+
     # tuning for the shared ranged download (overridden per backend config)
     download_chunk_bytes: int = 8 * 1024 * 1024
     download_concurrency: int = 16
@@ -202,6 +209,15 @@ class LocalFS(ObjectStorage):
             tmp = p.with_name(p.name + ".tmp")
             tmp.write_bytes(data)
             os.replace(tmp, p)
+
+    def get_range(self, key: str, start: int, end: int) -> bytes:
+        with timed(self.name, "GET_RANGE"):
+            p = self._abs(key)
+            if not p.is_file():
+                raise NoSuchKey(key)
+            with p.open("rb") as f:
+                f.seek(start)
+                return f.read(end - start + 1)
 
     def delete_object(self, key: str) -> None:
         with timed(self.name, "DELETE"):

@@ -475,11 +475,13 @@ class S3Storage(ObjectStorage):
 
     def get_range(self, key: str, start: int, end: int) -> bytes:
         """Ranged GET — the primitive the shared parallel download
-        (ObjectStorage.download_file) fans out over (s3.rs:383-492)."""
-        resp = self._check(
-            self._request("GET", key, headers={"Range": f"bytes={start}-{end}"}), key
-        )
-        return resp.content
+        (ObjectStorage.download_file) and the projected column-chunk scan
+        fan out over (s3.rs:383-492)."""
+        with timed(self.name, "GET_RANGE"):
+            resp = self._check(
+                self._request("GET", key, headers={"Range": f"bytes={start}-{end}"}), key
+            )
+            return resp.content
 
     def delete_prefix(self, prefix: str) -> None:
         """Batch DeleteObjects over a listed prefix."""

@@ -69,6 +69,21 @@ QUERY_CACHE_HIT = _counter("query_cache_hit", "Query cache hits", ["stream"])
 TOTAL_QUERY_BYTES_SCANNED_DATE = _gauge(
     "total_query_bytes_scanned_date", "Bytes scanned by queries on date", ["date"]
 )
+# parallel scan pipeline (query/provider.py): decoded tables waiting between
+# the fetch+decode pool and the consumer, per-file read failures that dropped
+# a file from the results (partial-result detector), and bytes the projected
+# column-chunk range reads did NOT download vs whole-object GETs
+SCAN_POOL_QUEUE_DEPTH = _gauge(
+    "query_scan_pool_queue_depth", "Decoded tables queued ahead of the consumer", []
+)
+SCAN_ERRORS = _counter(
+    "query_scan_errors", "Files dropped from a scan by read/decode failures", ["stream"]
+)
+SCAN_PROJECTION_BYTES_SAVED = _counter(
+    "query_scan_projection_bytes_saved",
+    "Bytes not fetched thanks to projected column-chunk range reads",
+    ["stream"],
+)
 DEVICE_EXECUTE_TIME = Histogram(
     "tpu_execute_time",
     "On-device operator execution seconds",

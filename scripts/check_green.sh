@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Pre-snapshot gate: run the tier-1 verify command from ROADMAP.md and exit
+# nonzero on ANY failure ("go green and stay green"). Run this before every
+# snapshot/PR; a red tier-1 must block the commit, not ride along.
+#
+# Usage: scripts/check_green.sh
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+  echo "check_green: TIER-1 RED (pytest rc=$rc)" >&2
+  exit "$rc"
+fi
+if grep -aqE '^[0-9]+ (failed|error)|, [0-9]+ (failed|error)' /tmp/_t1.log; then
+  echo "check_green: TIER-1 RED (failures in log despite rc=0)" >&2
+  exit 1
+fi
+echo "check_green: tier-1 GREEN"
