@@ -552,6 +552,146 @@ def bench_json_ingest(p) -> None:
     )
 
 
+def bench_ingest_pipeline() -> None:
+    """Write-path benchmark (parallel write path PR): N streams of backdated
+    minute buckets, measuring staging->queryable latency (flush -> compact ->
+    upload -> snapshot commit, per stream) and sync-path rows/s — serial
+    baseline (P_SYNC_WORKERS=1, two-phase local_sync + upload tick) vs the
+    pooled pipelined sync_cycle. Pure host work; runs with or without the
+    chip. Env knobs: BENCH_INGEST_STREAMS (6), BENCH_INGEST_ROWS (100000
+    rows per stream)."""
+    import pathlib
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.event import Event
+
+    n_streams = int(os.environ.get("BENCH_INGEST_STREAMS", "8"))
+    rows_per_stream = int(os.environ.get("BENCH_INGEST_ROWS", "60000"))
+    # pooled workers: at least 4 even on small hosts — parquet encode
+    # releases the GIL and the uploads are I/O, so overlap pays regardless
+    pooled_workers = int(
+        os.environ.get("BENCH_INGEST_WORKERS", str(max(4, Options().sync_workers)))
+    )
+    # model the remote object store: each upload pays one simulated RTT so
+    # the serial-vs-pipelined difference reflects the deployment the write
+    # path actually targets (set 0 to measure raw local-fs copies)
+    upload_ms = float(os.environ.get("BENCH_INGEST_UPLOAD_MS", "25"))
+    minutes = 4
+    base = datetime(2024, 5, 1, 0, 0, tzinfo=UTC)
+
+    def run_mode(mode: str) -> dict:
+        rng = np.random.default_rng(11)
+        workdir = tempfile.mkdtemp(prefix=f"ptpu-ingbench-{mode}-")
+        opts = Options()
+        opts.local_staging_path = pathlib.Path(workdir) / "staging"
+        opts.sync_workers = 1 if mode == "serial" else pooled_workers
+        storage = StorageOptions(
+            backend="local-store", root=pathlib.Path(workdir) / "data"
+        )
+        p = Parseable(opts, storage)
+        if upload_ms > 0:
+            real_upload = p.storage.upload_file
+
+            def slow_upload(key, path):
+                time.sleep(upload_ms / 1000.0)
+                return real_upload(key, path)
+
+            p.storage.upload_file = slow_upload
+        try:
+            per_minute = max(1, rows_per_stream // minutes)
+            for si in range(n_streams):
+                name = f"ing{si}"
+                stream = p.create_stream_if_not_exists(name)
+                for minute in range(minutes):
+                    ts = [
+                        base + timedelta(minutes=minute, milliseconds=int(o))
+                        for o in np.sort(rng.integers(0, 60_000, per_minute))
+                    ]
+                    tbl = pa.table(
+                        {
+                            DEFAULT_TIMESTAMP_KEY: pa.array(
+                                [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
+                            ),
+                            "host": pa.array([f"h{i % 32}" for i in range(per_minute)]),
+                            "status": pa.array(rng.choice([200.0, 404.0, 500.0], per_minute)),
+                            "bytes": pa.array(rng.random(per_minute) * 1000),
+                        }
+                    ).combine_chunks()
+                    for batch in tbl.to_batches():
+                        Event(
+                            stream_name=name,
+                            rb=batch,
+                            origin_size=batch.num_rows * 100,
+                            is_first_event=minute == 0,
+                            parsed_timestamp=base + timedelta(minutes=minute),
+                        ).process(stream, commit_schema=p.commit_schema)
+            # per-stream visibility instant = its snapshot commit landing
+            commit_times: dict[str, float] = {}
+            orig_update = p.update_snapshot
+
+            def timed_update(stream, entries):
+                orig_update(stream, entries)
+                commit_times[stream.name] = time.perf_counter()
+
+            p.update_snapshot = timed_update
+            t0 = time.perf_counter()
+            if mode == "serial":
+                p.local_sync(shutdown=True)
+                p.sync_all_streams()
+            else:
+                p.sync_cycle(shutdown=True)
+            total = time.perf_counter() - t0
+            p.update_snapshot = orig_update
+            lats = sorted(
+                commit_times.get(f"ing{si}", t0 + total) - t0 for si in range(n_streams)
+            )
+            p.shutdown()
+            return {
+                "total_s": total,
+                "lat_p50_s": percentile(lats, 0.50),
+                "lat_p95_s": percentile(lats, 0.95),
+                "rows_per_sec": n_streams * per_minute * minutes / total,
+            }
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    serial = run_mode("serial")
+    pooled = run_mode("pooled")
+    speedup = serial["total_s"] / max(pooled["total_s"], 1e-9)
+    print(
+        f"# ingest sync: serial {serial['total_s']:.3f}s "
+        f"(lat p50 {serial['lat_p50_s']:.3f}s p95 {serial['lat_p95_s']:.3f}s) | "
+        f"pooled {pooled['total_s']:.3f}s "
+        f"(lat p50 {pooled['lat_p50_s']:.3f}s p95 {pooled['lat_p95_s']:.3f}s) | "
+        f"{speedup:.2f}x",
+        file=sys.stderr,
+    )
+    emit(
+        "ingest_sync_rows_per_sec",
+        pooled["rows_per_sec"],
+        speedup,
+        {
+            "streams": n_streams,
+            "rows_per_stream": rows_per_stream,
+            "sync_workers": pooled_workers,
+            "upload_rtt_ms": upload_ms,
+            "serial_total_s": round(serial["total_s"], 4),
+            "pooled_total_s": round(pooled["total_s"], 4),
+            "serial_lat_p50_s": round(serial["lat_p50_s"], 4),
+            "serial_lat_p95_s": round(serial["lat_p95_s"], 4),
+            "pooled_lat_p50_s": round(pooled["lat_p50_s"], 4),
+            "pooled_lat_p95_s": round(pooled["lat_p95_s"], 4),
+            "note": (
+                "staging->queryable (flush+compact+upload+commit) across N "
+                "streams; serial = P_SYNC_WORKERS=1 two-phase ticks, pooled "
+                "= pipelined sync_cycle on the shared sync pool"
+            ),
+        },
+    )
+
+
 def bench_otel_ingest(p) -> None:
     """OTel-logs ingest line: the native C++ lane (fastpath.cpp walk ->
     NDJSON -> pyarrow reader -> staging) vs the Python flattener pipeline
@@ -686,6 +826,7 @@ def main() -> None:
             pb = Parseable(opts, storage)
             bench_otel_ingest(pb)
             bench_json_ingest(pb)
+            bench_ingest_pipeline()
             bench_config1(pb, with_tpu=False)
             bench_scale_subprocess(with_tpu=False)
         except Exception as e:  # noqa: BLE001
@@ -817,6 +958,7 @@ def main() -> None:
         bench_distributed_subprocess(total_rows)
         bench_otel_ingest(p)
         bench_json_ingest(p)
+        bench_ingest_pipeline()
         bench_config1(p, with_tpu=True)
         bench_scale_subprocess(with_tpu=True)
 

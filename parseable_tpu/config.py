@@ -275,7 +275,28 @@ class Options:
     multipart_threshold_bytes: int = field(
         default_factory=lambda: _env_int("P_MULTIPART_THRESHOLD", 25 * 1024 * 1024)
     )
+    # concurrent part/block PUTs within one multipart upload (s3/gcs/azure)
+    multipart_concurrency: int = field(
+        default_factory=lambda: _env_int("P_MULTIPART_CONCURRENCY", 8)
+    )
     upload_concurrency: int = field(default_factory=lambda: _env_int("P_UPLOAD_CONCURRENCY", 8))
+
+    # --- parallel write path (staging -> parquet -> object store) -------------
+    # workers on the shared sync pool: arrow-group -> parquet compaction jobs
+    # across all streams, plus per-stream upload/commit coordinators; parquet
+    # encode releases the GIL and uploads are network-bound, so threads overlap
+    sync_workers: int = field(
+        default_factory=lambda: _env_int("P_SYNC_WORKERS", min(8, os.cpu_count() or 1))
+    )
+    # pipeline uploads behind compaction on the local-sync tick (each parquet
+    # is handed to the uploader as its group finishes, instead of waiting for
+    # the next upload tick); the upload tick still runs to retry leftovers
+    sync_pipeline: bool = field(default_factory=lambda: _env_bool("P_SYNC_PIPELINE", True))
+    # bounded queue of post-upload enrichment tasks (enccache seed + field
+    # stats) processed off the upload critical path; producers block when full
+    enrich_queue_depth: int = field(
+        default_factory=lambda: _env_int("P_ENRICH_QUEUE_DEPTH", 64)
+    )
 
     # --- sync intervals (overridable for tests) -------------------------------
     local_sync_interval_secs: int = field(default_factory=lambda: _env_int("P_LOCAL_SYNC_INTERVAL", 60))

@@ -17,6 +17,8 @@ from __future__ import annotations
 import logging
 import re
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from datetime import UTC, datetime, timedelta
 
 import pyarrow as pa
@@ -33,10 +35,17 @@ from parseable_tpu.config import Mode, Options, StorageOptions, generate_node_id
 from parseable_tpu.event.format import LogSource, SchemaVersion
 from parseable_tpu.metastore import MetastoreError, ObjectStoreMetastore
 from parseable_tpu.storage import FullStats, ObjectStoreFormat, rfc3339_now
+from parseable_tpu.storage.enrichment import EnrichmentQueue
 from parseable_tpu.storage.object_storage import UploadPool, make_provider
-from parseable_tpu.streams import LogStreamMetadata, Stream, Streams
+from parseable_tpu.streams import _HOSTNAME, LogStreamMetadata, Stream, Streams
+from parseable_tpu.utils import telemetry
 from parseable_tpu.utils.arrowutil import merge_schemas
-from parseable_tpu.utils.metrics import EVENTS_STORAGE_SIZE_DATE, LIFETIME_EVENTS_STORAGE_SIZE, STORAGE_SIZE
+from parseable_tpu.utils.metrics import (
+    EVENTS_STORAGE_SIZE_DATE,
+    LIFETIME_EVENTS_STORAGE_SIZE,
+    STORAGE_SIZE,
+    SYNC_LAG_SECONDS,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -84,6 +93,7 @@ class Parseable:
             azure_access_key=getattr(self.storage_options, "azure_access_key", None),
             gcs_token=getattr(self.storage_options, "gcs_token", None),
             multipart_threshold=self.options.multipart_threshold_bytes,
+            multipart_concurrency=self.options.multipart_concurrency,
             download_chunk_bytes=self.options.hot_tier_download_chunk_bytes,
             download_concurrency=self.options.hot_tier_download_concurrency,
         )
@@ -93,6 +103,13 @@ class Parseable:
         ingestor_id = self.node_id if self.options.mode == Mode.INGEST else None
         self.streams = Streams(self.options, ingestor_id)
         self.uploader = UploadPool(self.storage, self.options.upload_concurrency)
+        # shared write-path pool: arrow-group compaction jobs across streams
+        # plus per-stream upload/commit coordinators (P_SYNC_WORKERS)
+        self.sync_pool = ThreadPoolExecutor(
+            max_workers=max(1, self.options.sync_workers), thread_name_prefix="sync"
+        )
+        # post-upload enccache seed + field stats, off the critical path
+        self.enrichment = EnrichmentQueue(self, self.options.enrich_queue_depth)
         self.hot_tier = None  # set by the server when hot tier is enabled
         self._json_locks: dict[str, threading.Lock] = {}
         self._json_locks_guard = threading.Lock()
@@ -281,86 +298,169 @@ class Parseable:
     # ----------------------------------------------------------------- sync
 
     def local_sync(self, shutdown: bool = False) -> None:
-        """60 s tick: flush arrows + convert to parquet (sync.rs:244-313)."""
-        self.streams.flush_and_convert(shutdown)
+        """60 s tick: flush arrows + convert to parquet (sync.rs:244-313).
+        Compaction jobs from all streams run concurrently on the sync pool;
+        parquet stays staged until the next upload tick (the pipelined
+        variant, `sync_cycle`, uploads each parquet as it lands)."""
+        self.streams.flush_and_convert(shutdown, pool=self.sync_pool)
+
+    def sync_cycle(self, shutdown: bool = False) -> None:
+        """Pipelined local-sync tick: compaction on the sync pool with each
+        finished parquet handed straight to the uploader (manifest entries
+        built in the upload workers), then one snapshot commit per stream
+        once its uploads land — staging->queryable no longer waits for the
+        next 30 s upload tick. Used by the server when P_SYNC_PIPELINE."""
+        pending: dict[Stream, list] = {}
+        plock = threading.Lock()
+
+        def on_parquet(stream: Stream, path) -> None:
+            sub = self._submit_upload(stream, path)
+            with plock:
+                pending.setdefault(stream, []).append(sub)
+
+        self.streams.flush_and_convert(
+            shutdown, pool=self.sync_pool, on_parquet=on_parquet
+        )
+        # conversions are done (uploads overlapped them); commit each stream
+        # concurrently as its own uploads finish
+        futs = [
+            (
+                s,
+                self.sync_pool.submit(
+                    telemetry.propagate(self._commit_stream_uploads), s, subs
+                ),
+            )
+            for s, subs in pending.items()
+        ]
+        for s, fut in futs:
+            try:
+                fut.result()
+            except Exception:
+                logger.exception("pipelined sync failed for %s", s.name)
+        self.enrichment.drain()
+
+    def _submit_upload(self, stream: Stream, f) -> tuple:
+        """Hand one staged parquet to the upload pool. The manifest entry is
+        created in the worker after upload+validation, concurrent with the
+        other in-flight uploads (it reads the local footer, not the object)."""
+        key = stream.stream_relative_path(f)
+
+        def build_entry(meta, key=key, f=f):
+            return create_from_parquet_file(self.storage.absolute_url(key), f)
+
+        return (f, key, self.uploader.submit(key, f, post=build_entry))
 
     def upload_files_from_staging(self, stream: Stream) -> list[str]:
         """30 s tick per stream: upload parquet, update catalog, delete staged
         (reference: object_storage.rs:1024-1139 + catalog update)."""
-        uploaded: list[str] = []
-        files = stream.parquet_files()
+        files = stream.claim_parquet(stream.parquet_files())
+        # one stat() pass sizes the batch, feeds the span's bytes attribute,
+        # and yields the per-stream sync lag (oldest unuploaded parquet age)
+        now = time.time()
+        total_bytes = 0
+        oldest = now
+        for f in files:
+            try:
+                st = f.stat()
+            except OSError:
+                continue
+            total_bytes += st.st_size
+            oldest = min(oldest, st.st_mtime)
+        SYNC_LAG_SECONDS.labels(stream.name).set(max(0.0, now - oldest))
         if not files:
-            return uploaded
+            return []
         from parseable_tpu.utils.telemetry import TRACER
 
-        with TRACER.span(
-            "storage.sync",
-            stream=stream.name,
-            bytes=sum(f.stat().st_size for f in files),
-        ) as sp:
-            uploaded = self._upload_files(stream, files)
+        with TRACER.span("storage.sync", stream=stream.name, bytes=total_bytes) as sp:
+            submitted = [self._submit_upload(stream, f) for f in files]
+            uploaded = self._finalize_uploads(stream, submitted)
             sp["files"] = len(uploaded)
         return uploaded
 
-    def _upload_files(self, stream: Stream, files: list) -> list[str]:
-        uploaded: list[str] = []
-        futures = []
-        for f in files:
-            key = stream.stream_relative_path(f)
-            futures.append((f, key, self.uploader.submit(key, f)))
-        manifest_files = []
-        for f, key, fut in futures:
+    def _commit_stream_uploads(self, stream: Stream, submitted: list) -> list[str]:
+        """Pipeline-side finalize: same span shape as the upload tick."""
+        from parseable_tpu.utils.telemetry import TRACER
+
+        total_bytes = 0
+        for f, _key, _fut in submitted:
             try:
-                fut.result()
+                total_bytes += f.stat().st_size
+            except OSError:
+                pass
+        with TRACER.span("storage.sync", stream=stream.name, bytes=total_bytes) as sp:
+            uploaded = self._finalize_uploads(stream, submitted)
+            sp["files"] = len(uploaded)
+        return uploaded
+
+    def _finalize_uploads(self, stream: Stream, submitted: list) -> list[str]:
+        """Await a stream's in-flight uploads, commit ONE snapshot update for
+        the batch, then delete staged files.
+
+        Durability ordering: staged parquet is unlinked only AFTER the
+        snapshot commit succeeds. An upload failure leaves its file claimed-
+        released for the next cycle; a snapshot-commit failure leaves every
+        staged file on disk — the retry re-uploads to the same key (the
+        non-deterministic filename is kept) and `Manifest.apply_change`
+        replaces by file_path, so nothing is double-counted and nothing is
+        uploaded-but-invisible."""
+        uploaded: list[str] = []
+        entries = []
+        done: list[tuple] = []
+        for f, key, fut in submitted:
+            try:
+                entry = fut.result()
             except Exception:
                 logger.exception("upload failed for %s; will retry next cycle", f)
+                stream.unclaim_parquet(f)
                 continue
-            entry = create_from_parquet_file(self.storage.absolute_url(key), f)
-            manifest_files.append(entry)
+            entries.append(entry)
             uploaded.append(key)
-            if self.options.mode != Mode.INGEST and self.options.query_engine == "tpu":
-                # seed the encoded-block cache while the parquet bytes are
-                # page-cache warm: first cold query then skips decode+encode
-                # entirely (the TPU hot-tier design, SURVEY row 43)
-                try:
-                    import pyarrow.parquet as pq
-
-                    from parseable_tpu.ops.device import encode_table
-                    from parseable_tpu.ops.enccache import get_enccache
-
-                    cache = get_enccache(self.options)
-                    if cache is not None:
-                        source_id = (
-                            f"{entry.file_path}|{entry.file_size}|{entry.num_rows}"
-                        ).encode()
-                        enc = encode_table(pq.read_table(f), None)
-                        if enc is not None:
-                            cache.put(source_id, enc)
-                except Exception:
-                    logger.exception("encoded-cache seed failed for %s", f)
-            if self.options.collect_dataset_stats and stream.name not in (
-                "pstats",
-                "pmeta",
-            ):
-                try:
-                    import pyarrow.parquet as pq
-
-                    from parseable_tpu.storage.field_stats import ingest_field_stats
-
-                    ingest_field_stats(self, stream.name, pq.read_table(f))
-                except Exception:
-                    logger.exception("field stats failed for %s", f)
+            done.append((f, entry))
+        if not entries:
+            return uploaded
+        try:
+            self.update_snapshot(stream, entries)
+        except Exception:
+            logger.exception(
+                "snapshot commit failed for %s; keeping %d staged parquet for retry",
+                stream.name,
+                len(done),
+            )
+            for f, _entry in done:
+                stream.unclaim_parquet(f)
+            return []
+        for f, entry in done:
+            # enrichment takes a hardlink before the unlink, so the staged
+            # file can go away while the background read is still queued
+            self.enrichment.submit(stream.name, entry, f)
             f.unlink(missing_ok=True)
-        if manifest_files:
-            self.update_snapshot(stream, manifest_files)
+            stream.unclaim_parquet(f)
         return uploaded
 
     def sync_all_streams(self) -> None:
+        """Upload tick: every stream syncs concurrently on the sync pool, so
+        one slow stream no longer delays every other stream's visibility."""
+        futs = []
         for name in self.streams.list_names():
             try:
-                self.upload_files_from_staging(self.get_stream(name))
+                stream = self.get_stream(name)
+            except StreamNotFound:
+                continue
+            futs.append(
+                (
+                    name,
+                    self.sync_pool.submit(
+                        telemetry.propagate(self.upload_files_from_staging), stream
+                    ),
+                )
+            )
+        for name, fut in futs:
+            try:
+                fut.result()
             except Exception:
                 logger.exception("object store sync failed for %s", name)
+        # deterministic cycle end for tests/shutdown; commits never wait here
+        self.enrichment.drain()
 
     # --------------------------------------------------------------- catalog
 
@@ -383,20 +483,40 @@ class Parseable:
             except MetastoreError:
                 fmt = ObjectStoreFormat(created_at=stream.metadata.created_at or rfc3339_now())
 
+            batch_paths = {e.file_path for e in entries}
             for entry in entries:
                 lower, upper = self._file_time_bounds(entry)
                 day_lower = lower.replace(hour=0, minute=0, second=0, microsecond=0)
                 day_upper = day_lower + timedelta(days=1) - timedelta(milliseconds=1)
                 prefix = partition_path(stream.name, lower, lower)
                 manifest = self.metastore.get_manifest(prefix) or Manifest()
-                replaced = manifest.apply_change(entry)
+                manifest.apply_change(entry)
                 self.metastore.put_manifest(prefix, manifest)
 
-                # On replacement (retried upload of the same file_path) count
-                # only the delta vs the replaced entry — not the full amounts.
-                d_rows = entry.num_rows - (replaced.num_rows if replaced else 0)
-                d_ingest = entry.ingestion_size - (replaced.ingestion_size if replaced else 0)
-                d_size = entry.file_size - (replaced.file_size if replaced else 0)
+                # This snapshot's item totals are recomputed from the files
+                # THIS NODE owns in the manifest (staged filenames embed
+                # hostname+ingestor_id, so ownership survives in the object
+                # key) rather than applied as per-entry deltas. That stays
+                # correct under BOTH replay shapes: a retried upload of the
+                # same file_path (replacement -> totals unchanged) and a
+                # retry after the manifest landed but the snapshot commit
+                # failed (the old delta-vs-replaced scheme counted 0 there,
+                # permanently losing the rows from the stream's stats).
+                # Filtering by owner matters in distributed mode: ingestors
+                # share minute manifests but keep per-node snapshots, and
+                # queriers sum stats across all nodes' stream jsons.
+                owner = _HOSTNAME + (self._node_suffix or "") + "."
+                owned = [
+                    f
+                    for f in manifest.files
+                    # entries in this very batch are ours by construction
+                    # (covers synthetic/legacy names without the host tag)
+                    if f.file_path in batch_paths
+                    or f.file_path.rsplit("/", 1)[-1].startswith(owner)
+                ]
+                new_rows = sum(f.num_rows for f in owned)
+                new_ingest = sum(f.ingestion_size for f in owned)
+                new_size = sum(f.file_size for f in owned)
 
                 manifest_path_full = f"{prefix}/manifest.json"
                 item = next(
@@ -410,13 +530,17 @@ class Parseable:
                         time_upper_bound=day_upper,
                     )
                     fmt.snapshot.manifest_list.append(item)
-                item.events_ingested += d_rows
-                item.ingestion_size += d_ingest
-                item.storage_size += d_size
+                d_rows = new_rows - item.events_ingested
+                d_size = new_size - item.storage_size
+                item.events_ingested = new_rows
+                item.ingestion_size = new_ingest
+                item.storage_size = new_size
                 fmt.stats.events += d_rows
                 fmt.stats.storage += d_size
-                fmt.stats.lifetime_events += d_rows
-                fmt.stats.lifetime_storage += d_size
+                # lifetime counters are monotonic: replacements that shrink a
+                # manifest must not roll them back
+                fmt.stats.lifetime_events += max(0, d_rows)
+                fmt.stats.lifetime_storage += max(0, d_size)
                 date = lower.date().isoformat()
                 if d_size > 0:
                     EVENTS_STORAGE_SIZE_DATE.labels("data", stream.name, "json", date).inc(d_size)
@@ -432,13 +556,18 @@ class Parseable:
     def shutdown(self) -> None:
         """Flush staging, convert, upload, then stop (sync.rs:71-86).
 
-        Two passes: uploading can itself ingest (field stats -> pstats), so a
-        second flush+upload drains anything produced during the first.
+        Two passes: enrichment can itself ingest (field stats -> pstats), so
+        a second flush+upload drains anything produced during the first
+        (sync_all_streams drains the enrichment queue before returning).
+        Then every write-path pool is stopped deterministically — no leaked
+        threads, no half-committed snapshots.
         """
         for _ in range(2):
             self.local_sync(shutdown=True)
             self.sync_all_streams()
+        self.enrichment.shutdown()
         self.uploader.shutdown()
+        self.sync_pool.shutdown(wait=True)
 
 
 # Global instance, set by the server entrypoint (reference: PARSEABLE Lazy).

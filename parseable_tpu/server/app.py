@@ -175,7 +175,13 @@ class ServerState:
             logger.info("P_PROFILE=cpu: global stack sampler started")
 
         if self.p.options.mode in (Mode.ALL, Mode.INGEST):
-            loop(self.p.options.local_sync_interval_secs, self.p.local_sync, "local-sync")
+            # pipelined tick uploads each parquet as compaction finishes;
+            # the upload tick still runs to retry leftovers (failed uploads
+            # or snapshot commits keep staged parquet for the next cycle)
+            local_tick = (
+                self.p.sync_cycle if self.p.options.sync_pipeline else self.p.local_sync
+            )
+            loop(self.p.options.local_sync_interval_secs, local_tick, "local-sync")
             loop(self.p.options.upload_interval_secs, self.p.sync_all_streams, "object-sync")
             from parseable_tpu.storage.retention import retention_tick
 

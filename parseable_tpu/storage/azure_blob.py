@@ -44,6 +44,7 @@ class AzureBlobStorage(ObjectStorage):
         access_key: str,
         endpoint: str | None = None,
         multipart_threshold: int = 25 * 1024 * 1024,
+        multipart_concurrency: int = 8,
         download_chunk_bytes: int = 8 * 1024 * 1024,
         download_concurrency: int = 16,
     ):
@@ -54,6 +55,7 @@ class AzureBlobStorage(ObjectStorage):
         self.key = base64.b64decode(access_key) if access_key else b""
         self.endpoint = (endpoint or f"https://{account}.blob.core.windows.net").rstrip("/")
         self.multipart_threshold = multipart_threshold
+        self.multipart_concurrency = max(1, multipart_concurrency)
         self.block_size = 25 * 1024 * 1024
         self.download_chunk_bytes = max(1 << 20, download_chunk_bytes)
         self.download_concurrency = max(1, download_concurrency)
@@ -205,7 +207,9 @@ class AzureBlobStorage(ObjectStorage):
                 )
                 return bid
 
-            with ThreadPoolExecutor(max_workers=min(8, n_blocks)) as pool:
+            with ThreadPoolExecutor(
+                max_workers=min(self.multipart_concurrency, n_blocks)
+            ) as pool:
                 block_ids = list(pool.map(put_block, range(n_blocks)))
             body = "<BlockList>" + "".join(
                 f"<Latest>{b}</Latest>" for b in block_ids

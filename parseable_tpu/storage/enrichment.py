@@ -1,0 +1,167 @@
+"""Post-upload enrichment queue: enccache seeding + field statistics.
+
+Both consumers need the uploaded parquet decoded into an Arrow table. The
+old write path read each file TWICE from disk (enccache seed, then field
+stats), inline in the upload wait loop — every uploaded byte was decoded
+twice on the critical path between upload completion and snapshot commit.
+
+Here one low-priority worker reads each table ONCE and shares it between
+both consumers, entirely off the critical path: upload completion and
+snapshot commits never wait on enrichment. The queue is bounded
+(P_ENRICH_QUEUE_DEPTH); producers block when it fills, which backpressures
+the sync cycle rather than growing without bound.
+
+Each task owns a hardlink (`<staged-name>.enrich`) made before the
+post-commit unlink of the staged parquet, so the durability path can delete
+staged files immediately while the queue still has bytes to read. The
+`.enrich` suffix keeps the link invisible to `Stream.parquet_files()`;
+crash leftovers are removed by `Stream.recover_orphans`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import shutil
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+
+from parseable_tpu.utils.metrics import ENRICH_QUEUE_DEPTH
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+@dataclass
+class _Task:
+    stream_name: str
+    entry: object  # catalog ManifestFile for the uploaded parquet
+    path: Path  # hardlink owned by the queue; unlinked after processing
+
+
+class EnrichmentQueue:
+    """Single-worker background queue for per-upload enrichment."""
+
+    def __init__(self, parseable, depth: int = 64):
+        self._p = parseable
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._worker: threading.Thread | None = None
+        self._guard = threading.Lock()
+
+    # -- consumer predicates ------------------------------------------------
+
+    def _wants(self, stream_name: str) -> tuple[bool, bool]:
+        from parseable_tpu.config import Mode
+
+        opts = self._p.options
+        seed = opts.mode != Mode.INGEST and opts.query_engine == "tpu"
+        stats = opts.collect_dataset_stats and stream_name not in ("pstats", "pmeta")
+        return seed, stats
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, stream_name: str, entry, staged_path: Path) -> bool:
+        """Queue enrichment for an uploaded parquet. Called after the
+        snapshot commit and before the staged file is unlinked; takes a
+        hardlink so the unlink cannot race the background read."""
+        seed, stats = self._wants(stream_name)
+        if not (seed or stats):
+            return False
+        link = staged_path.with_name(staged_path.name + ".enrich")
+        try:
+            if not link.exists():
+                try:
+                    os.link(staged_path, link)
+                except OSError:
+                    shutil.copyfile(staged_path, link)
+        except OSError:
+            logger.exception("enrichment link failed for %s", staged_path)
+            return False
+        self._ensure_worker()
+        self._q.put(_Task(stream_name, entry, link))
+        ENRICH_QUEUE_DEPTH.set(self._q.qsize())
+        return True
+
+    def _ensure_worker(self) -> None:
+        with self._guard:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._run, name="enrich", daemon=True
+                )
+                self._worker.start()
+
+    # -- worker side --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                if task is _STOP:
+                    return
+                self._process(task)
+            except Exception:
+                logger.exception("enrichment failed for %s", task.path)
+            finally:
+                if task is not _STOP:
+                    task.path.unlink(missing_ok=True)
+                self._q.task_done()
+                ENRICH_QUEUE_DEPTH.set(self._q.qsize())
+
+    def _process(self, task: _Task) -> None:
+        import pyarrow.parquet as pq
+
+        from parseable_tpu.utils.telemetry import TRACER
+
+        seed, stats = self._wants(task.stream_name)
+        if not (seed or stats):
+            return
+        with TRACER.span("storage.enrich", stream=task.stream_name) as sp:
+            # the single shared read both consumers feed from
+            table = pq.read_table(task.path)
+            sp["bytes"] = table.nbytes
+            if seed:
+                try:
+                    from parseable_tpu.ops.device import encode_table
+                    from parseable_tpu.ops.enccache import get_enccache
+
+                    cache = get_enccache(self._p.options)
+                    if cache is not None:
+                        entry = task.entry
+                        source_id = (
+                            f"{entry.file_path}|{entry.file_size}|{entry.num_rows}"
+                        ).encode()
+                        enc = encode_table(table, None)
+                        if enc is not None:
+                            cache.put(source_id, enc)
+                except Exception:
+                    logger.exception("encoded-cache seed failed for %s", task.path)
+            if stats:
+                try:
+                    from parseable_tpu.storage.field_stats import ingest_field_stats
+
+                    ingest_field_stats(self._p, task.stream_name, table)
+                except Exception:
+                    logger.exception("field stats failed for %s", task.path)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Block until every queued task has been processed (sync cycles end
+        with this so tests and shutdown see deterministic state; commits
+        themselves never wait here)."""
+        with self._guard:
+            alive = self._worker is not None and self._worker.is_alive()
+        if alive:
+            self._q.join()
+
+    def shutdown(self) -> None:
+        """Drain, then stop the worker thread deterministically."""
+        self.drain()
+        with self._guard:
+            w, self._worker = self._worker, None
+        if w is not None and w.is_alive():
+            self._q.put(_STOP)
+            w.join(timeout=60)

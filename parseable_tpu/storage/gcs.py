@@ -97,6 +97,9 @@ class GcsStorage(ObjectStorage):
         endpoint: str | None = None,
         token: str | None = None,
         multipart_threshold: int = 25 * 1024 * 1024,
+        # accepted for provider-tuning uniformity; GCS resumable sessions are
+        # inherently sequential (each chunk PUT continues the previous one)
+        multipart_concurrency: int = 8,
         resumable_chunk_size: int = 16 * 1024 * 1024,
         download_chunk_bytes: int = 8 * 1024 * 1024,
         download_concurrency: int = 16,

@@ -392,7 +392,12 @@ class AzureBlobProvider(ObjectStorageProvider):
 def make_provider(backend: str, **kw) -> ObjectStorageProvider:
     tuning = {
         k: kw[k]
-        for k in ("multipart_threshold", "download_chunk_bytes", "download_concurrency")
+        for k in (
+            "multipart_threshold",
+            "multipart_concurrency",
+            "download_chunk_bytes",
+            "download_concurrency",
+        )
         if kw.get(k) is not None
     }
     if backend in ("local-store", "localfs", "drive"):
@@ -431,7 +436,7 @@ class UploadPool:
         self.storage = storage
         self.pool = ThreadPoolExecutor(max_workers=concurrency, thread_name_prefix="upload")
 
-    def upload_and_validate(self, key: str, path: Path) -> ObjectMeta:
+    def upload_and_validate(self, key: str, path: Path, post: Callable | None = None):
         expected = path.stat().st_size
         start = time.monotonic()
         self.storage.upload_file(key, path)
@@ -441,10 +446,22 @@ class UploadPool:
                 f"uploaded object {key} size mismatch: {meta.size} != {expected}"
             )
         meta.last_modified = max(meta.last_modified, start)
+        if post is not None:
+            # post-upload work that belongs with the upload (manifest-entry
+            # creation from the local parquet footer) runs here, in the
+            # worker, concurrently with the other in-flight uploads instead
+            # of serially in the caller's completion loop
+            return post(meta)
         return meta
 
-    def submit(self, key: str, path: Path):
-        return self.pool.submit(self.upload_and_validate, key, path)
+    def submit(self, key: str, path: Path, post: Callable | None = None):
+        from parseable_tpu.utils import telemetry
+
+        # carry the submitter's trace context into the worker so per-call
+        # storage spans (PUT/PUT_MULTIPART/HEAD) join the sync tick's trace
+        return self.pool.submit(
+            telemetry.propagate(self.upload_and_validate), key, path, post
+        )
 
     def shutdown(self) -> None:
         self.pool.shutdown(wait=True)

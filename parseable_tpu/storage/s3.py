@@ -247,6 +247,7 @@ class S3Storage(ObjectStorage):
         secret_key: str | None = None,
         multipart_threshold: int = 25 * 1024 * 1024,
         multipart_part_size: int = 25 * 1024 * 1024,
+        multipart_concurrency: int = 8,
         download_chunk_bytes: int = 8 * 1024 * 1024,
         download_concurrency: int = 16,
         ssec_encryption_key: str | None = None,
@@ -296,6 +297,7 @@ class S3Storage(ObjectStorage):
         )
         self.multipart_threshold = multipart_threshold
         self.multipart_part_size = max(5 * 1024 * 1024, multipart_part_size)
+        self.multipart_concurrency = max(1, multipart_concurrency)
         self.download_chunk_bytes = max(1 << 20, download_chunk_bytes)
         self.download_concurrency = max(1, download_concurrency)
         self._session = requests.Session()
@@ -446,7 +448,9 @@ class S3Storage(ObjectStorage):
                 return i + 1, r.headers.get("ETag", "")
 
             try:
-                with ThreadPoolExecutor(max_workers=min(8, n_parts)) as pool:
+                with ThreadPoolExecutor(
+                    max_workers=min(self.multipart_concurrency, n_parts)
+                ) as pool:
                     etags = sorted(pool.map(put_part, range(n_parts)))
                 body = "<CompleteMultipartUpload>" + "".join(
                     f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"

@@ -86,6 +86,12 @@ class Stream:
             batch_rows=options.disk_write_batch_rows,
         )
         self.lock = threading.RLock()
+        # arrows claimed by an in-flight conversion job and parquet claimed by
+        # an in-flight upload (both guarded by self.lock): concurrent sync
+        # cycles must never compact the same arrows twice or upload the same
+        # parquet twice
+        self._claimed_arrows: set[Path] = set()
+        self._claimed_parquet: set[Path] = set()
 
     # --- filenames ---------------------------------------------------------
 
@@ -179,6 +185,42 @@ class Stream:
         except the leading schema key."""
         return arrows_name.split(".", 1)[1].rsplit(".data.", 1)[0]
 
+    def collect_conversion_jobs(self) -> list[tuple[str, list[Path], int]]:
+        """Group unclaimed `.arrows` into independent compaction jobs and
+        claim their inputs. Each job is one output parquet; claiming under
+        the stream lock means two concurrent cycles can never hand the same
+        arrows to two jobs (double compaction = duplicated rows)."""
+        with self.lock:
+            files = [f for f in self.arrow_files() if f not in self._claimed_arrows]
+            if not files:
+                return []
+            groups: dict[str, list[Path]] = {}
+            for f in files:
+                groups.setdefault(self._arrows_group_key(f.name), []).append(f)
+            jobs: list[tuple[str, list[Path], int]] = []
+            max_chunk = max(1, self.options.max_arrow_files_per_parquet)
+            for group_key, group_files in sorted(groups.items()):
+                for ci in range(0, len(group_files), max_chunk):
+                    chunk = group_files[ci : ci + max_chunk]
+                    jobs.append((group_key, chunk, ci // max_chunk))
+                    self._claimed_arrows.update(chunk)
+            return jobs
+
+    def run_conversion_job(
+        self, group_key: str, chunk: list[Path], part_index: int, claim_output: bool = False
+    ) -> Path | None:
+        """Execute one claimed compaction job; always releases the claim.
+        With `claim_output` the finished parquet is atomically claimed for
+        upload (pipeline mode), so a concurrent upload tick listing the
+        directory cannot submit it a second time."""
+        try:
+            return self._write_parquet_for(
+                group_key, chunk, part_index, claim_output=claim_output
+            )
+        finally:
+            with self.lock:
+                self._claimed_arrows.difference_update(chunk)
+
     def convert_disk_files_to_parquet(self, shutdown: bool = False) -> list[Path]:
         """Compact finished `.arrows` into parquet (streams.rs:902-981).
 
@@ -188,24 +230,30 @@ class Stream:
         after a successful rename.
         """
         outputs: list[Path] = []
-        files = self.arrow_files()
-        if not files:
-            return outputs
-        groups: dict[str, list[Path]] = {}
-        for f in files:
-            groups.setdefault(self._arrows_group_key(f.name), []).append(f)
-
-        max_chunk = max(1, self.options.max_arrow_files_per_parquet)
-        for group_key, group_files in sorted(groups.items()):
-            for ci in range(0, len(group_files), max_chunk):
-                chunk = group_files[ci : ci + max_chunk]
-                out = self._write_parquet_for(group_key, chunk, part_index=ci // max_chunk)
-                if out is not None:
-                    outputs.append(out)
+        for group_key, chunk, part_index in self.collect_conversion_jobs():
+            out = self.run_conversion_job(group_key, chunk, part_index)
+            if out is not None:
+                outputs.append(out)
         STAGING_FILES.labels(self.name).set(len(self.arrow_files()))
         return outputs
 
-    def _write_parquet_for(self, group_key: str, chunk: list[Path], part_index: int) -> Path | None:
+    # --- upload claims -----------------------------------------------------
+
+    def claim_parquet(self, files: list[Path]) -> list[Path]:
+        """Claim staged parquet for one upload cycle; already-claimed files
+        (another cycle or the pipeline owns them) are skipped."""
+        with self.lock:
+            out = [f for f in files if f not in self._claimed_parquet]
+            self._claimed_parquet.update(out)
+            return out
+
+    def unclaim_parquet(self, f: Path) -> None:
+        with self.lock:
+            self._claimed_parquet.discard(f)
+
+    def _write_parquet_for(
+        self, group_key: str, chunk: list[Path], part_index: int, claim_output: bool = False
+    ) -> Path | None:
         reader = MergedReverseRecordReader(chunk)
         batches = list(reader)
         if not batches:
@@ -236,7 +284,14 @@ class Stream:
         if part.stat().st_size == 0:
             part.unlink()
             raise StagingError(f"wrote empty parquet for {group_key}")
-        os.replace(part, final)
+        if claim_output:
+            # the rename and the upload claim are atomic vs. a concurrent
+            # upload tick: the file is never visible-but-unclaimed
+            with self.lock:
+                os.replace(part, final)
+                self._claimed_parquet.add(final)
+        else:
+            os.replace(part, final)
         for f in chunk:
             f.unlink(missing_ok=True)
         return final
@@ -284,6 +339,10 @@ class Stream:
             return
         for p in list(self.data_path.iterdir()):
             if p.name.endswith(".part.parquet"):
+                p.unlink(missing_ok=True)
+            elif p.name.endswith(".enrich"):
+                # hardlink owned by a previous run's enrichment queue; the
+                # data itself was uploaded (links are made post-commit)
                 p.unlink(missing_ok=True)
             elif p.name.endswith("." + PART_FILE_EXTENSION):
                 try:
@@ -340,14 +399,77 @@ class Streams:
 
             shutil.rmtree(s.data_path, ignore_errors=True)
 
-    def flush_and_convert(self, shutdown: bool = False) -> dict[str, list[Path]]:
-        """Per-stream prepare_parquet (reference: streams.rs:1518-1556)."""
+    def flush_and_convert(
+        self,
+        shutdown: bool = False,
+        pool=None,
+        on_parquet=None,
+    ) -> dict[str, list[Path]]:
+        """Per-stream flush + compaction (reference: streams.rs:1518-1556).
+
+        Without `pool`: the serial per-stream prepare_parquet path. With
+        `pool` (a ThreadPoolExecutor): arrow-group -> parquet jobs from ALL
+        streams run concurrently on it — per-group work is independent (the
+        `.part.parquet` rename protocol plus input claiming), so one stream's
+        heavy custom-partition fan-out no longer serializes behind another's.
+        `on_parquet(stream, path)` (pipeline mode) fires in the worker as
+        each parquet lands, with the output pre-claimed for upload — the
+        compaction->upload handoff that skips the next upload tick."""
         with self._lock:
             streams = list(self._streams.values())
         out: dict[str, list[Path]] = {}
+        if pool is None:
+            for s in streams:
+                try:
+                    out[s.name] = s.prepare_parquet(shutdown)
+                except Exception:
+                    logger.exception("flush_and_convert failed for stream %s", s.name)
+            return out
+
+        from parseable_tpu.utils import telemetry
+        from parseable_tpu.utils.telemetry import TRACER
+
+        def run_job(s: Stream, group_key: str, chunk: list[Path], part_index: int):
+            with TRACER.span("staging.compact", stream=s.name) as sp:
+                result = s.run_conversion_job(
+                    group_key, chunk, part_index, claim_output=on_parquet is not None
+                )
+                if result is not None:
+                    sp["bytes"] = result.stat().st_size if result.exists() else 0
+                    if on_parquet is not None:
+                        try:
+                            on_parquet(s, result)
+                        except Exception:
+                            # a failed handoff must not strand the claim: the
+                            # upload tick retries the file next cycle
+                            s.unclaim_parquet(result)
+                            raise
+                return result
+
+        futures: list[tuple[Stream, object]] = []
         for s in streams:
             try:
-                out[s.name] = s.prepare_parquet(shutdown)
+                # flush stays in the caller thread under the per-stream span
+                # (staging.write parents beneath it); job submission happens
+                # inside the span so compact spans parent there too
+                with TRACER.span("staging.flush", stream=s.name) as sp:
+                    s.flush(forced=shutdown)
+                    jobs = s.collect_conversion_jobs()
+                    sp["files"] = len(jobs)
+                    for group_key, chunk, part_index in jobs:
+                        futures.append(
+                            (s, pool.submit(telemetry.propagate(run_job), s, group_key, chunk, part_index))
+                        )
             except Exception:
                 logger.exception("flush_and_convert failed for stream %s", s.name)
+        for s, fut in futures:
+            try:
+                result = fut.result()
+                if result is not None:
+                    out.setdefault(s.name, []).append(result)
+            except Exception:
+                logger.exception("parquet conversion failed for stream %s", s.name)
+        for s in streams:
+            out.setdefault(s.name, [])
+            STAGING_FILES.labels(s.name).set(len(s.arrow_files()))
         return out

@@ -56,6 +56,16 @@ EVENTS_STORAGE_SIZE_DATE = _gauge(
     "events_storage_size_date", "Parquet storage size on date", ["type", "stream", "format", "date"]
 )
 STAGING_FILES = _gauge("staging_files", "Staging files count", ["stream"])
+# write-path health (core.py sync cycle): age of the oldest staged parquet
+# not yet uploaded when the cycle sized its batch — a growing lag means the
+# uploader is falling behind ingest — and enrichment tasks (enccache seed +
+# field stats) queued behind the upload critical path
+SYNC_LAG_SECONDS = _gauge(
+    "sync_lag_seconds", "Oldest unuploaded staged parquet age (seconds)", ["stream"]
+)
+ENRICH_QUEUE_DEPTH = _gauge(
+    "enrichment_queue_depth", "Post-upload enrichment tasks waiting", []
+)
 
 # --- query ---------------------------------------------------------------
 QUERY_EXECUTE_TIME = Histogram(

@@ -113,6 +113,20 @@ def trace_context(traceparent: str | None = None):
         _TRACE_CTX.reset(token)
 
 
+def propagate(fn):
+    """Bind `fn` to a snapshot of the caller's context so trace parentage
+    survives the hop onto a worker-pool thread (pool threads otherwise start
+    with an empty Context and record orphaned or unrecorded spans). Used by
+    the write-path pools (compaction, upload, per-stream sync coordinators);
+    the scan pool does the equivalent with an explicit copy_context()."""
+    ctx = contextvars.copy_context()
+
+    def bound(*args, **kwargs):
+        return ctx.run(fn, *args, **kwargs)
+
+    return bound
+
+
 @contextmanager
 def suppress_tracing():
     """Disable span recording in this context (pmeta self-writes)."""

@@ -1,0 +1,340 @@
+"""Parallel write path (core.py / streams.py sync cycle): pooled compaction,
+pipelined compaction->upload handoff, per-stream concurrent object sync,
+durability ordering (unlink only after snapshot commit), the background
+enrichment queue's single shared parquet read, and deterministic shutdown —
+all driven through a fault-injecting storage backend."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pyarrow.parquet as pq
+import pytest
+
+from parseable_tpu.config import Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.event.json_format import JsonEvent
+from parseable_tpu.metastore import MetastoreError
+from parseable_tpu.storage.object_storage import ObjectStorageError
+
+
+class FaultyStorage:
+    """Delegating wrapper over the real backend: injectable upload failures
+    plus per-key upload counting (double-upload detector)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.fail_uploads = 0  # fail the next N upload_file calls
+        self.upload_counts: dict[str, int] = {}
+        self.lock = threading.Lock()
+
+    def upload_file(self, key, path):
+        with self.lock:
+            self.upload_counts[key] = self.upload_counts.get(key, 0) + 1
+            if self.fail_uploads > 0:
+                self.fail_uploads -= 1
+                raise ObjectStorageError("injected upload failure")
+        return self.inner.upload_file(key, path)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def make_p(tmp_path, **overrides) -> tuple[Parseable, FaultyStorage]:
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    for k, v in overrides.items():
+        setattr(opts, k, v)
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    faulty = FaultyStorage(p.storage)
+    p.storage = faulty
+    p.uploader.storage = faulty
+    p.metastore.storage = faulty
+    return p, faulty
+
+
+def ingest(p: Parseable, name: str, n: int = 50):
+    stream = p.create_stream_if_not_exists(name)
+    rows = [{"k": i, "v": f"val{i}"} for i in range(n)]
+    JsonEvent(rows, name).into_event(stream.metadata).process(
+        stream, commit_schema=p.commit_schema
+    )
+    return stream
+
+
+def snapshot_events(p: Parseable, name: str) -> int:
+    fmt = p.metastore.get_stream_json(name)
+    return sum(i.events_ingested for i in fmt.snapshot.manifest_list)
+
+
+def test_upload_failure_leaves_file_for_next_cycle(tmp_path):
+    p, st = make_p(tmp_path)
+    s = ingest(p, "app", 50)
+    p.local_sync(shutdown=True)
+    assert len(s.parquet_files()) == 1
+
+    st.fail_uploads = 1
+    p.sync_all_streams()
+    # failed upload: staged parquet kept, claim released, nothing committed
+    assert len(s.parquet_files()) == 1
+    assert snapshot_events(p, "app") == 0
+
+    p.sync_all_streams()
+    assert s.parquet_files() == []
+    assert snapshot_events(p, "app") == 50
+    (key,) = st.upload_counts
+    assert st.upload_counts[key] == 2  # the retry, nothing more
+
+
+def test_snapshot_commit_failure_keeps_staged_parquet(tmp_path):
+    """The durability-ordering bug: uploaded data must never become
+    permanently invisible. A failed snapshot commit keeps the staged file;
+    the retry re-uploads the SAME filename and the manifest replaces by
+    file_path, so events are counted exactly once."""
+    p, st = make_p(tmp_path)
+    s = ingest(p, "app", 40)
+    p.local_sync(shutdown=True)
+    staged = s.parquet_files()
+    assert len(staged) == 1
+
+    orig = p.metastore.put_stream_json
+    fail = {"n": 1}
+
+    def flaky(stream, fmt, node_id=None):
+        if stream == "app" and fail["n"]:
+            fail["n"] -= 1
+            raise MetastoreError("injected commit failure")
+        return orig(stream, fmt, node_id)
+
+    p.metastore.put_stream_json = flaky
+    p.sync_all_streams()
+    # upload went through, commit did not: file still staged for retry
+    assert s.parquet_files() == staged
+    assert snapshot_events(p, "app") == 0
+
+    p.sync_all_streams()
+    assert s.parquet_files() == []
+    assert snapshot_events(p, "app") == 40
+    fmt = p.metastore.get_stream_json("app")
+    assert len(fmt.snapshot.manifest_list) == 1
+    manifest = p.metastore.get_manifest(
+        fmt.snapshot.manifest_list[0].manifest_path[: -len("/manifest.json")]
+    )
+    assert len(manifest.files) == 1  # replaced by file_path, not duplicated
+    assert manifest.files[0].num_rows == 40
+    (key,) = st.upload_counts
+    assert st.upload_counts[key] == 2
+    # the uploaded object is exactly where the manifest says it is
+    assert p.storage.get_object(manifest.files[0].file_path)[:4] == b"PAR1"
+
+
+def test_pipelined_sync_cycle_uploads_without_second_tick(tmp_path):
+    p, st = make_p(tmp_path)
+    s = ingest(p, "pipe", 30)
+    p.sync_cycle(shutdown=True)
+    # one cycle: converted AND uploaded AND committed
+    assert s.arrow_files() == []
+    assert s.parquet_files() == []
+    assert snapshot_events(p, "pipe") == 30
+    assert all(c == 1 for c in st.upload_counts.values())
+
+
+def test_pipelined_commit_failure_retried_by_upload_tick(tmp_path):
+    """A snapshot-commit failure inside the pipelined cycle releases the
+    upload claim; the regular upload tick retries the leftover file."""
+    p, st = make_p(tmp_path)
+    s = ingest(p, "app", 40)
+    orig = p.metastore.put_stream_json
+    fail = {"n": 1}
+
+    def flaky(stream, fmt, node_id=None):
+        if stream == "app" and fail["n"]:
+            fail["n"] -= 1
+            raise MetastoreError("injected commit failure")
+        return orig(stream, fmt, node_id)
+
+    p.metastore.put_stream_json = flaky
+    p.sync_cycle(shutdown=True)
+    assert len(s.parquet_files()) == 1
+    assert snapshot_events(p, "app") == 0
+    p.sync_all_streams()
+    assert s.parquet_files() == []
+    assert snapshot_events(p, "app") == 40
+    (key,) = st.upload_counts
+    assert st.upload_counts[key] == 2
+
+
+def test_concurrent_flush_convert_upload_no_loss_no_dupe(tmp_path):
+    """Writers race pipelined cycles and upload ticks across streams: every
+    event lands exactly once, no arrow compacted twice, no parquet uploaded
+    twice, and shutdown leaves staging empty with no write-path threads."""
+    p, st = make_p(tmp_path, sync_workers=4)
+    names = [f"conc{i}" for i in range(3)]
+    rounds, per_round = 8, 25
+    before_threads = set(threading.enumerate())
+    errors: list[BaseException] = []
+
+    def writer(name):
+        try:
+            stream = p.create_stream_if_not_exists(name)
+            for r in range(rounds):
+                rows = [{"k": r * per_round + i} for i in range(per_round)]
+                JsonEvent(rows, name).into_event(stream.metadata).process(
+                    stream, commit_schema=p.commit_schema
+                )
+                time.sleep(0.01)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def syncer(kind):
+        try:
+            for _ in range(6):
+                if kind == "pipeline":
+                    p.sync_cycle(shutdown=True)
+                else:
+                    p.sync_all_streams()
+                time.sleep(0.005)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(n,)) for n in names]
+    threads += [threading.Thread(target=syncer, args=(k,)) for k in ("pipeline", "tick")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    p.shutdown()
+
+    for n in names:
+        s = p.streams.get(n)
+        assert s.arrow_files() == []
+        assert s.parquet_files() == []
+        assert snapshot_events(p, n) == rounds * per_round
+    # no parquet key ever uploaded twice (no failures were injected)
+    dupes = {k: c for k, c in st.upload_counts.items() if c != 1}
+    assert not dupes
+    leaked = [
+        t
+        for t in threading.enumerate()
+        if t not in before_threads
+        and t.is_alive()
+        and t.name.startswith(("sync", "upload", "enrich"))
+    ]
+    assert not leaked
+
+
+def test_shutdown_drains_write_path_threads(tmp_path):
+    before = set(threading.enumerate())
+    p, _ = make_p(tmp_path, sync_workers=2)
+    s = ingest(p, "sd", 10)
+    p.shutdown()
+    assert s.arrow_files() == [] and s.parquet_files() == []
+    assert snapshot_events(p, "sd") == 10
+    leaked = [
+        t
+        for t in threading.enumerate()
+        if t not in before and t.is_alive() and t.name.startswith(("sync", "upload", "enrich"))
+    ]
+    assert not leaked
+
+
+def test_enrichment_reads_each_table_once(tmp_path, monkeypatch):
+    """Enccache seeding and field stats share ONE background read per
+    uploaded parquet (the old path read every file twice, inline)."""
+    p, _ = make_p(tmp_path, collect_dataset_stats=True, query_engine="tpu")
+    reads: list[str] = []
+    orig_read = pq.read_table
+
+    def counting(source, *a, **kw):
+        reads.append(str(source))
+        return orig_read(source, *a, **kw)
+
+    monkeypatch.setattr(pq, "read_table", counting)
+    ingest(p, "enr", 500)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()  # drains the enrichment queue before returning
+
+    enrich_reads = [r for r in reads if r.endswith(".enrich")]
+    assert len(enrich_reads) == 1  # one parquet -> one shared read
+    assert len(reads) == 1
+    # both consumers ran off that one table: enccache sidecar on disk...
+    assert list((tmp_path / "staging" / "encoded_cache").glob("*.enc"))
+    # ...and field stats rows staged into pstats
+    pstats = p.streams.get("pstats")
+    assert pstats is not None
+    assert sum(b.num_rows for b in pstats.staging_batches()) > 0
+    # the hardlink was cleaned up after processing
+    assert not list((tmp_path / "staging" / "enr").glob("*.enrich"))
+
+
+def test_enrichment_skipped_when_no_consumer(tmp_path):
+    p, _ = make_p(tmp_path, collect_dataset_stats=False, query_engine="cpu")
+    s = ingest(p, "plain", 10)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+    assert s.parquet_files() == []
+    assert not (tmp_path / "staging" / "encoded_cache").exists() or not list(
+        (tmp_path / "staging" / "encoded_cache").glob("*.enc")
+    )
+    assert p.streams.get("pstats") is None
+
+
+def test_sync_lag_gauge_tracks_oldest_staged_parquet(tmp_path):
+    from parseable_tpu.utils.metrics import SYNC_LAG_SECONDS
+
+    p, st = make_p(tmp_path)
+    ingest(p, "lagged", 10)
+    p.local_sync(shutdown=True)
+    st.fail_uploads = 1
+    p.sync_all_streams()  # fails; parquet ages on disk
+    time.sleep(0.05)
+    p.sync_all_streams()  # sizing pass observes the aged file
+    assert SYNC_LAG_SECONDS.labels("lagged")._value.get() >= 0.04
+    p.sync_all_streams()  # nothing staged -> lag resets
+    assert SYNC_LAG_SECONDS.labels("lagged")._value.get() == 0.0
+
+
+def test_parallel_compaction_matches_serial(tmp_path):
+    """Pooled group-level compaction produces the same staged parquet set
+    (groups, rows) as the serial path over an identical multi-bucket load."""
+    import pyarrow as pa
+    from datetime import UTC, datetime
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.streams import LogStreamMetadata, Stream
+
+    def build(opts, name):
+        s = Stream(name, opts, LogStreamMetadata())
+        for minute in range(4):
+            ts = datetime(2024, 5, 1, 10, minute, tzinfo=UTC)
+            batch = pa.RecordBatch.from_pydict(
+                {
+                    DEFAULT_TIMESTAMP_KEY: pa.array(
+                        [datetime(2024, 5, 1, 10, minute, sec) for sec in range(10)],
+                        type=pa.timestamp("ms"),
+                    )
+                }
+            )
+            s.push(f"k{minute}", batch, ts)
+        s.flush(forced=True)
+        return s
+
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    serial = build(opts, "serial")
+    serial_outs = serial.convert_disk_files_to_parquet()
+
+    p, _ = make_p(tmp_path / "pooled", sync_workers=4)
+    pooled = build(p.options, "pooled")
+    p.streams._streams[(None, "pooled")] = pooled
+    out = p.streams.flush_and_convert(shutdown=True, pool=p.sync_pool)
+    pooled_outs = out["pooled"]
+
+    assert len(pooled_outs) == len(serial_outs) == 4
+    serial_rows = sum(pq.read_table(f).num_rows for f in serial_outs)
+    pooled_rows = sum(pq.read_table(f).num_rows for f in pooled_outs)
+    assert pooled_rows == serial_rows == 40
+    assert pooled.arrow_files() == []
+    p.shutdown()
