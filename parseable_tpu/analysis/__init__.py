@@ -1,0 +1,27 @@
+"""plint — parseable_tpu's AST-based concurrency & invariant lint gate.
+
+Run it as `python -m parseable_tpu.analysis` (wired into
+scripts/check_green.sh after tier-1). See framework.py for the machinery,
+rules.py for the rule catalog, and the README "Static analysis" section for
+the workflow (suppressions, baseline policy, adding a rule).
+"""
+
+from parseable_tpu.analysis.framework import (
+    AnalysisReport,
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    run_analysis,
+)
+from parseable_tpu.analysis.rules import DEFAULT_RULES
+
+__all__ = [
+    "AnalysisReport",
+    "DEFAULT_RULES",
+    "Finding",
+    "Project",
+    "Rule",
+    "SourceFile",
+    "run_analysis",
+]

@@ -1,0 +1,103 @@
+"""plint CLI: `python -m parseable_tpu.analysis [paths...]`.
+
+Exit codes: 0 = no unbaselined findings, 1 = findings, 2 = usage/parse
+error. `--json` emits a machine-diffable report (stable ordering, content
+fingerprints) so two runs can be compared with plain `diff`/`jq`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import run_analysis, write_baseline
+from parseable_tpu.analysis.rules import DEFAULT_RULES
+
+DEFAULT_BASELINE = ".plint-baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m parseable_tpu.analysis",
+        description="plint: AST-based concurrency & invariant checks",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="files/dirs relative to --root (default: parseable_tpu)",
+    )
+    p.add_argument("--root", default=".", help="repository root (default: cwd)")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help=f"baseline file relative to --root (default: {DEFAULT_BASELINE})",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="acknowledge every current finding into the baseline file",
+    )
+    p.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="run only these rules (repeatable)",
+    )
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for cls in DEFAULT_RULES:
+            print(f"{cls.name:20s} {cls.description}")
+            print(f"{'':20s}   why: {cls.rationale}")
+        return 0
+
+    rules = [cls() for cls in DEFAULT_RULES]
+    if args.rule:
+        known = {r.name for r in rules}
+        unknown = set(args.rule) - known
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in set(args.rule)]
+
+    root = Path(args.root).resolve()
+    baseline_path = root / args.baseline
+    report = run_analysis(
+        root,
+        paths=args.paths or None,
+        rules=rules,
+        baseline_path=baseline_path,
+    )
+
+    if args.write_baseline:
+        write_baseline(baseline_path, report.findings)
+        print(f"baseline written: {len(report.findings)} finding(s) -> {baseline_path}")
+        return 0
+
+    if report.parse_errors:
+        for e in report.parse_errors:
+            print(f"parse error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.unbaselined:
+            print(f.render())
+        n_base = len(report.baselined)
+        base_note = f" ({n_base} baselined)" if n_base else ""
+        print(
+            f"plint: {len(report.unbaselined)} finding(s){base_note} across "
+            f"{report.files_checked} files"
+        )
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,313 @@
+"""plint core: source model, rule protocol, runner, suppressions, baseline.
+
+The analyzers in `rules.py` are plain `ast` visitors; this module owns
+everything around them so each rule stays ~100 lines of tree-walking:
+
+- `SourceFile`    — parsed module + its comments (`tokenize`-extracted, so
+  rules can read `# guarded-by:` annotations and `# plint: disable=` lines);
+- `Finding`       — one violation, with a line-number-free fingerprint so
+  baselines survive unrelated edits above the finding;
+- `Rule`          — per-file `check()` plus an optional whole-project
+  `finalize()` hook (cross-file rules like config/README drift);
+- `run_analysis`  — walk the tree, apply rules, drop suppressed findings,
+  split the rest into baselined vs. unbaselined.
+
+Suppression syntax (same line as the finding):
+
+    something_flagged()  # plint: disable=rule-name
+    something_flagged()  # plint: disable=rule-a,rule-b
+    something_flagged()  # plint: disable
+
+Baseline file (default `.plint-baseline.json` at the analysis root): a JSON
+document listing fingerprints of findings that are acknowledged but not yet
+fixed. The gate fails only on *unbaselined* findings, so adopting a new rule
+never blocks the tree while its backlog is burned down. Policy: baseline
+entries are tech debt with a paper trail — new code must lint clean, and
+entries should only ever be deleted (by fixing the finding), not added to
+dodge review.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+_SUPPRESS_RE = re.compile(r"plint:\s*disable(?:=([A-Za-z0-9_,-]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # analysis-root-relative posix path
+    line: int
+    message: str
+    context: str = ""  # enclosing scope (Class.method) — stable across edits
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity: a finding keeps its baseline entry
+        when unrelated code above it moves it down a few lines."""
+        raw = f"{self.rule}|{self.path}|{self.context}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "context": self.context,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{self.path}:{self.line}: {self.rule}{ctx}: {self.message}"
+
+
+class SourceFile:
+    """A parsed Python module plus its comment map and suppressions."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel.replace("\\", "/")
+        self.text = text
+        self.tree = ast.parse(text)
+        # line -> comment text (leading '#' stripped); one comment per line
+        self.comments: dict[int, str] = {}
+        # line -> suppressed rule names (None = every rule)
+        self.suppressions: dict[int, set[str] | None] = {}
+        self._scan_comments()
+
+    @classmethod
+    def from_path(cls, root: Path, path: Path) -> "SourceFile":
+        rel = path.relative_to(root).as_posix()
+        return cls(rel, path.read_text(encoding="utf-8"))
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                comment = tok.string.lstrip("#").strip()
+                self.comments[tok.start[0]] = comment
+                m = _SUPPRESS_RE.search(comment)
+                if m:
+                    names = m.group(1)
+                    self.suppressions[tok.start[0]] = (
+                        {n.strip() for n in names.split(",") if n.strip()}
+                        if names
+                        else None
+                    )
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            pass  # the file parsed as AST; a comment scan miss only loses
+            # suppressions/annotations, never findings
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if line not in self.suppressions:
+            return False
+        names = self.suppressions[line]
+        return names is None or rule in names
+
+
+@dataclass
+class Project:
+    """Everything `finalize()`-style rules need beyond a single module."""
+
+    root: Path
+    files: list[SourceFile] = field(default_factory=list)
+
+    def readme_text(self) -> str:
+        p = self.root / "README.md"
+        return p.read_text(encoding="utf-8") if p.is_file() else ""
+
+
+class Rule:
+    """Base class for one analyzer. Subclasses set `name`, `description`,
+    `rationale` and implement `check`; cross-file rules add `finalize`."""
+
+    name: str = "abstract"
+    description: str = ""
+    rationale: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith(".py")
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+# --------------------------------------------------------------- AST helpers
+
+
+def attr_chain(node: ast.AST) -> list[str]:
+    """`a.b.c.d` -> ["a", "b", "c", "d"]; [] when the chain bottoms out in
+    something that isn't a bare name (a call result, a subscript, ...)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def is_self_attr(node: ast.AST, attr: str | None = None) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and (attr is None or node.attr == attr)
+    )
+
+
+def enclosing_context(tree: ast.Module, target: ast.AST) -> str:
+    """Qualname-ish scope of `target` ("Class.method", "function", "")."""
+    path: list[str] = []
+
+    def walk(node: ast.AST, names: list[str]) -> bool:
+        for child in ast.iter_child_nodes(node):
+            nxt = names
+            if isinstance(
+                child, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                nxt = names + [child.name]
+            if child is target:
+                path.extend(nxt)
+                return True
+            if walk(child, nxt):
+                return True
+        return False
+
+    walk(tree, [])
+    return ".".join(path)
+
+
+# -------------------------------------------------------------------- runner
+
+
+@dataclass
+class AnalysisReport:
+    findings: list[Finding]  # all unsuppressed findings
+    baselined: list[Finding]
+    unbaselined: list[Finding]
+    files_checked: int
+    parse_errors: list[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.unbaselined
+
+    def to_json(self) -> dict:
+        return {
+            "files_checked": self.files_checked,
+            "parse_errors": self.parse_errors,
+            "baselined": [f.to_json() for f in self.baselined],
+            "findings": [f.to_json() for f in self.unbaselined],
+            "clean": self.clean,
+        }
+
+
+def iter_python_files(root: Path, paths: list[str]) -> Iterator[Path]:
+    for entry in paths:
+        p = root / entry
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+def load_baseline(path: Path | None) -> set[str]:
+    if path is None or not path.is_file():
+        return set()
+    doc = json.loads(path.read_text(encoding="utf-8"))
+    return {e["fingerprint"] for e in doc.get("findings", [])}
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    doc = {
+        "version": 1,
+        "comment": (
+            "Acknowledged plint findings. Entries are tech debt with a paper "
+            "trail: only remove them (by fixing the finding); never add one "
+            "to sidestep a review."
+        ),
+        "findings": [
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "path": f.path,
+                "context": f.context,
+                "message": f.message,
+            }
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    path.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+
+
+def run_analysis(
+    root: Path,
+    paths: list[str] | None = None,
+    rules: list[Rule] | None = None,
+    baseline_path: Path | None = None,
+) -> AnalysisReport:
+    """Analyze `paths` (default: the parseable_tpu package) under `root`."""
+    from parseable_tpu.analysis.rules import DEFAULT_RULES
+
+    root = Path(root)
+    rules = rules if rules is not None else [cls() for cls in DEFAULT_RULES]
+    paths = paths or ["parseable_tpu"]
+    project = Project(root=root)
+    parse_errors: list[str] = []
+    for p in iter_python_files(root, paths):
+        try:
+            project.files.append(SourceFile.from_path(root, p))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            parse_errors.append(f"{p}: {e}")
+
+    findings: list[Finding] = []
+    for sf in project.files:
+        # the analyzer does not lint itself: rule sources are full of
+        # pattern fragments that look like violations
+        if sf.rel.startswith("parseable_tpu/analysis/"):
+            continue
+        for rule in rules:
+            if not rule.applies(sf.rel):
+                continue
+            for f in rule.check(sf):
+                if not sf.is_suppressed(f.rule, f.line):
+                    findings.append(f)
+    by_rel = {sf.rel: sf for sf in project.files}
+    for rule in rules:
+        for f in rule.finalize(project):
+            sf = by_rel.get(f.path)
+            if sf is not None and sf.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    baseline = load_baseline(baseline_path)
+    baselined = [f for f in findings if f.fingerprint in baseline]
+    unbaselined = [f for f in findings if f.fingerprint not in baseline]
+    return AnalysisReport(
+        findings=findings,
+        baselined=baselined,
+        unbaselined=unbaselined,
+        files_checked=len(project.files),
+        parse_errors=parse_errors,
+    )

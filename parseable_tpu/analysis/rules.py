@@ -1,0 +1,637 @@
+"""plint rules: this codebase's concurrency & invariant checks.
+
+Each rule encodes one invariant PRs 1-3 made load-bearing (a threaded write
+path, a scan pool, pipelined uploads, trace propagation across pool hops)
+that nothing else enforces mechanically. The checks are lexical/AST-level —
+a lockdep for a dynamic language: cheap, conservative, and aimed at the
+failure modes that kill threaded storage systems under production load.
+
+Rule catalog (names are what `# plint: disable=<name>` takes):
+
+- lock-discipline   attributes annotated `# guarded-by: self.<lock>` may
+                    only be touched inside `with self.<lock>:`
+- pool-lifecycle    executors/threads stored on an object need a reachable
+                    `shutdown()`/`join()` somewhere in the class
+- trace-propagation work handed to the write/scan pools must carry the
+                    submitter's context (telemetry.propagate / ctx.run)
+- silent-swallow    broad `except Exception:` in storage/streams/core must
+                    log or count, never silently drop
+- config-drift      P_* env reads live in config.py accessors; every knob
+                    must be documented in README
+- blocking-in-async no time.sleep / direct storage-backend calls lexically
+                    inside `async def` server handlers
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from parseable_tpu.analysis.framework import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    attr_chain,
+    enclosing_context,
+    is_self_attr,
+)
+
+# modules that participate in the threaded write/scan paths; scope for the
+# rules that only make sense where pools hand work across threads
+_THREADED_MODULES = (
+    "parseable_tpu/core.py",
+    "parseable_tpu/streams.py",
+    "parseable_tpu/storage/object_storage.py",
+    "parseable_tpu/storage/s3.py",
+    "parseable_tpu/storage/gcs.py",
+    "parseable_tpu/storage/azure_blob.py",
+    "parseable_tpu/storage/enrichment.py",
+    "parseable_tpu/query/provider.py",
+    "parseable_tpu/server/cluster.py",
+)
+
+_SWALLOW_SCOPE_PREFIXES = ("parseable_tpu/storage/",)
+_SWALLOW_SCOPE_FILES = ("parseable_tpu/streams.py", "parseable_tpu/core.py")
+
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*(?:self\.)?([A-Za-z_][A-Za-z0-9_]*)")
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+_BLOCKING_STORAGE_OPS = {
+    "get_object",
+    "put_object",
+    "delete_object",
+    "head",
+    "list_prefix",
+    "list_dirs",
+    "upload_file",
+    "download_file",
+    "delete_prefix",
+    "get_range",
+    "get_objects",
+    "exists",
+}
+
+_POOL_RECEIVER_RE = re.compile(r"pool|executor|workers", re.IGNORECASE)
+
+_ENV_ACCESSOR_NAMES = {
+    "env_str",
+    "env_int",
+    "env_float",
+    "env_bool",
+    "_env",
+    "_env_int",
+    "_env_float",
+    "_env_bool",
+}
+
+_P_KEY_RE = re.compile(r"^P_[A-Z0-9_]+$")
+
+
+def _func_defs(cls: ast.ClassDef) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# 1. lock-discipline
+
+
+class LockDisciplineRule(Rule):
+    """`# guarded-by: self.<lock>` attributes only under `with self.<lock>`.
+
+    Declaration: a trailing comment on the attribute's assignment line
+    (conventionally in `__init__`). Every other method of the class must
+    then touch `self.<attr>` only lexically inside `with self.<lock>:`.
+    `__init__` itself is exempt (construction happens-before publication);
+    nested functions start with no locks held — a closure may run on
+    another thread long after the enclosing `with` exited."""
+
+    name = "lock-discipline"
+    description = "guarded attributes accessed outside their lock"
+    rationale = (
+        "~25 modules now share state across the sync/scan/upload pools; one "
+        "unguarded read is a data race that only shows up under load"
+    )
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(sf, node)
+
+    def _guarded_attrs(self, sf: SourceFile, cls: ast.ClassDef) -> dict[str, str]:
+        guarded: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            comment = sf.comments.get(node.lineno, "")
+            m = _GUARDED_BY_RE.search(comment)
+            if not m:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if is_self_attr(t):
+                    guarded[t.attr] = m.group(1)
+        return guarded
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef) -> Iterator[Finding]:
+        guarded = self._guarded_attrs(sf, cls)
+        if not guarded:
+            return
+        for fn in _func_defs(cls):
+            if fn.name == "__init__":
+                continue
+            for stmt in fn.body:
+                yield from self._check_stmt(sf, cls, fn, stmt, frozenset(), guarded)
+
+    @staticmethod
+    def _with_locks(stmt: ast.With) -> set[str]:
+        out = set()
+        for item in stmt.items:
+            if is_self_attr(item.context_expr):
+                out.add(item.context_expr.attr)
+        return out
+
+    def _check_stmt(self, sf, cls, fn, stmt, held, guarded) -> Iterator[Finding]:
+        if isinstance(stmt, ast.With):
+            inner = held | self._with_locks(stmt)
+            for item in stmt.items:
+                yield from self._check_expr(
+                    sf, cls, fn, item.context_expr, held, guarded
+                )
+            for s in stmt.body:
+                yield from self._check_stmt(sf, cls, fn, s, inner, guarded)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure can outlive the enclosing with-block: no locks held
+            for s in stmt.body:
+                yield from self._check_stmt(sf, cls, fn, s, frozenset(), guarded)
+            return
+        # expressions attached to this statement itself
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                yield from self._check_expr(sf, cls, fn, child, held, guarded)
+        # child statements and except-handler bodies keep the held set
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                yield from self._check_stmt(sf, cls, fn, child, held, guarded)
+
+    def _check_expr(self, sf, cls, fn, expr, held, guarded) -> Iterator[Finding]:
+        stack: list[tuple[ast.AST, frozenset[str]]] = [(expr, held)]
+        while stack:
+            node, h = stack.pop()
+            if isinstance(node, ast.Lambda):
+                # lambdas escape to other threads: nothing is held inside
+                stack.append((node.body, frozenset()))
+                continue
+            if is_self_attr(node) and node.attr in guarded:
+                lock = guarded[node.attr]
+                if lock not in h:
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.lineno,
+                        context=f"{cls.name}.{fn.name}",
+                        message=(
+                            f"self.{node.attr} is guarded by self.{lock} but "
+                            f"accessed outside `with self.{lock}`"
+                        ),
+                    )
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, h))
+
+
+# ---------------------------------------------------------------------------
+# 2. pool-lifecycle
+
+
+class PoolLifecycleRule(Rule):
+    """Executors/threads stored on `self` need a reachable shutdown/join.
+
+    Accepts a direct `self.<attr>.shutdown()`/`.join()` anywhere in the
+    class, or the unload-then-join idiom (`w, self._t = self._t, None` +
+    `w.join()`). Context-managed pools and fire-and-forget locals are out
+    of scope — only state that outlives the creating call is checked."""
+
+    name = "pool-lifecycle"
+    description = "executor/thread attribute with no shutdown/join path"
+    rationale = (
+        "a pool without a shutdown path leaks threads on every restart and "
+        "turns clean process exit into a hang or lost writes"
+    )
+
+    _CTOR_TAILS = {"ThreadPoolExecutor", "Thread", "ProcessPoolExecutor"}
+    _CLEANUP_ATTRS = {"shutdown", "join"}
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(sf, node)
+
+    def _is_ctor(self, value: ast.expr) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        chain = attr_chain(value.func)
+        return bool(chain) and chain[-1] in self._CTOR_TAILS
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef) -> Iterator[Finding]:
+        created: dict[str, tuple[int, str]] = {}  # attr -> (line, fn name)
+        for fn in _func_defs(cls):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and self._is_ctor(node.value):
+                    for t in node.targets:
+                        if is_self_attr(t):
+                            created.setdefault(t.attr, (node.lineno, fn.name))
+        if not created:
+            return
+        cleaned: set[str] = set()
+        for fn in _func_defs(cls):
+            aliases: dict[str, str] = {}  # local name -> self attr
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    self._collect_aliases(node, aliases)
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if node.func.attr not in self._CLEANUP_ATTRS:
+                        continue
+                    recv = node.func.value
+                    if is_self_attr(recv):
+                        cleaned.add(recv.attr)
+                    elif isinstance(recv, ast.Name) and recv.id in aliases:
+                        cleaned.add(aliases[recv.id])
+        for attr, (line, fn_name) in created.items():
+            if attr in cleaned:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=sf.rel,
+                line=line,
+                context=f"{cls.name}.{fn_name}",
+                message=(
+                    f"self.{attr} holds an executor/thread but no method of "
+                    f"{cls.name} ever calls its shutdown()/join()"
+                ),
+            )
+
+    @staticmethod
+    def _collect_aliases(node: ast.Assign, aliases: dict[str, str]) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Name) and is_self_attr(node.value):
+                aliases[target.id] = node.value.attr
+            elif (
+                isinstance(target, ast.Tuple)
+                and isinstance(node.value, ast.Tuple)
+                and len(target.elts) == len(node.value.elts)
+            ):
+                for t, v in zip(target.elts, node.value.elts):
+                    if isinstance(t, ast.Name) and is_self_attr(v):
+                        aliases[t.id] = v.attr
+
+
+# ---------------------------------------------------------------------------
+# 3. trace-propagation
+
+
+class TracePropagationRule(Rule):
+    """Work submitted to pools must carry the submitter's trace context.
+
+    In the threaded modules, `<pool>.submit(fn, ...)` / `<pool>.map(fn, ...)`
+    (receiver name containing pool/executor/workers) must wrap `fn` in
+    `telemetry.propagate(...)` or hand a context-bound `ctx.run`. Pool
+    threads otherwise start with an empty contextvars Context, so spans
+    recorded inside the task silently detach from the request/tick trace."""
+
+    name = "trace-propagation"
+    description = "pool submit/map without telemetry.propagate / ctx.run"
+    rationale = (
+        "spans lost across pool boundaries make production traces lie about "
+        "where the time went — the exact bug class PR 1-3 kept fixing by hand"
+    )
+
+    _METHODS = {"submit", "map"}
+
+    def applies(self, rel: str) -> bool:
+        return rel in _THREADED_MODULES
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        # names bound to a propagate()-wrapped callable anywhere in the
+        # module (e.g. `fetch = telemetry.propagate(...)` then
+        # `pool.map(fetch, ...)`) carry context by construction
+        bound: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and self._carries_context(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr not in self._METHODS:
+                continue
+            recv = node.func.value
+            recv_name = (
+                recv.attr if isinstance(recv, ast.Attribute) else getattr(recv, "id", "")
+            )
+            if not recv_name or not _POOL_RECEIVER_RE.search(recv_name):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if self._carries_context(first):
+                continue
+            if isinstance(first, ast.Name) and first.id in bound:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=sf.rel,
+                line=node.lineno,
+                context=enclosing_context(sf.tree, node),
+                message=(
+                    f"{recv_name}.{node.func.attr}() callable is not wrapped "
+                    "in telemetry.propagate() (or bound via ctx.run): spans "
+                    "recorded in the worker will detach from the trace"
+                ),
+            )
+
+    @staticmethod
+    def _carries_context(arg: ast.expr) -> bool:
+        # telemetry.propagate(fn) / propagate(fn)
+        if isinstance(arg, ast.Call):
+            chain = attr_chain(arg.func)
+            if chain and chain[-1] == "propagate":
+                return True
+        # ctx.run / context.run handed as the callable itself
+        chain = attr_chain(arg)
+        return bool(chain) and chain[-1] == "run"
+
+# ---------------------------------------------------------------------------
+# 4. silent-swallow
+
+
+class SilentSwallowRule(Rule):
+    """Broad exception handlers in the durability path must log or count.
+
+    In `storage/`, `streams.py`, and `core.py`, an `except Exception:` (or
+    bare / BaseException / contextlib.suppress(Exception)) whose body
+    neither raises, logs, nor increments a metric erases storage errors —
+    the staged-parquet durability chain then fails invisibly. Narrow
+    handlers (OSError, ValueError...) stay idiomatic and unflagged."""
+
+    name = "silent-swallow"
+    description = "broad except swallowing errors without log or counter"
+    rationale = (
+        "59 silent handlers existed at PR 4 time; a swallowed storage error "
+        "means uploads quietly stop and nobody finds out until data is gone"
+    )
+
+    _LOGGERLIKE = {"logger", "logging", "log", "warnings"}
+    _EVIDENCE_ATTRS = {
+        "exception",
+        "warning",
+        "warn",
+        "error",
+        "info",
+        "debug",
+        "critical",
+        "inc",
+        "observe",
+    }
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_SWALLOW_SCOPE_PREFIXES) or rel in _SWALLOW_SCOPE_FILES
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ExceptHandler):
+                if self._is_broad(node.type) and not self._has_evidence(node.body):
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.lineno,
+                        context=enclosing_context(sf.tree, node),
+                        message=(
+                            "broad except swallows the error silently: log it "
+                            "or increment an error counter (e.g. "
+                            "storage_swallowed_errors) — or narrow the type"
+                        ),
+                    )
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain and chain[-1] == "suppress":
+                    if any(self._is_broad(a) for a in node.args):
+                        yield Finding(
+                            rule=self.name,
+                            path=sf.rel,
+                            line=node.lineno,
+                            context=enclosing_context(sf.tree, node),
+                            message=(
+                                "contextlib.suppress of a broad exception "
+                                "hides storage errors; narrow it or handle "
+                                "with logging"
+                            ),
+                        )
+
+    @staticmethod
+    def _is_broad(typ: ast.expr | None) -> bool:
+        if typ is None:
+            return True
+        if isinstance(typ, ast.Tuple):
+            return any(SilentSwallowRule._is_broad(e) for e in typ.elts)
+        chain = attr_chain(typ)
+        return bool(chain) and chain[-1] in _BROAD_EXC_NAMES
+
+    def _has_evidence(self, body: list[ast.stmt]) -> bool:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    chain = attr_chain(node.func)
+                    if chain and chain[0] in self._LOGGERLIKE:
+                        return True
+                    if node.func.attr in self._EVIDENCE_ATTRS:
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 5. config-drift
+
+
+class ConfigDriftRule(Rule):
+    """P_* env reads go through config.py; every knob appears in README.
+
+    Per-file: flags `os.environ[...]` / `os.environ.get(...)` / `os.getenv`
+    with a literal P_* key anywhere outside config.py — scattered env reads
+    are how two modules end up disagreeing about a default. Project-wide:
+    every P_* key declared through the config accessors must appear in
+    README.md (verbatim, or covered by a documented `P_FAMILY_*` row)."""
+
+    name = "config-drift"
+    description = "P_* env read outside config.py, or knob missing from README"
+    rationale = (
+        "ten modules read P_* directly at PR 4 time; undocumented knobs are "
+        "unusable knobs, and scattered reads drift defaults apart"
+    )
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        if sf.rel == "parseable_tpu/config.py":
+            return
+        for node in ast.walk(sf.tree):
+            key = self._environ_key(node)
+            if key is not None and _P_KEY_RE.match(key):
+                yield Finding(
+                    rule=self.name,
+                    path=sf.rel,
+                    line=node.lineno,
+                    context=enclosing_context(sf.tree, node),
+                    message=(
+                        f"direct os.environ read of {key}: use the config.py "
+                        "accessors (env_str/env_int/env_bool/env_float) so "
+                        "defaults and parsing live in one place"
+                    ),
+                )
+
+    @staticmethod
+    def _environ_key(node: ast.AST) -> str | None:
+        # os.environ["K"] / os.environ.get("K", ...) / os.getenv("K", ...)
+        if isinstance(node, ast.Subscript):
+            if attr_chain(node.value) == ["os", "environ"] and isinstance(
+                node.slice, ast.Constant
+            ):
+                v = node.slice.value
+                return v if isinstance(v, str) else None
+        if isinstance(node, ast.Call) and node.args:
+            chain = attr_chain(node.func)
+            if chain in (["os", "environ", "get"], ["os", "getenv"]):
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    return first.value
+        return None
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        declared: dict[str, tuple[str, int]] = {}
+        for sf in project.files:
+            if sf.rel.startswith("parseable_tpu/analysis/"):
+                continue
+            for node in ast.walk(sf.tree):
+                key = None
+                if isinstance(node, ast.Call) and node.args:
+                    chain = attr_chain(node.func)
+                    if chain and chain[-1] in _ENV_ACCESSOR_NAMES:
+                        first = node.args[0]
+                        if isinstance(first, ast.Constant) and isinstance(
+                            first.value, str
+                        ):
+                            key = first.value
+                if key is None:
+                    key = self._environ_key(node)
+                if key is not None and _P_KEY_RE.match(key):
+                    declared.setdefault(key, (sf.rel, node.lineno))
+        readme = project.readme_text()
+        # family rows: a documented `P_KAFKA_*` covers every P_KAFKA_ key
+        families = [
+            m.group(1) for m in re.finditer(r"`?(P_[A-Z0-9_]+_)\*`?", readme)
+        ]
+        for key in sorted(declared):
+            if key in readme:
+                continue
+            if any(key.startswith(fam) for fam in families):
+                continue
+            rel, line = declared[key]
+            yield Finding(
+                rule=self.name,
+                path=rel,
+                line=line,
+                context="README",
+                message=(
+                    f"config knob {key} is not documented in README.md "
+                    "(add it to the configuration tables, or a P_FAMILY_* row)"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# 6. blocking-in-async
+
+
+class BlockingInAsyncRule(Rule):
+    """No blocking calls lexically inside `async def` server handlers.
+
+    Flags `time.sleep(...)` and direct storage-backend calls (an attribute
+    chain through `.storage.` ending in a blocking op) whose nearest
+    enclosing function is async. Closures handed to run_in_executor are
+    sync `def`s, so they pass. One blocking call on the event loop stalls
+    every in-flight request, not just the offending one."""
+
+    name = "blocking-in-async"
+    description = "time.sleep / blocking storage call inside async def"
+    rationale = (
+        "the aiohttp event loop serves every request; one synchronous "
+        "storage round trip inside a handler head-of-line blocks them all"
+    )
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("parseable_tpu/server/")
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        yield from self._walk(sf, sf.tree, in_async=False, ctx="")
+
+    def _walk(self, sf: SourceFile, node: ast.AST, in_async: bool, ctx: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                yield from self._walk(sf, child, True, f"{ctx}.{child.name}".strip("."))
+            elif isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                name = getattr(child, "name", "<lambda>")
+                yield from self._walk(sf, child, False, f"{ctx}.{name}".strip("."))
+            else:
+                if in_async and isinstance(child, ast.Call):
+                    f = self._flag(sf, child, ctx)
+                    if f is not None:
+                        yield f
+                yield from self._walk(sf, child, in_async, ctx)
+
+    def _flag(self, sf: SourceFile, call: ast.Call, ctx: str) -> Finding | None:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if chain == ["time", "sleep"]:
+            return Finding(
+                rule=self.name,
+                path=sf.rel,
+                line=call.lineno,
+                context=ctx,
+                message="time.sleep blocks the event loop: use asyncio.sleep",
+            )
+        if (
+            len(chain) >= 2
+            and "storage" in chain[:-1]
+            and chain[-1] in _BLOCKING_STORAGE_OPS
+        ):
+            return Finding(
+                rule=self.name,
+                path=sf.rel,
+                line=call.lineno,
+                context=ctx,
+                message=(
+                    f"blocking storage call .{chain[-1]}() on the event loop: "
+                    "move it to run_in_executor"
+                ),
+            )
+        return None
+
+
+DEFAULT_RULES = [
+    LockDisciplineRule,
+    PoolLifecycleRule,
+    TracePropagationRule,
+    SilentSwallowRule,
+    ConfigDriftRule,
+    BlockingInAsyncRule,
+]

@@ -110,6 +110,26 @@ def _env_bool(name: str, default: bool) -> bool:
     return v.lower() in ("1", "true", "yes", "on")
 
 
+# Public accessors for modules that read P_* knobs at call time rather than
+# through the Options dataclass (device caches, backend hardening flags, the
+# kafka connector). Keeping every env read behind these — enforced by plint's
+# config-drift rule — means defaults and parsing can never fork per module.
+def env_str(name: str, default: str | None = None) -> str | None:
+    return _env(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    return _env_int(name, default)
+
+
+def env_float(name: str, default: float) -> float:
+    return _env_float(name, default)
+
+
+def env_bool(name: str, default: bool) -> bool:
+    return _env_bool(name, default)
+
+
 @dataclass
 class Options:
     """All server options. Defaults mirror the reference (src/cli.rs:135-641)."""

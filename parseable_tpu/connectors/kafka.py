@@ -40,7 +40,12 @@ class ConnectorUnavailable(RuntimeError):
 
 
 def _env(name: str, default: str = "") -> str:
-    return os.environ.get(name, default)
+    # P_KAFKA_* reads route through the config accessors (plint:
+    # config-drift) so env parsing has exactly one implementation
+    from parseable_tpu.config import env_str
+
+    v = env_str(name, default)
+    return v if v is not None else default
 
 
 @dataclass

@@ -111,7 +111,7 @@ class Parseable:
         # post-upload enccache seed + field stats, off the critical path
         self.enrichment = EnrichmentQueue(self, self.options.enrich_queue_depth)
         self.hot_tier = None  # set by the server when hot tier is enabled
-        self._json_locks: dict[str, threading.Lock] = {}
+        self._json_locks: dict[str, threading.Lock] = {}  # guarded-by: self._json_locks_guard
         self._json_locks_guard = threading.Lock()
 
     def stream_json_lock(self, name: str) -> threading.Lock:

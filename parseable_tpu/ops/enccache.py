@@ -53,12 +53,16 @@ def _fname(source_id: bytes) -> str:
     return hashlib.sha1(source_id).hexdigest() + ".enc"
 
 
+# sentinel telling the write-behind thread to exit (EncodedBlockCache.shutdown)
+_WRITER_STOP = object()
+
+
 class EncodedBlockCache:
     def __init__(self, root: Path, budget_bytes: int | None = None):
         self.root = Path(root)
-        self.budget = budget_bytes or int(
-            os.environ.get("P_TPU_ENC_CACHE_BYTES", 16 << 30)
-        )
+        from parseable_tpu.config import env_int
+
+        self.budget = budget_bytes or env_int("P_TPU_ENC_CACHE_BYTES", 16 << 30)
         self._lock = threading.Lock()
         self._write_lock = threading.Lock()
         self._queue: "object" = None  # lazily-started background writer
@@ -120,21 +124,41 @@ class EncodedBlockCache:
             if self._queue is None:
                 self._queue = _q.Queue(maxsize=16)
                 self._writer = threading.Thread(
-                    target=self._writer_loop, name="enccache-writer", daemon=True
+                    target=self._writer_loop,
+                    args=(self._queue,),
+                    name="enccache-writer",
+                    daemon=True,
                 )
                 self._writer.start()
+            q = self._queue
         try:
-            self._queue.put_nowait((source_id, snap))
+            q.put_nowait((source_id, snap))
         except _q.Full:
             pass
 
-    def _writer_loop(self) -> None:
+    def _writer_loop(self, q) -> None:
+        # the queue is a parameter (not self._queue) so shutdown() can drop
+        # the attribute without racing this loop's next get()
         while True:
-            source_id, snap = self._queue.get()
+            item = q.get()
             try:
+                if item is _WRITER_STOP:
+                    return
+                source_id, snap = item
                 self.put(source_id, snap)
             finally:
-                self._queue.task_done()
+                q.task_done()
+
+    def shutdown(self) -> None:
+        """Stop the write-behind thread deterministically (pending writes
+        drain first). Idempotent; a later put_async restarts the writer."""
+        with self._lock:
+            q, w = self._queue, self._writer
+            self._queue = None
+            self._writer = None
+        if w is not None and w.is_alive():
+            q.put(_WRITER_STOP)
+            w.join(timeout=30)
 
     def wait_idle(self, timeout: float = 60.0) -> None:
         """Block until queued write-behinds have landed (benchmarks use
@@ -440,8 +464,10 @@ _GLOBAL_ROOT: Path | None = None
 def get_enccache(options=None) -> EncodedBlockCache | None:
     """Process-wide cache rooted in the staging dir; None when disabled
     (P_TPU_ENC_CACHE=0)."""
+    from parseable_tpu.config import env_str
+
     global _GLOBAL, _GLOBAL_ROOT
-    if os.environ.get("P_TPU_ENC_CACHE", "1") == "0":
+    if env_str("P_TPU_ENC_CACHE", "1") == "0":
         return None
     root: Path | None = None
     if options is not None and getattr(options, "local_staging_path", None) is not None:
@@ -449,6 +475,15 @@ def get_enccache(options=None) -> EncodedBlockCache | None:
     if _GLOBAL is None or (root is not None and root != _GLOBAL_ROOT):
         if root is None:
             return _GLOBAL
+        if _GLOBAL is not None:
+            _GLOBAL.shutdown()
         _GLOBAL = EncodedBlockCache(root)
         _GLOBAL_ROOT = root
     return _GLOBAL
+
+
+def shutdown_enccache() -> None:
+    """Stop the process-wide cache's write-behind thread (server shutdown
+    hook). The cache itself (disk entries) stays valid for the next start."""
+    if _GLOBAL is not None:
+        _GLOBAL.shutdown()

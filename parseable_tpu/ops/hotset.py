@@ -19,7 +19,6 @@ arrays gathered on device at run time, so a cached block serves any query.
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -39,7 +38,9 @@ class DeviceHotSet:
     """LRU byte-budgeted cache of encoded device blocks."""
 
     def __init__(self, budget_bytes: int | None = None):
-        self.budget = budget_bytes or int(os.environ.get("P_TPU_HOT_BYTES", 8 << 30))
+        from parseable_tpu.config import env_int
+
+        self.budget = budget_bytes or env_int("P_TPU_HOT_BYTES", 8 << 30)
         self._entries: OrderedDict[tuple, HotEntry] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()

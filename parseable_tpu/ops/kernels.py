@@ -100,9 +100,9 @@ def _use_pallas() -> bool:
     routing in, so toggling mid-process needs
     `fused_groupby_block.clear_cache()` (a process-level deployment
     choice, not a per-query switch)."""
-    import os
+    from parseable_tpu.config import env_str
 
-    return os.environ.get("P_TPU_USE_PALLAS", "") == "1"
+    return env_str("P_TPU_USE_PALLAS", "") == "1"
 
 
 @partial(jax.jit, static_argnames=("num_groups", "n_sum", "n_min", "n_max"))

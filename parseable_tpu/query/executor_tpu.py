@@ -1163,12 +1163,11 @@ class TpuQueryExecutor(QueryExecutor):
 
         def filtered() -> Iterator[pa.Table]:
             # bounds filtering happens once, in the inner executor's loop
-            import os
-
+            from parseable_tpu.config import env_str
             from parseable_tpu.ops.link import get_link
             from parseable_tpu.query.executor import _arr, evaluate
 
-            adaptive = os.environ.get("P_TPU_ADAPTIVE", "1") != "0"
+            adaptive = env_str("P_TPU_ADAPTIVE", "1") != "0"
             link = get_link(self.options)
             hotset_obj = get_hotset()
             compiler = PredicateCompiler()
@@ -1560,7 +1559,9 @@ class TpuQueryExecutor(QueryExecutor):
             specs_partializable,
         )
 
-        adaptive = os.environ.get("P_TPU_ADAPTIVE", "1") != "0"
+        from parseable_tpu.config import env_str
+
+        adaptive = env_str("P_TPU_ADAPTIVE", "1") != "0"
         link = get_link(self.options)
         needed = self.plan.needed_columns
         n_acc_rows = lay.n_rows

@@ -92,7 +92,7 @@ class _InflightBudget:
 
     def __init__(self, cap: int):
         self.cap = max(1, cap)
-        self._used = 0
+        self._used = 0  # guarded-by: self._cond
         self._cond = threading.Condition()
 
     def acquire(self, n: int, cancelled: threading.Event) -> bool:
@@ -287,8 +287,9 @@ class StreamScan:
         self.use_hot_stubs = use_hot_stubs
         self._sources: dict[bytes, ManifestFile] = {}
         self._manifest_files: list[ManifestFile] | None = None
-        self.stats = ScanStats()
-        # pool workers update the same ScanStats concurrently
+        # pool workers update the same ScanStats concurrently with the
+        # consumer thread's own bookkeeping
+        self.stats = ScanStats()  # guarded-by: self._stats_lock
         self._stats_lock = threading.Lock()
 
     # ---------------------------------------------------------------- helpers
@@ -360,7 +361,8 @@ class StreamScan:
                 if not m.key.endswith(".parquet") or m.key in seen:
                     continue
                 seen.add(m.key)
-                self.stats.files_total += 1
+                with self._stats_lock:
+                    self.stats.files_total += 1
                 out.append(ManifestFile(file_path=m.key, num_rows=0, file_size=m.size))
         if errors == len(prefixes) and errors:
             # storage down must error, not masquerade as an empty stream
@@ -393,12 +395,15 @@ class StreamScan:
                 if f.file_path in seen:
                     continue
                 seen.add(f.file_path)
-                self.stats.files_total += 1
+                with self._stats_lock:
+                    self.stats.files_total += 1
                 if not self._file_overlaps_time(f):
-                    self.stats.files_pruned += 1
+                    with self._stats_lock:
+                        self.stats.files_pruned += 1
                     continue
                 if not prune_file(f, self.plan.constraints):
-                    self.stats.files_pruned += 1
+                    with self._stats_lock:
+                        self.stats.files_pruned += 1
                     continue
                 files.append(f)
         return files
@@ -608,7 +613,8 @@ class StreamScan:
             if remote:
                 from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
 
-                self.stats.staging_batches += len(remote)
+                with self._stats_lock:
+                    self.stats.staging_batches += len(remote)
                 schema = merge_schemas([b.schema for b in remote])
                 table = pa.Table.from_batches([adapt_batch(schema, b) for b in remote])
                 cols = self._columns_for_read(table.column_names)
@@ -617,7 +623,8 @@ class StreamScan:
                 yield table
         batches = stream.staging_batches()
         if batches:
-            self.stats.staging_batches += len(batches)
+            with self._stats_lock:
+                self.stats.staging_batches += len(batches)
             table = pa.Table.from_batches(batches)
             cols = self._columns_for_read(table.column_names)
             if cols is not None:
@@ -628,7 +635,8 @@ class StreamScan:
                 pf = pq.ParquetFile(f)
                 cols = self._columns_for_read(pf.schema_arrow.names)
                 t = pf.read(columns=cols)
-                self.stats.rows_scanned += t.num_rows
+                with self._stats_lock:
+                    self.stats.rows_scanned += t.num_rows
                 yield t
             except Exception:
                 logger.exception("failed reading staged parquet %s", f)
@@ -659,9 +667,11 @@ class StreamScan:
         try:
             yield from self._tables_inner()
         finally:
+            with self._stats_lock:
+                scanned = self.stats.bytes_scanned
             TOTAL_QUERY_BYTES_SCANNED_DATE.labels(
                 datetime.now(UTC).date().isoformat()
-            ).inc(self.stats.bytes_scanned)
+            ).inc(scanned)
 
     def _tables_inner(self) -> Iterator[pa.Table]:
         if self._within_staging_window():
@@ -694,7 +704,8 @@ class StreamScan:
             if hotset is not None:
                 entry = hotset.get(key_fn(source_id))
                 if entry is not None:
-                    self.stats.rows_scanned += entry.meta.num_rows
+                    with self._stats_lock:
+                        self.stats.rows_scanned += entry.meta.num_rows
                     yield make_stub_fn(source_id, entry.meta.num_rows)
                     continue
                 # encoded-block disk cache: the executor loads device-ready
@@ -702,7 +713,8 @@ class StreamScan:
                 if enccache is not None and enccache.can_serve(
                     source_id, self.plan.needed_columns, dict_cols
                 ):
-                    self.stats.rows_scanned += f.num_rows
+                    with self._stats_lock:
+                        self.stats.rows_scanned += f.num_rows
                     yield make_stub_fn(source_id, f.num_rows)
                     continue
             to_fetch.append((f, source_id))

@@ -227,6 +227,11 @@ class ServerState:
 
             get_profiler().stop()
         self.p.shutdown()
+        # the encoded-block cache's write-behind thread (pool-lifecycle:
+        # every thread we start has a deterministic stop)
+        from parseable_tpu.ops.enccache import shutdown_enccache
+
+        shutdown_enccache()
         self.workers.shutdown(wait=False)
 
 
@@ -379,7 +384,11 @@ async def liveness(request: web.Request) -> web.Response:
 async def readiness(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     try:
-        state.p.storage.list_dirs("")
+        # storage round trip off the event loop: a slow/unreachable backend
+        # must fail THIS probe, not stall every in-flight request
+        await asyncio.get_running_loop().run_in_executor(
+            None, state.p.storage.list_dirs, ""
+        )
         return web.Response(status=200)
     except Exception:
         return web.Response(status=503)

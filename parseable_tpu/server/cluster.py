@@ -26,6 +26,7 @@ import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from parseable_tpu.core import Parseable
+from parseable_tpu.utils import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -103,8 +104,11 @@ def fetch_staging_batches(p: Parseable, stream: str) -> list[pa.RecordBatch]:
     nodes = live_ingestors(p)
     if not nodes:
         return []
+    # propagate: this runs inside a traced query — the per-node fetch spans
+    # must parent under it, not detach into the pool's empty context
     futures = [
-        _pool.submit(_fetch_one, p, n["domain_name"], stream) for n in nodes
+        _pool.submit(telemetry.propagate(_fetch_one), p, n["domain_name"], stream)
+        for n in nodes
     ]
     out: list[pa.RecordBatch] = []
     for f in futures:
@@ -169,7 +173,7 @@ def sync_with_ingestors(
             failed.append(domain)
 
     nodes = live_peers(p, kinds)
-    list(_pool.map(one, [n["domain_name"] for n in nodes]))
+    list(_pool.map(telemetry.propagate(one), [n["domain_name"] for n in nodes]))
     return failed
 
 

@@ -207,10 +207,13 @@ class AzureBlobStorage(ObjectStorage):
                 )
                 return bid
 
+            from parseable_tpu.utils import telemetry
+
             with ThreadPoolExecutor(
                 max_workers=min(self.multipart_concurrency, n_blocks)
             ) as pool:
-                block_ids = list(pool.map(put_block, range(n_blocks)))
+                # propagate: per-block PUT spans must join the upload trace
+                block_ids = list(pool.map(telemetry.propagate(put_block), range(n_blocks)))
             body = "<BlockList>" + "".join(
                 f"<Latest>{b}</Latest>" for b in block_ids
             ) + "</BlockList>"

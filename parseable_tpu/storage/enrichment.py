@@ -48,7 +48,7 @@ class EnrichmentQueue:
     def __init__(self, parseable, depth: int = 64):
         self._p = parseable
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
-        self._worker: threading.Thread | None = None
+        self._worker: threading.Thread | None = None  # guarded-by: self._guard
         self._guard = threading.Lock()
 
     # -- consumer predicates ------------------------------------------------

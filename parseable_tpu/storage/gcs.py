@@ -23,12 +23,15 @@ the metadata server supplies tokens for the attached service account.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Iterator
 from urllib.parse import quote
+
+from parseable_tpu.utils.metrics import STORAGE_SWALLOWED_ERRORS
 
 from parseable_tpu.storage.object_storage import (
     NoSuchKey,
@@ -37,6 +40,8 @@ from parseable_tpu.storage.object_storage import (
     ObjectStorageError,
     timed,
 )
+
+logger = logging.getLogger(__name__)
 
 _METADATA_TOKEN_URL = (
     "http://metadata.google.internal/computeMetadata/v1/"
@@ -79,8 +84,9 @@ class GcsTokenProvider:
                     self._cached = obj.get("access_token")
                     self._expires_at = now + float(obj.get("expires_in", 300))
                     return self._cached
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("GCE metadata token fetch failed: %s", e)
+                STORAGE_SWALLOWED_ERRORS.labels("gcs", "metadata_token").inc()
             # not on GCE / no metadata server: run anonymous (emulator)
             self._use_mds = False
             return None
@@ -104,14 +110,14 @@ class GcsStorage(ObjectStorage):
         download_chunk_bytes: int = 8 * 1024 * 1024,
         download_concurrency: int = 16,
     ):
-        import os
-
         import requests
+
+        from parseable_tpu.config import env_str
 
         self.bucket = bucket
         self.endpoint = (endpoint or "https://storage.googleapis.com").rstrip("/")
         self.tokens = GcsTokenProvider(
-            token or os.environ.get("P_GCS_TOKEN"),
+            token or env_str("P_GCS_TOKEN"),
             # a custom endpoint means an emulator/mock: skip the metadata
             # server probe entirely
             use_metadata_server=endpoint is None,
@@ -290,8 +296,13 @@ class GcsStorage(ObjectStorage):
                         # best-effort session cancel
                         try:
                             self._session.delete(session, timeout=10)
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            logger.debug(
+                                "gcs resumable session cancel failed: %s", e
+                            )
+                            STORAGE_SWALLOWED_ERRORS.labels(
+                                "gcs", "resumable_cancel"
+                            ).inc()
                         raise ObjectStorageError(
                             f"gcs resumable chunk for {key!r} -> {r.status_code}: {r.text[:200]}"
                         )
@@ -320,5 +331,8 @@ class GcsStorage(ObjectStorage):
             keys = [m.key for m in self.list_prefix(prefix)]
             if not keys:
                 return
+            from parseable_tpu.utils import telemetry
+
             with ThreadPoolExecutor(max_workers=min(8, len(keys))) as pool:
-                list(pool.map(self.delete_object, keys))
+                # propagate: per-key DELETE spans must join the caller's trace
+                list(pool.map(telemetry.propagate(self.delete_object), keys))

@@ -216,7 +216,7 @@ class HotTierManager:
         if "pstats" not in self.budgets:
             try:
                 exists = bool(self.p.metastore.get_all_stream_jsons("pstats"))
-            except Exception:  # noqa: BLE001 - metastore miss = not yet
+            except MetastoreError:  # metastore miss = stream not created yet
                 exists = False
             if exists:
                 with self._lock:

@@ -104,17 +104,22 @@ class ObjectStorage(ABC):
             tmp.write_bytes(self.get_object(key))
         else:
             with timed(self.name, "GET_RANGED"):
+                from parseable_tpu.utils import telemetry
+
                 ranges = [
                     (o, min(o + chunk, meta.size) - 1) for o in range(0, meta.size, chunk)
                 ]
+                # propagate: per-chunk GET spans must join the caller's trace
+                fetch = telemetry.propagate(
+                    lambda r: self.get_range(key, r[0], r[1])
+                )
                 with tmp.open("wb") as f:
                     f.truncate(meta.size)
                     with ThreadPoolExecutor(
                         max_workers=max(1, self.download_concurrency)
                     ) as pool:
                         for offset, data in zip(
-                            (r[0] for r in ranges),
-                            pool.map(lambda r: self.get_range(key, r[0], r[1]), ranges),
+                            (r[0] for r in ranges), pool.map(fetch, ranges)
                         ):
                             f.seek(offset)
                             f.write(data)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import datetime as _dt
 import hashlib
 import hmac
+import logging
 import threading
 import xml.etree.ElementTree as ET
 from concurrent.futures import ThreadPoolExecutor
@@ -36,6 +37,9 @@ from parseable_tpu.storage.object_storage import (
     ObjectStorageError,
     timed,
 )
+from parseable_tpu.utils.metrics import STORAGE_SWALLOWED_ERRORS
+
+logger = logging.getLogger(__name__)
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 # strip namespaces from ListBucketResult etc. so find() stays simple
@@ -187,8 +191,11 @@ class ImdsCredentials:
             )
             if tok.status_code == 200:
                 return {"X-aws-ec2-metadata-token": tok.text}
-        except Exception:
-            pass
+        except Exception as e:
+            # recoverable by design (v1 fallback / caller raises below),
+            # but never invisible: count it so a flapping IMDS shows up
+            logger.debug("IMDSv2 token fetch failed: %s", e)
+            STORAGE_SWALLOWED_ERRORS.labels("s3", "imds_token").inc()
         if not self.imdsv1_fallback:
             raise ObjectStorageError(
                 "IMDSv2 token fetch failed and IMDSv1 fallback is disabled "
@@ -259,6 +266,8 @@ class S3Storage(ObjectStorage):
 
         import requests
 
+        from parseable_tpu.config import env_bool, env_str
+
         self.bucket = bucket
         self.region = region or "us-east-1"
         self.endpoint = (endpoint or f"https://s3.{self.region}.amazonaws.com").rstrip("/")
@@ -272,24 +281,23 @@ class S3Storage(ObjectStorage):
         ssec = (
             ssec_encryption_key
             if ssec_encryption_key is not None
-            else os.environ.get("P_S3_SSEC_ENCRYPTION_KEY", "")
+            else env_str("P_S3_SSEC_ENCRYPTION_KEY", "")
         )
         self.ssec_headers = parse_ssec_key(ssec) if ssec else None
         self.set_checksum = (
             set_checksum
             if set_checksum is not None
-            else os.environ.get("P_S3_CHECKSUM", "").lower() in ("1", "true")
+            else env_bool("P_S3_CHECKSUM", False)
         )
         # no static credentials anywhere: the EC2 instance-metadata chain
         # supplies (and refreshes) temporary role credentials
         self._imds = (
             ImdsCredentials(
-                endpoint=metadata_endpoint or os.environ.get("P_AWS_METADATA_ENDPOINT"),
+                endpoint=metadata_endpoint or env_str("P_AWS_METADATA_ENDPOINT"),
                 imdsv1_fallback=(
                     imdsv1_fallback
                     if imdsv1_fallback is not None
-                    else os.environ.get("P_AWS_IMDSV1_FALLBACK", "").lower()
-                    in ("1", "true")
+                    else env_bool("P_AWS_IMDSV1_FALLBACK", False)
                 ),
             )
             if not ak and not sk
@@ -448,10 +456,13 @@ class S3Storage(ObjectStorage):
                 return i + 1, r.headers.get("ETag", "")
 
             try:
+                from parseable_tpu.utils import telemetry
+
                 with ThreadPoolExecutor(
                     max_workers=min(self.multipart_concurrency, n_parts)
                 ) as pool:
-                    etags = sorted(pool.map(put_part, range(n_parts)))
+                    # propagate: per-part PUT spans must join the upload trace
+                    etags = sorted(pool.map(telemetry.propagate(put_part), range(n_parts)))
                 body = "<CompleteMultipartUpload>" + "".join(
                     f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
                     for n, e in etags

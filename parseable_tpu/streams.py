@@ -81,17 +81,16 @@ class Stream:
         self.ingestor_id = ingestor_id
         self.tenant = tenant
         self.data_path = options.staging_dir() / (f"{tenant}.{name}" if tenant else name)
-        self.writer = Writer(
+        self.writer = Writer(  # guarded-by: self.lock
             enable_memory=options.enable_memory_staging,
             batch_rows=options.disk_write_batch_rows,
         )
         self.lock = threading.RLock()
         # arrows claimed by an in-flight conversion job and parquet claimed by
-        # an in-flight upload (both guarded by self.lock): concurrent sync
-        # cycles must never compact the same arrows twice or upload the same
-        # parquet twice
-        self._claimed_arrows: set[Path] = set()
-        self._claimed_parquet: set[Path] = set()
+        # an in-flight upload: concurrent sync cycles must never compact the
+        # same arrows twice or upload the same parquet twice
+        self._claimed_arrows: set[Path] = set()  # guarded-by: self.lock
+        self._claimed_parquet: set[Path] = set()  # guarded-by: self.lock
 
     # --- filenames ---------------------------------------------------------
 
@@ -362,7 +361,7 @@ class Streams:
     def __init__(self, options: Options, ingestor_id: str | None = None):
         self.options = options
         self.ingestor_id = ingestor_id
-        self._streams: dict[tuple[str | None, str], Stream] = {}
+        self._streams: dict[tuple[str | None, str], Stream] = {}  # guarded-by: self._lock
         self._lock = threading.RLock()
 
     def get(self, name: str, tenant: str | None = None) -> Stream | None:

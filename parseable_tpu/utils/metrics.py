@@ -116,6 +116,17 @@ DEVICE_JIT_PROGRAMS = _gauge(
     "tpu_jit_programs", "XLA programs compiled (jit cache misses)", []
 )
 
+# errors a storage backend deliberately recovers from (credential-probe
+# fallbacks, best-effort session cancels): recoverable by design, but a
+# nonzero rate is the early signal of a flapping metadata server or a
+# misbehaving endpoint — plint's silent-swallow rule requires every such
+# handler to log and tick this
+STORAGE_SWALLOWED_ERRORS = _counter(
+    "storage_swallowed_errors",
+    "Errors swallowed by deliberate storage-backend fallbacks",
+    ["backend", "op"],
+)
+
 # --- storage layer calls (reference: storage/metrics_layer.rs) ----------
 STORAGE_REQUEST_TIME = Histogram(
     "storage_request_response_time",

@@ -68,3 +68,8 @@ class ResourceMonitor:
 
     def stop(self) -> None:
         self._stop.set()
+        # the wait() wakes immediately on set(); join so a stop/start pair
+        # (or process exit) can never stack two monitor threads
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

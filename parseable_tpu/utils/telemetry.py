@@ -27,7 +27,6 @@ from __future__ import annotations
 import contextvars
 import json
 import logging
-import os
 import random
 import threading
 import time
@@ -117,12 +116,17 @@ def propagate(fn):
     """Bind `fn` to a snapshot of the caller's context so trace parentage
     survives the hop onto a worker-pool thread (pool threads otherwise start
     with an empty Context and record orphaned or unrecorded spans). Used by
-    the write-path pools (compaction, upload, per-stream sync coordinators);
-    the scan pool does the equivalent with an explicit copy_context()."""
+    the write-path pools (compaction, upload, per-stream sync coordinators)
+    and the storage backends' part/chunk fan-outs; the scan pool does the
+    equivalent with an explicit copy_context().
+
+    Each invocation runs in its own copy of the snapshot: a Context object
+    cannot be entered by two threads at once (RuntimeError), and one wrapped
+    callable is routinely fanned out via `pool.map` across many workers."""
     ctx = contextvars.copy_context()
 
     def bound(*args, **kwargs):
-        return ctx.run(fn, *args, **kwargs)
+        return ctx.copy().run(fn, *args, **kwargs)
 
     return bound
 
@@ -149,7 +153,7 @@ class SpanSink:
 
     def __init__(self):
         self._p = None
-        self._rows: list[dict] = []
+        self._rows: list[dict] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     @property
@@ -218,9 +222,11 @@ def clear_recent_spans() -> None:
 
 class Tracer:
     def __init__(self, endpoint: str | None = None, service_name: str = "parseable-tpu"):
-        self.endpoint = endpoint or os.environ.get("P_OTLP_ENDPOINT") or None
+        from parseable_tpu.config import env_str
+
+        self.endpoint = endpoint or env_str("P_OTLP_ENDPOINT") or None
         self.service_name = service_name
-        self._spans: list[dict] = []
+        self._spans: list[dict] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._flush_inflight = threading.Lock()
 

@@ -22,3 +22,11 @@ if grep -aqE '^[0-9]+ (failed|error)|, [0-9]+ (failed|error)' /tmp/_t1.log; then
   exit 1
 fi
 echo "check_green: tier-1 GREEN"
+
+# static-analysis gate: the tree must lint clean (zero unbaselined plint
+# findings) before snapshot — concurrency/invariant bugs are cheapest here
+if ! python -m parseable_tpu.analysis; then
+  echo "check_green: PLINT RED (unbaselined findings; see above)" >&2
+  exit 1
+fi
+echo "check_green: plint GREEN"
