@@ -1,11 +1,15 @@
-"""plint — parseable_tpu's AST-based concurrency & invariant lint gate.
+"""plint — parseable_tpu's AST + call-graph concurrency & invariant gate.
 
 Run it as `python -m parseable_tpu.analysis` (wired into
-scripts/check_green.sh after tier-1). See framework.py for the machinery,
-rules.py for the rule catalog, and the README "Static analysis" section for
-the workflow (suppressions, baseline policy, adding a rule).
+scripts/check_green.sh after tier-1; `--changed` + result cache by
+default there, PLINT_FULL=1 for the authoritative full run). See
+framework.py for the machinery, rules.py / rules_interproc.py for the rule
+catalog, callgraph.py for the whole-program symbol table + call graph, and
+the README "Static analysis" section for the workflow (suppressions,
+baseline policy, lock-order annotations, adding a rule).
 """
 
+from parseable_tpu.analysis.callgraph import CallGraph, build_call_graph
 from parseable_tpu.analysis.framework import (
     AnalysisReport,
     Finding,
@@ -18,10 +22,12 @@ from parseable_tpu.analysis.rules import DEFAULT_RULES
 
 __all__ = [
     "AnalysisReport",
+    "CallGraph",
     "DEFAULT_RULES",
     "Finding",
     "Project",
     "Rule",
     "SourceFile",
+    "build_call_graph",
     "run_analysis",
 ]

@@ -2,26 +2,126 @@
 
 Exit codes: 0 = no unbaselined findings, 1 = findings, 2 = usage/parse
 error. `--json` emits a machine-diffable report (stable ordering, content
-fingerprints) so two runs can be compared with plain `diff`/`jq`.
+fingerprints) so two runs can be compared with plain `diff`/`jq`;
+`--json-out FILE` writes the same report as a gate artifact while keeping
+human-readable output on stdout.
+
+Gate speed (the check_green.sh path):
+- `--changed` lints the whole tree but *reports* only findings in files
+  that differ from `git merge-base HEAD main` (plus uncommitted/untracked
+  files). The full parse still happens — interprocedural rules need the
+  complete call graph — so a cross-file consequence of your edit in an
+  unchanged file is the one thing --changed can miss; run without it (or
+  PLINT_FULL=1 in check_green.sh) for the authoritative answer.
+- an mtime-keyed result cache (default `.plint-cache.json`, disable with
+  --no-cache) skips the analysis entirely when no analyzed file, the
+  README, or the baseline changed since the last run with the same flags.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
+import subprocess
 import sys
+import time
 from pathlib import Path
 
-from parseable_tpu.analysis.framework import run_analysis, write_baseline
+from parseable_tpu.analysis.framework import (
+    iter_python_files,
+    run_analysis,
+    write_baseline,
+)
 from parseable_tpu.analysis.rules import DEFAULT_RULES
 
 DEFAULT_BASELINE = ".plint-baseline.json"
+DEFAULT_CACHE = ".plint-cache.json"
+# bump when rule semantics/fingerprints change: stale caches must miss
+PLINT_VERSION = "2"
+
+
+def changed_files(root: Path) -> set[str] | None:
+    """Repo-relative paths differing from `git merge-base HEAD main`,
+    plus uncommitted + untracked files. None when git can't answer
+    (not a repo, no main ref, ...) — callers fall back to a full report."""
+
+    def git(*args: str) -> str | None:
+        try:
+            proc = subprocess.run(
+                ["git", *args],
+                cwd=root,
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    base_out = git("merge-base", "HEAD", "main")
+    if base_out is None:
+        return None
+    base = base_out.strip()
+    diff = git("diff", "--name-only", base, "--", "*.py")
+    if diff is None:
+        return None
+    untracked = git("ls-files", "--others", "--exclude-standard", "--", "*.py") or ""
+    return {
+        line.strip()
+        for line in (diff + untracked).splitlines()
+        if line.strip().endswith(".py")
+    }
+
+
+def tree_state_key(
+    root: Path, paths: list[str], flags: dict, report_only: set[str] | None
+) -> str:
+    """Cache key: every analyzed file's (path, mtime_ns, size), the README
+    (config-drift reads it), the baseline file, rule-set version, and the
+    reporting flags. Any edit anywhere in the analyzed tree misses."""
+    h = hashlib.sha1()
+    h.update(PLINT_VERSION.encode())
+    h.update(("|".join(sorted(r.name for r in flags["rules"]))).encode())
+    h.update(json.dumps(sorted(report_only)).encode() if report_only is not None else b"-")
+    h.update(json.dumps(sorted(paths)).encode())
+    for extra in ("README.md", flags["baseline"]):
+        p = root / extra
+        try:
+            st = p.stat()
+            h.update(f"{extra}:{st.st_mtime_ns}:{st.st_size};".encode())
+        except OSError:
+            h.update(f"{extra}:-;".encode())
+    for p in iter_python_files(root, paths):
+        try:
+            st = p.stat()
+        except OSError:
+            continue
+        h.update(f"{p.relative_to(root).as_posix()}:{st.st_mtime_ns}:{st.st_size};".encode())
+    return h.hexdigest()
+
+
+def explain(rule_name: str) -> int:
+    for cls in DEFAULT_RULES:
+        if cls.name == rule_name:
+            print(f"{cls.name}: {cls.description}")
+            print(f"why: {cls.rationale}")
+            doc = (cls.__doc__ or "").strip()
+            if doc:
+                print()
+                print(doc)
+            print()
+            print(f"suppress one line with:  # plint: disable={cls.name}")
+            return 0
+    known = ", ".join(cls.name for cls in DEFAULT_RULES)
+    print(f"unknown rule {rule_name!r}; known rules: {known}", file=sys.stderr)
+    return 2
 
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m parseable_tpu.analysis",
-        description="plint: AST-based concurrency & invariant checks",
+        description="plint: AST + call-graph concurrency & invariant checks",
     )
     p.add_argument(
         "paths",
@@ -31,6 +131,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--root", default=".", help="repository root (default: cwd)")
     p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--json-out",
+        metavar="FILE",
+        default=None,
+        help="also write the JSON report to FILE (gate artifact)",
+    )
     p.add_argument(
         "--baseline",
         default=DEFAULT_BASELINE,
@@ -49,13 +155,42 @@ def main(argv: list[str] | None = None) -> int:
         help="run only these rules (repeatable)",
     )
     p.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    p.add_argument(
+        "--explain",
+        metavar="RULE",
+        default=None,
+        help="print one rule's rationale, fix patterns, and suppression syntax",
+    )
+    p.add_argument(
+        "--changed",
+        action="store_true",
+        help=(
+            "report findings only in files changed vs `git merge-base HEAD "
+            "main` (+ uncommitted/untracked); the whole tree is still "
+            "analyzed. Falls back to a full report when git can't answer "
+            "or nothing changed"
+        ),
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="skip the mtime-keyed result cache",
+    )
+    p.add_argument(
+        "--cache",
+        default=DEFAULT_CACHE,
+        help=f"cache file relative to --root (default: {DEFAULT_CACHE})",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
         for cls in DEFAULT_RULES:
-            print(f"{cls.name:20s} {cls.description}")
-            print(f"{'':20s}   why: {cls.rationale}")
+            print(f"{cls.name:30s} {cls.description}")
+            print(f"{'':30s}   why: {cls.rationale}")
         return 0
+
+    if args.explain:
+        return explain(args.explain)
 
     rules = [cls() for cls in DEFAULT_RULES]
     if args.rule:
@@ -68,35 +203,82 @@ def main(argv: list[str] | None = None) -> int:
 
     root = Path(args.root).resolve()
     baseline_path = root / args.baseline
-    report = run_analysis(
-        root,
-        paths=args.paths or None,
-        rules=rules,
-        baseline_path=baseline_path,
-    )
+    paths = args.paths or ["parseable_tpu"]
 
-    if args.write_baseline:
-        write_baseline(baseline_path, report.findings)
-        print(f"baseline written: {len(report.findings)} finding(s) -> {baseline_path}")
-        return 0
+    report_only: set[str] | None = None
+    if args.changed:
+        changed = changed_files(root)
+        if changed:
+            report_only = changed
+        # empty/None -> full report: a vacuous gate is worse than a slow one
 
-    if report.parse_errors:
-        for e in report.parse_errors:
-            print(f"parse error: {e}", file=sys.stderr)
-        return 2
+    started = time.monotonic()
+    cache_path = root / args.cache
+    cache_key = None
+    doc = None
+    if not args.no_cache and not args.write_baseline:
+        cache_key = tree_state_key(
+            root, paths, {"rules": rules, "baseline": args.baseline}, report_only
+        )
+        try:
+            cached = json.loads(cache_path.read_text(encoding="utf-8"))
+            if cached.get("key") == cache_key:
+                doc = cached["report"]
+        except (OSError, ValueError, KeyError):
+            doc = None
+
+    if doc is None:
+        report = run_analysis(
+            root,
+            paths=args.paths or None,
+            rules=rules,
+            baseline_path=baseline_path,
+            report_only=report_only,
+        )
+
+        if args.write_baseline:
+            write_baseline(baseline_path, report.findings)
+            print(
+                f"baseline written: {len(report.findings)} finding(s) -> {baseline_path}"
+            )
+            return 0
+
+        if report.parse_errors:
+            for e in report.parse_errors:
+                print(f"parse error: {e}", file=sys.stderr)
+            return 2
+
+        doc = report.to_json()
+        doc["elapsed_seconds"] = round(time.monotonic() - started, 3)
+        doc["changed_only"] = report_only is not None
+        if cache_key is not None:
+            try:
+                cache_path.write_text(
+                    json.dumps({"key": cache_key, "report": doc}), encoding="utf-8"
+                )
+            except OSError:
+                pass  # caching is best-effort; never fail the gate over it
+    else:
+        doc = dict(doc, cached=True)
+
+    if args.json_out:
+        Path(args.json_out).write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
 
     if args.json:
-        print(json.dumps(report.to_json(), indent=2))
+        print(json.dumps(doc, indent=2))
     else:
-        for f in report.unbaselined:
-            print(f.render())
-        n_base = len(report.baselined)
+        for f in doc["findings"]:
+            ctx = f" [{f['context']}]" if f.get("context") else ""
+            print(f"{f['path']}:{f['line']}: {f['rule']}{ctx}: {f['message']}")
+        n_base = len(doc.get("baselined", []))
         base_note = f" ({n_base} baselined)" if n_base else ""
+        scope_note = " (changed files only)" if doc.get("changed_only") else ""
+        cache_note = " [cached]" if doc.get("cached") else ""
         print(
-            f"plint: {len(report.unbaselined)} finding(s){base_note} across "
-            f"{report.files_checked} files"
+            f"plint: {len(doc['findings'])} finding(s){base_note} across "
+            f"{doc['files_checked']} files{scope_note}{cache_note}"
         )
-    return 0 if report.clean else 1
+    return 0 if doc["clean"] else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,959 @@
+"""Whole-program symbol table + call graph for plint's interprocedural rules.
+
+PR 4's rules are lexical: they see one file at a time, so a handler that
+calls a helper that calls `storage.list_dirs()` passes `blocking-in-async`,
+and nothing at all observes the *order* locks nest across call chains. This
+module gives rules_interproc.py the project-wide view:
+
+- `Module`    — dotted name, import alias map, module-level lock objects;
+- `ClassInfo` — methods, resolved base classes, and **attribute types**
+  (`self.x = ClassName(...)` / annotated ctor params / `self.x: T`), the key
+  to resolving `self.metastore.get_stream_json(...)` into a real method;
+- `FuncInfo`  — one function/method/nested def, with its outgoing
+  `CallEdge`s (direct calls vs. *deferred* references handed to executors),
+  its directly-blocking call sites, and its lock acquisition sites;
+- `CallGraph` — the index over all of it, plus the interprocedural
+  summaries the rules consume (`blocking_reach`, `acquires_closure`,
+  `raise_escapes`).
+
+Resolution is deliberately conservative: an edge exists only when the
+callee is resolved to a project symbol through names, `self`, annotated
+locals/params, or attribute types. Dynamic dispatch we can't see simply
+produces no edge — rules built on the graph under-approximate, they never
+guess.
+
+Everything here is pure AST walking over `Project.files`; building the
+graph for the whole ~20k LoC package takes well under a second, and the
+result is memoized per `Project` (see `build_call_graph`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from parseable_tpu.analysis.framework import Project, SourceFile, attr_chain
+
+# files that are part of the analyzer itself: never modeled (rule sources
+# are full of pattern fragments that would pollute the graph)
+_SELF_PREFIX = "parseable_tpu/analysis/"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_REENTRANT_CTORS = {"RLock"}
+
+# callables that move their function argument onto another thread/loop hop:
+# a reference passed through these is a *deferred* edge of kind "executor"
+_EXECUTOR_RECEIVERS = re.compile(r"pool|executor|workers", re.IGNORECASE)
+_EXECUTOR_FUNCS = {"run_in_executor", "_run_traced"}
+_THREAD_CTORS = {"Thread", "Timer"}
+
+# blocking primitives (kind tags are stable: rules and tests key on them)
+_BLOCKING_STORAGE_OPS = {
+    "get_object",
+    "put_object",
+    "delete_object",
+    "head",
+    "list_prefix",
+    "list_dirs",
+    "upload_file",
+    "download_file",
+    "delete_prefix",
+    "get_range",
+    "get_objects",
+    "exists",
+}
+
+_LOCK_ID_RE = re.compile(r"lock-id:\s*([A-Za-z_][A-Za-z0-9_.]*)(\s+reentrant)?")
+_LOCK_ORDER_RE = re.compile(
+    r"lock-order:\s*([A-Za-z_][A-Za-z0-9_.]*)\s*<\s*([A-Za-z_][A-Za-z0-9_.]*)"
+)
+
+
+def rel_to_module(rel: str) -> str:
+    """`parseable_tpu/query/provider.py` -> `parseable_tpu.query.provider`."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+@dataclass
+class LockDef:
+    """One lock object: a `self.<attr>` of a class or a module global."""
+
+    lock_id: str  # "Class.attr" or "module_tail._NAME" — what messages show
+    reentrant: bool
+    rel: str
+    line: int
+
+
+@dataclass
+class LockSite:
+    """One `with <lock>:` acquisition inside a function."""
+
+    lock_id: str
+    line: int
+    reentrant: bool
+    held: tuple[str, ...]  # lock ids lexically held at this acquisition
+    same_instance: bool  # receiver is `self.<attr>` (identity-preserving)
+
+
+@dataclass
+class CallEdge:
+    callee: str  # FuncInfo key
+    line: int
+    deferred: bool  # reference handed along, not called here
+    executor: bool  # crosses a thread/loop hop (run_in_executor, pool, Thread)
+    held: tuple[str, ...]  # lock ids lexically held at the call site
+    self_receiver: bool  # call shaped `self.meth(...)` (instance-preserving)
+
+
+@dataclass
+class BlockingSite:
+    kind: str  # "time.sleep" | "storage-op" | "parquet-io" | "urlopen" | "future-result"
+    line: int
+    detail: str  # rendered call, e.g. ".storage.list_dirs()"
+
+
+@dataclass
+class FuncInfo:
+    key: str  # "parseable_tpu.core:Parseable.local_sync"
+    rel: str
+    qualname: str  # "Parseable.local_sync" / "handler.work"
+    name: str
+    line: int
+    is_async: bool
+    cls: str | None  # ClassInfo key of the enclosing class, if a method
+    node: ast.FunctionDef | ast.AsyncFunctionDef = field(repr=False, default=None)
+    edges: list[CallEdge] = field(default_factory=list)
+    blocking: list[BlockingSite] = field(default_factory=list)
+    locks: list[LockSite] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    key: str  # "parseable_tpu.core.Parseable"
+    rel: str
+    name: str
+    line: int
+    bases: list[str] = field(default_factory=list)  # resolved ClassInfo keys
+    methods: dict[str, str] = field(default_factory=dict)  # name -> FuncInfo key
+    attr_types: dict[str, str] = field(default_factory=dict)  # attr -> ClassInfo key
+    lock_attrs: dict[str, LockDef] = field(default_factory=dict)
+
+
+@dataclass
+class Module:
+    rel: str
+    dotted: str
+    imports: dict[str, str] = field(default_factory=dict)  # local name -> dotted target
+    functions: dict[str, str] = field(default_factory=dict)  # top-level name -> key
+    classes: dict[str, str] = field(default_factory=dict)  # name -> ClassInfo key
+    lock_globals: dict[str, LockDef] = field(default_factory=dict)
+
+
+class CallGraph:
+    """Project-wide function index + call edges + derived summaries."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, Module] = {}  # dotted -> Module
+        self.classes: dict[str, ClassInfo] = {}  # key -> ClassInfo
+        self.funcs: dict[str, FuncInfo] = {}  # key -> FuncInfo
+        # `# lock-order: A < B` declarations: (A, B, rel, line)
+        self.declared_order: list[tuple[str, str, str, int]] = []
+
+    # ------------------------------------------------------------- lookups
+
+    def methods_named(self, name: str) -> list[FuncInfo]:
+        return [f for f in self.funcs.values() if f.name == name]
+
+    def resolve_method(self, cls_key: str, name: str) -> str | None:
+        """Look `name` up on the class, then its project base classes."""
+        seen: set[str] = set()
+        stack = [cls_key]
+        while stack:
+            k = stack.pop(0)
+            if k in seen:
+                continue
+            seen.add(k)
+            ci = self.classes.get(k)
+            if ci is None:
+                continue
+            if name in ci.methods:
+                return ci.methods[name]
+            stack.extend(ci.bases)
+        return None
+
+    def class_lock(self, cls_key: str, attr: str) -> LockDef | None:
+        seen: set[str] = set()
+        stack = [cls_key]
+        while stack:
+            k = stack.pop(0)
+            if k in seen:
+                continue
+            seen.add(k)
+            ci = self.classes.get(k)
+            if ci is None:
+                continue
+            if attr in ci.lock_attrs:
+                return ci.lock_attrs[attr]
+            stack.extend(ci.bases)
+        return None
+
+    # ------------------------------------------- interprocedural summaries
+
+    def blocking_reach(self) -> dict[str, tuple[BlockingSite, tuple[str, ...]]]:
+        """For every function: the first blocking primitive reachable through
+        DIRECT (non-deferred) call edges, with the call chain that reaches it
+        (tuple of function keys, excluding the starting function). Fixpoint
+        over the graph; deferred/executor edges never propagate blockage —
+        that is precisely the `run_in_executor` absolution."""
+        reach: dict[str, tuple[BlockingSite, tuple[str, ...]]] = {}
+        for key, fn in self.funcs.items():
+            if fn.blocking:
+                site = min(fn.blocking, key=lambda s: s.line)
+                reach[key] = (site, ())
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.funcs.items():
+                best = reach.get(key)
+                if best is not None and not best[1]:
+                    continue  # already directly blocking: no shorter chain
+                for e in sorted(fn.edges, key=lambda e: e.line):
+                    if e.deferred or e.executor:
+                        continue
+                    sub = reach.get(e.callee)
+                    if sub is None or e.callee == key:
+                        continue
+                    chain = (e.callee, *sub[1])
+                    if key in chain:
+                        continue  # recursion guard
+                    if best is None or len(chain) < len(best[1]):
+                        best = (sub[0], chain)
+                        reach[key] = best
+                        changed = True
+        return reach
+
+    def acquires_closure(self) -> dict[str, dict[str, tuple[str, ...]]]:
+        """For every function: {lock_id -> call chain (possibly empty) that
+        acquires it}, through direct non-deferred edges."""
+        acq: dict[str, dict[str, tuple[str, ...]]] = {}
+        for key, fn in self.funcs.items():
+            acq[key] = {s.lock_id: () for s in fn.locks}
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.funcs.items():
+                mine = acq[key]
+                for e in fn.edges:
+                    if e.deferred or e.executor or e.callee == key:
+                        continue
+                    for lock, chain in acq.get(e.callee, {}).items():
+                        if lock in mine:
+                            continue
+                        new_chain = (e.callee, *chain)
+                        if key in new_chain:
+                            continue
+                        mine[lock] = new_chain
+                        changed = True
+        return acq
+
+    def raise_escapes(self) -> dict[str, tuple[int, tuple[str, ...]]]:
+        """For every function: (line, chain) of a `raise` that escapes it —
+        not enclosed in a broad `except` within the raising function, and
+        not absorbed by a broad `except` wrapping the call site on the way
+        up. Direct edges only (a deferred callee's raises are the *worker's*
+        problem — which is exactly what escaping-exception-in-worker asks)."""
+        escapes: dict[str, tuple[int, tuple[str, ...]]] = {}
+        for key, fn in self.funcs.items():
+            line = _local_escaping_raise(fn.node)
+            if line is not None:
+                escapes[key] = (line, ())
+        guarded = {
+            key: _broadly_guarded_call_lines(fn.node) for key, fn in self.funcs.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.funcs.items():
+                if key in escapes and not escapes[key][1]:
+                    continue
+                for e in sorted(fn.edges, key=lambda e: e.line):
+                    if e.deferred or e.executor or e.callee == key:
+                        continue
+                    sub = escapes.get(e.callee)
+                    if sub is None or e.line in guarded[key]:
+                        continue
+                    chain = (e.callee, *sub[1])
+                    if key in chain:
+                        continue
+                    cur = escapes.get(key)
+                    if cur is None or len(chain) < len(cur[1]):
+                        escapes[key] = (sub[0], chain)
+                        changed = True
+        return escapes
+
+
+# ---------------------------------------------------------------------------
+# local AST analyses shared with the builder
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        chain = attr_chain(t)
+        if chain and chain[-1] in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _own_statements(fn: ast.AST):
+    """Yield the statements of `fn` (nested def/class statements included)
+    WITHOUT descending into their bodies — those are separate graph nodes."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        stmt = stack.pop(0)
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                stack.append(child)
+
+
+def _local_escaping_raise(fn: ast.AST) -> int | None:
+    """Line of the first `raise` in fn's own body not covered by a broad
+    except of a `try` *in the same function*. A raise inside an except
+    handler's body escapes (nothing above it in this try catches it)."""
+    if fn is None:
+        return None
+    hits: list[int] = []
+
+    def walk_stmt(stmt: ast.stmt, protected: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Raise):
+            if not protected:
+                hits.append(stmt.lineno)
+            return
+        if isinstance(stmt, ast.Try):
+            broad = any(_is_broad_handler(h) for h in stmt.handlers)
+            for b in stmt.body:
+                walk_stmt(b, protected or broad)
+            for h in stmt.handlers:
+                for b in h.body:
+                    walk_stmt(b, protected)
+            for b in stmt.orelse:
+                walk_stmt(b, protected or broad)
+            for b in stmt.finalbody:
+                walk_stmt(b, protected)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                if isinstance(child, ast.ExceptHandler):
+                    for b in child.body:
+                        walk_stmt(b, protected)
+                else:
+                    walk_stmt(child, protected)
+
+    for stmt in fn.body:
+        walk_stmt(stmt, False)
+    return min(hits) if hits else None
+
+
+def _broadly_guarded_call_lines(fn: ast.AST) -> set[int]:
+    """Lines inside `try:` bodies whose handlers include a broad except —
+    calls there cannot let a callee's raise escape this function."""
+    out: set[int] = set()
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try) and any(
+            _is_broad_handler(h) for h in node.handlers
+        ):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if hasattr(sub, "lineno"):
+                        out.add(sub.lineno)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# builder
+
+
+class _Builder:
+    def __init__(self, project: Project):
+        self.project = project
+        self.g = CallGraph()
+
+    # ----- pass 1: symbols
+
+    def build(self) -> CallGraph:
+        files = [
+            sf for sf in self.project.files if not sf.rel.startswith(_SELF_PREFIX)
+        ]
+        for sf in files:
+            self._collect_module(sf)
+        for sf in files:
+            self._link_classes(sf)
+        for sf in files:
+            self._collect_attr_types(sf)
+        for sf in files:
+            self._collect_edges(sf)
+        return self.g
+
+    def _collect_module(self, sf: SourceFile) -> None:
+        dotted = rel_to_module(sf.rel)
+        mod = Module(rel=sf.rel, dotted=dotted)
+        self.g.modules[dotted] = mod
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        mod.imports[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        mod.imports[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg_parts = dotted.split(".")
+                    # level 1 inside a module: the containing package
+                    pkg_parts = pkg_parts[: len(pkg_parts) - node.level]
+                    base = ".".join(pkg_parts + ([node.module] if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mod.imports[a.asname or a.name] = f"{base}.{a.name}" if base else a.name
+        # module-level defs, classes, lock globals; comment-driven order decls
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(sf, mod, node, qual=node.name, cls=None)
+                self._add_nested(sf, mod, node, prefix=node.name, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                ckey = f"{dotted}.{node.name}"
+                ci = ClassInfo(key=ckey, rel=sf.rel, name=node.name, line=node.lineno)
+                self.g.classes[ckey] = ci
+                mod.classes[node.name] = ckey
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fkey = self._add_func(
+                            sf, mod, item, qual=f"{node.name}.{item.name}", cls=ckey
+                        )
+                        ci.methods[item.name] = fkey
+                        self._add_nested(
+                            sf, mod, item, prefix=f"{node.name}.{item.name}", cls=ckey
+                        )
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                chain = attr_chain(node.value.func)
+                if chain and chain[-1] in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            tail = dotted.rsplit(".", 1)[-1]
+                            mod.lock_globals[t.id] = LockDef(
+                                lock_id=f"{tail}.{t.id}",
+                                reentrant=chain[-1] in _REENTRANT_CTORS,
+                                rel=sf.rel,
+                                line=node.lineno,
+                            )
+        for line, comment in sf.comments.items():
+            m = _LOCK_ORDER_RE.search(comment)
+            if m:
+                self.g.declared_order.append((m.group(1), m.group(2), sf.rel, line))
+
+    def _add_func(self, sf, mod: Module, node, qual: str, cls: str | None) -> str:
+        key = f"{mod.dotted}:{qual}"
+        self.g.funcs[key] = FuncInfo(
+            key=key,
+            rel=sf.rel,
+            qualname=qual,
+            name=node.name,
+            line=node.lineno,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            cls=cls,
+            node=node,
+        )
+        if cls is None and "." not in qual:
+            mod.functions[node.name] = key
+        return key
+
+    def _add_nested(self, sf, mod: Module, fn, prefix: str, cls: str | None) -> None:
+        for stmt in _own_statements(fn):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(sf, mod, stmt, qual=f"{prefix}.{stmt.name}", cls=cls)
+                self._add_nested(sf, mod, stmt, prefix=f"{prefix}.{stmt.name}", cls=cls)
+
+    # ----- pass 2: base-class links
+
+    def _link_classes(self, sf: SourceFile) -> None:
+        mod = self.g.modules[rel_to_module(sf.rel)]
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = self.g.classes[mod.classes[node.name]]
+            for b in node.bases:
+                ck = self._resolve_class_expr(mod, b)
+                if ck is not None:
+                    ci.bases.append(ck)
+
+    def _resolve_class_expr(self, mod: Module, expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            # string annotation: "ServerState" / "parseable_tpu.core.Parseable"
+            name = expr.value.strip().strip("'\"")
+            return self._resolve_class_name(mod, name.split("."))
+        chain = attr_chain(expr)
+        if not chain:
+            # Optional[T] / T | None: try the subscript value / left side
+            if isinstance(expr, ast.Subscript):
+                inner = expr.slice
+                if isinstance(inner, ast.Tuple) and inner.elts:
+                    inner = inner.elts[0]
+                return self._resolve_class_expr(mod, inner)
+            if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.BitOr):
+                return self._resolve_class_expr(mod, expr.left)
+            return None
+        return self._resolve_class_name(mod, chain)
+
+    def _resolve_class_name(self, mod: Module, chain: list[str]) -> str | None:
+        head, rest = chain[0], chain[1:]
+        if not rest:
+            if head in mod.classes:
+                return mod.classes[head]
+            target = mod.imports.get(head)
+            if target is not None and target in self.g.classes:
+                return target
+            return None
+        # module.Class (or deeper package path)
+        target = mod.imports.get(head)
+        if target is None:
+            return None
+        cand = f"{target}.{'.'.join(rest)}"
+        if cand in self.g.classes:
+            return cand
+        # `from parseable_tpu import storage` then storage.ObjectStorage
+        m = self.g.modules.get(target)
+        if m is not None and rest[0] in m.classes and len(rest) == 1:
+            return m.classes[rest[0]]
+        return None
+
+    # ----- pass 3: attribute types + lock attrs
+
+    def _collect_attr_types(self, sf: SourceFile) -> None:
+        mod = self.g.modules[rel_to_module(sf.rel)]
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = self.g.classes[mod.classes[node.name]]
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                params = self._param_types(mod, item)
+                for stmt in _own_statements(item):
+                    tgt = None
+                    val = None
+                    ann = None
+                    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                        tgt, val = stmt.targets[0], stmt.value
+                    elif isinstance(stmt, ast.AnnAssign):
+                        tgt, val, ann = stmt.target, stmt.value, stmt.annotation
+                    if tgt is None or not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    attr = tgt.attr
+                    # lock attribute?
+                    if isinstance(val, ast.Call):
+                        chain = attr_chain(val.func)
+                        if chain and chain[-1] in _LOCK_CTORS:
+                            ci.lock_attrs.setdefault(
+                                attr,
+                                LockDef(
+                                    lock_id=f"{ci.name}.{attr}",
+                                    reentrant=chain[-1] in _REENTRANT_CTORS,
+                                    rel=sf.rel,
+                                    line=stmt.lineno,
+                                ),
+                            )
+                            continue
+                    ck = None
+                    if ann is not None:
+                        ck = self._resolve_class_expr(mod, ann)
+                    if ck is None and isinstance(val, ast.Call):
+                        ck = self._resolve_class_expr(mod, val.func)
+                    if ck is None and isinstance(val, ast.Name):
+                        ck = params.get(val.id)
+                    if ck is not None:
+                        ci.attr_types.setdefault(attr, ck)
+
+    def _param_types(self, mod: Module, fn) -> dict[str, str]:
+        out: dict[str, str] = {}
+        args = list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs)
+        for a in args:
+            if a.annotation is not None:
+                ck = self._resolve_class_expr(mod, a.annotation)
+                if ck is not None:
+                    out[a.arg] = ck
+        return out
+
+    # ----- pass 4: edges, blocking sites, lock sites
+
+    def _collect_edges(self, sf: SourceFile) -> None:
+        mod = self.g.modules[rel_to_module(sf.rel)]
+        for fn in self.g.funcs.values():
+            if fn.rel != sf.rel or fn.node is None:
+                continue
+            _FuncScanner(self, sf, mod, fn).scan()
+
+
+class _FuncScanner:
+    """Walk one function's own body: local var types, call edges with the
+    lexically-held lock set, blocking primitives, lock acquisitions."""
+
+    def __init__(self, b: _Builder, sf: SourceFile, mod: Module, fn: FuncInfo):
+        self.b = b
+        self.g = b.g
+        self.sf = sf
+        self.mod = mod
+        self.fn = fn
+        self.locals: dict[str, str] = b._param_types(mod, fn.node)
+        if fn.cls is not None:
+            self.locals.setdefault("self", fn.cls)
+            self.locals.setdefault("cls", fn.cls)
+        # local names of defs nested directly in this function
+        self.local_defs: dict[str, str] = {}
+        for stmt in _own_statements(fn.node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_defs[stmt.name] = f"{mod.dotted}:{fn.qualname}.{stmt.name}"
+        # submit-future locals: names assigned from `<pool>.submit(...)`
+        self.future_names: set[str] = set()
+
+    # -- type resolution ---------------------------------------------------
+
+    def _resolve_chain_type(self, chain: list[str]) -> str | None:
+        """Type (ClassInfo key) of `a.b.c` — resolving the base through
+        locals/imports and each attribute through attr_types."""
+        if not chain:
+            return None
+        head, rest = chain[0], chain[1:]
+        cur: str | None = self.locals.get(head)
+        if cur is None:
+            target = self.mod.imports.get(head)
+            if target is not None:
+                if target in self.g.classes and not rest:
+                    return target
+                # walk module attributes: module.Class / package.module.Class
+                cur_mod = target
+                while rest:
+                    nxt = f"{cur_mod}.{rest[0]}"
+                    if nxt in self.g.classes:
+                        cur = nxt
+                        rest = rest[1:]
+                        break
+                    if nxt in self.g.modules:
+                        cur_mod = nxt
+                        rest = rest[1:]
+                        continue
+                    return None
+                if cur is None:
+                    return None
+            elif head in self.mod.classes and not rest:
+                return self.mod.classes[head]
+            else:
+                return None
+        for attr in rest:
+            ci = self.g.classes.get(cur)
+            if ci is None:
+                return None
+            nxt = None
+            seen: set[str] = set()
+            stack = [cur]
+            while stack:
+                k = stack.pop(0)
+                if k in seen:
+                    continue
+                seen.add(k)
+                c = self.g.classes.get(k)
+                if c is None:
+                    continue
+                if attr in c.attr_types:
+                    nxt = c.attr_types[attr]
+                    break
+                stack.extend(c.bases)
+            if nxt is None:
+                return None
+            cur = nxt
+        return cur
+
+    def _resolve_callee(self, func: ast.expr) -> tuple[str | None, bool]:
+        """Resolve a call's target to a FuncInfo key. Returns
+        (key, self_receiver)."""
+        chain = attr_chain(func)
+        if not chain:
+            return None, False
+        if len(chain) == 1:
+            name = chain[0]
+            if name in self.local_defs:
+                return self.local_defs[name], False
+            if name in self.mod.functions:
+                return self.mod.functions[name], False
+            target = self.mod.imports.get(name)
+            if target is not None:
+                mod_name, _, tail = target.rpartition(".")
+                m = self.g.modules.get(mod_name)
+                if m is not None and tail in m.functions:
+                    return m.functions[tail], False
+                if target in self.g.classes:
+                    init = self.g.resolve_method(target, "__init__")
+                    return init, False
+            if name in self.mod.classes:
+                return self.g.resolve_method(self.mod.classes[name], "__init__"), False
+            return None, False
+        *base, meth = chain
+        # Class.method / module.func / module.Class(...)
+        base_type = self._resolve_chain_type(base)
+        if base_type is not None:
+            key = self.g.resolve_method(base_type, meth)
+            return key, base == ["self"]
+        # module function through imports: telemetry.propagate etc.
+        target = self.mod.imports.get(base[0])
+        if target is not None:
+            cur = target
+            for part in base[1:]:
+                cur = f"{cur}.{part}"
+            m = self.g.modules.get(cur)
+            if m is not None:
+                if meth in m.functions:
+                    return m.functions[meth], False
+                if meth in m.classes:
+                    return self.g.resolve_method(m.classes[meth], "__init__"), False
+            if cur in self.g.classes:  # module.Class.method (unbound)
+                return self.g.resolve_method(cur, meth), False
+        return None, False
+
+    # -- the walk ----------------------------------------------------------
+
+    def scan(self) -> None:
+        for stmt in self.fn.node.body:
+            self._stmt(stmt, held=())
+
+    def _with_lock(self, item: ast.withitem) -> tuple[LockDef | None, bool]:
+        """Resolve one with-item to a lock. Returns (lockdef, same_instance).
+        Comment annotation `# lock-id: Name [reentrant]` on the with line
+        wins (dynamic acquisitions like `with self.stream_json_lock(n):`)."""
+        expr = item.context_expr
+        comment = self.sf.comments.get(expr.lineno, "")
+        m = _LOCK_ID_RE.search(comment)
+        if m:
+            return (
+                LockDef(
+                    lock_id=m.group(1),
+                    reentrant=bool(m.group(2)),
+                    rel=self.sf.rel,
+                    line=expr.lineno,
+                ),
+                False,
+            )
+        chain = attr_chain(expr)
+        if not chain:
+            return None, False
+        if len(chain) == 1:
+            ld = self.mod.lock_globals.get(chain[0])
+            if ld is None:
+                target = self.mod.imports.get(chain[0])
+                if target is not None:
+                    mod_name, _, tail = target.rpartition(".")
+                    m2 = self.g.modules.get(mod_name)
+                    if m2 is not None:
+                        ld = m2.lock_globals.get(tail)
+            return ld, ld is not None
+        *base, attr = chain
+        base_type = self._resolve_chain_type(base)
+        if base_type is not None:
+            ld = self.g.class_lock(base_type, attr)
+            if ld is not None:
+                return ld, base == ["self"]
+        # module-global through import: `with othermod._LOCK:`
+        target = self.mod.imports.get(base[0])
+        if target is not None and len(base) == 1:
+            m2 = self.g.modules.get(target)
+            if m2 is not None:
+                ld = m2.lock_globals.get(attr)
+                if ld is not None:
+                    return ld, True
+        return None, False
+
+    def _stmt(self, stmt: ast.stmt, held: tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate node
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+                ld, same = self._with_lock(item)
+                if ld is not None:
+                    self.fn.locks.append(
+                        LockSite(
+                            lock_id=ld.lock_id,
+                            line=item.context_expr.lineno,
+                            reentrant=ld.reentrant,
+                            held=inner,
+                            same_instance=same,
+                        )
+                    )
+                    inner = inner + (ld.lock_id,)
+            for s in stmt.body:
+                self._stmt(s, inner)
+            return
+        # local type tracking on plain assignments
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                if isinstance(stmt.value, ast.Call):
+                    ck = self.b._resolve_class_expr(self.mod, stmt.value.func)
+                    if ck is not None:
+                        self.locals[t.id] = ck
+                    fchain = attr_chain(stmt.value.func)
+                    if fchain and fchain[-1] == "submit":
+                        self.future_names.add(t.id)
+                elif isinstance(stmt.value, ast.Name):
+                    if stmt.value.id in self.locals:
+                        self.locals[t.id] = self.locals[stmt.value.id]
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            ck = self.b._resolve_class_expr(self.mod, stmt.annotation)
+            if ck is not None:
+                self.locals[stmt.target.id] = ck
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                self._stmt(child, held)
+            elif isinstance(child, ast.withitem):  # pragma: no cover - handled above
+                self._expr(child.context_expr, held)
+
+    def _expr(self, expr: ast.expr, held: tuple[str, ...]) -> None:
+        # walk EVERY node under the expression (comprehension generators and
+        # keyword arguments are not ast.expr but contain calls) — only
+        # lambdas are skipped: their bodies run in a separate context
+        stack: list[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue  # separate execution context; not modeled as a node
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+            for child in ast.iter_child_nodes(node):
+                stack.append(child)
+
+    def _call(self, call: ast.Call, held: tuple[str, ...]) -> None:
+        chain = attr_chain(call.func)
+        self._record_blocking(call, chain)
+        key, self_recv = self._resolve_callee(call.func)
+        if key is not None:
+            self.fn.edges.append(
+                CallEdge(
+                    callee=key,
+                    line=call.lineno,
+                    deferred=False,
+                    executor=False,
+                    held=held,
+                    self_receiver=self_recv,
+                )
+            )
+        # references handed as arguments -> deferred edges
+        executor = self._is_executor_call(chain)
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            self._ref_edges(arg, call.lineno, held, executor)
+
+    def _is_executor_call(self, chain: list[str]) -> bool:
+        if not chain:
+            return False
+        tail = chain[-1]
+        if tail in _EXECUTOR_FUNCS:
+            return True
+        if tail in _THREAD_CTORS:
+            return True
+        if tail in ("submit", "map") and len(chain) >= 2:
+            recv = chain[-2]
+            return bool(_EXECUTOR_RECEIVERS.search(recv)) or recv in (
+                "uploader",
+                "enrichment",
+            )
+        return False
+
+    def _ref_edges(
+        self, arg: ast.expr, line: int, held: tuple[str, ...], executor: bool
+    ) -> None:
+        """A bare function reference inside an argument becomes a deferred
+        edge (executor=True when the receiving call moves it cross-thread).
+        Wrapper calls like telemetry.propagate(fn) are looked through."""
+        if isinstance(arg, ast.Call):
+            for a in list(arg.args) + [kw.value for kw in arg.keywords]:
+                self._ref_edges(a, line, held, executor)
+            return
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            key, self_recv = self._resolve_callee(arg)
+            if key is not None and key in self.g.funcs:
+                self.fn.edges.append(
+                    CallEdge(
+                        callee=key,
+                        line=line,
+                        deferred=True,
+                        executor=executor,
+                        held=held,
+                        self_receiver=self_recv,
+                    )
+                )
+
+    def _record_blocking(self, call: ast.Call, chain: list[str]) -> None:
+        line = call.lineno
+        add = self.fn.blocking.append
+        if chain == ["time", "sleep"]:
+            add(BlockingSite("time.sleep", line, "time.sleep(...)"))
+            return
+        if chain:
+            tail = chain[-1]
+            if (
+                len(chain) >= 2
+                and "storage" in chain[:-1]
+                and tail in _BLOCKING_STORAGE_OPS
+            ):
+                add(BlockingSite("storage-op", line, f".storage.{tail}()"))
+                return
+            if chain[0] in ("pq", "parquet") and tail in (
+                "read_table",
+                "write_table",
+                "ParquetFile",
+                "read_metadata",
+            ):
+                add(BlockingSite("parquet-io", line, f"pq.{tail}(...)"))
+                return
+            if tail == "urlopen":
+                add(BlockingSite("urlopen", line, "urllib.request.urlopen(...)"))
+                return
+            if tail == "result":
+                # fut.result() on a known pool future, or chained
+                # `<pool>.submit(...).result()`
+                recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+                if isinstance(recv, ast.Name) and recv.id in self.future_names:
+                    add(BlockingSite("future-result", line, f"{recv.id}.result()"))
+                elif isinstance(recv, ast.Call):
+                    rchain = attr_chain(recv.func)
+                    if rchain and rchain[-1] == "submit":
+                        add(BlockingSite("future-result", line, ".submit(...).result()"))
+
+
+def build_call_graph(project: Project) -> CallGraph:
+    """Build (or fetch the memoized) whole-program call graph."""
+    cached = getattr(project, "_callgraph", None)
+    if cached is not None:
+        return cached
+    g = _Builder(project).build()
+    project._callgraph = g
+    return g

@@ -35,11 +35,33 @@ import io
 import json
 import re
 import tokenize
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from pathlib import Path
 from typing import Iterable, Iterator
 
 _SUPPRESS_RE = re.compile(r"plint:\s*disable(?:=([A-Za-z0-9_,-]+))?")
+
+
+_WS_RUN_RE = re.compile(r"\s+")
+
+
+def normalize_snippet(line: str) -> str:
+    """Canonical form of a flagged source line: trailing comment stripped
+    (rough token-free heuristic: a `#` not inside quotes), whitespace runs
+    collapsed. Renames of the *enclosing* function never touch it; edits to
+    the flagged line itself do — which is exactly when a human should
+    re-triage the finding anyway."""
+    out = []
+    quote: str | None = None
+    for ch in line:
+        if quote is None and ch == "#":
+            break
+        if quote is None and ch in "'\"":
+            quote = ch
+        elif quote is not None and ch == quote:
+            quote = None
+        out.append(ch)
+    return _WS_RUN_RE.sub(" ", "".join(out)).strip()
 
 
 @dataclass(frozen=True)
@@ -50,12 +72,26 @@ class Finding:
     path: str  # analysis-root-relative posix path
     line: int
     message: str
-    context: str = ""  # enclosing scope (Class.method) — stable across edits
+    context: str = ""  # enclosing scope (Class.method) — display only
+    snippet: str = ""  # normalized source line — part of the identity
 
     @property
     def fingerprint(self) -> str:
-        """Line-number-free identity: a finding keeps its baseline entry
-        when unrelated code above it moves it down a few lines."""
+        """Identity = (rule, path, normalized snippet). Line numbers are
+        out (unrelated edits above must not unbaseline), and so are the
+        enclosing scope and the message (renaming a function used to shift
+        every fingerprint inside it even when the finding itself was
+        untouched). Two identical flagged lines in one file share a
+        fingerprint — one baseline entry acknowledges both, the same
+        tradeoff clang-tidy/NOLINT files make."""
+        raw = f"{self.rule}|{self.path}|{self.snippet}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    @property
+    def legacy_fingerprint(self) -> str:
+        """Pre-v2 identity (rule, path, context, message) — still honored
+        when matching baselines so existing baseline files migrate without
+        a flag day."""
         raw = f"{self.rule}|{self.path}|{self.context}|{self.message}"
         return hashlib.sha1(raw.encode()).hexdigest()[:16]
 
@@ -65,6 +101,7 @@ class Finding:
             "path": self.path,
             "line": self.line,
             "context": self.context,
+            "snippet": self.snippet,
             "message": self.message,
             "fingerprint": self.fingerprint,
         }
@@ -80,6 +117,7 @@ class SourceFile:
     def __init__(self, rel: str, text: str):
         self.rel = rel.replace("\\", "/")
         self.text = text
+        self.lines = text.splitlines()
         self.tree = ast.parse(text)
         # line -> comment text (leading '#' stripped); one comment per line
         self.comments: dict[int, str] = {}
@@ -117,6 +155,11 @@ class SourceFile:
             return False
         names = self.suppressions[line]
         return names is None or rule in names
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return normalize_snippet(self.lines[line - 1])
+        return ""
 
 
 @dataclass
@@ -252,6 +295,7 @@ def write_baseline(path: Path, findings: list[Finding]) -> None:
                 "rule": f.rule,
                 "path": f.path,
                 "context": f.context,
+                "snippet": f.snippet,
                 "message": f.message,
             }
             for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
@@ -265,8 +309,14 @@ def run_analysis(
     paths: list[str] | None = None,
     rules: list[Rule] | None = None,
     baseline_path: Path | None = None,
+    report_only: set[str] | None = None,
 ) -> AnalysisReport:
-    """Analyze `paths` (default: the parseable_tpu package) under `root`."""
+    """Analyze `paths` (default: the parseable_tpu package) under `root`.
+
+    `report_only` (used by the CLI's --changed mode) restricts *reporting*
+    to findings in those rel paths while still parsing and analyzing the
+    whole tree — the interprocedural rules need the full call graph even
+    when only one file changed."""
     from parseable_tpu.analysis.rules import DEFAULT_RULES
 
     root = Path(root)
@@ -280,6 +330,14 @@ def run_analysis(
         except (SyntaxError, UnicodeDecodeError) as e:
             parse_errors.append(f"{p}: {e}")
 
+    by_rel = {sf.rel: sf for sf in project.files}
+
+    def finish(f: Finding) -> Finding:
+        if f.snippet:
+            return f
+        sf = by_rel.get(f.path)
+        return replace(f, snippet=sf.snippet(f.line)) if sf is not None else f
+
     findings: list[Finding] = []
     for sf in project.files:
         # the analyzer does not lint itself: rule sources are full of
@@ -291,19 +349,28 @@ def run_analysis(
                 continue
             for f in rule.check(sf):
                 if not sf.is_suppressed(f.rule, f.line):
-                    findings.append(f)
-    by_rel = {sf.rel: sf for sf in project.files}
+                    findings.append(finish(f))
     for rule in rules:
         for f in rule.finalize(project):
             sf = by_rel.get(f.path)
             if sf is not None and sf.is_suppressed(f.rule, f.line):
                 continue
-            findings.append(f)
+            findings.append(finish(f))
 
+    if report_only is not None:
+        findings = [f for f in findings if f.path in report_only]
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     baseline = load_baseline(baseline_path)
-    baselined = [f for f in findings if f.fingerprint in baseline]
-    unbaselined = [f for f in findings if f.fingerprint not in baseline]
+    baselined = [
+        f
+        for f in findings
+        if f.fingerprint in baseline or f.legacy_fingerprint in baseline
+    ]
+    unbaselined = [
+        f
+        for f in findings
+        if f.fingerprint not in baseline and f.legacy_fingerprint not in baseline
+    ]
     return AnalysisReport(
         findings=findings,
         baselined=baselined,

@@ -627,6 +627,14 @@ class BlockingInAsyncRule(Rule):
         return None
 
 
+from parseable_tpu.analysis.rules_interproc import (  # noqa: E402
+    INTERPROC_RULES,
+    EscapingExceptionRule,
+    LockOrderRule,
+    ResourceLeakRule,
+    TransitiveBlockingRule,
+)
+
 DEFAULT_RULES = [
     LockDisciplineRule,
     PoolLifecycleRule,
@@ -634,4 +642,5 @@ DEFAULT_RULES = [
     SilentSwallowRule,
     ConfigDriftRule,
     BlockingInAsyncRule,
+    *INTERPROC_RULES,
 ]

@@ -1,0 +1,700 @@
+"""plint interprocedural rules: whole-program checks over the call graph.
+
+Where rules.py sees one file at a time, these four see the project through
+`callgraph.build_call_graph` — the lockdep/RacerX half of plint:
+
+- transitive-blocking-in-async  blocking work reachable from an async
+                                handler through ANY call chain
+- lock-order                    cycles in the lock-acquisition graph and
+                                double-acquisition of non-reentrant locks
+- resource-leak                 file/parquet/socket handles that can escape
+                                a function unclosed
+- escaping-exception-in-worker  pool workers whose raises nobody observes
+
+All four run in `finalize()`/`check()` off the same memoized graph, so the
+whole-program pass costs one graph build regardless of rule count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from parseable_tpu.analysis.callgraph import (
+    CallGraph,
+    FuncInfo,
+    build_call_graph,
+)
+from parseable_tpu.analysis.framework import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    attr_chain,
+    enclosing_context,
+)
+
+_SERVER_PREFIX = "parseable_tpu/server/"
+
+# modules whose functions the write/scan/server paths own; resource-leak
+# stays scoped here (the ISSUE's fix surface) to keep the rule's backlog
+# fixable in one PR rather than linting the whole world at once
+_LEAK_SCOPE_PREFIXES = (
+    "parseable_tpu/server/",
+    "parseable_tpu/query/",
+    "parseable_tpu/ops/",
+    "parseable_tpu/storage/",
+    "parseable_tpu/staging/",
+)
+_LEAK_SCOPE_FILES = ("parseable_tpu/core.py", "parseable_tpu/streams.py")
+
+_POOL_RECEIVER_RE = re.compile(r"pool|executor|workers", re.IGNORECASE)
+
+
+def _chain_str(g: CallGraph, start: str, chain: tuple[str, ...]) -> str:
+    names = [g.funcs[k].qualname if k in g.funcs else k for k in (start, *chain)]
+    return " -> ".join(names)
+
+
+# ---------------------------------------------------------------------------
+# 7. transitive-blocking-in-async
+
+
+class TransitiveBlockingRule(Rule):
+    """Blocking work must not be *reachable* from an async server handler.
+
+    Why: `blocking-in-async` only sees a handler's own body. A handler that
+    calls a helper that calls `self.storage.list_dirs()` — or
+    `metastore.put_document()`, `pq.read_table()`, `pool.submit(...).result()`,
+    `urllib.request.urlopen()` — stalls the event loop exactly the same,
+    three frames deeper. This rule walks the project call graph from every
+    `async def` in `parseable_tpu/server/` and flags any path that reaches a
+    blocking primitive without crossing an executor hop.
+
+    Fix patterns:
+    - wrap the sync call chain: `await _run_traced(state, fn, *args)` (the
+      context-propagating run_in_executor helper in server/app.py), or
+      `await asyncio.get_running_loop().run_in_executor(None, work)`;
+    - a nested sync `def work(): ...` handed to run_in_executor is the
+      canonical shape — the rule treats executor hops as absolution;
+    - truly non-blocking helpers that trip the storage heuristic can be
+      suppressed per line: `# plint: disable=transitive-blocking-in-async`.
+
+    The rule reports the shortest offending chain (handler -> helper -> ...
+    -> primitive) so the fix site is obvious. Direct (depth-0) time.sleep /
+    storage calls stay with the lexical rule; depth-0 findings here cover
+    the primitives it does not know (parquet IO, urlopen, Future.result)."""
+
+    name = "transitive-blocking-in-async"
+    description = "blocking call reachable from async handler via call graph"
+    rationale = (
+        "one synchronous storage round trip anywhere under an async handler "
+        "head-of-line blocks every in-flight request; call-depth is no excuse"
+    )
+
+    # primitives the lexical blocking-in-async rule already reports at depth 0
+    _LEXICAL_KINDS = {"time.sleep", "storage-op"}
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        g = build_call_graph(project)
+        reach = g.blocking_reach()
+        for fn in sorted(g.funcs.values(), key=lambda f: (f.rel, f.line)):
+            if not fn.is_async or not fn.rel.startswith(_SERVER_PREFIX):
+                continue
+            # depth 0: primitives the lexical rule does not cover
+            for site in sorted(fn.blocking, key=lambda s: s.line):
+                if site.kind in self._LEXICAL_KINDS:
+                    continue
+                yield Finding(
+                    rule=self.name,
+                    path=fn.rel,
+                    line=site.line,
+                    context=fn.qualname,
+                    message=(
+                        f"blocking {site.kind} ({site.detail}) on the event "
+                        "loop: move it behind run_in_executor/_run_traced"
+                    ),
+                )
+            seen: set[str] = set()
+            for e in sorted(fn.edges, key=lambda e: e.line):
+                if e.deferred or e.executor or e.callee in seen:
+                    continue
+                callee = g.funcs.get(e.callee)
+                if callee is None or callee.is_async:
+                    continue  # async callees report at their own def
+                sub = reach.get(e.callee)
+                if sub is None:
+                    continue
+                seen.add(e.callee)
+                site, chain = sub
+                yield Finding(
+                    rule=self.name,
+                    path=fn.rel,
+                    line=e.line,
+                    context=fn.qualname,
+                    message=(
+                        f"blocking {site.kind} ({site.detail}) reachable from "
+                        f"async handler via {_chain_str(g, e.callee, chain)}: "
+                        "hop through run_in_executor (_run_traced) first"
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# 8. lock-order
+
+
+class LockOrderRule(Rule):
+    """The project-wide lock-acquisition graph must stay cycle-free.
+
+    Why: the sync pool, upload pool, scan pool, enrichment worker, and HTTP
+    handlers all take locks; once two threads can take two locks in opposite
+    orders, a deadlock is a scheduler coin-flip away (the lockdep/RacerX
+    model: detect the *possibility* statically, not the event). The rule
+    builds edges A -> B for every site that acquires B while holding A —
+    lexically nested `with` blocks AND acquisitions reached through the call
+    graph — and flags (1) cycles, (2) acquisitions that contradict a
+    declared order, (3) double-acquisition of a non-reentrant
+    `threading.Lock` on one path (instant self-deadlock).
+
+    Lock identity is class-level (`Stream.lock`, `EncodedBlockCache._lock`,
+    module globals as `module._LOCK`), the standard lockdep approximation:
+    two instances of the same class nesting the same attribute is itself an
+    ordering hazard worth a look.
+
+    Conventions the rule consumes:
+    - `# lock-order: A < B` (comment anywhere) declares that A is acquired
+      before B; contradicting acquisitions are flagged even before a full
+      observed cycle exists, and the declarations double as the documented
+      lock hierarchy;
+    - `# lock-id: Name [reentrant]` on a `with` line names a dynamic
+      acquisition (`with self.stream_json_lock(n):`) so it joins the graph;
+    - false positives suppress per line:
+      `# plint: disable=lock-order`.
+
+    Fix patterns: release the outer lock before calling into the subsystem
+    that takes the inner one (copy what you need out of the guarded state),
+    or invert the inner acquisition to match the declared hierarchy."""
+
+    name = "lock-order"
+    description = "lock-acquisition cycles / non-reentrant double acquisition"
+    rationale = (
+        "four pools interleave over ~15 locks; an A->B / B->A inversion is "
+        "a production deadlock that no test will ever reproduce on schedule"
+    )
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        g = build_call_graph(project)
+        acq = g.acquires_closure()
+        reentrant: dict[str, bool] = {}
+        for ci in g.classes.values():
+            for ld in ci.lock_attrs.values():
+                reentrant[ld.lock_id] = ld.reentrant
+        for mod in g.modules.values():
+            for ld in mod.lock_globals.values():
+                reentrant[ld.lock_id] = ld.reentrant
+        for fn in g.funcs.values():
+            for s in fn.locks:
+                reentrant.setdefault(s.lock_id, s.reentrant)
+
+        # observed edges: (a, b) -> (rel, line, via)
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        self_deadlocks: list[Finding] = []
+        seen_dead: set[tuple[str, str]] = set()
+
+        def dead(fn: FuncInfo, lock: str, line: int, via: str) -> None:
+            key = (fn.key, lock)
+            if key in seen_dead:
+                return
+            seen_dead.add(key)
+            self_deadlocks.append(
+                Finding(
+                    rule=self.name,
+                    path=fn.rel,
+                    line=line,
+                    context=fn.qualname,
+                    message=(
+                        f"non-reentrant lock {lock} acquired twice on one "
+                        f"path{via}: threading.Lock self-deadlocks (use RLock "
+                        "or restructure so the outer hold is released first)"
+                    ),
+                )
+            )
+
+        for fn in g.funcs.values():
+            for s in fn.locks:
+                for h in s.held:
+                    if h == s.lock_id:
+                        if not reentrant.get(s.lock_id, False):
+                            dead(fn, s.lock_id, s.line, "")
+                    else:
+                        edges.setdefault((h, s.lock_id), (fn.rel, s.line, ""))
+            for e in fn.edges:
+                if e.deferred or e.executor or not e.held:
+                    continue
+                for lock, chain in acq.get(e.callee, {}).items():
+                    via = f" via {_chain_str(g, e.callee, chain)}"
+                    for h in e.held:
+                        if h == lock:
+                            if not reentrant.get(lock, False):
+                                dead(fn, lock, e.line, via)
+                        else:
+                            edges.setdefault((h, lock), (fn.rel, e.line, via))
+
+        yield from self_deadlocks
+
+        # declared-order constraints join the graph as intended edges
+        declared: dict[tuple[str, str], tuple[str, int]] = {}
+        for a, b, rel, line in g.declared_order:
+            declared[(a, b)] = (rel, line)
+
+        # direct contradiction: observed B->A against declared A<B
+        for (a, b), (rel, line, via) in sorted(edges.items()):
+            if (b, a) in declared:
+                drel, dline = declared[(b, a)]
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    context="",
+                    message=(
+                        f"acquires {b} while holding {a}{via}, contradicting "
+                        f"declared `# lock-order: {b} < {a}` ({drel}:{dline})"
+                    ),
+                )
+
+        # cycles over observed edges only (declared contradictions are
+        # reported above; declared edges among themselves are documentation)
+        adj: dict[str, list[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        for k in adj:
+            adj[k].sort()
+        reported: set[tuple[str, ...]] = set()
+        for start in sorted(adj):
+            cycle = _find_cycle(adj, start)
+            if cycle is None:
+                continue
+            canon = _canon_cycle(cycle)
+            if canon in reported:
+                continue
+            reported.add(canon)
+            a, b = cycle[0], cycle[1]
+            rel, line, via = edges[(a, b)]
+            path = " -> ".join([*cycle, cycle[0]])
+            yield Finding(
+                rule=self.name,
+                path=rel,
+                line=line,
+                context="",
+                message=(
+                    f"lock-order cycle (potential deadlock): {path}; break "
+                    "the cycle or declare+enforce a hierarchy with "
+                    "`# lock-order: A < B`"
+                ),
+            )
+
+
+def _find_cycle(adj: dict[str, list[str]], start: str) -> list[str] | None:
+    """DFS from `start`; first cycle found, as a node list (no repeat)."""
+    path: list[str] = []
+    on_path: set[str] = set()
+    visited: set[str] = set()
+
+    def dfs(n: str) -> list[str] | None:
+        visited.add(n)
+        path.append(n)
+        on_path.add(n)
+        for m in adj.get(n, ()):
+            if m in on_path:
+                return path[path.index(m) :]
+            if m not in visited:
+                got = dfs(m)
+                if got is not None:
+                    return got
+        path.pop()
+        on_path.discard(n)
+        return None
+
+    return dfs(start)
+
+
+def _canon_cycle(cycle: list[str]) -> tuple[str, ...]:
+    i = cycle.index(min(cycle))
+    return tuple(cycle[i:] + cycle[:i])
+
+
+# ---------------------------------------------------------------------------
+# 9. resource-leak
+
+
+class ResourceLeakRule(Rule):
+    """File/parquet/socket handles must be closed on every path.
+
+    Why: the scan pool opens parquet readers per file per query and the
+    write path opens staging files per tick; a handle that leaks on an
+    early return only shows up hours later as EMFILE on the hot path.
+
+    A *resource* is the result of `open()`, `<path>.open()`,
+    `pq.ParquetFile()`, `pa.ipc.open_file/open_stream/new_file()`,
+    `urllib.request.urlopen()`, or `socket.socket/create_connection()`.
+    Accepted custody patterns:
+    - `with ctor(...) as x:` / a later `with x:`;
+    - `x.close()` inside a `finally:`;
+    - ownership transfer: `return x` / `yield x` / `self.attr = x` /
+      passing x to another call (the callee owns it now).
+
+    Flagged:
+    - never closed and never escaping;
+    - closed on the straight-line path but with a `return`/`raise` between
+      acquisition and close (leak on early exit — put the close in
+      `finally:` or use `with`);
+    - used as an immediate call chain (`pq.ParquetFile(f).read()`): nothing
+      holds the handle, so nothing can close it — bind it in a `with`.
+
+    Suppress a deliberate leak per line:
+    `# plint: disable=resource-leak`."""
+
+    name = "resource-leak"
+    description = "unclosed file/parquet/socket handle on some path"
+    rationale = (
+        "per-query per-file opens across pool threads turn one leaked "
+        "handle into EMFILE under load; GC finalizers are not a close path"
+    )
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_LEAK_SCOPE_PREFIXES) or rel in _LEAK_SCOPE_FILES
+
+    _IPC_TAILS = {"open_file", "open_stream", "new_file"}
+
+    def _is_resource_ctor(self, call: ast.Call) -> str | None:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        tail = chain[-1]
+        if chain == ["open"]:
+            return "open()"
+        if tail == "open" and len(chain) >= 2:
+            return f"{chain[-2]}.open()"
+        if tail == "ParquetFile" and chain[0] in ("pq", "parquet"):
+            return "pq.ParquetFile()"
+        if tail in self._IPC_TAILS and "ipc" in chain:
+            return f"ipc.{tail}()"
+        if tail == "urlopen":
+            return "urlopen()"
+        if chain[0] == "socket" and tail in ("socket", "create_connection"):
+            return f"socket.{tail}()"
+        return None
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_func(sf, node)
+
+    @staticmethod
+    def _own_statements(fn) -> list[ast.stmt]:
+        """Top-down statement list of fn's own body, nested defs excluded;
+        each statement appears exactly once."""
+        own: list[ast.stmt] = []
+        stack = list(fn.body)
+        while stack:
+            s = stack.pop(0)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            own.append(s)
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                    stack.append(child)
+        return own
+
+    @staticmethod
+    def _own_nodes(own: list[ast.stmt]) -> Iterator[ast.AST]:
+        """Every AST node in `own` exactly once: expressions are walked from
+        their OWN statement only (the statement list contains both parents
+        and children, so walking each fully would multi-count)."""
+        for s in own:
+            yield s
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                    continue  # visited via its own `own` entry
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                yield from ast.walk(child)
+
+    def _check_func(self, sf: SourceFile, fn) -> Iterator[Finding]:
+        own = self._own_statements(fn)
+        nodes = list(self._own_nodes(own))
+
+        with_ctx: set[int] = set()  # id() of calls used as with-contexts
+        bound: dict[str, tuple[ast.Call, str]] = {}
+        assigned_calls: set[int] = set()
+        for s in own:
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        with_ctx.add(id(item.context_expr))
+            if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+                kind = self._is_resource_ctor(s.value)
+                if kind and len(s.targets) == 1 and isinstance(s.targets[0], ast.Name):
+                    bound[s.targets[0].id] = (s.value, kind)
+                    assigned_calls.add(id(s.value))
+            if isinstance(s, ast.Return) and isinstance(s.value, ast.Call):
+                assigned_calls.add(id(s.value))  # ownership transferred out
+
+        # immediate chains: ctor(...).something — nothing can ever close it
+        for node in nodes:
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Call)
+                and id(node.value) not in with_ctx
+                and id(node.value) not in assigned_calls
+            ):
+                kind = self._is_resource_ctor(node.value)
+                if kind:
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.value.lineno,
+                        context=enclosing_context(sf.tree, fn) or fn.name,
+                        message=(
+                            f"{kind} used as an immediate call chain: the "
+                            "handle can never be closed — bind it in a "
+                            "`with`"
+                        ),
+                    )
+
+        for name, (ctor, kind) in bound.items():
+            yield from self._check_binding(sf, fn, own, nodes, name, ctor, kind)
+
+    def _check_binding(
+        self,
+        sf: SourceFile,
+        fn,
+        own: list[ast.stmt],
+        nodes: list[ast.AST],
+        name: str,
+        ctor: ast.Call,
+        kind: str,
+    ) -> Iterator[Finding]:
+        closed_lines: list[int] = []
+        finally_closed = False
+        escapes = False
+        with_used = False
+        for s in own:
+            if isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    if isinstance(item.context_expr, ast.Name) and item.context_expr.id == name:
+                        with_used = True
+            if isinstance(s, ast.Try):
+                for b in s.finalbody:
+                    for sub in ast.walk(b):
+                        if self._is_close_of(sub, name):
+                            finally_closed = True
+            if isinstance(s, ast.Return) and isinstance(s.value, ast.Name):
+                if s.value.id == name:
+                    escapes = True
+        for sub in nodes:
+            if self._is_close_of(sub, name):
+                closed_lines.append(sub.lineno)
+            elif isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                if isinstance(getattr(sub, "value", None), ast.Name) and sub.value.id == name:
+                    escapes = True
+            elif isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)) and isinstance(
+                        sub.value, ast.Name
+                    ) and sub.value.id == name:
+                        escapes = True  # stored: owner is elsewhere now
+            elif isinstance(sub, ast.Call):
+                for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                    if isinstance(a, ast.Name) and a.id == name:
+                        fchain = attr_chain(sub.func)
+                        if not (fchain and fchain[-1] == "close"):
+                            escapes = True  # handed to a callee
+        if with_used or finally_closed:
+            return
+        ctx = enclosing_context(sf.tree, fn) or fn.name
+        if not closed_lines:
+            if escapes:
+                return
+            yield Finding(
+                rule=self.name,
+                path=sf.rel,
+                line=ctor.lineno,
+                context=ctx,
+                message=(
+                    f"{kind} bound to `{name}` is never closed on any path "
+                    "in this function: use `with` or close in `finally:`"
+                ),
+            )
+            return
+        first_close = min(closed_lines)
+        for sub in nodes:
+            if (
+                isinstance(sub, (ast.Return, ast.Raise))
+                and ctor.lineno < sub.lineno < first_close
+            ):
+                yield Finding(
+                    rule=self.name,
+                    path=sf.rel,
+                    line=ctor.lineno,
+                    context=ctx,
+                    message=(
+                        f"{kind} bound to `{name}` leaks on the early "
+                        f"exit at line {sub.lineno} (close() only runs on "
+                        "the fall-through path): use `with` or `finally:`"
+                    ),
+                )
+                return
+
+    @staticmethod
+    def _is_close_of(node: ast.AST, name: str) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "close"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+        )
+
+
+# ---------------------------------------------------------------------------
+# 10. escaping-exception-in-worker
+
+
+class EscapingExceptionRule(Rule):
+    """Exceptions raised inside pool workers must be observed somewhere.
+
+    Why: `ThreadPoolExecutor.submit` stores the worker's exception on the
+    Future; if nobody calls `.result()` (and the worker doesn't catch), the
+    error *silently vanishes* — an upload that never happened, an alert that
+    never fired, and no log line to show for it.
+
+    Flagged: `pool.submit(fn, ...)` / `pool.map(fn, ...)` used as a bare
+    statement (the Future/iterator is discarded) where `fn` — resolved
+    through the call graph, `telemetry.propagate(...)` unwrapped — can
+    complete with an uncaught `raise` (its own or via any callee chain).
+
+    Fix patterns:
+    - keep the future and `.result()` it (batch loops already do this);
+    - catch-and-log at the worker's top level (`except Exception:
+      logger.exception(...)`) — the pattern sync ticks use;
+    - add a done-callback that logs `fut.exception()`.
+
+    Suppress a genuinely fire-and-forget site per line:
+    `# plint: disable=escaping-exception-in-worker`."""
+
+    name = "escaping-exception-in-worker"
+    description = "fire-and-forget pool work whose exceptions vanish"
+    rationale = (
+        "a worker exception on a discarded Future is invisible: no log, no "
+        "counter, no retry — the failure mode PRs 2-3 fought repeatedly"
+    )
+
+    def finalize(self, project: Project) -> Iterable[Finding]:
+        g = build_call_graph(project)
+        escapes = g.raise_escapes()
+        for sf in project.files:
+            if sf.rel.startswith("parseable_tpu/analysis/"):
+                continue
+            mod_funcs = [f for f in g.funcs.values() if f.rel == sf.rel]
+            if not mod_funcs:
+                continue
+            yield from self._check_file(g, escapes, sf, mod_funcs)
+
+    def _check_file(
+        self,
+        g: CallGraph,
+        escapes: dict,
+        sf: SourceFile,
+        mod_funcs: list[FuncInfo],
+    ) -> Iterator[Finding]:
+        # fire-and-forget sites: bare-statement submit/map on pool-like
+        for fn in mod_funcs:
+            if fn.node is None:
+                continue
+            for stmt in ast.walk(fn.node):
+                if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+                    continue
+                call = stmt.value
+                if not (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("submit", "map")
+                ):
+                    continue
+                recv = call.func.value
+                recv_name = (
+                    recv.attr
+                    if isinstance(recv, ast.Attribute)
+                    else getattr(recv, "id", "")
+                )
+                if not recv_name or not _POOL_RECEIVER_RE.search(recv_name):
+                    continue
+                if not call.args:
+                    continue
+                worker = self._unwrap(call.args[0])
+                key = self._resolve_ref(g, fn, worker, call.lineno)
+                if key is None:
+                    continue
+                esc = escapes.get(key)
+                if esc is None:
+                    continue
+                line, chain = esc
+                wname = g.funcs[key].qualname if key in g.funcs else key
+                via = (
+                    f" via {_chain_str(g, key, chain)}"
+                    if chain
+                    else f" (raise at {g.funcs[key].rel}:{line})"
+                )
+                yield Finding(
+                    rule=self.name,
+                    path=sf.rel,
+                    line=call.lineno,
+                    context=enclosing_context(sf.tree, call),
+                    message=(
+                        f"{recv_name}.{call.func.attr}({wname}) discards the "
+                        f"Future but the worker can raise{via}: exceptions "
+                        "vanish — .result() it, log in the worker, or attach "
+                        "a done-callback"
+                    ),
+                )
+
+    @staticmethod
+    def _unwrap(arg: ast.expr) -> ast.expr:
+        # telemetry.propagate(fn) / ctx.run -> the wrapped callable
+        while isinstance(arg, ast.Call):
+            chain = attr_chain(arg.func)
+            if chain and chain[-1] == "propagate" and arg.args:
+                arg = arg.args[0]
+                continue
+            break
+        return arg
+
+    def _resolve_ref(
+        self, g: CallGraph, fn: FuncInfo, ref: ast.expr, line: int
+    ) -> str | None:
+        """Resolve a worker reference to a FuncInfo key using the deferred
+        edges the graph recorded at the submit call's line."""
+        if not isinstance(ref, (ast.Name, ast.Attribute)):
+            return None
+        chain = attr_chain(ref)
+        if not chain:
+            return None
+        tail = chain[-1]
+        for e in fn.edges:
+            if e.deferred and e.line == line:
+                callee = g.funcs.get(e.callee)
+                if callee is not None and callee.name == tail:
+                    return e.callee
+        return None
+
+
+INTERPROC_RULES = [
+    TransitiveBlockingRule,
+    LockOrderRule,
+    ResourceLeakRule,
+    EscapingExceptionRule,
+]

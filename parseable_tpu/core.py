@@ -477,7 +477,7 @@ class Parseable:
     def update_snapshot(self, stream: Stream, entries: list) -> None:
         """Append manifest entries + refresh the stream snapshot
         (reference: catalog/mod.rs:108-497)."""
-        with self.stream_json_lock(stream.name):
+        with self.stream_json_lock(stream.name):  # lock-id: Parseable.stream_json
             try:
                 fmt = self.metastore.get_stream_json(stream.name, self._node_suffix)
             except MetastoreError:

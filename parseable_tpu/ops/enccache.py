@@ -65,6 +65,9 @@ class EncodedBlockCache:
         self.budget = budget_bytes or env_int("P_TPU_ENC_CACHE_BYTES", 16 << 30)
         self._lock = threading.Lock()
         self._write_lock = threading.Lock()
+        # put() holds the write lock across _put -> _evict_over_budget,
+        # which takes the state lock; never acquire them the other way
+        # lock-order: EncodedBlockCache._write_lock < EncodedBlockCache._lock
         self._queue: "object" = None  # lazily-started background writer
         self._writer: threading.Thread | None = None
         self.hits = 0

@@ -474,9 +474,9 @@ class StreamScan:
             with telemetry.TRACER.span(
                 "scan.decode", file=f.file_path, stream=self.plan.stream
             ):
-                pf = pq.ParquetFile(src)
-                cols = self._columns_for_read(pf.schema_arrow.names)
-                table = pf.read(columns=cols, use_threads=use_threads)
+                with pq.ParquetFile(src) as pf:
+                    cols = self._columns_for_read(pf.schema_arrow.names)
+                    table = pf.read(columns=cols, use_threads=use_threads)
             with self._stats_lock:
                 self.stats.rows_scanned += table.num_rows
             return table
@@ -577,9 +577,8 @@ class StreamScan:
                 bytes=fetched,
                 stream=self.plan.stream,
             ):
-                table = pq.ParquetFile(_SparseFile(size, segments)).read(
-                    columns=cols, use_threads=use_threads
-                )
+                with pq.ParquetFile(_SparseFile(size, segments)) as pf:
+                    table = pf.read(columns=cols, use_threads=use_threads)
             return table
         finally:
             # every byte actually pulled counts — including the footer probe
@@ -632,9 +631,9 @@ class StreamScan:
             yield table
         for f in stream.parquet_files():
             try:
-                pf = pq.ParquetFile(f)
-                cols = self._columns_for_read(pf.schema_arrow.names)
-                t = pf.read(columns=cols)
+                with pq.ParquetFile(f) as pf:
+                    cols = self._columns_for_read(pf.schema_arrow.names)
+                    t = pf.read(columns=cols)
                 with self._stats_lock:
                     self.stats.rows_scanned += t.num_rows
                 yield t

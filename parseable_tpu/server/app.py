@@ -774,7 +774,8 @@ async def counts(request: web.Request) -> web.Response:
 @require(Action.LIST_STREAM)
 async def list_streams(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
-    state.p.load_streams_from_storage()
+    # storage-backed discovery off the event loop (transitive-blocking)
+    await _run_traced(state, state.p.load_streams_from_storage)
     allowed = state.rbac.user_allowed_streams(request["username"])
     names = state.p.streams.list_names()
     if allowed is not None:
@@ -826,13 +827,17 @@ async def put_stream(request: web.Request) -> web.Response:
             await asyncio.get_running_loop().run_in_executor(None, _persist)
             fanout_to_ingestors(state, "PUT", f"/api/v1/logstream/{name}", headers=_xp_headers(request))
             return web.json_response({"message": f"updated stream {name}"})
-        state.p.create_stream_if_not_exists(
-            name,
-            time_partition=time_partition,
-            custom_partition=custom_partition,
-            static_schema=static_schema,
-            telemetry_type=telemetry_type,
-        )
+        def _create() -> None:
+            # metastore round trips (stream json + schema) off the loop
+            state.p.create_stream_if_not_exists(
+                name,
+                time_partition=time_partition,
+                custom_partition=custom_partition,
+                static_schema=static_schema,
+                telemetry_type=telemetry_type,
+            )
+
+        await _run_traced(state, _create)
     except StreamError as e:
         return web.json_response({"error": str(e)}, status=400)
     fanout_to_ingestors(state, "PUT", f"/api/v1/logstream/{name}", headers=_xp_headers(request))
@@ -849,8 +854,13 @@ async def delete_stream(request: web.Request) -> web.Response:
     name = request.match_info["name"]
     if not state.p.streams.contains(name):
         return web.json_response({"error": f"stream {name} not found"}, status=404)
-    state.p.streams.delete(name)
-    state.p.metastore.delete_stream(name)
+
+    def _delete() -> None:
+        # staging rmtree + object-store prefix delete: both block
+        state.p.streams.delete(name)
+        state.p.metastore.delete_stream(name)
+
+    await _run_traced(state, _delete)
     fanout_to_ingestors(state, "DELETE", f"/api/v1/logstream/{name}")
     return web.json_response({"message": f"deleted stream {name}"})
 
@@ -898,7 +908,7 @@ async def stream_stats(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     name = request.match_info["name"]
     try:
-        fmts = state.p.metastore.get_all_stream_jsons(name)
+        fmts = await _run_traced(state, state.p.metastore.get_all_stream_jsons, name)
     except Exception:
         fmts = []
     if not fmts and not state.p.streams.contains(name):
@@ -970,15 +980,21 @@ async def put_hot_tier(request: web.Request) -> web.Response:
     except StreamNotFound:
         return web.json_response({"error": f"stream {name} not found"}, status=404)
     body = await request.json()
-    try:
+
+    def _enable() -> None:
+        # hot_tier() lazily restores budgets from the metastore and the
+        # reconcile downloads parquet: all of it belongs on a worker
         state.hot_tier().set_budget(name, body.get("size", ""))
+        state.p.metastore.put_document(
+            "hottier", name, {"stream": name, "size": body.get("size")}
+        )
+        # reconcile eagerly so the tier warms without waiting for the tick
+        state.hot_tier().reconcile(name)
+
+    try:
+        await _run_traced(state, _enable)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
-    state.p.metastore.put_document("hottier", name, {"stream": name, "size": body.get("size")})
-    # reconcile eagerly so the tier warms without waiting for the tick
-    await asyncio.get_running_loop().run_in_executor(
-        state.workers, state.hot_tier().reconcile, name
-    )
     return web.json_response({"message": f"hot tier enabled for {name}"})
 
 
@@ -986,20 +1002,24 @@ async def put_hot_tier(request: web.Request) -> web.Response:
 async def get_hot_tier(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     name = request.match_info["name"]
-    budget = state.hot_tier().get_budget(name)
+    # first call builds the manager from persisted metastore budgets
+    ht = await _run_traced(state, state.hot_tier)
+    budget = ht.get_budget(name)
     if budget is None:
         return web.json_response({"error": "hot tier not enabled"}, status=404)
-    return web.json_response(
-        {"size": budget, "used_size": state.hot_tier().used_bytes(name)}
-    )
+    return web.json_response({"size": budget, "used_size": ht.used_bytes(name)})
 
 
 @require(Action.DELETE_HOT_TIER, "name")
 async def delete_hot_tier(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     name = request.match_info["name"]
-    state.hot_tier().disable(name)
-    state.p.metastore.delete_document("hottier", name)
+
+    def _disable() -> None:
+        state.hot_tier().disable(name)
+        state.p.metastore.delete_document("hottier", name)
+
+    await _run_traced(state, _disable)
     return web.json_response({"message": f"hot tier disabled for {name}"})
 
 
@@ -1062,7 +1082,7 @@ async def put_user(request: web.Request) -> web.Response:
         body = json.loads(raw)
     roles = set(body.get("roles", []))
     password = state.rbac.put_user(username, roles=roles)
-    state.save_rbac()
+    await _run_traced(state, state.save_rbac)
     fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response(password)
 
@@ -1085,7 +1105,7 @@ async def delete_user(request: web.Request) -> web.Response:
     if username == state.p.options.username:
         return web.json_response({"error": "cannot delete root user"}, status=400)
     state.rbac.delete_user(username)
-    state.save_rbac()
+    await _run_traced(state, state.save_rbac)
     fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": f"deleted user {username}"})
 
@@ -1102,7 +1122,7 @@ async def put_user_roles(request: web.Request) -> web.Response:
     if missing:
         return web.json_response({"error": f"unknown roles {missing}"}, status=400)
     u.roles = roles
-    state.save_rbac()
+    await _run_traced(state, state.save_rbac)
     fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": "updated roles"})
 
@@ -1121,7 +1141,7 @@ async def put_role(request: web.Request) -> web.Response:
     except (ValueError, AttributeError, TypeError) as e:
         return web.json_response({"error": f"invalid role body: {e}"}, status=400)
     state.rbac.put_role(name, perms)
-    state.save_rbac()
+    await _run_traced(state, state.save_rbac)
     fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": f"updated role {name}"})
 
@@ -1139,7 +1159,7 @@ async def delete_role(request: web.Request) -> web.Response:
         state.rbac.delete_role(request.match_info["name"])
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
-    state.save_rbac()
+    await _run_traced(state, state.save_rbac)
     fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response({"message": "deleted role"})
 
@@ -1204,18 +1224,24 @@ def crud_routes(collection: str, put_action: Action, get_action: Action, delete_
         if collection == "correlations":
             # reference validates correlation configs against live streams
             # (correlation.rs:280); executable here via the JOIN SQL surface
+            # — may fall back to a storage-backed stream listing, so it
+            # runs on a worker like the put itself
             try:
-                _validate_correlation(state, body, request["username"])
+                await _run_traced(
+                    state, _validate_correlation, state, body, request["username"]
+                )
             except ValueError as e:
                 return web.json_response({"error": str(e)}, status=400)
-        state.p.metastore.put_document(collection, doc_id, body)
+        await _run_traced(state, state.p.metastore.put_document, collection, doc_id, body)
         return web.json_response(body)
 
     async def get_doc(request: web.Request):
         state: ServerState = request.app["state"]
         if not state.rbac.authorize(request["username"], get_action):
             return web.json_response({"error": "Forbidden"}, status=403)
-        doc = state.p.metastore.get_document(collection, request.match_info["id"])
+        doc = await _run_traced(
+            state, state.p.metastore.get_document, collection, request.match_info["id"]
+        )
         if doc is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response(doc)
@@ -1224,13 +1250,17 @@ def crud_routes(collection: str, put_action: Action, get_action: Action, delete_
         state: ServerState = request.app["state"]
         if not state.rbac.authorize(request["username"], get_action):
             return web.json_response({"error": "Forbidden"}, status=403)
-        return web.json_response(state.p.metastore.list_documents(collection))
+        return web.json_response(
+            await _run_traced(state, state.p.metastore.list_documents, collection)
+        )
 
     async def delete_doc(request: web.Request):
         state: ServerState = request.app["state"]
         if not state.rbac.authorize(request["username"], delete_action):
             return web.json_response({"error": "Forbidden"}, status=403)
-        state.p.metastore.delete_document(collection, request.match_info["id"])
+        await _run_traced(
+            state, state.p.metastore.delete_document, collection, request.match_info["id"]
+        )
         return web.json_response({"message": "deleted"})
 
     return put_doc, get_doc, list_docs, delete_doc
@@ -1339,11 +1369,18 @@ async def alert_set_enabled(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     alert_id = request.match_info["id"]
     action = request.match_info["action"]
-    doc = state.p.metastore.get_document("alerts", alert_id)
+
+    def _toggle() -> dict | None:
+        doc = state.p.metastore.get_document("alerts", alert_id)
+        if doc is None:
+            return None
+        doc["state"] = "disabled" if action == "disable" else "enabled"
+        state.p.metastore.put_document("alerts", alert_id, doc)
+        return doc
+
+    doc = await _run_traced(state, _toggle)
     if doc is None:
         return web.json_response({"error": "unknown alert"}, status=404)
-    doc["state"] = "disabled" if action == "disable" else "enabled"
-    state.p.metastore.put_document("alerts", alert_id, doc)
     return web.json_response({"message": f"alert {action}d"})
 
 
@@ -1355,7 +1392,7 @@ async def alert_evaluate_now(request: web.Request) -> web.Response:
 
     state: ServerState = request.app["state"]
     alert_id = request.match_info["id"]
-    doc = state.p.metastore.get_document("alerts", alert_id)
+    doc = await _run_traced(state, state.p.metastore.get_document, "alerts", alert_id)
     if doc is None:
         return web.json_response({"error": "unknown alert"}, status=404)
 
@@ -1382,7 +1419,7 @@ async def alert_update_notification_state(request: web.Request) -> web.Response:
     NotificationState — mute/snooze alert notifications)."""
     state: ServerState = request.app["state"]
     alert_id = request.match_info["id"]
-    doc = state.p.metastore.get_document("alerts", alert_id)
+    doc = await _run_traced(state, state.p.metastore.get_document, "alerts", alert_id)
     if doc is None:
         return web.json_response({"error": "unknown alert"}, status=404)
     try:
@@ -1401,7 +1438,7 @@ async def alert_update_notification_state(request: web.Request) -> web.Response:
                 status=400,
             )
     doc["notification_state"] = new_state
-    state.p.metastore.put_document("alerts", alert_id, doc)
+    await _run_traced(state, state.p.metastore.put_document, "alerts", alert_id, doc)
     return web.json_response({"message": "notification state updated", "state": new_state})
 
 
@@ -1426,14 +1463,21 @@ async def put_outbound_policy(request: web.Request) -> web.Response:
         "denied_domains": [str(d) for d in body.get("denied_domains") or []],
         "denied_cidrs": [str(c) for c in body.get("denied_cidrs") or []],
     }
-    state.p.metastore.put_document("policies", "outbound_policy", policy)
+    await _run_traced(
+        state, state.p.metastore.put_document, "policies", "outbound_policy", policy
+    )
     return web.json_response(policy)
 
 
 @require(Action.GET_ALERT)
 async def get_outbound_policy(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
-    policy = state.p.metastore.get_document("policies", "outbound_policy") or {}
+    policy = (
+        await _run_traced(
+            state, state.p.metastore.get_document, "policies", "outbound_policy"
+        )
+        or {}
+    )
     return web.json_response(policy)
 
 
@@ -1442,7 +1486,8 @@ async def dashboards_list_tags(request: web.Request) -> web.Response:
     """GET /api/v1/dashboards/list_tags (reference: users/dashboards.rs)."""
     state: ServerState = request.app["state"]
     tags: set[str] = set()
-    for doc in state.p.metastore.list_documents("dashboards"):
+    docs = await _run_traced(state, state.p.metastore.list_documents, "dashboards")
+    for doc in docs:
         for tag in doc.get("tags") or []:
             tags.add(str(tag))
     return web.json_response(sorted(tags))
@@ -1453,7 +1498,7 @@ async def dashboard_add_tile(request: web.Request) -> web.Response:
     """PUT /api/v1/dashboards/{id}/add_tile (reference: add_tile route)."""
     state: ServerState = request.app["state"]
     dash_id = request.match_info["id"]
-    doc = state.p.metastore.get_document("dashboards", dash_id)
+    doc = await _run_traced(state, state.p.metastore.get_document, "dashboards", dash_id)
     if doc is None:
         return web.json_response({"error": "unknown dashboard"}, status=404)
     try:
@@ -1464,7 +1509,7 @@ async def dashboard_add_tile(request: web.Request) -> web.Response:
         return web.json_response({"error": "tile needs a title"}, status=400)
     doc.setdefault("tiles", []).append(tile)
     doc["modified"] = rfc3339_now()
-    state.p.metastore.put_document("dashboards", dash_id, doc)
+    await _run_traced(state, state.p.metastore.put_document, "dashboards", dash_id, doc)
     return web.json_response(doc)
 
 
@@ -1472,7 +1517,9 @@ async def dashboard_add_tile(request: web.Request) -> web.Response:
 async def alert_state_handler(request: web.Request) -> web.Response:
     """GET /api/v1/alerts/{id}/state — current state incl. MTTR fields."""
     state: ServerState = request.app["state"]
-    doc = state.p.metastore.get_document("alert_state", request.match_info["id"])
+    doc = await _run_traced(
+        state, state.p.metastore.get_document, "alert_state", request.match_info["id"]
+    )
     if doc is None:
         return web.json_response({"error": "no state yet"}, status=404)
     return web.json_response(doc)
@@ -1660,7 +1707,7 @@ async def cluster_info(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     from parseable_tpu.server import cluster as C
 
-    nodes = state.p.metastore.list_nodes()
+    nodes = await _run_traced(state, state.p.metastore.list_nodes)
     for n in nodes:
         n["pmeta_last_scrape"] = C.LAST_PMETA_SCRAPE
     return web.json_response(nodes)
@@ -1685,9 +1732,17 @@ def fanout_to_ingestors(
         return
     from parseable_tpu.server import cluster as C
 
-    state.workers.submit(
-        C.sync_with_ingestors, state.p, method, path, json_body, headers, kinds
-    )
+    def _fanout() -> None:
+        # worker owns its errors: the Future is discarded, so an uncaught
+        # raise (metastore listing, peer I/O) would otherwise vanish
+        try:
+            failed = C.sync_with_ingestors(state.p, method, path, json_body, headers, kinds)
+            if failed:
+                logger.warning("peer fan-out %s %s failed for: %s", method, path, failed)
+        except Exception:
+            logger.exception("peer fan-out %s %s failed", method, path)
+
+    state.workers.submit(telemetry.propagate(_fanout))
 
 
 async def internal_rbac_reload(request: web.Request) -> web.Response:
@@ -1697,7 +1752,7 @@ async def internal_rbac_reload(request: web.Request) -> web.Response:
     state: ServerState = request.app["state"]
     if not state.rbac.authorize(request["username"], Action.PUT_USER):
         return web.json_response({"error": "Forbidden"}, status=403)
-    state.reload_rbac()
+    await _run_traced(state, state.reload_rbac)
     return web.json_response({"message": "rbac reloaded"})
 
 

@@ -86,6 +86,10 @@ class Stream:
             batch_rows=options.disk_write_batch_rows,
         )
         self.lock = threading.RLock()
+        # the write path's documented hierarchy (enforced by plint's
+        # lock-order rule): registry -> stream -> memory writer
+        # lock-order: Streams._lock < Stream.lock
+        # lock-order: Stream.lock < MemWriter._lock
         # arrows claimed by an in-flight conversion job and parquet claimed by
         # an in-flight upload: concurrent sync cycles must never compact the
         # same arrows twice or upload the same parquet twice
@@ -347,7 +351,9 @@ class Stream:
                 try:
                     import pyarrow.ipc as ipc
 
-                    ipc.open_file(str(p)).schema  # noqa: B018 — validity probe
+                    # validity probe; `with` releases the fd before the rename
+                    with ipc.open_file(str(p)) as probe:
+                        probe.schema  # noqa: B018
                     final = Path(str(p)[: -len(PART_FILE_EXTENSION)] + ARROW_FILE_EXTENSION)
                     os.replace(p, final)
                 except (pa.ArrowInvalid, pa.ArrowIOError, OSError):

@@ -228,6 +228,10 @@ class Tracer:
         self.service_name = service_name
         self._spans: list[dict] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
+        # flush() holds the export serializer while _flush_locked swaps the
+        # buffer under the span lock; the reverse nesting would deadlock a
+        # recording thread against a slow exporter
+        # lock-order: Tracer._flush_inflight < Tracer._lock
         self._flush_inflight = threading.Lock()
 
     @property

@@ -24,9 +24,18 @@ fi
 echo "check_green: tier-1 GREEN"
 
 # static-analysis gate: the tree must lint clean (zero unbaselined plint
-# findings) before snapshot — concurrency/invariant bugs are cheapest here
-if ! python -m parseable_tpu.analysis; then
-  echo "check_green: PLINT RED (unbaselined findings; see above)" >&2
+# findings) before snapshot — concurrency/invariant bugs are cheapest here.
+# Default: --changed (findings reported only for files differing from
+# `git merge-base HEAD main`, whole tree still analyzed) + the mtime result
+# cache, so the gate stays fast as the rule count grows. PLINT_FULL=1 runs
+# the authoritative full-tree report. The JSON report lands at
+# /tmp/plint.json either way (gate artifact).
+plint_args=(--json-out /tmp/plint.json)
+if [ "${PLINT_FULL:-0}" != "1" ]; then
+  plint_args+=(--changed)
+fi
+if ! python -m parseable_tpu.analysis "${plint_args[@]}"; then
+  echo "check_green: PLINT RED (unbaselined findings; see above and /tmp/plint.json)" >&2
   exit 1
 fi
-echo "check_green: plint GREEN"
+echo "check_green: plint GREEN (report: /tmp/plint.json)"

@@ -1,0 +1,772 @@
+"""plint v2 tests: call graph + the four interprocedural rules.
+
+Per rule: a true-positive fixture (the transitive-blocking chain is three
+calls deep across two files; the lock cycle is A->B / B->A across two
+files), a negative via the accepted idiom (run_in_executor hop, one-way
+lock nesting, with/finally custody, catch-in-worker), and suppression.
+Plus: the v2 fingerprint scheme (rename-stable, legacy-baseline
+migration), the CLI satellites (--changed, result cache, --explain,
+--json-out), the <15s full-run wall-clock budget, and behavioral
+regressions for the real bugs the new rules caught in the tree (blocking
+metastore calls on the event loop, the peer fan-out worker whose
+exceptions vanished).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from parseable_tpu.analysis.callgraph import build_call_graph
+from parseable_tpu.analysis.framework import (
+    Project,
+    SourceFile,
+    run_analysis,
+)
+from parseable_tpu.analysis.rules_interproc import (
+    EscapingExceptionRule,
+    LockOrderRule,
+    ResourceLeakRule,
+    TransitiveBlockingRule,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def make_project(files: dict[str, str]) -> Project:
+    project = Project(root=Path("/fixture"))
+    for rel, code in files.items():
+        project.files.append(SourceFile(rel, textwrap.dedent(code)))
+    return project
+
+
+def finalize(rule, files: dict[str, str]) -> list:
+    """Run one whole-program rule the way the runner would (suppressions
+    honored)."""
+    project = make_project(files)
+    by_rel = {sf.rel: sf for sf in project.files}
+    out = []
+    for f in rule.finalize(project):
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.is_suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    return out
+
+
+def check(rule, code: str, rel: str) -> list:
+    if not rule.applies(rel):
+        return []
+    sf = SourceFile(rel, textwrap.dedent(code))
+    return [f for f in rule.check(sf) if not sf.is_suppressed(f.rule, f.line)]
+
+
+# ------------------------------------------------------------ call graph
+
+
+def test_callgraph_resolves_self_attrs_and_annotated_locals():
+    project = make_project(
+        {
+            "parseable_tpu/core.py": """
+                class Store:
+                    def fetch(self):
+                        return 1
+
+                class Svc:
+                    def __init__(self, store: Store):
+                        self.store = store
+
+                    def go(self):
+                        return self.store.fetch()
+            """,
+            "parseable_tpu/user.py": """
+                from parseable_tpu.core import Svc
+
+                def use():
+                    svc: Svc = make()
+                    return svc.go()
+            """,
+        }
+    )
+    g = build_call_graph(project)
+    go = g.funcs["parseable_tpu.core:Svc.go"]
+    assert any(e.callee == "parseable_tpu.core:Store.fetch" for e in go.edges)
+    use = g.funcs["parseable_tpu.user:use"]
+    assert any(e.callee == "parseable_tpu.core:Svc.go" for e in use.edges)
+
+
+# ------------------------------------------- transitive-blocking-in-async
+
+
+BLOCKING_CHAIN = {
+    # three calls deep, across two files: the lexical rule cannot see this
+    "parseable_tpu/server/app.py": """
+        from parseable_tpu.server.helpers import lookup
+
+        async def handler(request):
+            return lookup(request)
+    """,
+    "parseable_tpu/server/helpers.py": """
+        def lookup(req):
+            return deep(req)
+
+        def deep(req):
+            return fetch(req)
+
+        def fetch(req):
+            return req.state.p.storage.get_object("k")
+    """,
+}
+
+
+def test_transitive_blocking_three_deep_chain_across_files():
+    out = finalize(TransitiveBlockingRule(), BLOCKING_CHAIN)
+    assert len(out) == 1
+    f = out[0]
+    assert f.path == "parseable_tpu/server/app.py"
+    assert f.context == "handler"
+    assert "lookup -> deep -> fetch" in f.message
+    assert "storage-op" in f.message
+
+
+def test_transitive_blocking_executor_hop_is_absolution():
+    code = {
+        "parseable_tpu/server/app.py": """
+            import asyncio
+
+            from parseable_tpu.server.helpers import lookup
+            from parseable_tpu.utils import telemetry
+
+            async def handler(request, state):
+                def work():
+                    return lookup(request)
+                await asyncio.get_running_loop().run_in_executor(None, work)
+                state.workers.submit(telemetry.propagate(lookup), request)
+                return None
+        """,
+        "parseable_tpu/server/helpers.py": BLOCKING_CHAIN[
+            "parseable_tpu/server/helpers.py"
+        ],
+    }
+    assert finalize(TransitiveBlockingRule(), code) == []
+
+
+def test_transitive_blocking_depth0_new_primitives():
+    code = {
+        "parseable_tpu/server/app.py": """
+            import pyarrow.parquet as pq
+            import urllib.request
+
+            async def handler(request):
+                t = pq.read_table("x.parquet")
+                urllib.request.urlopen("http://peer/metrics")
+                return t
+        """
+    }
+    out = finalize(TransitiveBlockingRule(), code)
+    kinds = sorted(f.message.split()[1] for f in out)
+    assert kinds == ["parquet-io", "urlopen"]
+
+
+def test_transitive_blocking_suppression_and_scope():
+    suppressed = {
+        "parseable_tpu/server/app.py": BLOCKING_CHAIN[
+            "parseable_tpu/server/app.py"
+        ].replace(
+            "return lookup(request)",
+            "return lookup(request)  # plint: disable=transitive-blocking-in-async",
+        ),
+        "parseable_tpu/server/helpers.py": BLOCKING_CHAIN[
+            "parseable_tpu/server/helpers.py"
+        ],
+    }
+    assert finalize(TransitiveBlockingRule(), suppressed) == []
+    # async defs outside parseable_tpu/server/ are out of scope
+    moved = {
+        "parseable_tpu/query/app.py": BLOCKING_CHAIN["parseable_tpu/server/app.py"].replace(
+            "parseable_tpu.server.helpers", "parseable_tpu.query.helpers"
+        ),
+        "parseable_tpu/query/helpers.py": BLOCKING_CHAIN[
+            "parseable_tpu/server/helpers.py"
+        ],
+    }
+    assert finalize(TransitiveBlockingRule(), moved) == []
+
+
+# ----------------------------------------------------------- lock-order
+
+
+LOCK_CYCLE = {
+    # A -> B in one file, B -> A in another: the seeded deadlock fixture
+    "parseable_tpu/storage/alpha.py": """
+        import threading
+
+        from parseable_tpu.storage.beta import Beta
+
+        class Alpha:
+            def __init__(self, beta: Beta):
+                self._lock = threading.Lock()
+                self.beta = beta
+
+            def outer(self):
+                with self._lock:
+                    self.beta.enter()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+    """,
+    "parseable_tpu/storage/beta.py": """
+        import threading
+
+        class Beta:
+            def __init__(self, alpha: "object" = None):
+                self._lock = threading.Lock()
+                self.alpha = alpha
+
+            def attach(self, alpha):
+                from parseable_tpu.storage.alpha import Alpha
+
+                self.alpha: Alpha = alpha
+
+            def enter(self):
+                with self._lock:
+                    return 1
+
+            def outer(self):
+                with self._lock:
+                    self.alpha.inner()
+    """,
+}
+
+
+def test_lock_order_detects_cycle_across_two_files():
+    out = finalize(LockOrderRule(), LOCK_CYCLE)
+    cycles = [f for f in out if "lock-order cycle" in f.message]
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    assert "Alpha._lock" in msg and "Beta._lock" in msg
+
+
+def test_lock_order_one_way_nesting_is_clean():
+    one_way = {
+        "parseable_tpu/storage/alpha.py": LOCK_CYCLE["parseable_tpu/storage/alpha.py"],
+        "parseable_tpu/storage/beta.py": LOCK_CYCLE["parseable_tpu/storage/beta.py"].replace(
+            "self.alpha.inner()", "return 2"
+        ),
+    }
+    assert finalize(LockOrderRule(), one_way) == []
+
+
+def test_lock_order_self_deadlock_via_call_chain():
+    code = {
+        "parseable_tpu/storage/c.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        self.g()
+
+                def g(self):
+                    with self._lock:
+                        return 1
+        """
+    }
+    out = finalize(LockOrderRule(), code)
+    assert len(out) == 1
+    assert "acquired twice" in out[0].message and "C.f" in out[0].context
+    # an RLock is reentrant: same shape, no finding
+    rcode = {
+        "parseable_tpu/storage/c.py": code["parseable_tpu/storage/c.py"].replace(
+            "threading.Lock()", "threading.RLock()"
+        )
+    }
+    assert finalize(LockOrderRule(), rcode) == []
+
+
+def test_lock_order_declared_order_contradiction():
+    code = {
+        "parseable_tpu/storage/d.py": """
+            import threading
+
+            # lock-order: D._a < D._b
+
+            class D:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def wrong(self):
+                    with self._b:
+                        with self._a:
+                            return 1
+        """
+    }
+    out = finalize(LockOrderRule(), code)
+    assert len(out) == 1
+    assert "contradicting declared" in out[0].message
+    assert "D._a < D._b" in out[0].message
+
+
+def test_lock_order_lock_id_annotation_names_dynamic_locks():
+    code = {
+        "parseable_tpu/storage/e.py": """
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._reg = threading.Lock()
+
+                def dyn_lock(self, key):
+                    return threading.Lock()
+
+                def a_then_dyn(self):
+                    with self._reg:
+                        with self.dyn_lock("k"):  # lock-id: E.dyn
+                            return 1
+
+                def dyn_then_a(self):
+                    with self.dyn_lock("k"):  # lock-id: E.dyn
+                        with self._reg:
+                            return 2
+        """
+    }
+    out = finalize(LockOrderRule(), code)
+    assert any("lock-order cycle" in f.message and "E.dyn" in f.message for f in out)
+
+
+# --------------------------------------------------------- resource-leak
+
+
+def test_resource_leak_never_closed():
+    code = """
+        def f(path):
+            fh = open(path)
+            data = fh.read()
+            return data
+    """
+    out = check(ResourceLeakRule(), code, "parseable_tpu/storage/x.py")
+    assert len(out) == 1 and "never closed" in out[0].message
+
+
+def test_resource_leak_on_early_return():
+    code = """
+        def g(path, flag):
+            fh = open(path)
+            if flag:
+                return None
+            data = fh.read()
+            fh.close()
+            return data
+    """
+    out = check(ResourceLeakRule(), code, "parseable_tpu/storage/x.py")
+    assert len(out) == 1 and "early" in out[0].message
+
+
+def test_resource_leak_immediate_chain():
+    code = """
+        import pyarrow.parquet as pq
+
+        def h(path):
+            return pq.ParquetFile(path).read()
+    """
+    out = check(ResourceLeakRule(), code, "parseable_tpu/query/x.py")
+    assert len(out) == 1 and "immediate call chain" in out[0].message
+
+
+def test_resource_leak_custody_patterns_clean():
+    code = """
+        def a(path):
+            with open(path) as fh:
+                return fh.read()
+
+        def b(path):
+            fh = open(path)
+            try:
+                return fh.read()
+            finally:
+                fh.close()
+
+        def c(path):
+            fh = open(path)
+            return fh  # ownership transfer
+
+        def d(path, sink):
+            fh = open(path)
+            sink.adopt(fh)  # callee owns it now
+
+        def e(self, path):
+            fh = open(path)
+            self.fh = fh  # stored: closed elsewhere
+    """
+    assert check(ResourceLeakRule(), code, "parseable_tpu/storage/x.py") == []
+
+
+def test_resource_leak_suppression_and_scope():
+    code = """
+        def f(path):
+            fh = open(path)  # plint: disable=resource-leak
+            return fh.read()
+    """
+    assert check(ResourceLeakRule(), code, "parseable_tpu/storage/x.py") == []
+    bare = "def f(p):\n    fh = open(p)\n    return fh.read()\n"
+    # rule scope: write/scan/server surface only
+    assert check(ResourceLeakRule(), bare, "parseable_tpu/rbac/__init__.py") == []
+
+
+# ------------------------------------------- escaping-exception-in-worker
+
+
+RAISING_WORKER = {
+    "parseable_tpu/storage/w.py": """
+        class Svc:
+            def kick(self):
+                self.pool.submit(job)
+
+        def job():
+            helper()
+
+        def helper():
+            raise RuntimeError("boom")
+    """
+}
+
+
+def test_escaping_exception_flags_fire_and_forget():
+    out = finalize(EscapingExceptionRule(), RAISING_WORKER)
+    assert len(out) == 1
+    f = out[0]
+    assert "job" in f.message and "vanish" in f.message
+    assert "helper" in f.message  # the chain to the raise is named
+
+
+def test_escaping_exception_caught_in_worker_clean():
+    code = {
+        "parseable_tpu/storage/w.py": RAISING_WORKER[
+            "parseable_tpu/storage/w.py"
+        ].replace(
+            "def job():\n            helper()",
+            "def job():\n"
+            "            try:\n"
+            "                helper()\n"
+            "            except Exception:\n"
+            "                print('logged')",
+        )
+    }
+    assert finalize(EscapingExceptionRule(), code) == []
+
+
+def test_escaping_exception_observed_future_clean():
+    code = {
+        "parseable_tpu/storage/w.py": RAISING_WORKER[
+            "parseable_tpu/storage/w.py"
+        ].replace(
+            "self.pool.submit(job)",
+            "fut = self.pool.submit(job)\n        return fut.result()",
+        )
+    }
+    assert finalize(EscapingExceptionRule(), code) == []
+
+
+def test_escaping_exception_unwraps_propagate_and_suppression():
+    wrapped = {
+        "parseable_tpu/storage/w.py": RAISING_WORKER[
+            "parseable_tpu/storage/w.py"
+        ].replace("self.pool.submit(job)", "self.pool.submit(telemetry.propagate(job))")
+    }
+    assert len(finalize(EscapingExceptionRule(), wrapped)) == 1
+    suppressed = {
+        "parseable_tpu/storage/w.py": RAISING_WORKER[
+            "parseable_tpu/storage/w.py"
+        ].replace(
+            "self.pool.submit(job)",
+            "self.pool.submit(job)  # plint: disable=escaping-exception-in-worker",
+        )
+    }
+    assert finalize(EscapingExceptionRule(), suppressed) == []
+
+
+# ----------------------------------------------------- fingerprints (v2)
+
+
+LOCKED_TREE = {
+    "parseable_tpu/streams.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._items = []  # guarded-by: self._lock
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._items.append(2)
+    """,
+}
+
+
+def _write_tree(root: Path, files: dict[str, str]) -> None:
+    for rel, code in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    (root / "README.md").write_text("no knobs\n")
+
+
+def test_fingerprint_survives_function_rename(tmp_path):
+    _write_tree(tmp_path, LOCKED_TREE)
+    before = run_analysis(tmp_path).unbaselined
+    assert len(before) == 1
+
+    renamed = LOCKED_TREE["parseable_tpu/streams.py"].replace("def bad", "def worse")
+    (tmp_path / "parseable_tpu/streams.py").write_text(textwrap.dedent(renamed))
+    after = run_analysis(tmp_path).unbaselined
+    assert len(after) == 1
+    # the enclosing scope changed...
+    assert before[0].context == "Box.bad" and after[0].context == "Box.worse"
+    # ...but the v2 identity (rule, path, normalized snippet) did not
+    assert before[0].fingerprint == after[0].fingerprint
+    # while the legacy identity would have shifted (the v1 bug)
+    assert before[0].legacy_fingerprint != after[0].legacy_fingerprint
+
+
+def test_baseline_migration_accepts_legacy_fingerprints(tmp_path):
+    _write_tree(tmp_path, LOCKED_TREE)
+    report = run_analysis(tmp_path)
+    assert len(report.unbaselined) == 1
+    legacy = report.unbaselined[0].legacy_fingerprint
+    baseline = tmp_path / ".plint-baseline.json"
+    baseline.write_text(
+        json.dumps({"version": 1, "findings": [{"fingerprint": legacy}]})
+    )
+    migrated = run_analysis(tmp_path, baseline_path=baseline)
+    assert migrated.clean and len(migrated.baselined) == 1
+
+
+def test_fingerprint_ignores_line_shift_and_comments(tmp_path):
+    _write_tree(tmp_path, LOCKED_TREE)
+    before = run_analysis(tmp_path).unbaselined[0]
+    shifted = (
+        "# leading comment\n"
+        + textwrap.dedent(LOCKED_TREE["parseable_tpu/streams.py"]).replace(
+            "self._items.append(2)", "self._items.append(2)  # trailing note"
+        )
+    )
+    (tmp_path / "parseable_tpu/streams.py").write_text(shifted)
+    after = run_analysis(tmp_path).unbaselined[0]
+    assert before.fingerprint == after.fingerprint
+
+
+# -------------------------------------------------------- CLI satellites
+
+
+def _plint(root: Path, *args: str):
+    cmd = [sys.executable, "-m", "parseable_tpu.analysis", "--root", str(root), *args]
+    return subprocess.run(cmd, capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def _git(root: Path, *args: str) -> None:
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=root,
+        check=True,
+        capture_output=True,
+    )
+
+
+def test_cli_changed_reports_only_changed_files(tmp_path):
+    clean = "VALUE = 1\n"
+    dirty = 'import os\n\nFLAG = os.environ.get("P_SNEAKY")\n'
+    _write_tree(
+        tmp_path,
+        {
+            "parseable_tpu/old.py": dirty,  # pre-existing debt on main
+            "parseable_tpu/new.py": clean,
+        },
+    )
+    (tmp_path / "README.md").write_text("`P_SNEAKY` and `P_SNEAKY2` documented\n")
+    _git(tmp_path, "init", "-b", "main")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-m", "seed")
+    # a new violation lands in new.py only
+    (tmp_path / "parseable_tpu/new.py").write_text(
+        'import os\n\nFLAG = os.environ.get("P_SNEAKY2")\n'
+    )
+    proc = _plint(tmp_path, "--changed", "--no-cache", "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["changed_only"] is True
+    assert [f["path"] for f in doc["findings"]] == ["parseable_tpu/new.py"]
+    # the full run still sees the pre-existing finding in old.py
+    proc = _plint(tmp_path, "--no-cache", "--json")
+    doc = json.loads(proc.stdout)
+    assert {f["path"] for f in doc["findings"]} == {
+        "parseable_tpu/new.py",
+        "parseable_tpu/old.py",
+    }
+
+
+def test_cli_result_cache_hits_and_invalidates(tmp_path):
+    _write_tree(tmp_path, {"parseable_tpu/mod.py": "VALUE = 1\n"})
+    first = _plint(tmp_path, "--json")
+    assert first.returncode == 0
+    assert "cached" not in json.loads(first.stdout)
+    second = _plint(tmp_path, "--json")
+    assert json.loads(second.stdout).get("cached") is True
+    # any edit invalidates (mtime+size keyed over every analyzed file)
+    time.sleep(0.01)
+    (tmp_path / "parseable_tpu/mod.py").write_text("VALUE = 2\n")
+    third = _plint(tmp_path, "--json")
+    assert "cached" not in json.loads(third.stdout)
+
+
+def test_cli_json_out_artifact(tmp_path):
+    _write_tree(tmp_path, {"parseable_tpu/mod.py": "VALUE = 1\n"})
+    out = tmp_path / "plint-report.json"
+    proc = _plint(tmp_path, "--no-cache", "--json-out", str(out))
+    assert proc.returncode == 0
+    doc = json.loads(out.read_text())
+    assert doc["clean"] is True and "findings" in doc
+
+
+def test_cli_explain_from_docstrings():
+    for rule, needle in (
+        ("transitive-blocking-in-async", "run_in_executor"),
+        ("lock-order", "lock-order: A < B"),
+        ("resource-leak", "finally"),
+        ("escaping-exception-in-worker", ".result()"),
+    ):
+        proc = subprocess.run(
+            [sys.executable, "-m", "parseable_tpu.analysis", "--explain", rule],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0
+        assert needle in proc.stdout
+        assert f"# plint: disable={rule}" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "parseable_tpu.analysis", "--explain", "nope"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 2
+
+
+def test_full_run_wall_clock_budget():
+    """The gate budget: a full (uncached) run over the real tree in <15s."""
+    started = time.monotonic()
+    report = run_analysis(REPO_ROOT, baseline_path=REPO_ROOT / ".plint-baseline.json")
+    elapsed = time.monotonic() - started
+    assert report.files_checked > 50
+    assert elapsed < 15.0, f"full plint run took {elapsed:.1f}s (budget 15s)"
+
+
+# ----------------------------------------------- live-tree regressions
+
+
+def test_live_tree_lock_hierarchy_is_declared():
+    """The write-path lock hierarchy is annotated in the real tree and the
+    rule consumes it (the annotations double as documentation)."""
+    project = Project(root=REPO_ROOT)
+    from parseable_tpu.analysis.framework import iter_python_files
+
+    for p in iter_python_files(REPO_ROOT, ["parseable_tpu"]):
+        project.files.append(SourceFile.from_path(REPO_ROOT, p))
+    g = build_call_graph(project)
+    declared = {(a, b) for a, b, _, _ in g.declared_order}
+    assert ("Streams._lock", "Stream.lock") in declared
+    assert ("Stream.lock", "MemWriter._lock") in declared
+    assert ("EncodedBlockCache._write_lock", "EncodedBlockCache._lock") in declared
+    assert ("Tracer._flush_inflight", "Tracer._lock") in declared
+    # the dynamic stream-json lock joins the graph via its # lock-id: tag
+    us = g.funcs["parseable_tpu.core:Parseable.update_snapshot"]
+    assert [s.lock_id for s in us.locks] == ["Parseable.stream_json"]
+
+
+def test_fanout_worker_failure_is_logged_not_swallowed(tmp_path, caplog):
+    """escaping-exception-in-worker regression: the cluster fan-out used to
+    submit sync_with_ingestors and drop the Future — a metastore error
+    vanished without a log line."""
+    import logging
+
+    from parseable_tpu.server import app as app_mod
+    from parseable_tpu.server import cluster
+    from tests.test_server import make_state
+    from parseable_tpu.config import Mode
+
+    state = make_state(tmp_path, mode=Mode.QUERY)
+    orig = cluster.sync_with_ingestors
+
+    def boom(*a, **k):
+        raise RuntimeError("metastore down")
+
+    cluster.sync_with_ingestors = boom
+    try:
+        with caplog.at_level(logging.ERROR, logger="parseable_tpu.server.app"):
+            app_mod.fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload")
+            state.workers.shutdown(wait=True)
+    finally:
+        cluster.sync_with_ingestors = orig
+        state.p.shutdown()
+    assert any("peer fan-out" in r.message for r in caplog.records)
+
+
+def test_metastore_calls_leave_the_event_loop(tmp_path):
+    """transitive-blocking regression: management handlers used to call the
+    metastore (object storage) directly on the event loop; they must now
+    run it on a worker thread."""
+    import asyncio
+
+    from tests.test_server import AUTH, make_state, run, with_client
+
+    state = make_state(tmp_path)
+    seen_threads: list[int] = []
+    orig = state.p.metastore.get_document
+
+    def recording_get_document(collection, doc_id):
+        seen_threads.append(threading.get_ident())
+        return orig(collection, doc_id)
+
+    state.p.metastore.get_document = recording_get_document
+
+    async def fn(client):
+        loop_thread = threading.get_ident()
+        r = await client.get("/api/v1/alert-target-policy", headers=AUTH)
+        assert r.status == 200
+        assert seen_threads, "handler never reached the metastore"
+        assert all(t != loop_thread for t in seen_threads), (
+            "metastore called on the event loop thread"
+        )
+
+    try:
+        run(with_client(state, fn))
+    finally:
+        state.p.shutdown()
+
+
+def test_scan_parquet_readers_are_closed(tmp_path):
+    """resource-leak regression: StreamScan's per-file ParquetFile readers
+    are context-managed now — the fd is released eagerly, not whenever GC
+    gets around to the reader."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pf_path = tmp_path / "x.parquet"
+    pq.write_table(pa.table({"a": [1, 2, 3]}), pf_path)
+    with pq.ParquetFile(pf_path) as pf:
+        assert pf.read().num_rows == 3
+    # the reader is closed the moment the with-block exits
+    with pytest.raises(Exception):
+        pf.read()
