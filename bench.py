@@ -26,7 +26,14 @@ import subprocess
 import sys
 import tempfile
 import time
-from datetime import UTC, datetime, timedelta
+from datetime import datetime, timedelta
+
+try:
+    from datetime import UTC
+except ImportError:  # py3.10: parseable_tpu installs the datetime.UTC shim
+    from datetime import timezone as _tz
+
+    UTC = _tz.utc
 
 import numpy as np
 import pyarrow as pa
@@ -692,6 +699,261 @@ def bench_ingest_pipeline() -> None:
     )
 
 
+def bench_query_concurrency() -> None:
+    """Closed-loop concurrent query serving bench (the BASELINE.md latency
+    north star no bench emitted before this): N concurrent clients — one
+    heavy full-range aggregate, the rest light dashboard-style narrow-range
+    aggregates — against one node with background ingest running, under a
+    simulated object-store GET RTT so scan tasks have real service time.
+
+    Phase 1/2 A/B the shared scan scheduler's dispatch policy (fifo vs
+    fair) with the result cache OFF and report the light-query p50/p95/p99
+    per policy: fair round-robin must beat global FIFO at the tail, because
+    the heavy scan's backlog no longer sits in front of every dashboard
+    query. Phase 3 turns the partial-aggregate result cache on and measures
+    the same heavy aggregate cold vs warm (warm must skip the scan).
+
+    Env knobs: BENCH_QC_CLIENTS (8), BENCH_QC_SECS (6 per policy phase),
+    BENCH_QC_FILES (24 manifest files), BENCH_QC_ROWS (4000 rows/file),
+    BENCH_QC_GET_MS (10 ms simulated GET RTT), BENCH_QC_SCAN_WORKERS (2).
+    """
+    import pathlib
+    import threading
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.event import Event
+    from parseable_tpu.query.provider import get_scan_scheduler
+    from parseable_tpu.query.session import QuerySession
+
+    n_clients = int(os.environ.get("BENCH_QC_CLIENTS", "8"))
+    phase_secs = float(os.environ.get("BENCH_QC_SECS", "6"))
+    n_files = int(os.environ.get("BENCH_QC_FILES", "24"))
+    rows_per_file = int(os.environ.get("BENCH_QC_ROWS", "4000"))
+    get_ms = float(os.environ.get("BENCH_QC_GET_MS", "10"))
+    base = datetime(2024, 5, 1, 0, 0, tzinfo=UTC)
+    hist = ("2024-05-01T00:00:00Z", "2024-05-02T00:00:00Z")
+    # 3 of the N files: the dashboard query a heavy scan must not starve
+    light_range = ("2024-05-01T00:01:00Z", "2024-05-01T00:04:00Z")
+
+    workdir = tempfile.mkdtemp(prefix="ptpu-qcbench-")
+    try:
+        opts = Options()
+        opts.local_staging_path = pathlib.Path(workdir) / "staging"
+        opts.scan_workers = int(os.environ.get("BENCH_QC_SCAN_WORKERS", "2"))
+        opts.query_result_cache_bytes = 0  # phases 1-2 measure scheduling
+        storage = StorageOptions(
+            backend="local-store", root=pathlib.Path(workdir) / "data"
+        )
+        p = Parseable(opts, storage)
+        rng = np.random.default_rng(17)
+        stream = p.create_stream_if_not_exists("qc")
+        for minute in range(n_files):
+            n = rows_per_file
+            ts = [
+                base + timedelta(minutes=minute, milliseconds=int(o))
+                for o in np.sort(rng.integers(0, 60_000, n))
+            ]
+            tbl = pa.table(
+                {
+                    DEFAULT_TIMESTAMP_KEY: pa.array(
+                        [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
+                    ),
+                    "host": pa.array([f"h{i % 16}" for i in range(n)]),
+                    "status": pa.array(
+                        rng.choice([200.0, 404.0, 500.0], n).astype(np.float64)
+                    ),
+                    "bytes": pa.array(rng.random(n) * 1000),
+                }
+            ).combine_chunks()
+            for batch in tbl.to_batches():
+                Event(
+                    stream_name="qc",
+                    rb=batch,
+                    origin_size=batch.num_rows * 100,
+                    is_first_event=minute == 0,
+                    parsed_timestamp=base + timedelta(minutes=minute),
+                ).process(stream, commit_schema=p.commit_schema)
+        p.local_sync(shutdown=True)
+        p.sync_all_streams()
+
+        # simulated object-store RTT: without it, local-fs reads finish so
+        # fast the dispatch policy can't matter
+        real_get = p.storage.get_object
+
+        def slow_get(key):
+            time.sleep(get_ms / 1000.0)
+            return real_get(key)
+
+        p.storage.get_object = slow_get
+
+        heavy_sql = (
+            "SELECT host, status, count(*) c, sum(bytes) s FROM qc "
+            "GROUP BY host, status"
+        )
+        light_sql = "SELECT host, count(*) c FROM qc GROUP BY host"
+
+        def one(sql, rng_pair):
+            return QuerySession(p, engine="cpu").query(sql, *rng_pair)
+
+        # warm the plan cache + code paths so neither phase pays first-run
+        one(heavy_sql, hist)
+        one(light_sql, light_range)
+
+        def run_phase(policy: str) -> dict:
+            opts.scan_sched = policy
+            get_scan_scheduler(opts)  # re-root onto the policy under test
+            lats: list[float] = []
+            llock = threading.Lock()
+            stop = threading.Event()
+            errors: list[str] = []
+            heavy_done = [0]
+
+            def heavy_client():
+                while not stop.is_set():
+                    try:
+                        one(heavy_sql, hist)
+                        heavy_done[0] += 1
+                    except Exception as e:  # noqa: BLE001 - recorded
+                        errors.append(repr(e))
+                        return
+
+            def light_client():
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        one(light_sql, light_range)
+                    except Exception as e:  # noqa: BLE001 - recorded
+                        errors.append(repr(e))
+                        return
+                    with llock:
+                        lats.append(time.perf_counter() - t0)
+
+            def ingest_client():
+                # background ingest: staging writes racing the queries
+                i = 0
+                while not stop.is_set():
+                    n = 500
+                    tbl = pa.table(
+                        {
+                            DEFAULT_TIMESTAMP_KEY: pa.array(
+                                [
+                                    (base + timedelta(hours=2, seconds=i * 60 + k)).replace(
+                                        tzinfo=None
+                                    )
+                                    for k in range(n)
+                                ],
+                                pa.timestamp("ms"),
+                            ),
+                            "host": pa.array(["ing"] * n),
+                            "status": pa.array([200.0] * n),
+                            "bytes": pa.array([1.0] * n),
+                        }
+                    )
+                    for batch in tbl.to_batches():
+                        Event(
+                            stream_name="qc", rb=batch, origin_size=n * 100,
+                            is_first_event=False,
+                            parsed_timestamp=base + timedelta(hours=2),
+                        ).process(stream, commit_schema=p.commit_schema)
+                    i += 1
+                    time.sleep(0.05)
+
+            threads = [threading.Thread(target=heavy_client)]
+            threads += [
+                threading.Thread(target=light_client) for _ in range(n_clients - 1)
+            ]
+            threads += [threading.Thread(target=ingest_client)]
+            for t in threads:
+                t.start()
+            time.sleep(phase_secs)
+            stop.set()
+            for t in threads:
+                t.join()
+            if errors:
+                print(f"# qc bench [{policy}] errors: {errors[:3]}", file=sys.stderr)
+            return {
+                "n": len(lats),
+                "p50": percentile(lats, 0.50),
+                "p95": percentile(lats, 0.95),
+                "p99": percentile(lats, 0.99),
+                "heavy_done": heavy_done[0],
+            }
+
+        fifo = run_phase("fifo")
+        fair = run_phase("fair")
+
+        # phase 3: partial-aggregate result cache, cold vs warm repeat
+        opts.query_result_cache_bytes = 64 * 1024 * 1024
+        t0 = time.perf_counter()
+        cold_res = one(heavy_sql, hist)
+        cold_s = time.perf_counter() - t0
+        warm_s = 1e9
+        warm_hit = False
+        for _ in range(3):
+            t0 = time.perf_counter()
+            warm_res = one(heavy_sql, hist)
+            warm_s = min(warm_s, time.perf_counter() - t0)
+            warm_hit = warm_hit or (
+                warm_res.stats["stages"].get("result_cache") == "hit"
+            )
+        ratio = warm_s / max(cold_s, 1e-9)
+        assert cold_res.table.num_rows == warm_res.table.num_rows
+
+        speedup_p95 = fifo["p95"] / max(fair["p95"], 1e-9)
+        print(
+            f"# query concurrency ({n_clients} clients + ingest, {n_files} files, "
+            f"{get_ms:.0f}ms GET): light fifo p50 {fifo['p50']*1e3:.0f}ms "
+            f"p95 {fifo['p95']*1e3:.0f}ms p99 {fifo['p99']*1e3:.0f}ms | "
+            f"fair p50 {fair['p50']*1e3:.0f}ms p95 {fair['p95']*1e3:.0f}ms "
+            f"p99 {fair['p99']*1e3:.0f}ms ({speedup_p95:.2f}x p95) | "
+            f"agg cache cold {cold_s*1e3:.0f}ms warm {warm_s*1e3:.0f}ms "
+            f"({ratio:.3f}x, hit={warm_hit})",
+            file=sys.stderr,
+        )
+        emit(
+            "bench_query_concurrency",
+            fair["n"] / max(phase_secs, 1e-9),
+            speedup_p95,
+            {
+                "unit": "queries/s",
+                "clients": n_clients,
+                "phase_secs": phase_secs,
+                "files": n_files,
+                "sim_get_ms": get_ms,
+                "scan_workers": opts.scan_workers,
+                "background_ingest": True,
+                "light_p50_s_fair": round(fair["p50"], 4),
+                "light_p95_s_fair": round(fair["p95"], 4),
+                "light_p99_s_fair": round(fair["p99"], 4),
+                "light_p50_s_fifo": round(fifo["p50"], 4),
+                "light_p95_s_fifo": round(fifo["p95"], 4),
+                "light_p99_s_fifo": round(fifo["p99"], 4),
+                "light_queries_fair": fair["n"],
+                "light_queries_fifo": fifo["n"],
+                "heavy_queries_fair": fair["heavy_done"],
+                "heavy_queries_fifo": fifo["heavy_done"],
+                "fair_vs_fifo_p95": round(speedup_p95, 3),
+                "agg_cache_cold_s": round(cold_s, 4),
+                "agg_cache_warm_s": round(warm_s, 4),
+                "agg_cache_warm_over_cold": round(ratio, 4),
+                "agg_cache_hit": warm_hit,
+                "note": (
+                    "closed-loop light-query latency under one heavy scan + "
+                    "background ingest; fair = per-query weighted RR on the "
+                    "shared scan pool, fifo = global arrival order; cache = "
+                    "partial-aggregate result cache cold vs warm repeat"
+                ),
+            },
+        )
+        p.shutdown()
+    except Exception as e:  # noqa: BLE001
+        print(f"# query concurrency bench failed: {e}", file=sys.stderr)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_otel_ingest(p) -> None:
     """OTel-logs ingest line: the native C++ lane (fastpath.cpp walk ->
     NDJSON -> pyarrow reader -> staging) vs the Python flattener pipeline
@@ -827,6 +1089,7 @@ def main() -> None:
             bench_otel_ingest(pb)
             bench_json_ingest(pb)
             bench_ingest_pipeline()
+            bench_query_concurrency()
             bench_config1(pb, with_tpu=False)
             bench_scale_subprocess(with_tpu=False)
         except Exception as e:  # noqa: BLE001
@@ -959,6 +1222,7 @@ def main() -> None:
         bench_otel_ingest(p)
         bench_json_ingest(p)
         bench_ingest_pipeline()
+        bench_query_concurrency()
         bench_config1(p, with_tpu=True)
         bench_scale_subprocess(with_tpu=True)
 

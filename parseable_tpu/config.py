@@ -237,6 +237,36 @@ class Options:
     # pyarrow-compute fallback engine (the measured baseline).
     query_engine: str = field(default_factory=lambda: _env("P_QUERY_ENGINE", "tpu"))
 
+    # --- concurrent query serving (admission + caches + dedicated pool) -------
+    # dedicated bounded executor for query CPU work, so queries cannot
+    # starve the event loop's other executor users (ingest, metastore I/O)
+    query_workers: int = field(
+        default_factory=lambda: _env_int("P_QUERY_WORKERS", min(8, os.cpu_count() or 1))
+    )
+    # admission control on /api/v1/query and /api/v1/counts: at most this
+    # many queries execute at once; 0 disables the gate entirely
+    query_max_concurrent: int = field(
+        default_factory=lambda: _env_int("P_QUERY_MAX_CONCURRENT", 32)
+    )
+    # bounded wait queue past the concurrency gate; arrivals beyond it are
+    # shed immediately with 503 + Retry-After
+    query_queue_depth: int = field(
+        default_factory=lambda: _env_int("P_QUERY_QUEUE_DEPTH", 128)
+    )
+    # how long a queued query waits for a slot before 503
+    query_queue_timeout_ms: int = field(
+        default_factory=lambda: _env_int("P_QUERY_QUEUE_TIMEOUT_MS", 1000)
+    )
+    # LRU plan/parse cache entries keyed on (sql, stream schema); 0 disables
+    query_plan_cache_entries: int = field(
+        default_factory=lambda: _env_int("P_QUERY_PLAN_CACHE", 256)
+    )
+    # byte budget for the partial-aggregate result cache keyed on
+    # (stream, manifest-set fingerprint, plan fingerprint); 0 disables
+    query_result_cache_bytes: int = field(
+        default_factory=lambda: _env_int("P_QUERY_RESULT_CACHE_BYTES", 64 * 1024 * 1024)
+    )
+
     # --- parallel scan pipeline (query/provider.py) ---------------------------
     # concurrent manifest-file fetch+decode workers; parquet decode releases
     # the GIL and object-store GETs are network-bound, so threads overlap well
@@ -247,6 +277,10 @@ class Options:
     scan_inflight_bytes: int = field(
         default_factory=lambda: _env_int("P_SCAN_INFLIGHT_BYTES", 256 * 1024 * 1024)
     )
+    # cross-query dispatch policy for the shared scan pool: "fair" serves
+    # active queries weighted round-robin (a 10k-file scan cannot starve a
+    # 3-file dashboard query); "fifo" is strict global arrival order
+    scan_sched: str = field(default_factory=lambda: _env("P_SCAN_SCHED", "fair"))
     # projected column-chunk range reads for remote parquet (footer via tail
     # get_range, then only the projected columns' byte ranges); 0 disables
     scan_range_reads: bool = field(

@@ -294,6 +294,11 @@ class Parseable:
         merged = merge_schemas([current, new_schema])
         stream.metadata.schema = {f.name: f for f in merged}
         self.metastore.put_schema(stream_name, merged)
+        # plans are keyed on a schema fingerprint; evict eagerly so stale
+        # plans for the old shape free their LRU slots immediately
+        from parseable_tpu.query.session import invalidate_plan_cache
+
+        invalidate_plan_cache(stream_name)
 
     # ----------------------------------------------------------------- sync
 
@@ -550,6 +555,11 @@ class Parseable:
             if fmt.first_event_at is None and stream.metadata.first_event_at:
                 fmt.first_event_at = stream.metadata.first_event_at
             self.metastore.put_stream_json(stream.name, fmt, self._node_suffix)
+        # the committed snapshot supersedes every cached aggregate interim
+        # for this stream (their manifest-set fingerprints are now stale)
+        from parseable_tpu.query.partials import invalidate_result_cache
+
+        invalidate_result_cache(stream.name)
 
     # -------------------------------------------------------------- shutdown
 

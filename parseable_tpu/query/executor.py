@@ -856,6 +856,13 @@ class HashAggregator:
 class QueryExecutor:
     """Execute a LogicalPlan over an iterator of tables (CPU engine)."""
 
+    # set by the session when the query is result-cache eligible: receives
+    # the merged interim (finalized partials) the moment the scan has been
+    # fully reduced, before HAVING/projection/ORDER BY run. Every engine
+    # (CPU two-phase, classic hash aggregate, TPU dense fold) funnels its
+    # interim through finalize_from_interim, so one hook covers them all.
+    interim_sink = None
+
     def __init__(self, plan: LogicalPlan):
         self.plan = plan
 
@@ -1229,6 +1236,8 @@ class QueryExecutor:
         """Post-aggregation: HAVING, projection over __g/__agg slots, ORDER
         BY/LIMIT. Shared by the sparse (dict) fold and the TPU engine's
         vectorized dense finalize."""
+        if self.interim_sink is not None:
+            self.interim_sink(interim)
         sel = self.plan.select
 
         # group exprs referenced post-agg resolve to the key columns.

@@ -25,11 +25,16 @@ to the legacy multi-column group_by.
 
 from __future__ import annotations
 
+import hashlib
+import threading
+from collections import OrderedDict
 from typing import Any
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
+
+from parseable_tpu.utils.metrics import QUERY_RESULT_CACHE, QUERY_RESULT_CACHE_BYTES
 
 # aggregate functions expressible in partial format: stddev/var carry
 # (count, sum, sum-of-squares) columns; percentile/distinct need sketch /
@@ -47,6 +52,131 @@ def specs_partializable(specs) -> bool:
 
 class _FastPathUnavailable(Exception):
     pass
+
+
+# --------------------------------------------------------------------------
+# partial-aggregate result cache
+
+
+class PartialResultCache:
+    """LRU cache of *finalized partials* — the merged interim (__g/__agg)
+    table an aggregate produces after consuming its whole scan — keyed on
+    (stream, manifest-set fingerprint, plan fingerprint).
+
+    A repeated `GROUP BY` over an unchanged snapshot then skips the scan
+    entirely: the session re-runs only HAVING / projection / ORDER BY /
+    LIMIT over the cached interim. Correctness comes from the key: the
+    manifest-set fingerprint covers every (path, size, rows) the scan
+    would read, so any snapshot commit, retention sweep, or compaction
+    changes the key. update_snapshot additionally evicts the stream's
+    entries eagerly (invalidate_stream) so stale interims don't squat on
+    the byte budget. Arrow tables are immutable, so entries are shared
+    without copies. Thread-safe: queries hit it from worker threads."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max(1, max_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, pa.Table] = OrderedDict()  # guarded-by: self._lock
+        self._bytes = 0  # guarded-by: self._lock
+        self.hits = 0  # guarded-by: self._lock
+        self.misses = 0  # guarded-by: self._lock
+
+    def get(self, key: tuple) -> pa.Table | None:
+        with self._lock:
+            table = self._entries.get(key)
+            if table is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+                self._entries.move_to_end(key)
+        QUERY_RESULT_CACHE.labels("hit" if table is not None else "miss").inc()
+        return table
+
+    def put(self, key: tuple, table: pa.Table) -> None:
+        size = table.nbytes
+        if size > self.max_bytes:
+            return  # one oversized interim must not wipe the whole cache
+        with self._lock:
+            prev = self._entries.pop(key, None)
+            if prev is not None:
+                self._bytes -= prev.nbytes
+            self._entries[key] = table
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes
+            QUERY_RESULT_CACHE_BYTES.set(self._bytes)
+
+    def invalidate_stream(self, stream: str) -> int:
+        """Evict every entry for `stream` (snapshot commit / retention)."""
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] == stream]
+            for k in doomed:
+                self._bytes -= self._entries.pop(k).nbytes
+            QUERY_RESULT_CACHE_BYTES.set(self._bytes)
+        return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            QUERY_RESULT_CACHE_BYTES.set(0)
+
+
+_RESULT_CACHE: PartialResultCache | None = None
+_RESULT_CACHE_LOCK = threading.Lock()
+
+
+def get_result_cache(options=None) -> PartialResultCache | None:
+    """Process-wide result cache sized by P_QUERY_RESULT_CACHE_BYTES
+    (0 disables). Re-roots when the configured budget changes."""
+    global _RESULT_CACHE
+    budget = getattr(options, "query_result_cache_bytes", 64 * 1024 * 1024)
+    if budget <= 0:
+        return None
+    with _RESULT_CACHE_LOCK:
+        if _RESULT_CACHE is None or _RESULT_CACHE.max_bytes != budget:
+            _RESULT_CACHE = PartialResultCache(budget)
+        return _RESULT_CACHE
+
+
+def invalidate_result_cache(stream: str) -> int:
+    """Snapshot-commit hook (core.update_snapshot): drop the stream's
+    cached interims the moment the manifest set they were built from is
+    superseded."""
+    with _RESULT_CACHE_LOCK:
+        cache = _RESULT_CACHE
+    return cache.invalidate_stream(stream) if cache is not None else 0
+
+
+def manifest_fingerprint(files) -> str:
+    """Content fingerprint of a scan's manifest set: (path, size, rows) of
+    every file the pruned scan would read. Any upload, compaction, or
+    retention change to the set changes the digest."""
+    h = hashlib.blake2b(digest_size=16)
+    for f in sorted(files, key=lambda f: f.file_path):
+        h.update(f"{f.file_path}|{f.file_size}|{f.num_rows}\n".encode())
+    return h.hexdigest()
+
+
+def plan_fingerprint(lp, engine: str) -> str:
+    """Semantic fingerprint of what the interim depends on: the full
+    statement (WHERE/GROUP BY/aggregates), the effective time bounds, the
+    projected columns, and the engine (device partial sums are f32 per
+    block — close, but not bit-identical to the CPU's f64)."""
+    from parseable_tpu.query import sql as S
+
+    cols = sorted(lp.needed_columns) if lp.needed_columns is not None else ["*"]
+    text = "\x1f".join(
+        [
+            S.format_statement(lp.select),
+            str(lp.time_bounds.low),
+            str(lp.time_bounds.high),
+            ",".join(cols),
+            engine,
+        ]
+    )
+    return hashlib.blake2b(text.encode(), digest_size=16).hexdigest()
 
 
 def _encode_key(arr: pa.ChunkedArray | pa.Array) -> tuple[np.ndarray, pa.Array]:

@@ -38,7 +38,8 @@ import logging
 import queue as _queue
 import struct
 import threading
-from concurrent.futures import ThreadPoolExecutor
+import time as _time
+from collections import deque
 from dataclasses import dataclass
 from datetime import UTC, datetime, timedelta
 from pathlib import Path
@@ -52,6 +53,7 @@ from parseable_tpu.catalog import ManifestFile, Snapshot
 from parseable_tpu.core import Parseable
 from parseable_tpu.query.planner import LogicalPlan, prune_file
 from parseable_tpu.utils.metrics import (
+    QUERY_SCAN_SCHED_WAIT,
     SCAN_ERRORS,
     SCAN_POOL_QUEUE_DEPTH,
     SCAN_PROJECTION_BYTES_SAVED,
@@ -76,42 +78,302 @@ class ScanStats:
     # bytes the projected range reads did not download vs whole-object GETs
     bytes_saved_by_projection: int = 0
     range_read_files: int = 0
+    # cumulative time this query's scan tasks waited for a shared-pool
+    # worker (enqueue -> dispatch): THE cross-query contention signal
+    sched_wait_seconds: float = 0.0
 
 
 # --------------------------------------------------------------------------
-# parallel fetch+decode pool
+# shared scan scheduler: per-query lanes, weighted round-robin dispatch
 
 
-class _InflightBudget:
-    """Bounds decoded bytes held between the pool and the consumer.
+class ScanLane:
+    """One query's slice of the shared scan pool.
 
-    Workers acquire an estimate (the manifest file size) before fetching and
-    the consumer releases it when it takes the table. An item larger than the
-    whole cap is admitted alone (cap is a ceiling on *concurrent* holdings,
-    never a deadlock)."""
+    Holds the query's undispatched tasks, its in-flight byte budget, and
+    the completion queue its consumer drains. All dispatch-side state is
+    guarded by the owning scheduler's lock (dispatch decisions must see a
+    consistent cross-lane picture); the results queue is its own sync."""
 
-    def __init__(self, cap: int):
-        self.cap = max(1, cap)
-        self._used = 0  # guarded-by: self._cond
+    def __init__(self, sched: "ScanScheduler", inflight_bytes: int, weight: int,
+                 on_wait: Callable[[float], None] | None = None):
+        self._sched = sched
+        self.cap = max(1, inflight_bytes)
+        self.weight = max(1, weight)
+        self.credits = self.weight  # guarded-by: sched._cond
+        self.tasks: "deque" = deque()  # guarded-by: sched._cond
+        self.used = 0  # guarded-by: sched._cond - decoded bytes in flight
+        self.running = 0  # guarded-by: sched._cond - tasks mid-execution
+        self.closed = False  # guarded-by: sched._cond
+        self.cancelled = threading.Event()
+        self.results: _queue.Queue = _queue.Queue()
+        self.on_wait = on_wait  # per-query sched-wait accounting (stats)
+
+    def submit(self, fn: Callable[[], None], est: int) -> None:
+        self._sched._submit(self, fn, min(max(1, est), self.cap))
+
+    def release_bytes(self, est: int) -> None:
+        """Consumer took a decoded table: free its budget, wake dispatch."""
+        self._sched._release_bytes(self, min(max(1, est), self.cap))
+
+    def close(self) -> None:
+        """Drop undispatched tasks and wait for this lane's running tasks
+        to finish — after close() returns, no storage call runs or will
+        ever run on this lane's behalf."""
+        self.cancelled.set()
+        self._sched._close_lane(self)
+
+
+class ScanScheduler:
+    """Shared fetch+decode worker pool with per-query fairness.
+
+    Replaces the per-query ThreadPoolExecutor + global FIFO contention: one
+    process-wide set of P_SCAN_WORKERS threads serves every concurrent
+    query through per-query *lanes*. Dispatch policy:
+
+    - "fair" (default): weighted round-robin across lanes with queued work.
+      Each lane spends `weight` credits per round, so a 10k-file scan and a
+      3-file dashboard query alternate dispatches instead of the big scan
+      occupying every worker until its backlog drains.
+    - "fifo": strict global arrival order — the pre-scheduler behavior,
+      kept for A/B measurement (bench.py compares the two).
+
+    A lane's task is only dispatched when its own inflight-byte budget has
+    room, so a slow consumer parks its *lane*, never a worker thread.
+    Queue-wait (enqueue -> dispatch) lands in the
+    query_scan_sched_wait_seconds histogram and per-query ScanStats.
+    """
+
+    def __init__(self, workers: int, policy: str = "fair"):
+        self.workers = max(1, workers)
+        self.policy = policy if policy in ("fair", "fifo") else "fair"
         self._cond = threading.Condition()
+        self._lanes: list[ScanLane] = []  # guarded-by: self._cond
+        self._rr = 0  # guarded-by: self._cond - round-robin cursor
+        self._seq = 0  # guarded-by: self._cond - global arrival order
+        self._pending = 0  # guarded-by: self._cond - undispatched tasks
+        self._stopped = False  # guarded-by: self._cond
+        # NOT "scan-" prefixed: these are shared infrastructure threads that
+        # outlive any one scan (per-scan thread-leak checks key on "scan*")
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"qsched-{i}", daemon=True)
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
 
-    def acquire(self, n: int, cancelled: threading.Event) -> bool:
-        n = min(n, self.cap)  # oversized items admit alone
-        with self._cond:
-            while self._used and self._used + n > self.cap:
-                if cancelled.is_set():
-                    return False
-                self._cond.wait(timeout=0.1)
-            if cancelled.is_set():
-                return False
-            self._used += n
-            return True
+    # ---------------------------------------------------------------- lanes
 
-    def release(self, n: int) -> None:
-        n = min(n, self.cap)
+    def lane(self, *, inflight_bytes: int, weight: int = 1,
+             on_wait: Callable[[float], None] | None = None) -> ScanLane:
+        ln = ScanLane(self, inflight_bytes, weight, on_wait)
         with self._cond:
-            self._used = max(0, self._used - n)
+            if self._stopped:
+                raise RuntimeError("scan scheduler is stopped")
+            self._lanes.append(ln)
+        return ln
+
+    def _submit(self, lane: ScanLane, fn: Callable[[], None], est: int) -> None:
+        with self._cond:
+            if lane.closed or self._stopped:
+                # complete immediately: the task fn observes the cancelled
+                # flag and posts its skip record, so consumers never hang
+                lane.cancelled.set()
+                fn()
+                return
+            lane.tasks.append((fn, est, self._seq, _time.monotonic()))
+            self._seq += 1
+            self._pending += 1
+            SCAN_POOL_QUEUE_DEPTH.set(self._pending)
+            self._cond.notify()
+
+    def _release_bytes(self, lane: ScanLane, est: int) -> None:
+        with self._cond:
+            lane.used = max(0, lane.used - est)
             self._cond.notify_all()
+
+    def _close_lane(self, lane: ScanLane) -> None:
+        with self._cond:
+            if lane.closed:
+                return
+            lane.closed = True
+            self._pending -= len(lane.tasks)
+            lane.tasks.clear()
+            SCAN_POOL_QUEUE_DEPTH.set(self._pending)
+            # synchronous drain: tasks already mid-fetch finish and their
+            # results are dropped; nothing queued ever touches storage
+            while lane.running:
+                self._cond.wait()
+            if lane in self._lanes:
+                self._lanes.remove(lane)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _fits(self, lane: ScanLane) -> bool:
+        est = lane.tasks[0][1]
+        # an item larger than the whole cap admits alone (the cap bounds
+        # concurrent holdings, never deadlocks)
+        return lane.used == 0 or lane.used + est <= lane.cap
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                # wait until some lane has a dispatchable head task (queued
+                # work whose inflight budget has room)
+                while True:
+                    if self._stopped:
+                        return
+                    eligible = [
+                        ln for ln in self._lanes if ln.tasks and self._fits(ln)
+                    ]
+                    if eligible:
+                        break
+                    self._cond.wait()
+                if self.policy == "fifo":
+                    lane = min(eligible, key=lambda ln: ln.tasks[0][2])
+                else:
+                    lane = None
+                    n = len(self._lanes)
+                    for _pass in range(2):
+                        for off in range(n):
+                            cand = self._lanes[(self._rr + off) % n]
+                            if cand.tasks and cand.credits > 0 and self._fits(cand):
+                                lane = cand
+                                self._rr = (self._rr + off + 1) % max(1, n)
+                                cand.credits -= 1
+                                break
+                        if lane is not None:
+                            break
+                        # every eligible lane spent its credits: new round
+                        for ln in self._lanes:
+                            ln.credits = ln.weight
+                    if lane is None:  # pragma: no cover - eligible non-empty
+                        lane = eligible[0]
+                fn, est, _seq, enq = lane.tasks.popleft()
+                lane.used += est
+                lane.running += 1
+                self._pending -= 1
+                SCAN_POOL_QUEUE_DEPTH.set(self._pending)
+            wait = max(0.0, _time.monotonic() - enq)
+            QUERY_SCAN_SCHED_WAIT.observe(wait)
+            if lane.on_wait is not None:
+                try:
+                    lane.on_wait(wait)
+                except Exception:  # pragma: no cover - stats cb must not kill
+                    logger.exception("scan sched wait callback failed")
+            try:
+                fn()
+            finally:
+                with self._cond:
+                    lane.running -= 1
+                    self._cond.notify_all()
+
+    def shutdown(self) -> None:
+        """Stop the workers and error-complete whatever was still queued so
+        no consumer hangs. Deterministic: joins every thread."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cond.notify_all()
+            leftovers = [(ln, list(ln.tasks)) for ln in self._lanes]
+            for ln in self._lanes:
+                ln.cancelled.set()
+                ln.tasks.clear()
+            self._pending = 0
+            SCAN_POOL_QUEUE_DEPTH.set(0)
+        for t in self._threads:
+            t.join()
+        for ln, tasks in leftovers:
+            for fn, _est, _seq, _enq in tasks:
+                fn()  # cancelled flag set: posts the skip record
+
+
+_SCHED: ScanScheduler | None = None
+_SCHED_LOCK = threading.Lock()
+
+
+def get_scan_scheduler(options=None) -> ScanScheduler:
+    """Process-wide scheduler, sized by P_SCAN_WORKERS / P_SCAN_SCHED.
+    Re-roots (shutdown + rebuild) when the configuration changes — tests
+    and the A/B bench flip policy between phases with no scans in flight."""
+    global _SCHED
+    import os as _os
+
+    workers = max(1, getattr(options, "scan_workers", 0) or min(8, _os.cpu_count() or 1))
+    policy = getattr(options, "scan_sched", "fair") or "fair"
+    with _SCHED_LOCK:
+        if _SCHED is not None and (_SCHED.workers != workers or _SCHED.policy != policy):
+            old, _SCHED = _SCHED, None
+            old.shutdown()
+        if _SCHED is None:
+            _SCHED = ScanScheduler(workers, policy)
+        return _SCHED
+
+
+def shutdown_scan_scheduler() -> None:
+    global _SCHED
+    with _SCHED_LOCK:
+        if _SCHED is not None:
+            _SCHED.shutdown()
+            _SCHED = None
+
+
+def lane_iter(
+    lane: ScanLane,
+    items: list,
+    fetch: Callable,
+    size_of: Callable[[object], int],
+):
+    """Run `fetch(item)` for every item through the lane's scheduler,
+    yielding `(item, result)` pairs **as they complete** (completion order,
+    not submission order — the engines merge blocks orderlessly, and
+    head-of-line blocking would idle the device behind one slow GET).
+
+    Contract (the scan pool's cancellation guarantees, unchanged from the
+    per-query pool it replaced):
+    - closing the generator cancels not-yet-dispatched tasks, so no storage
+      call is issued after close; tasks already mid-fetch finish and their
+      results are dropped; the drain is synchronous;
+    - in-flight decoded bytes are bounded by the lane's budget (estimated
+      by `size_of`); the trace context at submission is carried into every
+      worker so per-file spans parent correctly.
+
+    `fetch` errors propagate to the consumer (expected per-file read errors
+    are already converted to `None` results by the caller's fetch fn).
+    """
+    for item in items:
+        est = max(1, size_of(item))
+        # each task enters its own copy of the submitter's context so spans
+        # recorded during fetch/decode join the query's trace
+        ctx = contextvars.copy_context()
+
+        def task(item=item, est=est, ctx=ctx):
+            # every code path MUST put exactly one record or the consumer hangs
+            if lane.cancelled.is_set():
+                lane.results.put((item, None, None, est))
+                return
+            try:
+                out = ctx.run(fetch, item)
+            except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
+                lane.results.put((item, None, e, est))
+                return
+            lane.results.put((item, out, None, est))
+
+        lane.submit(task, est)
+
+    received = 0
+    try:
+        while received < len(items):
+            item, out, err, est = lane.results.get()
+            received += 1
+            lane.release_bytes(est)
+            if err is not None:
+                raise err
+            if out is not None:
+                yield item, out
+    finally:
+        lane.close()
 
 
 def scan_pool_iter(
@@ -122,73 +384,16 @@ def scan_pool_iter(
     inflight_bytes: int,
     size_of: Callable[[object], int],
 ):
-    """Run `fetch(item)` over a bounded thread pool, yielding
-    `(item, result)` pairs **as they complete** (completion order, not
-    submission order — the engines merge blocks orderlessly, and head-of-line
-    blocking would idle the device behind one slow GET).
-
-    Contract (the tentpole's cancellation guarantees):
-    - closing the generator cancels not-yet-started tasks, so no storage
-      call is issued after close; tasks already mid-fetch finish and their
-      results are dropped;
-    - the pool is drained synchronously on close — no leaked threads;
-    - in-flight decoded bytes are bounded by `inflight_bytes` (estimated by
-      `size_of`); the trace context at construction is carried into every
-      worker so per-file spans parent correctly.
-
-    `fetch` errors propagate to the consumer (expected per-file read errors
-    are already converted to `None` results by the caller's fetch fn).
-    """
-    results: _queue.Queue = _queue.Queue()
-    cancelled = threading.Event()
-    budget = _InflightBudget(inflight_bytes)
-
-    def task(item):
-        # every code path MUST put exactly one record or the consumer hangs
-        try:
-            est = max(1, size_of(item))
-            if cancelled.is_set() or not budget.acquire(est, cancelled):
-                results.put((item, None, None, 0))
-                return
-        except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
-            results.put((item, None, e, 0))
-            return
-        try:
-            out = fetch(item)
-        except BaseException as e:  # noqa: BLE001 - re-raised in the consumer
-            results.put((item, None, e, est))
-            return
-        results.put((item, out, None, est))
-        SCAN_POOL_QUEUE_DEPTH.set(results.qsize())
-
-    pool = ThreadPoolExecutor(max_workers=max(1, workers), thread_name_prefix="scan")
-    futures = []
-    for item in items:
-        # each worker enters its own copy of the submitter's context so
-        # spans recorded during fetch/decode join the query's trace
-        ctx = contextvars.copy_context()
-        futures.append(pool.submit(ctx.run, task, item))
-
-    received = 0
+    """Single-query pool over a throwaway scheduler (compat shim for
+    callers that want an isolated pool; production scans share the global
+    scheduler via get_scan_scheduler + lane_iter). Threads are joined when
+    the generator finishes or is closed."""
+    sched = ScanScheduler(max(1, workers), "fair")
+    lane = sched.lane(inflight_bytes=inflight_bytes)
     try:
-        while received < len(items):
-            item, out, err, est = results.get()
-            received += 1
-            SCAN_POOL_QUEUE_DEPTH.set(results.qsize())
-            if est:
-                budget.release(est)
-            if err is not None:
-                raise err
-            if out is not None:
-                yield item, out
+        yield from lane_iter(lane, items, fetch, size_of)
     finally:
-        cancelled.set()
-        for fut in futures:
-            fut.cancel()
-        # synchronous drain: mid-fetch tasks finish, everything queued after
-        # the cancel flag exits before touching storage
-        pool.shutdown(wait=True)
-        SCAN_POOL_QUEUE_DEPTH.set(0)
+        sched.shutdown()
 
 
 # --------------------------------------------------------------------------
@@ -728,12 +933,21 @@ class StreamScan:
                 yield self._stamp(t, source_id)
             return
         inflight = max(1, getattr(opts, "scan_inflight_bytes", 256 * 1024 * 1024))
-        pooled = scan_pool_iter(
+
+        def on_wait(seconds: float) -> None:
+            with self._stats_lock:
+                self.stats.sched_wait_seconds += seconds
+
+        # shared cross-query scheduler: this query's files ride one lane,
+        # dispatched fairly against every other in-flight query's lanes
+        lane = get_scan_scheduler(opts).lane(
+            inflight_bytes=inflight, on_wait=on_wait
+        )
+        pooled = lane_iter(
+            lane,
             to_fetch,
             lambda pair: self._read_parquet(pair[0], use_threads=False),
-            workers=workers,
-            inflight_bytes=inflight,
-            size_of=lambda pair: pair[0].file_size or 1,
+            lambda pair: pair[0].file_size or 1,
         )
         try:
             for (f, source_id), t in pooled:
@@ -741,7 +955,7 @@ class StreamScan:
                     continue
                 yield self._stamp(t, source_id)
         finally:
-            # explicit, synchronous pool drain when the consumer closes us
+            # explicit, synchronous lane drain when the consumer closes us
             # (a for-loop does not close its source generator on its own)
             pooled.close()
 

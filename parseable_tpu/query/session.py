@@ -9,8 +9,11 @@ counts, and everything else runs on the selected engine (tpu|cpu).
 
 from __future__ import annotations
 
+import copy as _copy
 import logging
+import threading
 import time as _time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from datetime import UTC
 from typing import Any
@@ -23,10 +26,96 @@ from parseable_tpu.query.executor import QueryExecutor
 from parseable_tpu.query.planner import LogicalPlan, TimeBounds, plan as build_plan
 from parseable_tpu.query.provider import StreamScan
 from parseable_tpu.utils.arrowutil import record_batches_to_json
-from parseable_tpu.utils.metrics import QUERY_EXECUTE_TIME
+from parseable_tpu.utils.metrics import (
+    QUERY_CACHE_HIT,
+    QUERY_EXECUTE_TIME,
+    QUERY_PLAN_CACHE,
+)
 from parseable_tpu.utils.timeutil import TimeRange
 
 logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# plan/parse cache
+
+
+class PlanCache:
+    """Thread-safe LRU over parsed ASTs and logical plans.
+
+    Two entry kinds share the store: ("ast", sql) -> pristine parsed
+    Select, and ("plan", sql, stream, schema_fp) -> the LogicalPlan as
+    built by build_plan, before any per-request state (API time bounds,
+    deadline, schema hint) is applied. Entries are stored AND returned as
+    deepcopies — planning and execution mutate both structures freely, so
+    the cached originals must never be reachable from a running query.
+
+    Invalidation: the schema fingerprint in the key makes a schema change
+    miss naturally; commit_schema additionally calls invalidate_stream so
+    superseded plans don't squat on LRU slots."""
+
+    def __init__(self, max_entries: int):
+        self.max_entries = max(1, max_entries)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, Any] = OrderedDict()  # guarded-by: self._lock
+        self.hits = 0  # guarded-by: self._lock
+        self.misses = 0  # guarded-by: self._lock
+
+    def get(self, key: tuple):
+        with self._lock:
+            val = self._entries.get(key)
+            if val is None:
+                self.misses += 1
+                return None
+            self.hits += 1
+            self._entries.move_to_end(key)
+        return _copy.deepcopy(val)
+
+    def put(self, key: tuple, val) -> None:
+        val = _copy.deepcopy(val)
+        with self._lock:
+            self._entries[key] = val
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def invalidate_stream(self, stream: str) -> int:
+        with self._lock:
+            doomed = [
+                k for k in self._entries if k[0] == "plan" and k[2] == stream
+            ]
+            for k in doomed:
+                del self._entries[k]
+        return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_PLAN_CACHE: PlanCache | None = None
+_PLAN_CACHE_LOCK = threading.Lock()
+
+
+def get_plan_cache(options=None) -> PlanCache | None:
+    """Process-wide plan/parse cache sized by P_QUERY_PLAN_CACHE
+    (0 disables). Re-roots when the configured capacity changes."""
+    global _PLAN_CACHE
+    entries = getattr(options, "query_plan_cache_entries", 256)
+    if entries <= 0:
+        return None
+    with _PLAN_CACHE_LOCK:
+        if _PLAN_CACHE is None or _PLAN_CACHE.max_entries != entries:
+            _PLAN_CACHE = PlanCache(entries)
+        return _PLAN_CACHE
+
+
+def invalidate_plan_cache(stream: str) -> int:
+    """Schema-change hook (core.commit_schema): evict the stream's plans.
+    The parsed-AST entries stay — SQL text doesn't depend on schema."""
+    with _PLAN_CACHE_LOCK:
+        cache = _PLAN_CACHE
+    return cache.invalidate_stream(stream) if cache is not None else 0
 
 
 def _is_composite(select: S.Select) -> bool:
@@ -222,14 +311,40 @@ class QuerySession:
         from parseable_tpu.utils.telemetry import TRACER
 
         with TRACER.span("query", engine=self.engine) as sp:
+            self._plan_cache_state = None
+            self._result_cache_state = None
             tp = _time.perf_counter()
-            select = S.parse_sql(sql_text)
+            select = self._parse_cached(sql_text)
             self._parse_ms = round((_time.perf_counter() - tp) * 1000, 3)
             self._sql_text = sql_text
-            result = self._query_ast(select, start_time, end_time, allowed_streams, t0)
+            result = self._query_ast(
+                select, start_time, end_time, allowed_streams, t0, sql_key=sql_text
+            )
             sp["stream"] = ",".join(sorted(_referenced_streams(select))) or "?"
             sp["rows"] = result.table.num_rows
             return result
+
+    def _parse_cached(self, sql_text: str) -> S.Select:
+        """parse_sql through the plan/parse cache: the cached AST is
+        pristine (stored before any planning mutation) and handed out as a
+        deepcopy, so repeated dashboard statements skip the parser."""
+        cache = get_plan_cache(self.p.options)
+        if cache is None:
+            return S.parse_sql(sql_text)
+        cached = cache.get(("ast", sql_text))
+        if cached is not None:
+            return cached
+        select = S.parse_sql(sql_text)
+        cache.put(("ast", sql_text), select)
+        return select
+
+    def _schema_fingerprint(self, stream: str) -> int | None:
+        """Fingerprint of the stream's committed schema — part of every
+        plan-cache key so a schema change can never serve a stale plan."""
+        s = self.p.streams.get(stream)
+        if s is None or not s.metadata.schema:
+            return None
+        return hash(tuple((n, str(f.type)) for n, f in s.metadata.schema.items()))
 
     def _query_ast(
         self,
@@ -238,6 +353,7 @@ class QuerySession:
         end_time: str | None,
         allowed_streams: set[str] | None,
         t0: float | None = None,
+        sql_key: str | None = None,
     ) -> QueryResult:
         t0 = t0 if t0 is not None else _time.monotonic()
         if select.explain:
@@ -256,7 +372,9 @@ class QuerySession:
         if cte_tables is not None and select.table in cte_tables:
             return self._query_cte_table(select, cte_tables[select.table], t0)
         tplan = _time.perf_counter()
-        lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
+        lp = self._plan_ast(
+            select, start_time, end_time, allowed_streams, t0, sql_key=sql_key
+        )
         plan_ms = round((_time.perf_counter() - tplan) * 1000, 3)
 
         scan = StreamScan(
@@ -290,6 +408,11 @@ class QuerySession:
                     "execute_ms": round(max(exec_s - timer.seconds, 0.0) * 1000, 3),
                     "total_ms": round(elapsed * 1000, 3),
                     "bytes_saved_by_projection": scan.stats.bytes_saved_by_projection,
+                    # cross-query contention: time this query's scan tasks
+                    # spent queued behind other queries on the shared pool
+                    "sched_wait_ms": round(scan.stats.sched_wait_seconds * 1000, 3),
+                    "plan_cache": getattr(self, "_plan_cache_state", None),
+                    "result_cache": getattr(self, "_result_cache_state", None),
                 },
             }
         )
@@ -460,8 +583,27 @@ class QuerySession:
         end_time: str | None,
         allowed_streams: set[str] | None,
         t0: float,
+        sql_key: str | None = None,
     ) -> LogicalPlan:
-        lp = build_plan(select)
+        # plan cache: keyed on (sql, stream, schema fingerprint), storing
+        # the plan as built — RBAC, stream resolution, API time bounds and
+        # the safety rails are per-request and re-applied below on a copy
+        lp = None
+        cache_key = None
+        cache = get_plan_cache(self.p.options) if sql_key is not None else None
+        if cache is not None and select.table:
+            fp = self._schema_fingerprint(select.table)
+            if fp is not None:
+                cache_key = ("plan", sql_key, select.table, fp)
+                lp = cache.get(cache_key)
+        if cache_key is not None:
+            state = "hit" if lp is not None else "miss"
+            QUERY_PLAN_CACHE.labels(state).inc()
+            self._plan_cache_state = state
+        if lp is None:
+            lp = build_plan(select)
+            if cache_key is not None:
+                cache.put(cache_key, lp)
         if allowed_streams is not None and lp.stream not in allowed_streams:
             raise QueryError(f"unauthorized for stream {lp.stream!r}")
         self.resolve_stream(lp.stream)
@@ -488,21 +630,38 @@ class QuerySession:
         start_time: str | None = None,
         end_time: str | None = None,
         allowed_streams: set[str] | None = None,
+        on_close=None,
     ):
         """Streaming variant (reference: handlers/http/query.rs:325-407):
         returns an iterator of pyarrow Tables, emitted as the scan
         progresses, so `SELECT *` over a huge range never materializes in
         full. Row export is IO-bound, so it always runs the CPU engine —
-        the device path exists for aggregation."""
+        the device path exists for aggregation.
+
+        `on_close` fires exactly once when the returned generator finishes
+        OR is closed/abandoned mid-stream — the admission-control hook: an
+        abandoned HTTP export must hand its concurrency permit back, not
+        hold it until GC. (If the generator is never started, on_close
+        never fires — callers keep their own idempotent backstop.)"""
         t0 = _time.monotonic()
-        select = S.parse_sql(sql_text)
+        select = self._parse_cached(sql_text)
         if _is_composite(select) or select.explain:
             # set operations / CTEs / joins need the full result before the
             # first row can stream (and EXPLAIN emits plan rows, never a
             # scan); materialize through the normal path, one chunk out
             result = self._query_ast(select, start_time, end_time, allowed_streams, t0)
-            return iter([result.table])
-        lp = self._plan_ast(select, start_time, end_time, allowed_streams, t0)
+
+            def single():
+                try:
+                    yield result.table
+                finally:
+                    if on_close is not None:
+                        on_close()
+
+            return single()
+        lp = self._plan_ast(
+            select, start_time, end_time, allowed_streams, t0, sql_key=sql_text
+        )
         # streaming exports are paced by the client (resp.write backpressure
         # counts as wall time); the SQL timeout would truncate every large
         # download, so it doesn't apply here — memory stays bounded by the
@@ -514,11 +673,14 @@ class QuerySession:
 
         def streamed():
             # explicit close so an abandoned HTTP export cancels the scan
-            # pool deterministically instead of waiting for GC
+            # pool deterministically instead of waiting for GC — and
+            # releases the admission slot on the same close path
             try:
                 yield from executor.execute_select_stream(tables)
             finally:
                 tables.close()
+                if on_close is not None:
+                    on_close()
 
         return streamed()
 
@@ -841,6 +1003,44 @@ class QuerySession:
                 table = pa.table({name: pa.array([fast], pa.int64())})
                 return QueryResult(table, [name], {"fast_path": "manifest_count"}), timer
 
+        # partial-aggregate result cache: a repeated aggregate over an
+        # unchanged manifest set skips the scan — only HAVING/projection/
+        # ORDER BY re-run over the cached interim. Eligibility requires the
+        # query range to stay clear of the staging window (staging rows are
+        # invisible to the manifest fingerprint, and concurrent ingest
+        # would make a cached answer stale the moment it was stored).
+        from parseable_tpu.query.partials import (
+            get_result_cache,
+            manifest_fingerprint,
+            plan_fingerprint,
+        )
+
+        self._result_cache_state = None
+        result_cache = get_result_cache(self.p.options)
+        result_key = None
+        if (
+            result_cache is not None
+            and lp.is_aggregate
+            and not scan._within_staging_window()
+        ):
+            result_key = (
+                lp.stream,
+                manifest_fingerprint(scan.manifest_files()),
+                plan_fingerprint(lp, self.engine),
+            )
+            interim = result_cache.get(result_key)
+            if interim is not None:
+                self._result_cache_state = "hit"
+                QUERY_CACHE_HIT.labels(lp.stream).inc()
+                ex = QueryExecutor(lp)
+                _agg, rewritten, _names = ex.build_aggregator()
+                table = ex.finalize_from_interim(interim, rewritten)
+                return (
+                    QueryResult(table, table.column_names, {"result_cache": "hit"}),
+                    timer,
+                )
+            self._result_cache_state = "miss"
+
         use_tpu = self.engine == "tpu"
         fallback = False
         if use_tpu:
@@ -876,6 +1076,16 @@ class QuerySession:
             executor.source_loader = scan.read_source
         else:
             executor = QueryExecutor(lp)
+        if result_key is not None:
+            # store the merged interim the moment the engine produces it —
+            # but never a partial one (scan_errors means files were dropped)
+            def _sink(interim, _key=result_key, _cache=result_cache, _scan=scan):
+                with _scan._stats_lock:
+                    errors = _scan.stats.scan_errors
+                if errors == 0:
+                    _cache.put(_key, interim)
+
+            executor.interim_sink = _sink
         # both engines consume the scan's parallel fetch+decode pipeline
         # (provider.py): the pool overlaps object-store GETs and parquet
         # decode with engine compute, bounded by P_SCAN_INFLIGHT_BYTES —

@@ -1,0 +1,149 @@
+"""Query admission control: bounded concurrency + bounded wait queue.
+
+Parity target (reference: handlers/http/resource_check.rs:41-137 — the
+503 resource-shed middleware this build already applies to ingest): the
+query plane gets its own explicit gate instead of riding CPU/memory
+thresholds. At most P_QUERY_MAX_CONCURRENT queries execute at once; up to
+P_QUERY_QUEUE_DEPTH more wait (P_QUERY_QUEUE_TIMEOUT_MS each) for a slot;
+everything past that sheds immediately with 503 + Retry-After so clients
+back off instead of piling onto a saturated node.
+
+The gate is thread-safety-first: permits are released from worker threads
+(streaming generators close on the query pool), so all state lives behind
+a threading.Lock and queued waiters are asyncio futures woken via their
+captured loop's call_soon_threadsafe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import deque
+
+from parseable_tpu.utils.metrics import QUERY_INFLIGHT, QUERY_QUEUED, QUERY_SHED
+
+
+class QueryShed(Exception):
+    """Raised by acquire() when the request must be shed with 503."""
+
+    def __init__(self, reason: str, retry_after_secs: int):
+        super().__init__(f"query admission: {reason}")
+        self.reason = reason
+        self.retry_after_secs = max(1, retry_after_secs)
+
+
+class QueryPermit:
+    """One admitted query's slot. release() is idempotent and thread-safe —
+    the streaming path releases from whichever thread closes the generator,
+    with the HTTP handler's finally as a backstop."""
+
+    def __init__(self, gate: "QueryAdmission"):
+        self._gate = gate
+        self._lock = threading.Lock()
+        self._released = False  # guarded-by: self._lock
+
+    def release(self) -> None:
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        self._gate._release()
+
+
+class QueryAdmission:
+    """Counting gate with a bounded FIFO wait queue.
+
+    In-flight and queued gauges reconcile by construction: a request is
+    exactly one of executing (inflight), queued (waiters), or shed
+    (counter, labeled queue_full/timeout)."""
+
+    def __init__(self, max_concurrent: int, queue_depth: int, queue_timeout_ms: int):
+        self.max_concurrent = max(1, max_concurrent)
+        self.queue_depth = max(0, queue_depth)
+        self.queue_timeout_ms = max(1, queue_timeout_ms)
+        # reentrant: _release -> _wake_next re-enters from grant recycling
+        self._lock = threading.RLock()
+        self._inflight = 0  # guarded-by: self._lock
+        # (future, loop) pairs in arrival order
+        self._waiters: deque = deque()  # guarded-by: self._lock
+        QUERY_INFLIGHT.set(0)
+        QUERY_QUEUED.set(0)
+
+    @property
+    def retry_after_secs(self) -> int:
+        # shed clients should come back once the queue has had a chance to
+        # drain: one full queue-timeout, rounded up to a whole second
+        return max(1, (self.queue_timeout_ms + 999) // 1000)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"inflight": self._inflight, "queued": len(self._waiters)}
+
+    async def acquire(self) -> QueryPermit:
+        """Admit, queue, or shed. Raises QueryShed on a full queue or a
+        queue-timeout; otherwise returns the permit to release."""
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            if self._inflight < self.max_concurrent:
+                self._inflight += 1
+                QUERY_INFLIGHT.set(self._inflight)
+                return QueryPermit(self)
+            if len(self._waiters) >= self.queue_depth:
+                QUERY_SHED.labels("queue_full").inc()
+                raise QueryShed("queue full", self.retry_after_secs)
+            fut: asyncio.Future = loop.create_future()
+            self._waiters.append((fut, loop))
+            QUERY_QUEUED.set(len(self._waiters))
+        try:
+            await asyncio.wait_for(fut, self.queue_timeout_ms / 1000.0)
+            return QueryPermit(self)
+        except asyncio.TimeoutError:
+            with self._lock:
+                try:
+                    self._waiters.remove((fut, loop))
+                    QUERY_QUEUED.set(len(self._waiters))
+                except ValueError:
+                    # a release popped us in the same instant the timeout
+                    # fired: the slot is ours if set_result beat wait_for's
+                    # cancellation; if the grant callback instead finds the
+                    # future cancelled, IT recycles the slot (exactly one
+                    # owner either way — never both)
+                    if fut.done() and not fut.cancelled():
+                        return QueryPermit(self)
+            QUERY_SHED.labels("timeout").inc()
+            raise QueryShed("queue timeout", self.retry_after_secs) from None
+
+    def _release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+            QUERY_INFLIGHT.set(self._inflight)
+            self._wake_next()
+
+    def _wake_next(self) -> None:
+        """Hand a free slot to the oldest waiter (the lock is reentrant —
+        callers already hold it). The inflight count is bumped HERE, not
+        when the waiter wakes, so the gauge never undercounts; a waiter
+        that turns out to be cancelled gives the slot back via _release."""
+        with self._lock:
+            while self._waiters and self._inflight < self.max_concurrent:
+                fut, loop = self._waiters.popleft()
+                QUERY_QUEUED.set(len(self._waiters))
+                self._inflight += 1
+                QUERY_INFLIGHT.set(self._inflight)
+
+                def grant(f=fut):
+                    if f.cancelled():
+                        # waiter timed out between pop and grant: recycle
+                        self._release()
+                    elif not f.done():
+                        f.set_result(True)
+
+                try:
+                    loop.call_soon_threadsafe(grant)
+                except RuntimeError:
+                    # waiter's loop is gone (connection torn down): recycle
+                    # the slot for the next waiter
+                    self._inflight -= 1
+                    QUERY_INFLIGHT.set(self._inflight)
+                    continue
+                return

@@ -66,6 +66,26 @@ class ServerState:
         self.p = p
         self.rbac = self._load_rbac()
         self.workers = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ingest")
+        # dedicated bounded executor for query CPU work: scans/aggregation
+        # saturating it must not starve ingest, metastore I/O, or the other
+        # run_in_executor users riding the general pool
+        self.query_workers = ThreadPoolExecutor(
+            max_workers=max(1, p.options.query_workers), thread_name_prefix="query"
+        )
+        # admission control for /api/v1/query + /api/v1/counts (reference:
+        # resource_check.rs:41-137, previously applied only to ingest):
+        # bounded concurrency, bounded wait queue, 503 + Retry-After past it
+        from parseable_tpu.server.admission import QueryAdmission
+
+        self.query_gate = (
+            QueryAdmission(
+                p.options.query_max_concurrent,
+                p.options.query_queue_depth,
+                p.options.query_queue_timeout_ms,
+            )
+            if p.options.query_max_concurrent > 0
+            else None
+        )
         self.started_at = time.time()
         self.shutting_down = False
         self._sync_stop = threading.Event()
@@ -232,6 +252,11 @@ class ServerState:
         from parseable_tpu.ops.enccache import shutdown_enccache
 
         shutdown_enccache()
+        # shared scan-scheduler workers (cross-query fair dispatch)
+        from parseable_tpu.query.provider import shutdown_scan_scheduler
+
+        shutdown_scan_scheduler()
+        self.query_workers.shutdown(wait=False)
         self.workers.shutdown(wait=False)
 
 
@@ -247,6 +272,34 @@ def _run_traced(state: "ServerState", fn, *args):
     return asyncio.get_running_loop().run_in_executor(
         state.workers, lambda: ctx.run(fn, *args)
     )
+
+
+def _run_query_traced(state: "ServerState", fn, *args):
+    """Like _run_traced but on the dedicated query pool (P_QUERY_WORKERS):
+    query CPU work must not occupy the general worker pool that ingest and
+    metastore round trips depend on."""
+    ctx = contextvars.copy_context()
+    return asyncio.get_running_loop().run_in_executor(
+        state.query_workers, lambda: ctx.run(fn, *args)
+    )
+
+
+async def _admit_query(state: "ServerState"):
+    """Pass the admission gate. Returns (permit, None) when admitted —
+    permit may be None when the gate is disabled — or (None, response)
+    when the request was shed with 503 + Retry-After."""
+    if state.query_gate is None:
+        return None, None
+    from parseable_tpu.server.admission import QueryShed
+
+    try:
+        return await state.query_gate.acquire(), None
+    except QueryShed as e:
+        return None, web.json_response(
+            {"error": f"query load shed ({e.reason}); retry later"},
+            status=503,
+            headers={"Retry-After": str(e.retry_after_secs)},
+        )
 
 
 _TRACED_POST_PATHS = ("/api/v1/ingest", "/api/v1/query", "/api/v1/counts", "/v1/")
@@ -614,15 +667,25 @@ async def query(request: web.Request) -> web.Response:
 
     from parseable_tpu.query.executor import MemoryLimitExceeded, QueryTimeout
 
+    permit, shed = await _admit_query(state)
+    if shed is not None:
+        return shed
+
     if streaming:
-        return await _query_streaming(request, state, sql, start, end, allowed, send_fields)
+        # the streamed generator owns the permit from here: it releases on
+        # exhaustion AND on close/abandonment (its release is idempotent,
+        # and _query_streaming keeps a finally backstop for errors before
+        # the generator ever starts)
+        return await _query_streaming(
+            request, state, sql, start, end, allowed, send_fields, permit
+        )
 
     def work():
         sess = QuerySession(state.p)
         return sess.query(sql, start, end, allowed_streams=allowed)
 
     try:
-        result = await _run_traced(state, work)
+        result = await _run_query_traced(state, work)
     except QueryTimeout as e:
         return web.json_response({"error": str(e)}, status=504)
     except MemoryLimitExceeded as e:
@@ -636,6 +699,9 @@ async def query(request: web.Request) -> web.Response:
     except Exception as e:
         logger.exception("query failed")
         return web.json_response({"error": str(e)}, status=500)
+    finally:
+        if permit is not None:
+            permit.release()
 
     rows = result.to_json_rows()
     if send_fields:
@@ -643,28 +709,43 @@ async def query(request: web.Request) -> web.Response:
     return web.json_response(rows)
 
 
-async def _query_streaming(request, state, sql, start, end, allowed, send_fields=False):
+async def _query_streaming(
+    request, state, sql, start, end, allowed, send_fields=False, permit=None
+):
     """Chunked NDJSON response (reference: query.rs:325-407): one line per
     scanned block, emitted as the scan progresses — a `SELECT *` over a big
-    range streams without the server holding the full result."""
+    range streams without the server holding the full result.
+
+    The admission permit rides the generator's close path: an abandoned
+    response (client gone mid-stream) releases its concurrency slot the
+    moment the generator closes, not when GC finds it. Release is
+    idempotent, so the pre-generator error paths below double as backstop."""
     from parseable_tpu.query.session import QuerySession as QS
     from parseable_tpu.utils.arrowutil import record_batches_to_json
 
     loop = asyncio.get_running_loop()
+    release = permit.release if permit is not None else (lambda: None)
 
     def start_stream():
         sess = QS(state.p)
-        it = sess.query_stream(sql, start, end, allowed_streams=allowed)
+        it = sess.query_stream(
+            sql, start, end, allowed_streams=allowed, on_close=release
+        )
         return iter(it)
 
     try:
-        it = await loop.run_in_executor(state.workers, start_stream)
+        it = await loop.run_in_executor(state.query_workers, start_stream)
     except QueryError as e:
+        release()
         if "unauthorized" in str(e):
             return web.json_response({"error": "Forbidden"}, status=403)
         return web.json_response({"error": str(e)}, status=400)
     except (SqlError, TimeParseError) as e:
+        release()
         return web.json_response({"error": str(e)}, status=400)
+    except BaseException:
+        release()
+        raise
 
     resp = web.StreamResponse(
         headers={"Content-Type": "application/x-ndjson", "Transfer-Encoding": "chunked"}
@@ -674,7 +755,7 @@ async def _query_streaming(request, state, sql, start, end, allowed, send_fields
     try:
         try:
             while True:
-                part = await loop.run_in_executor(state.workers, lambda: next(it, None))
+                part = await loop.run_in_executor(state.query_workers, lambda: next(it, None))
                 if part is None:
                     break
                 if not fields_sent:
@@ -727,6 +808,10 @@ async def counts(request: web.Request) -> web.Response:
 
     allowed = state.rbac.user_allowed_streams(request["username"])
 
+    permit, shed = await _admit_query(state)
+    if shed is not None:
+        return shed
+
     def work():
         from parseable_tpu.utils.timeutil import TimeRange, expected_time_bins
 
@@ -762,9 +847,12 @@ async def counts(request: web.Request) -> web.Response:
         return out
 
     try:
-        records = await _run_traced(state, work)
+        records = await _run_query_traced(state, work)
     except (SqlError, QueryError, TimeParseError, StreamNotFound) as e:
         return web.json_response({"error": str(e)}, status=400)
+    finally:
+        if permit is not None:
+            permit.release()
     return web.json_response({"fields": ["startTime", "endTime", "count"], "records": records})
 
 

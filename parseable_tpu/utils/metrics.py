@@ -76,6 +76,32 @@ QUERY_EXECUTE_TIME = Histogram(
     registry=REGISTRY,
 )
 QUERY_CACHE_HIT = _counter("query_cache_hit", "Query cache hits", ["stream"])
+# concurrent query serving (admission control + shared scan scheduler +
+# plan/result caches): in-flight/queued gauges and the shed counter must
+# reconcile (inflight <= max_concurrent, queued <= queue_depth, everything
+# past that sheds 503); sched-wait is the per-task queue time between a
+# scan task's enqueue and its dispatch on the shared pool
+QUERY_INFLIGHT = _gauge("query_inflight", "Queries currently executing", [])
+QUERY_QUEUED = _gauge("query_queued", "Queries waiting for an admission slot", [])
+QUERY_SHED = _counter(
+    "query_shed", "Queries shed by admission control", ["reason"]
+)
+QUERY_SCAN_SCHED_WAIT = Histogram(
+    "query_scan_sched_wait_seconds",
+    "Scan task wait between enqueue and dispatch on the shared scan pool",
+    [],
+    namespace=METRICS_NAMESPACE,
+    registry=REGISTRY,
+)
+QUERY_PLAN_CACHE = _counter(
+    "query_plan_cache", "Plan/parse cache lookups", ["result"]
+)
+QUERY_RESULT_CACHE = _counter(
+    "query_result_cache", "Partial-aggregate result cache lookups", ["result"]
+)
+QUERY_RESULT_CACHE_BYTES = _gauge(
+    "query_result_cache_bytes", "Bytes held by the partial-aggregate result cache", []
+)
 TOTAL_QUERY_BYTES_SCANNED_DATE = _gauge(
     "total_query_bytes_scanned_date", "Bytes scanned by queries on date", ["date"]
 )

@@ -1,0 +1,588 @@
+"""Concurrent query serving: fair scan scheduling, admission control,
+plan/result caches, and the deterministic load-bench smoke.
+
+The reference covers query-side resource governance through DataFusion's
+session/runtime config plus the 503 resource-shed middleware
+(resource_check.rs); here the same guarantees are asserted in-process:
+fairness is an ordering property of the shared scheduler, admission is
+503 + Retry-After with reconciling gauges, and both caches must evict on
+exactly the events that invalidate them (schema change, snapshot commit).
+"""
+
+import asyncio
+import base64
+import json
+import logging
+import threading
+import time
+from datetime import UTC, datetime, timedelta
+
+import numpy as np
+import pyarrow as pa
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+from parseable_tpu.config import Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.event import Event
+from parseable_tpu.server.app import ServerState, build_app
+from parseable_tpu.utils import metrics as prom
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+BASE = datetime(2024, 5, 1, 0, 0, tzinfo=UTC)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def with_client(state, fn):
+    app = build_app(state)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def sample(name, labels=None):
+    return prom.REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+
+def build_stream(p, name, minutes=4, rows_per_minute=300):
+    """Historical minute buckets, synced to parquet + committed snapshot —
+    the query range stays far outside the staging window."""
+    rng = np.random.default_rng(3)
+    stream = p.create_stream_if_not_exists(name)
+    for minute in range(minutes):
+        n = rows_per_minute
+        ts = [
+            BASE + timedelta(minutes=minute, milliseconds=int(o))
+            for o in np.sort(rng.integers(0, 60_000, n))
+        ]
+        tbl = pa.table(
+            {
+                DEFAULT_TIMESTAMP_KEY: pa.array(
+                    [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
+                ),
+                "host": pa.array([f"h{i % 8}" for i in range(n)]),
+                "bytes": pa.array(rng.random(n) * 1000),
+            }
+        ).combine_chunks()
+        for batch in tbl.to_batches():
+            Event(
+                stream_name=name,
+                rb=batch,
+                origin_size=batch.num_rows * 100,
+                is_first_event=minute == 0,
+                parsed_timestamp=BASE + timedelta(minutes=minute),
+            ).process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+
+HIST_RANGE = {"start_time": "2024-05-01T00:00:00Z", "end_time": "2024-05-02T00:00:00Z"}
+
+
+# ------------------------------------------------------------- scheduler
+
+
+def _drive_lanes(policy):
+    """One blocked worker, two lanes, deterministic dispatch order."""
+    from parseable_tpu.query.provider import ScanScheduler
+
+    sched = ScanScheduler(1, policy)
+    order: list[str] = []
+    olock = threading.Lock()
+    gate = threading.Event()
+    done = threading.Event()
+    total = 8  # gate + A2..A6 + B1..B2
+
+    def task(tag, wait=None):
+        def fn():
+            if wait is not None:
+                wait.wait(timeout=10)
+            with olock:
+                order.append(tag)
+                if len(order) == total:
+                    done.set()
+
+        return fn
+
+    try:
+        lane_a = sched.lane(inflight_bytes=1 << 30)
+        lane_b = sched.lane(inflight_bytes=1 << 30)
+        # the gate task occupies the only worker while the backlog builds
+        lane_a.submit(task("gate", gate), 1)
+        time.sleep(0.05)
+        for i in range(2, 7):
+            lane_a.submit(task(f"A{i}"), 1)
+        for i in range(1, 3):
+            lane_b.submit(task(f"B{i}"), 1)
+        gate.set()
+        assert done.wait(timeout=10)
+    finally:
+        sched.shutdown()
+    return order
+
+
+def test_fair_scheduler_interleaves_lanes():
+    order = _drive_lanes("fair")
+    # round-robin: the small lane's work lands inside the big lane's
+    # backlog, not behind it
+    assert order.index("B1") <= order.index("A3")
+    assert order.index("B2") < order.index("A6")
+
+
+def test_fifo_scheduler_is_arrival_order():
+    order = _drive_lanes("fifo")
+    assert order == ["gate", "A2", "A3", "A4", "A5", "A6", "B1", "B2"]
+
+
+def test_sched_wait_surfaces_in_stats(parseable):
+    p = parseable
+    p.options.scan_workers = 2
+    build_stream(p, "swait")
+    from parseable_tpu.query.session import QuerySession
+
+    before = sample("parseable_query_scan_sched_wait_seconds_count")
+    res = QuerySession(p, engine="cpu").query(
+        "SELECT host, sum(bytes) s FROM swait GROUP BY host", **HIST_RANGE
+    )
+    stages = res.stats["stages"]
+    assert stages["sched_wait_ms"] >= 0.0
+    assert sample("parseable_query_scan_sched_wait_seconds_count") > before
+
+
+def test_scheduler_reroots_on_policy_change():
+    from parseable_tpu.query.provider import get_scan_scheduler
+
+    o = Options()
+    o.scan_sched = "fair"
+    fair = get_scan_scheduler(o)
+    o.scan_sched = "fifo"
+    fifo = get_scan_scheduler(o)
+    assert fifo is not fair and fifo.policy == "fifo"
+    assert fair._stopped  # old workers joined, not leaked
+    o.scan_sched = "fair"
+    get_scan_scheduler(o)
+
+
+# ------------------------------------------------------- admission control
+
+
+class _BlockingSession:
+    """QuerySession stand-in whose query parks until released."""
+
+    release = threading.Event()
+    started: list = []
+
+    def __init__(self, p, engine=None):
+        pass
+
+    def query(self, sql, start=None, end=None, allowed_streams=None):
+        _BlockingSession.started.append(sql)
+        assert _BlockingSession.release.wait(timeout=30)
+
+        class R:
+            fields = ["x"]
+            stats = {}
+
+            @staticmethod
+            def to_json_rows():
+                return [{"x": 1}]
+
+        return R()
+
+
+def make_state(tmp_path, **opt_overrides):
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    for k, v in opt_overrides.items():
+        setattr(opts, k, v)
+    p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
+    return ServerState(p)
+
+
+def test_admission_queue_and_shed(tmp_path, monkeypatch):
+    state = make_state(
+        tmp_path,
+        query_max_concurrent=1,
+        query_queue_depth=1,
+        query_queue_timeout_ms=5_000,
+    )
+    monkeypatch.setattr("parseable_tpu.server.app.QuerySession", _BlockingSession)
+    _BlockingSession.release = threading.Event()
+    _BlockingSession.started = []
+    body = {"query": "SELECT 1 FROM x"}
+
+    async def fn(client):
+        t1 = asyncio.ensure_future(client.post("/api/v1/query", json=body, headers=AUTH))
+        for _ in range(100):
+            if _BlockingSession.started:
+                break
+            await asyncio.sleep(0.02)
+        assert _BlockingSession.started, "first query never started"
+        t2 = asyncio.ensure_future(client.post("/api/v1/query", json=body, headers=AUTH))
+        for _ in range(100):
+            if state.query_gate.snapshot()["queued"] == 1:
+                break
+            await asyncio.sleep(0.02)
+        # gauges reconcile: one executing, one queued
+        snap = state.query_gate.snapshot()
+        assert snap == {"inflight": 1, "queued": 1}
+        assert sample("parseable_query_inflight") == 1
+        assert sample("parseable_query_queued") == 1
+        # past max_concurrent + queue depth: immediate 503 + Retry-After
+        shed_before = sample("parseable_query_shed_total", {"reason": "queue_full"})
+        r3 = await client.post("/api/v1/query", json=body, headers=AUTH)
+        assert r3.status == 503
+        assert int(r3.headers["Retry-After"]) >= 1
+        assert (await r3.json())["error"].startswith("query load shed")
+        assert sample("parseable_query_shed_total", {"reason": "queue_full"}) == shed_before + 1
+        # release: both admitted queries complete, gauges drain to zero
+        _BlockingSession.release.set()
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert r1.status == 200 and r2.status == 200
+        assert state.query_gate.snapshot() == {"inflight": 0, "queued": 0}
+        assert sample("parseable_query_inflight") == 0
+        assert sample("parseable_query_queued") == 0
+
+    run(with_client(state, fn))
+    state.stop()
+
+
+def test_admission_queue_timeout_sheds(tmp_path, monkeypatch):
+    state = make_state(
+        tmp_path,
+        query_max_concurrent=1,
+        query_queue_depth=4,
+        query_queue_timeout_ms=150,
+    )
+    monkeypatch.setattr("parseable_tpu.server.app.QuerySession", _BlockingSession)
+    _BlockingSession.release = threading.Event()
+    _BlockingSession.started = []
+    body = {"query": "SELECT 1 FROM x"}
+
+    async def fn(client):
+        t1 = asyncio.ensure_future(client.post("/api/v1/query", json=body, headers=AUTH))
+        for _ in range(100):
+            if _BlockingSession.started:
+                break
+            await asyncio.sleep(0.02)
+        shed_before = sample("parseable_query_shed_total", {"reason": "timeout"})
+        r2 = await client.post("/api/v1/query", json=body, headers=AUTH)
+        assert r2.status == 503
+        assert "Retry-After" in r2.headers
+        assert sample("parseable_query_shed_total", {"reason": "timeout"}) == shed_before + 1
+        # the timed-out waiter left the queue; the slot is still held
+        assert state.query_gate.snapshot() == {"inflight": 1, "queued": 0}
+        _BlockingSession.release.set()
+        assert (await t1).status == 200
+        assert state.query_gate.snapshot() == {"inflight": 0, "queued": 0}
+
+    run(with_client(state, fn))
+    state.stop()
+
+
+def test_admission_disabled_with_zero_knob(tmp_path):
+    state = make_state(tmp_path, query_max_concurrent=0)
+    assert state.query_gate is None
+    state.stop()
+
+
+def test_streaming_generator_releases_slot_on_close(parseable):
+    """An abandoned streaming export hands its admission slot back when the
+    generator closes — not only on exhaustion (the permit-leak fix)."""
+    p = parseable
+    build_stream(p, "leak", minutes=3)
+    from parseable_tpu.query.session import QuerySession
+
+    released = []
+    it = QuerySession(p, engine="cpu").query_stream(
+        "SELECT host FROM leak", on_close=lambda: released.append(1), **HIST_RANGE
+    )
+    assert next(it) is not None
+    assert not released
+    it.close()  # abandoned mid-stream
+    assert released == [1]
+
+    # exhaustion also fires it, exactly once
+    released.clear()
+    it = QuerySession(p, engine="cpu").query_stream(
+        "SELECT host FROM leak LIMIT 5", on_close=lambda: released.append(1), **HIST_RANGE
+    )
+    list(it)
+    assert released == [1]
+
+
+def test_streaming_http_releases_permit(tmp_path):
+    state = make_state(tmp_path, query_max_concurrent=2)
+    build_stream(state.p, "shttp", minutes=2)
+
+    async def fn(client):
+        r = await client.post(
+            "/api/v1/query",
+            json={"query": "SELECT host FROM shttp", "streaming": True, **{
+                "startTime": HIST_RANGE["start_time"], "endTime": HIST_RANGE["end_time"],
+            }},
+            headers=AUTH,
+        )
+        assert r.status == 200
+        body = await r.text()
+        assert body.strip()
+        assert state.query_gate.snapshot() == {"inflight": 0, "queued": 0}
+
+    run(with_client(state, fn))
+    state.stop()
+
+
+# ----------------------------------------------------------- plan cache
+
+
+def test_plan_cache_hits_and_schema_invalidation(parseable):
+    p = parseable
+    build_stream(p, "plans")
+    from parseable_tpu.query.session import QuerySession
+
+    sql = "SELECT host, sum(bytes) s FROM plans GROUP BY host"
+    hits_before = sample("parseable_query_plan_cache_total", {"result": "hit"})
+    r1 = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE)
+    assert r1.stats["stages"]["plan_cache"] == "miss"
+    r2 = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE)
+    assert r2.stats["stages"]["plan_cache"] == "hit"
+    assert sample("parseable_query_plan_cache_total", {"result": "hit"}) == hits_before + 1
+    assert sorted(map(tuple, (d.items() for d in r1.to_json_rows()))) == sorted(
+        map(tuple, (d.items() for d in r2.to_json_rows()))
+    )
+
+    # schema change: the committed merge must evict the stream's plans
+    p.commit_schema("plans", pa.schema([pa.field("extra_col", pa.float64())]))
+    r3 = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE)
+    assert r3.stats["stages"]["plan_cache"] == "miss"
+    # and the new column resolves through a fresh plan
+    r4 = QuerySession(p, engine="cpu").query(
+        "SELECT count(extra_col) c FROM plans", **HIST_RANGE
+    )
+    assert r4.to_json_rows()[0]["c"] == 0
+
+
+def test_plan_cache_under_concurrent_readers_and_schema_commits(parseable):
+    """No stale plans, no torn reads: readers race schema commits and every
+    query must still answer from a consistent plan."""
+    p = parseable
+    build_stream(p, "race")
+    from parseable_tpu.query.session import QuerySession
+
+    sql = "SELECT host, count(*) c FROM race GROUP BY host"
+    expected = sum(
+        r["c"] for r in QuerySession(p, engine="cpu").query(sql, **HIST_RANGE).to_json_rows()
+    )
+    errors: list = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                rows = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE).to_json_rows()
+                if sum(r["c"] for r in rows) != expected:
+                    errors.append(("count", rows))
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                errors.append(("raise", repr(e)))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(10):
+        p.commit_schema("race", pa.schema([pa.field(f"c{i}", pa.int64())]))
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+
+
+# ---------------------------------------------------------- result cache
+
+
+def test_result_cache_hit_skips_scan_and_commit_evicts(parseable):
+    p = parseable
+    p.options.query_result_cache_bytes = 8 * 1024 * 1024
+    build_stream(p, "agg", minutes=3, rows_per_minute=200)
+    from parseable_tpu.query.session import QuerySession
+
+    sql = "SELECT host, count(*) c, sum(bytes) s FROM agg GROUP BY host"
+    r1 = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE)
+    assert r1.stats["stages"]["result_cache"] == "miss"
+    assert r1.stats["bytes_scanned"] > 0
+    total1 = sum(r["c"] for r in r1.to_json_rows())
+    assert total1 == 600
+
+    hit_before = sample("parseable_query_cache_hit_total", {"stream": "agg"})
+    r2 = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE)
+    assert r2.stats["stages"]["result_cache"] == "hit"
+    assert r2.stats["bytes_scanned"] == 0  # the scan was skipped entirely
+    assert sum(r["c"] for r in r2.to_json_rows()) == total1
+    assert sample("parseable_query_cache_hit_total", {"stream": "agg"}) == hit_before + 1
+
+    # snapshot commit (new data synced) must evict: no stale rows
+    stream = p.get_stream("agg")
+    n = 50
+    tbl = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(
+                [(BASE + timedelta(minutes=10, seconds=i)).replace(tzinfo=None) for i in range(n)],
+                pa.timestamp("ms"),
+            ),
+            "host": pa.array(["h0"] * n),
+            "bytes": pa.array([1.0] * n),
+        }
+    )
+    for batch in tbl.to_batches():
+        Event(
+            stream_name="agg", rb=batch, origin_size=n * 100, is_first_event=False,
+            parsed_timestamp=BASE + timedelta(minutes=10),
+        ).process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+
+    r3 = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE)
+    assert r3.stats["stages"]["result_cache"] == "miss"
+    assert sum(r["c"] for r in r3.to_json_rows()) == total1 + n
+
+
+def test_result_cache_ineligible_inside_staging_window(parseable):
+    """A query whose range touches the staging window must bypass the
+    cache — concurrent ingest would make the cached interim stale."""
+    p = parseable
+    build_stream(p, "fresh", minutes=2)
+    from parseable_tpu.query.session import QuerySession
+
+    res = QuerySession(p, engine="cpu").query(
+        "SELECT host, count(*) c FROM fresh GROUP BY host"  # no end bound
+    )
+    assert res.stats["stages"]["result_cache"] is None
+
+
+def test_result_cache_concurrent_readers_no_torn_reads(parseable):
+    """Readers racing a snapshot commit see either the old or the new
+    answer — never a mix, never an error."""
+    p = parseable
+    build_stream(p, "torn", minutes=2, rows_per_minute=150)
+    from parseable_tpu.query.session import QuerySession
+
+    sql = "SELECT count(*) c FROM torn WHERE bytes >= 0"
+    old_total = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE).to_json_rows()[0]["c"]
+    n_new = 40
+    results: list = []
+    errors: list = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                c = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE).to_json_rows()[0]["c"]
+                results.append(c)
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    stream = p.get_stream("torn")
+    tbl = pa.table(
+        {
+            DEFAULT_TIMESTAMP_KEY: pa.array(
+                [(BASE + timedelta(minutes=1, seconds=i)).replace(tzinfo=None) for i in range(n_new)],
+                pa.timestamp("ms"),
+            ),
+            "host": pa.array(["hx"] * n_new),
+            "bytes": pa.array([2.0] * n_new),
+        }
+    )
+    for batch in tbl.to_batches():
+        Event(
+            stream_name="torn", rb=batch, origin_size=n_new * 100, is_first_event=False,
+            parsed_timestamp=BASE + timedelta(minutes=1),
+        ).process(stream, commit_schema=p.commit_schema)
+    p.local_sync(shutdown=True)
+    p.sync_all_streams()
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert results and set(results) <= {old_total, old_total + n_new}
+    # post-commit steady state: the new answer, served (warm) from cache
+    final = QuerySession(p, engine="cpu").query(sql, **HIST_RANGE).to_json_rows()[0]["c"]
+    assert final == old_total + n_new
+
+
+# ------------------------------------------------- load-bench smoke (CI)
+
+
+def test_load_smoke_counters_monotonic(tmp_path):
+    """Fast deterministic mini load bench: concurrent queries through the
+    HTTP layer, then assert the serving counters moved the right way —
+    cache hits grew monotonically, nothing was shed, gauges drained."""
+    state = make_state(tmp_path, query_max_concurrent=8, query_queue_depth=8)
+    state.p.options.scan_workers = 2
+    build_stream(state.p, "smoke", minutes=3, rows_per_minute=100)
+    sql = "SELECT host, count(*) c FROM smoke GROUP BY host"
+    body = {"query": sql, "startTime": HIST_RANGE["start_time"], "endTime": HIST_RANGE["end_time"]}
+
+    plan_hits0 = sample("parseable_query_plan_cache_total", {"result": "hit"})
+    result_hits0 = sample("parseable_query_result_cache_total", {"result": "hit"})
+    shed0 = sum(
+        sample("parseable_query_shed_total", {"reason": r}) for r in ("queue_full", "timeout")
+    )
+
+    async def fn(client):
+        rs = await asyncio.gather(
+            *[client.post("/api/v1/query", json=body, headers=AUTH) for _ in range(12)]
+        )
+        assert all(r.status == 200 for r in rs)
+        payloads = [await r.json() for r in rs]
+        assert all(sum(row["c"] for row in rows) == 300 for rows in payloads)
+
+    run(with_client(state, fn))
+
+    plan_hits1 = sample("parseable_query_plan_cache_total", {"result": "hit"})
+    result_hits1 = sample("parseable_query_result_cache_total", {"result": "hit"})
+    shed1 = sum(
+        sample("parseable_query_shed_total", {"reason": r}) for r in ("queue_full", "timeout")
+    )
+    assert plan_hits1 > plan_hits0, "repeated statement never hit the plan cache"
+    assert result_hits1 > result_hits0, "repeated aggregate never hit the result cache"
+    assert shed1 == shed0, "a generous gate shed queries under a tiny load"
+    assert state.query_gate.snapshot() == {"inflight": 0, "queued": 0}
+    assert sample("parseable_query_inflight") == 0 and sample("parseable_query_queued") == 0
+    state.stop()
+
+
+# ----------------------------------------------------- slow-query joins
+
+
+def test_slow_query_log_carries_joinable_trace_id(tmp_path, caplog):
+    """The slow-query line's trace_id must equal the request's
+    X-P-Trace-Id so the log entry joins against pmeta spans."""
+    state = make_state(tmp_path, slow_query_ms=1)
+    build_stream(state.p, "slowq", minutes=2)
+
+    async def fn(client):
+        with caplog.at_level(logging.WARNING, logger="parseable_tpu.query.session"):
+            r = await client.post(
+                "/api/v1/query",
+                json={"query": "SELECT host, count(*) FROM slowq GROUP BY host"},
+                headers=AUTH,
+            )
+            assert r.status == 200
+            return r.headers["X-P-Trace-Id"]
+
+    trace_id = run(with_client(state, fn))
+    slow = [r.getMessage() for r in caplog.records if "slow query" in r.getMessage()]
+    assert slow, "no slow-query line at a 1ms threshold"
+    assert f"trace_id={trace_id}" in slow[0]
+    state.stop()
