@@ -56,6 +56,13 @@ def build_dataset(
       random request ids so per-block message uniques ≈ 50k — the case
       where host-side dictionary encode and the group-space explosion are
       the real costs.
+    - "highentropy": low-compressibility numerics (full-range uniform
+      bytes/latency, random per-row message ids) so parquet compression
+      buys ~nothing and disk size approaches logical size — the profile
+      the tiering story must survive (memory-pressure runs cap
+      P_TPU_HOT_BYTES below the working set; see bench_memory_pressure).
+      Group keys stay moderate-cardinality so the device group space is
+      dense while the payload bytes stay incompressible.
     """
     from parseable_tpu import DEFAULT_TIMESTAMP_KEY
     from parseable_tpu.event import Event
@@ -73,6 +80,14 @@ def build_dataset(
         paths = np.array(
             [f"/api/v1/tenant{t}/resource{r}" for t in range(400) for r in range(256)]
         )  # 102,400 paths
+        messages = None  # synthesized per batch with unique request ids
+    elif profile == "highentropy":
+        # moderate-cardinality group keys (dense device group space), but
+        # per-row-unique messages: every batch's message column is ~pure
+        # entropy, so parquet compression buys nothing and disk size
+        # approaches logical size (the tiering-under-pressure profile)
+        hosts = np.array([f"10.0.{i}.{j}" for i in range(8) for j in range(16)])
+        paths = np.array([f"/api/v1/resource{i}" for i in range(128)])
         messages = None  # synthesized per batch with unique request ids
     else:
         hosts = np.array([f"10.0.{i}.{j}" for i in range(4) for j in range(8)])
@@ -120,7 +135,13 @@ def build_dataset(
                 "path": pa.array(paths[rng.integers(0, len(paths), n)]),
                 "message": batch_messages,
                 "status": pa.array(statuses[rng.integers(0, len(statuses), n)].astype(np.float64)),
-                "bytes": pa.array(rng.integers(100, 50_000, n).astype(np.float64)),
+                # highentropy: full-mantissa uniform floats defeat both
+                # parquet byte-stream compression and dictionary encoding
+                "bytes": pa.array(
+                    (rng.random(n) * 50_000).astype(np.float64)
+                    if profile == "highentropy"
+                    else rng.integers(100, 50_000, n).astype(np.float64)
+                ),
                 "latency_ms": pa.array((rng.random(n) * 500).astype(np.float64)),
             }
         ).combine_chunks()
@@ -954,6 +975,251 @@ def bench_query_concurrency() -> None:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_memory_pressure(emit_line: bool = True) -> dict | None:
+    """Tiering under real memory pressure (ROADMAP "make the tiering story
+    true"): a high-entropy dataset split across many parquet files, queried
+    warm with P_TPU_HOT_BYTES capped WELL below the encoded working set, so
+    every repetition pays eviction + re-ship for the part that doesn't fit.
+    A/Bs the eviction policy (P_TPU_HOT_POLICY=cost vs lru) over >=10 warm
+    reps and reports p50/p95 per policy plus the tiering counters — done
+    means hotset_evictions > 0 while the cost-policy warm ratio still beats
+    the CPU engine.
+
+    Under pressure LRU is pathological for a cyclic warm scan (each rep
+    flushes exactly the blocks the next rep needs first); the cost policy's
+    frequency x ship-cost scoring + probationary segment converges on a
+    stable resident subset, and the query-aware prefetcher overlaps the
+    re-ship of the rest with device compute.
+
+    Like bench_query_concurrency / bench_ingest_pipeline, the deployment's
+    I/O costs are simulated so a local-fs dev box measures the path the
+    design targets: every storage GET pays BENCH_MP_GET_MS (the CPU engine
+    re-fetches parquet from the object store every rep) and every enccache
+    block load pays BENCH_MP_SHIP_MS (the tier's local re-ship: NVMe read +
+    PCIe put — cheaper than a remote GET, which is exactly why the tier
+    exists). Prefetch overlaps the re-ship with compute; protected hot-set
+    hits skip it entirely.
+
+    Env knobs: BENCH_MP_FILES (12), BENCH_MP_FILE_ROWS (100000),
+    BENCH_MP_REPEATS (10), BENCH_MP_BUDGET_FRAC (0.35 of the measured
+    working set), BENCH_MP_GET_MS (25), BENCH_MP_SHIP_MS (10). Pure
+    in-process work; runs with or without the real chip (tier-1 smokes it
+    with tiny knobs so the eviction path can never rot into dead code
+    again)."""
+    import pathlib
+
+    from parseable_tpu import DEFAULT_TIMESTAMP_KEY
+    from parseable_tpu.config import Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.event import Event
+    from parseable_tpu.ops.enccache import get_enccache
+    from parseable_tpu.ops.hotset import get_hotset
+    from parseable_tpu.query.session import QuerySession
+
+    n_files = int(os.environ.get("BENCH_MP_FILES", "12"))
+    rows_per_file = int(os.environ.get("BENCH_MP_FILE_ROWS", "100000"))
+    repeats = int(os.environ.get("BENCH_MP_REPEATS", "10"))
+    budget_frac = float(os.environ.get("BENCH_MP_BUDGET_FRAC", "0.35"))
+    get_ms = float(os.environ.get("BENCH_MP_GET_MS", "25"))
+    ship_ms = float(os.environ.get("BENCH_MP_SHIP_MS", "10"))
+    rows_total = n_files * rows_per_file
+    base = datetime(2024, 5, 1, 0, 0, tzinfo=UTC)
+    sql = (
+        "SELECT path, host, count(*) c, sum(bytes) s FROM mp "
+        "GROUP BY path, host"
+    )
+
+    saved_env = {
+        k: os.environ.get(k) for k in ("P_TPU_HOT_BYTES", "P_TPU_HOT_POLICY")
+    }
+    workdir = tempfile.mkdtemp(prefix="ptpu-mpbench-")
+    summary: dict | None = None
+    unpatch: list = []  # (obj, attr, original) — the enccache is process-global
+    try:
+        opts = Options()
+        opts.local_staging_path = pathlib.Path(workdir) / "staging"
+        storage = StorageOptions(
+            backend="local-store", root=pathlib.Path(workdir) / "data"
+        )
+        p = Parseable(opts, storage)
+        rng = np.random.default_rng(23)
+        stream = p.create_stream_if_not_exists("mp")
+        n_hosts = int(os.environ.get("BENCH_MP_HOSTS", "32"))
+        hosts = [f"10.0.{i // 16}.{i % 16}" for i in range(n_hosts)]
+        paths = [f"/api/v1/resource{i}" for i in range(64)]
+        for minute in range(n_files):
+            n = rows_per_file
+            ts = [
+                base + timedelta(minutes=minute, milliseconds=int(o))
+                for o in np.sort(rng.integers(0, 60_000, n))
+            ]
+            tbl = pa.table(
+                {
+                    DEFAULT_TIMESTAMP_KEY: pa.array(
+                        [t.replace(tzinfo=None) for t in ts], pa.timestamp("ms")
+                    ),
+                    "host": pa.array(np.array(hosts)[rng.integers(0, len(hosts), n)]),
+                    "path": pa.array(np.array(paths)[rng.integers(0, len(paths), n)]),
+                    # high-entropy payload: full-mantissa uniform floats and
+                    # per-row-unique messages — parquet compression buys
+                    # ~nothing, disk size ~= logical size
+                    "bytes": pa.array((rng.random(n) * 50_000).astype(np.float64)),
+                    "message": pa.array(
+                        [f"request {minute * n + i} completed" for i in range(n)]
+                    ),
+                }
+            ).combine_chunks()
+            for batch in tbl.to_batches():
+                Event(
+                    stream_name="mp",
+                    rb=batch,
+                    origin_size=batch.num_rows * 100,
+                    is_first_event=minute == 0,
+                    parsed_timestamp=base + timedelta(minutes=minute),
+                ).process(stream, commit_schema=p.commit_schema)
+        p.local_sync(shutdown=True)
+        p.sync_all_streams()
+
+        # simulated deployment I/O: object-store GET RTT on the storage
+        # client (paid by anything re-reading parquet) and a local re-ship
+        # latency on enccache block loads (the tier's miss cost)
+        if get_ms > 0:
+            real_get_object = p.storage.get_object
+            real_get_range = p.storage.get_range
+
+            def slow_get_object(key):
+                time.sleep(get_ms / 1000.0)
+                return real_get_object(key)
+
+            def slow_get_range(key, start, end):
+                time.sleep(get_ms / 1000.0)
+                return real_get_range(key, start, end)
+
+            p.storage.get_object = slow_get_object
+            p.storage.get_range = slow_get_range
+
+        cpu = timed_runs(p, "mp", "cpu", sql, max(2, min(repeats, 3)))
+
+        def run_tpu() -> tuple[float, dict]:
+            t0 = time.perf_counter()
+            res = QuerySession(p, engine="tpu").query(sql)
+            return time.perf_counter() - t0, res.stats
+
+        # phase 0: all-resident pass under the default (huge) budget to
+        # measure the encoded working set and seed the enccache
+        os.environ.pop("P_TPU_HOT_BYTES", None)
+        os.environ["P_TPU_HOT_POLICY"] = "cost"
+        hs = get_hotset()
+        hs.clear()
+        run_tpu()
+        working_set = hs.resident_bytes
+        ec = get_enccache(p.options)
+        if ec is not None:
+            ec.wait_idle()
+            if ship_ms > 0:
+                real_ec_get = ec.get
+
+                def slow_ec_get(source_id, needed, dict_cols):
+                    time.sleep(ship_ms / 1000.0)
+                    return real_ec_get(source_id, needed, dict_cols)
+
+                ec.get = slow_ec_get
+                unpatch.append((ec, "get", real_ec_get))
+        budget = max(1, int(working_set * budget_frac))
+        os.environ["P_TPU_HOT_BYTES"] = str(budget)
+
+        phases: dict[str, dict] = {}
+        for policy in ("lru", "cost"):
+            os.environ["P_TPU_HOT_POLICY"] = policy
+            hs = get_hotset()  # re-roots onto the capped budget + policy
+            hs.clear()
+            run_tpu()  # populate up to the capped budget
+            ev0, times, last_stats = hs.evictions, [], {}
+            for _ in range(max(1, repeats)):
+                dt, last_stats = run_tpu()
+                times.append(dt)
+            stages = (last_stats.get("stages") or {}).get("hotset") or {}
+            phases[policy] = {
+                "p50": percentile(times, 0.50),
+                "p95": percentile(times, 0.95),
+                "evictions": hs.evictions - ev0,
+                "resident_bytes": hs.resident_bytes,
+                "prefetch_issued": stages.get("prefetch_issued", 0),
+                "prefetch_hits": stages.get("prefetch_hits", 0),
+                "prefetch_wasted": stages.get("prefetch_wasted", 0),
+            }
+
+        import jax
+
+        cost, lru = phases["cost"], phases["lru"]
+        cpus = (
+            len(os.sched_getaffinity(0))
+            if hasattr(os, "sched_getaffinity")
+            else (os.cpu_count() or 1)
+        )
+        summary = {
+            "files": n_files,
+            "rows": rows_total,
+            "repeats": repeats,
+            "profile": "highentropy",
+            "sim_get_ms": get_ms,
+            "sim_ship_ms": ship_ms,
+            "platform": jax.devices()[0].platform,
+            "cpus": cpus,
+            "working_set_bytes": working_set,
+            "hot_budget_bytes": budget,
+            "hotset_evictions": cost["evictions"],
+            "hotset_evictions_lru": lru["evictions"],
+            "warm_p50_s_cost": round(cost["p50"], 4),
+            "warm_p95_s_cost": round(cost["p95"], 4),
+            "warm_p50_s_lru": round(lru["p50"], 4),
+            "warm_p95_s_lru": round(lru["p95"], 4),
+            "cost_vs_lru_p95": round(lru["p95"] / max(cost["p95"], 1e-9), 3),
+            "cpu_p50_s": round(cpu["p50"], 4),
+            "warm_vs_cpu": round(cpu["p50"] / max(cost["p50"], 1e-9), 3),
+            "prefetch_issued": cost["prefetch_issued"],
+            "prefetch_hits": cost["prefetch_hits"],
+            "prefetch_wasted": cost["prefetch_wasted"],
+            "enccache_dropped": getattr(ec, "dropped", 0) if ec else 0,
+            "note": (
+                "warm reps with P_TPU_HOT_BYTES capped below the encoded "
+                "working set over a high-entropy profile; cost = freq x "
+                "recency x re-ship-cost eviction + probation + prefetch, "
+                "lru = plain LRU A/B"
+            ),
+        }
+        print(
+            f"# memory pressure ({n_files} files, ws {working_set/1e6:.1f}MB, "
+            f"budget {budget/1e6:.1f}MB): cost p50 {cost['p50']*1e3:.0f}ms "
+            f"p95 {cost['p95']*1e3:.0f}ms ({cost['evictions']} evictions, "
+            f"{cost['prefetch_hits']}/{cost['prefetch_issued']} prefetch hits) | "
+            f"lru p50 {lru['p50']*1e3:.0f}ms p95 {lru['p95']*1e3:.0f}ms "
+            f"({lru['evictions']} evictions) | cpu p50 {cpu['p50']*1e3:.0f}ms",
+            file=sys.stderr,
+        )
+        if emit_line:
+            emit(
+                "bench_memory_pressure",
+                rows_total / max(cost["p50"], 1e-9),
+                cpu["p50"] / max(cost["p50"], 1e-9),
+                summary,
+            )
+        p.shutdown()
+    except Exception as e:  # noqa: BLE001
+        print(f"# memory pressure bench failed: {e}", file=sys.stderr)
+    finally:
+        for obj, attr, orig in unpatch:
+            setattr(obj, attr, orig)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        get_hotset().clear()  # drop capped-budget state for later phases
+        shutil.rmtree(workdir, ignore_errors=True)
+    return summary
+
+
 def bench_otel_ingest(p) -> None:
     """OTel-logs ingest line: the native C++ lane (fastpath.cpp walk ->
     NDJSON -> pyarrow reader -> staging) vs the Python flattener pipeline
@@ -1090,6 +1356,7 @@ def main() -> None:
             bench_json_ingest(pb)
             bench_ingest_pipeline()
             bench_query_concurrency()
+            bench_memory_pressure()
             bench_config1(pb, with_tpu=False)
             bench_scale_subprocess(with_tpu=False)
         except Exception as e:  # noqa: BLE001
@@ -1223,6 +1490,7 @@ def main() -> None:
         bench_json_ingest(p)
         bench_ingest_pipeline()
         bench_query_concurrency()
+        bench_memory_pressure()
         bench_config1(p, with_tpu=True)
         bench_scale_subprocess(with_tpu=True)
 

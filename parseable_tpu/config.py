@@ -366,6 +366,13 @@ class Options:
     mesh_shape: str = field(default_factory=lambda: _env("P_TPU_MESH", ""))
     # pad row blocks to this many rows before shipping to device (static shapes)
     device_block_rows: int = field(default_factory=lambda: _env_int("P_TPU_BLOCK_ROWS", 1 << 20))
+    # query-aware prefetch: while block i aggregates, up to this many
+    # upcoming enccache-resident blocks ship in the background (also the
+    # shipped-but-unconsumed window, so prefetch cargo can never exceed
+    # depth blocks of the hot-set budget); 0 disables
+    tpu_prefetch_depth: int = field(
+        default_factory=lambda: _env_int("P_TPU_PREFETCH_DEPTH", 1)
+    )
 
     # --- observability --------------------------------------------------------
     # queries slower than this log a structured slow-query line with the

@@ -24,6 +24,13 @@ frombuffer views, so a cold scan's host cost is one page-cache read +
 device_put from contiguous memory (an mmap here measured 75x slower to
 ship). Eviction is LRU-by-mtime over a byte budget
 (P_TPU_ENC_CACHE_BYTES, default 16 GiB).
+
+Write-behind backpressure: the background writer's queue is bounded
+(P_TPU_ENC_QUEUE_DEPTH, default 16). Under sustained ingest a producer
+blocks for at most P_TPU_ENC_QUEUE_TIMEOUT_MS (default 250) waiting for
+room, then the seed is dropped — COUNTED (`dropped` attr + the
+tpu_enccache_dropped_writes counter) and logged, never lost silently; a
+queue-depth gauge makes the pressure visible before drops start.
 """
 
 from __future__ import annotations
@@ -40,6 +47,7 @@ from typing import Any
 import numpy as np
 
 from parseable_tpu.ops.device import EncodedBatch, EncodedColumn, pow2_block
+from parseable_tpu.utils.metrics import ENCCACHE_DROPS, ENCCACHE_QUEUE_DEPTH
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +80,7 @@ class EncodedBlockCache:
         self._writer: threading.Thread | None = None
         self.hits = 0
         self.misses = 0
+        self.dropped = 0  # write-behind seeds shed after the bounded wait
         # stale tmp files from a previous crash/kill are dead weight, and
         # pre-PTEC3 entries are dead bytes against the budget. Cleanup
         # happens HERE (once, at open) rather than in _read_header: an
@@ -105,8 +114,12 @@ class EncodedBlockCache:
     def put_async(self, source_id: bytes, enc: EncodedBatch) -> None:
         """Write-behind: snapshot the column references (the caller strips
         host arrays right after) and persist on a background thread — the
-        merge re-read/rewrite must not sit on the query's cold path. A full
-        queue drops the write (pure cache; next query retries)."""
+        merge re-read/rewrite must not sit on the query's cold path.
+
+        Backpressure is deterministic: when the bounded queue is full the
+        producer blocks up to P_TPU_ENC_QUEUE_TIMEOUT_MS for the writer to
+        drain, then the seed is dropped — counted and logged (pure cache;
+        the next query re-encodes), never lost silently."""
         import queue as _q
 
         snap_cols = {
@@ -123,9 +136,13 @@ class EncodedBlockCache:
             row_mask=enc.row_mask,
             time_origin_ms=enc.time_origin_ms,
         )
+        from parseable_tpu.config import env_float, env_int
+
         with self._lock:
             if self._queue is None:
-                self._queue = _q.Queue(maxsize=16)
+                self._queue = _q.Queue(
+                    maxsize=max(1, env_int("P_TPU_ENC_QUEUE_DEPTH", 16))
+                )
                 self._writer = threading.Thread(
                     target=self._writer_loop,
                     args=(self._queue,),
@@ -134,10 +151,28 @@ class EncodedBlockCache:
                 )
                 self._writer.start()
             q = self._queue
+        timeout = max(0.0, env_float("P_TPU_ENC_QUEUE_TIMEOUT_MS", 250.0)) / 1000.0
         try:
-            q.put_nowait((source_id, snap))
+            if timeout > 0:
+                q.put((source_id, snap), timeout=timeout)
+            else:
+                q.put_nowait((source_id, snap))
         except _q.Full:
-            pass
+            with self._lock:
+                self.dropped += 1
+                dropped = self.dropped
+            ENCCACHE_DROPS.inc()
+            # first drop warns (the overload signal); the rest stay debug so
+            # a sustained storm can't flood the log — the counter carries
+            # the rate either way
+            log = logger.warning if dropped == 1 else logger.debug
+            log(
+                "enccache write-behind queue full after %.0fms wait; "
+                "dropped seed (%d dropped so far) — next query re-encodes",
+                timeout * 1000,
+                dropped,
+            )
+        ENCCACHE_QUEUE_DEPTH.set(q.qsize())
 
     def _writer_loop(self, q) -> None:
         # the queue is a parameter (not self._queue) so shutdown() can drop
@@ -151,6 +186,7 @@ class EncodedBlockCache:
                 self.put(source_id, snap)
             finally:
                 q.task_done()
+                ENCCACHE_QUEUE_DEPTH.set(q.qsize())
 
     def shutdown(self) -> None:
         """Stop the write-behind thread deterministically (pending writes

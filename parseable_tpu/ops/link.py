@@ -118,6 +118,14 @@ class LinkProfile:
         v = self._v
         return v["h2d_lat"] + nbytes / v["h2d_bw"]
 
+    def ship_cost_per_byte(self, nbytes: int) -> float:
+        """Estimated re-ship seconds per resident byte — the hot set's
+        eviction score (ops/hotset.py). Amortizing the per-put latency over
+        the block size means small blocks on a high-latency link score
+        higher than their bandwidth share: evicting them buys back few
+        bytes but costs a whole round trip to bring back."""
+        return self.ship_cost(nbytes) / max(1, nbytes)
+
     def read_cost(self, nbytes: int) -> float:
         v = self._v
         return v["d2h_lat"] + nbytes / v["d2h_bw"]

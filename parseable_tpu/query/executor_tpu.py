@@ -1132,19 +1132,29 @@ class TpuQueryExecutor(QueryExecutor):
             "h2d_bytes": 0,
             "d2h_bytes": 0,
         }
+        # query-aware prefetch (ops/prefetch.py): built lazily on the first
+        # source-id'd block, once the scan has published its ordered stub
+        # list; closed in execute()'s finally on every exit path
+        self._prefetcher = None
+        self._prefetch_tried = False
 
     # ------------------------------------------------------------------ main
 
     def execute(self, tables: Iterator[pa.Table]) -> pa.Table:
-        if self.plan.is_aggregate:
-            try:
-                return self._execute_aggregate_tpu(tables)
-            except UnsupportedOnDevice as e:
-                # plan-time rejection: the iterator is untouched; materialize
-                # any hot stubs for the CPU engine
-                logger.info("TPU path unsupported (%s); falling back to CPU", e)
-                return super()._execute_aggregate(self._materialize(t) for t in tables)
-        return self._execute_select_tpu(tables)
+        try:
+            if self.plan.is_aggregate:
+                try:
+                    return self._execute_aggregate_tpu(tables)
+                except UnsupportedOnDevice as e:
+                    # plan-time rejection: the iterator is untouched;
+                    # materialize any hot stubs for the CPU engine
+                    logger.info("TPU path unsupported (%s); falling back to CPU", e)
+                    return super()._execute_aggregate(
+                        self._materialize(t) for t in tables
+                    )
+            return self._execute_select_tpu(tables)
+        finally:
+            self._close_prefetcher()
 
     # ------------------------------------------------- select (mask on device)
 
@@ -1232,6 +1242,66 @@ class TpuQueryExecutor(QueryExecutor):
     # set by the session: re-reads a source when a stubbed block got evicted
     # between the provider's hot check and execution
     source_loader: Callable[[bytes], pa.Table] | None = None
+    # set by the session: the StreamScan whose `prefetchable` list (ordered
+    # enccache-servable stub sources) drives the query-aware prefetcher
+    prefetch_scan = None
+
+    def _ensure_prefetcher(self, needed: set[str] | None, dict_cols: set[str]) -> None:
+        """Build the prefetcher once the scan has published its ordered
+        stub list (first source-id'd block => the list is complete)."""
+        if self._prefetch_tried or self._prefetcher is not None:
+            return
+        self._prefetch_tried = True
+        scan = self.prefetch_scan
+        sources = list(getattr(scan, "prefetchable", ()) or ())
+        depth = getattr(self.options, "tpu_prefetch_depth", 2)
+        if len(sources) < 2 or depth <= 0:
+            return
+        from parseable_tpu.ops.prefetch import ScanPrefetcher
+
+        def ship(source_id: bytes) -> tuple | None:
+            return self._prefetch_ship(source_id, needed, dict_cols)
+
+        self._prefetcher = ScanPrefetcher(sources, ship, depth=depth)
+
+    def _prefetch_ship(
+        self, source_id: bytes, needed: set[str] | None, dict_cols: set[str]
+    ) -> tuple | None:
+        """Worker-thread half of the prefetcher: enccache -> device -> hot
+        set. Returns the hot key on a completed ship, None when skipped."""
+        from parseable_tpu.ops.enccache import get_enccache
+
+        hotset = get_hotset()
+        key = hot_key(source_id, needed, dict_cols)
+        if hotset.contains(key):
+            return None
+        enccache = get_enccache(self.options)
+        if enccache is None:
+            return None
+        enc = enccache.get(source_id, needed, dict_cols)
+        if enc is None:
+            return None
+        est = sum(
+            c.values.nbytes + (0 if c.all_valid else c.valid.nbytes)
+            for c in enc.columns.values()
+        )
+        if est > hotset.budget:
+            return None  # could never be admitted; don't ship it
+        dev, nbytes = _transfer(enc, self.mesh)
+        self.route_stats["h2d_bytes"] += nbytes
+        _strip_host_values(enc)
+        hotset.put(key, HotEntry(dev=dev, meta=enc, nbytes=nbytes))
+        # admission control may have refused the put (probation empty,
+        # candidate colder than every protected entry): only report a
+        # completed ship when the entry is actually resident
+        return key if hotset.contains(key) else None
+
+    def _close_prefetcher(self) -> None:
+        pf, self._prefetcher = self._prefetcher, None
+        if pf is None:
+            return
+        counters = pf.close()
+        self.route_stats.update(counters)
 
     def _adaptive_gate(
         self,
@@ -1306,8 +1376,24 @@ class TpuQueryExecutor(QueryExecutor):
         enccache = None
         if source is not None:
             key = hot_key(source, needed, dict_cols)
-            entry = hotset.get(key)
+            # kick the lookahead BEFORE resolving this block: while it
+            # encodes/ships/aggregates, the next blocks ship in background
+            self._ensure_prefetcher(needed, dict_cols)
+            pf = self._prefetcher
+            if pf is not None:
+                pf.on_block(source)
+            # a prefetched block's one planned consumption is not proven
+            # reuse: serve it untouched so it can't promote into protected
+            prefetched = pf is not None and pf.peek(key)
+            entry = hotset.get(key, touch=not prefetched)
+            if entry is None and pf is not None and pf.claim(source):
+                # the prefetcher was mid-ship on exactly this block: it
+                # finished — re-check instead of shipping a second copy
+                prefetched = pf.peek(key)
+                entry = hotset.get(key, touch=not prefetched)
             if entry is not None:
+                if pf is not None:
+                    pf.consumed(key)
                 self.route_stats["device_warm"] += 1
                 return entry.meta, entry.dev
             from parseable_tpu.ops.enccache import get_enccache

@@ -492,6 +492,11 @@ class StreamScan:
         self.use_hot_stubs = use_hot_stubs
         self._sources: dict[bytes, ManifestFile] = {}
         self._manifest_files: list[ManifestFile] | None = None
+        # ordered source ids the scan stubbed (hot-set or enccache
+        # resident): the TPU executor's prefetcher walks this list to ship
+        # block i+1 while block i aggregates. Complete before the first
+        # stub is yielded (the partition loop runs eagerly).
+        self.prefetchable: list[bytes] = []
         # pool workers update the same ScanStats concurrently with the
         # consumer thread's own bookkeeping
         self.stats = ScanStats()  # guarded-by: self._stats_lock
@@ -900,6 +905,7 @@ class StreamScan:
             key_fn = lambda sid: hot_key(sid, self.plan.needed_columns, dict_cols)
             make_stub_fn = make_stub
         to_fetch: list[tuple[ManifestFile, bytes]] = []
+        stubs: list[tuple[bytes, int]] = []
         for f in self.manifest_files():
             # size + row count make the id content-sensitive: a rewritten
             # object at the same path must not serve a stale cached block
@@ -908,20 +914,26 @@ class StreamScan:
             if hotset is not None:
                 entry = hotset.get(key_fn(source_id))
                 if entry is not None:
-                    with self._stats_lock:
-                        self.stats.rows_scanned += entry.meta.num_rows
-                    yield make_stub_fn(source_id, entry.meta.num_rows)
+                    stubs.append((source_id, entry.meta.num_rows))
                     continue
                 # encoded-block disk cache: the executor loads device-ready
                 # columns; skip the parquet read entirely
                 if enccache is not None and enccache.can_serve(
                     source_id, self.plan.needed_columns, dict_cols
                 ):
-                    with self._stats_lock:
-                        self.stats.rows_scanned += f.num_rows
-                    yield make_stub_fn(source_id, f.num_rows)
+                    stubs.append((source_id, f.num_rows))
                     continue
             to_fetch.append((f, source_id))
+        # publish the ordered stub list BEFORE the first stub yield: the
+        # executor's prefetcher ships block i+1 from the enccache while
+        # block i aggregates (hot-now entries are included too — under
+        # eviction pressure they may be gone by the time the engine gets
+        # there, and the prefetcher skips anything still resident)
+        self.prefetchable = [sid for sid, _rows in stubs]
+        for source_id, rows in stubs:
+            with self._stats_lock:
+                self.stats.rows_scanned += rows
+            yield make_stub_fn(source_id, rows)
 
         opts = getattr(self.p, "options", None)
         workers = min(len(to_fetch), max(1, getattr(opts, "scan_workers", 1)))

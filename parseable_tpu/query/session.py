@@ -413,11 +413,28 @@ class QuerySession:
                     "sched_wait_ms": round(scan.stats.sched_wait_seconds * 1000, 3),
                     "plan_cache": getattr(self, "_plan_cache_state", None),
                     "result_cache": getattr(self, "_result_cache_state", None),
+                    # tiering state for this process + this query's prefetch
+                    # outcome (None on the CPU engine — no device tier)
+                    "hotset": self._hotset_stage(result.stats.get("device_routes")),
                 },
             }
         )
         self._maybe_log_slow(select, elapsed, result.stats)
         return result
+
+    def _hotset_stage(self, routes: dict | None) -> dict | None:
+        """stats.stages.hotset: first-class tier state (budget, residency,
+        evictions, oversize rejections) plus this query's prefetch counters
+        — previously these lived only as Python attrs on the singleton."""
+        if self.engine != "tpu":
+            return None
+        from parseable_tpu.ops.hotset import get_hotset
+
+        snap = get_hotset().stats_snapshot()
+        for k in ("prefetch_issued", "prefetch_hits", "prefetch_wasted"):
+            if routes and k in routes:
+                snap[k] = routes[k]
+        return snap
 
     def _maybe_log_slow(self, select: S.Select, elapsed: float, stats: dict) -> None:
         """Slow-query log (gated by P_SLOW_QUERY_MS; 0 disables): one
@@ -1074,6 +1091,9 @@ class QuerySession:
             self._set_scan_time_hint(lp, scan)
             executor: QueryExecutor = TpuQueryExecutor(lp, self.p.options)
             executor.source_loader = scan.read_source
+            # the scan's ordered stub list drives query-aware prefetch:
+            # block i+1 ships from the enccache while block i aggregates
+            executor.prefetch_scan = scan
         else:
             executor = QueryExecutor(lp)
         if result_key is not None:

@@ -141,6 +141,33 @@ DEVICE_TRANSFER_BYTES = _gauge(
 DEVICE_JIT_PROGRAMS = _gauge(
     "tpu_jit_programs", "XLA programs compiled (jit cache misses)", []
 )
+# --- tiering under memory pressure (ops/hotset.py, ops/enccache.py) ------
+# first-class hot-set state: what's resident, how hard eviction is working,
+# and entries rejected for exceeding the whole budget (previously a silent
+# return). The enccache write-behind queue degrades deterministically under
+# sustained ingest: depth gauge + a drop counter that must stay 0 in steady
+# state. Prefetch results: shipped (background encode+ship done), hit
+# (consumed by the query), wasted (shipped but never consumed before close).
+HOTSET_RESIDENT_BYTES = _gauge(
+    "tpu_hotset_resident_bytes", "Bytes of encoded blocks resident in the device hot set", []
+)
+HOTSET_EVICTIONS = _counter(
+    "tpu_hotset_evictions", "Hot-set entries evicted under budget pressure", []
+)
+HOTSET_REJECTED_OVERSIZE = _counter(
+    "tpu_hotset_rejected_oversize", "Hot-set puts rejected for exceeding the whole budget", []
+)
+ENCCACHE_QUEUE_DEPTH = _gauge(
+    "tpu_enccache_queue_depth", "Write-behind encodes queued for the enccache writer", []
+)
+ENCCACHE_DROPS = _counter(
+    "tpu_enccache_dropped_writes",
+    "Write-behind enccache seeds dropped after the bounded backpressure wait",
+    [],
+)
+PREFETCH_EVENTS = _counter(
+    "tpu_prefetch", "Query-aware prefetch outcomes", ["result"]
+)
 
 # errors a storage backend deliberately recovers from (credential-probe
 # fallbacks, best-effort session cancels): recoverable by design, but a

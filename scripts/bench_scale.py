@@ -157,6 +157,68 @@ def run_battery(p, sess_cpu, sess, sql: str, rows_total: int, emit, label: str) 
     }
 
 
+def run_pressure_battery(p, sql: str, rows_total: int, emit) -> dict:
+    """Memory-pressure phase (ROADMAP "make the tiering story true"): the
+    SAME scale query with P_TPU_HOT_BYTES capped well below the encoded
+    working set (BENCH_SCALE_HOT_BYTES, default 2 GiB vs the ~7-11 GB
+    encoded working set), warm p50/p95 over >=BENCH_SCALE_PRESSURE_REPS
+    (10) reps per eviction policy (P_TPU_HOT_POLICY cost vs lru A/B).
+    The recorded scale runs showed hotset_evictions: 0 — the budget was
+    never exceeded, so the "100 GB on a 16 GiB device" label was untested.
+    This phase makes the eviction path the thing under measurement.
+    BENCH_SCALE_PRESSURE=0 skips."""
+    if os.environ.get("BENCH_SCALE_PRESSURE", "1") == "0":
+        return {}
+    import bench as _bench
+    from parseable_tpu.ops.hotset import get_hotset
+    from parseable_tpu.query.session import QuerySession
+
+    budget = int(os.environ.get("BENCH_SCALE_HOT_BYTES", str(2 << 30)))
+    reps = int(os.environ.get("BENCH_SCALE_PRESSURE_REPS", "10"))
+    saved = {k: os.environ.get(k) for k in ("P_TPU_HOT_BYTES", "P_TPU_HOT_POLICY")}
+    out: dict = {"pressure_budget_bytes": budget}
+    try:
+        os.environ["P_TPU_HOT_BYTES"] = str(budget)
+        for policy in ("lru", "cost"):
+            os.environ["P_TPU_HOT_POLICY"] = policy
+            hs = get_hotset()  # re-roots onto the capped budget + policy
+            hs.clear()
+            sess = QuerySession(p, engine="tpu")
+            sess.query(sql)  # populate up to the capped budget
+            ev0, times = hs.evictions, []
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                sess.query(sql)
+                times.append(time.perf_counter() - t0)
+            p50 = _bench.percentile(times, 0.50)
+            p95 = _bench.percentile(times, 0.95)
+            emit(
+                f"tpu_pressure_{policy}",
+                config="scale_topk_pressure",
+                budget_bytes=budget,
+                warm_p50_s=round(p50, 2),
+                warm_p95_s=round(p95, 2),
+                rows_per_sec=round(rows_total / max(p50, 1e-9)),
+                hotset_evictions=hs.evictions - ev0,
+                hotset_resident_gb=round(hs.resident_bytes / 2**30, 2),
+            )
+            out[f"pressure_{policy}_p50_s"] = round(p50, 2)
+            out[f"pressure_{policy}_p95_s"] = round(p95, 2)
+            out[f"pressure_{policy}_evictions"] = hs.evictions - ev0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        get_hotset().clear()
+    if "pressure_cost_p95_s" in out and "pressure_lru_p95_s" in out:
+        out["pressure_cost_vs_lru_p95"] = round(
+            out["pressure_lru_p95_s"] / max(out["pressure_cost_p95_s"], 1e-9), 3
+        )
+    return out
+
+
 def main(real: bool = False, max_minutes: int = 0) -> None:
     meta_path = WORK / "meta.json"
     if not meta_path.exists():
@@ -198,6 +260,9 @@ def main(real: bool = False, max_minutes: int = 0) -> None:
     sess_cpu = QuerySession(p, engine="cpu")
     sess = QuerySession(p, engine="tpu")
     result = run_battery(p, sess_cpu, sess, sql, rows, emit, "scale_topk")
+    pressure = run_pressure_battery(p, sql, rows, emit)
+    if pressure:
+        result.update(pressure)
     summary = {
         "metric": "scale_topk_multicol_rows_per_sec",
         "value": result["rows_per_sec_warm"],
