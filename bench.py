@@ -545,24 +545,29 @@ def bench_json_ingest(p) -> None:
     flatten_and_push_logs(p, "ingbench", None, LogSource.JSON, {}, raw_body=bodies[0])
     pj.read_json(_io.BytesIO(floor_bodies[0]))
 
-    best = 1e9
-    for _ in range(3):
+    # p50/p95 over reps for BOTH lines — the repo's bench policy (PR 2)
+    # bans best-of: a best-of hides the tail variance the latency north
+    # star exists to capture, and it biased this line's vs_baseline
+    reps = max(3, int(os.environ.get("BENCH_REPEATS", "3")))
+    ours_times: list[float] = []
+    for _ in range(reps):
         t0 = time.perf_counter()
         for b in bodies:
             flatten_and_push_logs(p, "ingbench", None, LogSource.JSON, {}, raw_body=b)
-        best = min(best, time.perf_counter() - t0)
-    ours = n / best
+        ours_times.append(time.perf_counter() - t0)
+    ours = n / percentile(ours_times, 0.50)
 
-    floor_best = 1e9
-    for _ in range(3):
+    floor_times: list[float] = []
+    for _ in range(reps):
         t0 = time.perf_counter()
         for b in floor_bodies:
             pj.read_json(_io.BytesIO(b))
-        floor_best = min(floor_best, time.perf_counter() - t0)
-    floor = n / floor_best
+        floor_times.append(time.perf_counter() - t0)
+    floor = n / percentile(floor_times, 0.50)
     print(
-        f"# json ingest: {ours:,.0f} rows/s end-to-end | pyarrow floor "
-        f"{floor:,.0f} rows/s | {ours / floor:.2f}x of floor",
+        f"# json ingest: {ours:,.0f} rows/s end-to-end (p50; p95 "
+        f"{n / percentile(ours_times, 0.95):,.0f}) | pyarrow floor {floor:,.0f} rows/s | "
+        f"{ours / floor:.2f}x of floor",
         file=sys.stderr,
     )
     emit(
@@ -573,9 +578,14 @@ def bench_json_ingest(p) -> None:
             "note": (
                 "full pipeline (native C++ flatten -> arrow JSON reader -> "
                 "schema/staging) vs raw pyarrow read_json floor on the "
-                "same bytes"
+                "same bytes; p50 over reps, never best-of"
             ),
+            "repeats": reps,
+            "latency_p50_s": round(percentile(ours_times, 0.50), 4),
+            "latency_p95_s": round(percentile(ours_times, 0.95), 4),
             "pyarrow_floor_rows_per_sec": round(floor, 1),
+            "pyarrow_floor_p50_s": round(percentile(floor_times, 0.50), 4),
+            "pyarrow_floor_p95_s": round(percentile(floor_times, 0.95), 4),
         },
     )
 
@@ -1220,6 +1230,170 @@ def bench_memory_pressure(emit_line: bool = True) -> dict | None:
     return summary
 
 
+def bench_distributed_fanout() -> None:
+    """Distributed fan-out bench with a REAL multi-process baseline
+    (ROADMAP: "give the distributed mesh bench a real baseline ... not
+    vs_baseline: 1.0"): scripts/blackbox.py boots 1 querier per data plane
+    + N ingestor processes over a shared LocalFS store, sustains background
+    ingest, and replays a dashboard-style GROUP BY aggregate over the last
+    minutes against both planes:
+
+    - central pull (P_QUERY_PUSHDOWN=0): the querier pulls every peer's
+      staging window over Arrow IPC and scans all parquet itself;
+    - pushdown (default): peers execute scan + partial aggregation on
+      node-local data and ship one partial table each.
+
+    Reports p50/p95 client-side latency and BYTES OVER THE WIRE (the
+    querier<->ingestor data plane: raw staging IPC vs partial tables) per
+    query, p50/p95 over BENCH_DF_QUERIES reps. vs_baseline = central p95 /
+    pushdown p95. Env knobs: BENCH_DF (0 skips), BENCH_DF_INGESTORS (2),
+    BENCH_DF_QUERIES (12), BENCH_DF_PRELOAD_ROWS (24000 per ingestor),
+    BENCH_DF_INGEST_ROWS (400 per background tick)."""
+    import pathlib
+    import threading
+
+    if os.environ.get("BENCH_DF", "1") == "0":
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "scripts"))
+    from blackbox import ClusterHarness
+
+    n_ing = int(os.environ.get("BENCH_DF_INGESTORS", "2"))
+    n_queries = int(os.environ.get("BENCH_DF_QUERIES", "12"))
+    preload = int(os.environ.get("BENCH_DF_PRELOAD_ROWS", "200000"))
+    bg_rows = int(os.environ.get("BENCH_DF_INGEST_ROWS", "1000"))
+    workdir = tempfile.mkdtemp(prefix="ptpu-dfbench-")
+    sql = "SELECT host, count(*) c, sum(v) s, avg(v) a FROM dfb GROUP BY host"
+    rng = np.random.default_rng(31)
+
+    def batch(n: int) -> list[dict]:
+        return [
+            {"host": f"h{int(i) % 16}", "v": float(v)}
+            for i, v in zip(rng.integers(0, 1 << 30, n), rng.random(n) * 100)
+        ]
+
+    try:
+        with ClusterHarness(pathlib.Path(workdir)) as cluster:
+            # sync fast so preloaded rows reach manifests while background
+            # ingest keeps a live staging window on every peer
+            ing_env = {"P_LOCAL_SYNC_INTERVAL": "3", "P_STORAGE_UPLOAD_INTERVAL": "2"}
+            ingestors = [
+                cluster.spawn("ingest", f"ing{i}", env_extra=ing_env)
+                for i in range(n_ing)
+            ]
+            q_central = cluster.spawn(
+                "query", "q-central", env_extra={"P_QUERY_PUSHDOWN": "0"}
+            )
+            q_push = cluster.spawn(
+                "query", "q-push", env_extra={"P_QUERY_PUSHDOWN": "1"}
+            )
+            for node in [*ingestors, q_central, q_push]:
+                cluster.wait_live(node)
+
+            t0 = time.perf_counter()
+            for node in ingestors:
+                done = 0
+                while done < preload:
+                    k = min(4000, preload - done)
+                    cluster.ingest(node, "dfb", batch(k))
+                    done += k
+            print(
+                f"# fanout bench: {n_ing}x{preload} rows preloaded in "
+                f"{time.perf_counter() - t0:.1f}s",
+                file=sys.stderr,
+            )
+            time.sleep(6)  # one sync tick: most of the preload reaches manifests
+
+            stop = threading.Event()
+
+            def background_ingest():
+                while not stop.is_set():
+                    for node in ingestors:
+                        try:
+                            cluster.ingest(node, "dfb", batch(bg_rows))
+                        except Exception as e:  # noqa: BLE001 - bench-only
+                            print(f"# bg ingest failed: {e}", file=sys.stderr)
+                            return
+                    stop.wait(0.25)
+
+            bg = threading.Thread(target=background_ingest, daemon=True)
+            bg.start()
+
+            def phase(node) -> dict:
+                cluster.query(node, sql, "5m", "now")  # warm plan/stream load
+                lats, wire, push_ok, fallbacks = [], [], 0, 0
+                for _ in range(n_queries):
+                    t0 = time.perf_counter()
+                    records, stats = cluster.query(node, sql, "5m", "now")
+                    lats.append(time.perf_counter() - t0)
+                    fan = (stats.get("stages") or {}).get("fanout") or {}
+                    wire.append(
+                        fan.get("bytes", 0) + fan.get("fanin_bytes", 0)
+                    )
+                    push_ok += fan.get("ok", 0)
+                    fallbacks += fan.get("fallback", 0)
+                    assert records, "dashboard aggregate returned no groups"
+                return {
+                    "p50": percentile(lats, 0.50),
+                    "p95": percentile(lats, 0.95),
+                    "wire_bytes_per_query": sum(wire) / max(1, len(wire)),
+                    "pushdown_ok": push_ok,
+                    "fallbacks": fallbacks,
+                }
+
+            central = phase(q_central)
+            push = phase(q_push)
+            stop.set()
+            bg.join(10)
+
+        byte_reduction = central["wire_bytes_per_query"] / max(
+            1.0, push["wire_bytes_per_query"]
+        )
+        p95_speedup = central["p95"] / max(push["p95"], 1e-9)
+        print(
+            f"# distributed fanout ({n_ing} ingestors + 2 queriers, background "
+            f"ingest): central p50 {central['p50']*1e3:.0f}ms p95 "
+            f"{central['p95']*1e3:.0f}ms {central['wire_bytes_per_query']/1e3:.1f}KB/q | "
+            f"pushdown p50 {push['p50']*1e3:.0f}ms p95 {push['p95']*1e3:.0f}ms "
+            f"{push['wire_bytes_per_query']/1e3:.1f}KB/q | {p95_speedup:.2f}x p95, "
+            f"{byte_reduction:.1f}x fewer bytes",
+            file=sys.stderr,
+        )
+        emit(
+            "bench_distributed_fanout",
+            1.0 / max(push["p50"], 1e-9),
+            p95_speedup,
+            {
+                "unit": "queries/s",
+                "processes": n_ing + 2,
+                "ingestors": n_ing,
+                "queries_per_phase": n_queries,
+                "background_ingest": True,
+                "central_p50_s": round(central["p50"], 4),
+                "central_p95_s": round(central["p95"], 4),
+                "pushdown_p50_s": round(push["p50"], 4),
+                "pushdown_p95_s": round(push["p95"], 4),
+                "central_wire_bytes_per_query": round(central["wire_bytes_per_query"], 1),
+                "pushdown_wire_bytes_per_query": round(push["wire_bytes_per_query"], 1),
+                "wire_byte_reduction": round(byte_reduction, 2),
+                "pushdown_ok_total": push["pushdown_ok"],
+                "pushdown_fallbacks": push["fallbacks"],
+                "note": (
+                    "1 querier per data plane + N ingestor PROCESSES over "
+                    "LocalFS (scripts/blackbox.py) under sustained ingest; "
+                    "dashboard GROUP BY over the last 5 minutes; central = "
+                    "raw staging pull + full local scan, pushdown = per-peer "
+                    "partial aggregation; wire bytes = querier<->ingestor "
+                    "data plane only"
+                ),
+            },
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"# distributed fanout bench failed: {e}", file=sys.stderr)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_otel_ingest(p) -> None:
     """OTel-logs ingest line: the native C++ lane (fastpath.cpp walk ->
     NDJSON -> pyarrow reader -> staging) vs the Python flattener pipeline
@@ -1356,6 +1530,7 @@ def main() -> None:
             bench_json_ingest(pb)
             bench_ingest_pipeline()
             bench_query_concurrency()
+            bench_distributed_fanout()
             bench_memory_pressure()
             bench_config1(pb, with_tpu=False)
             bench_scale_subprocess(with_tpu=False)
@@ -1490,6 +1665,7 @@ def main() -> None:
         bench_json_ingest(p)
         bench_ingest_pipeline()
         bench_query_concurrency()
+        bench_distributed_fanout()
         bench_memory_pressure()
         bench_config1(p, with_tpu=True)
         bench_scale_subprocess(with_tpu=True)

@@ -267,6 +267,31 @@ class Options:
         default_factory=lambda: _env_int("P_QUERY_RESULT_CACHE_BYTES", 64 * 1024 * 1024)
     )
 
+    # --- distributed query fan-out (query/fanout.py, server/cluster.py) -------
+    # scatter partial-aggregate execution to live ingestors (scan + partial
+    # aggregation run on node-local data; the querier merges interim tables)
+    # instead of pulling every peer's raw staging window; 0 reverts to the
+    # central-pull data plane (the A/B baseline the fan-out bench measures)
+    query_pushdown: bool = field(
+        default_factory=lambda: _env_bool("P_QUERY_PUSHDOWN", True)
+    )
+    # per-peer pushdown request timeout; a timed-out peer gets ONE retry,
+    # then falls back to central pull of just that peer's data
+    fanout_timeout_ms: int = field(
+        default_factory=lambda: _env_int("P_FANOUT_TIMEOUT_MS", 10_000)
+    )
+    # straggler hedging: a duplicate request is sent to a peer whose first
+    # attempt is still outstanding after this long (first answer wins,
+    # the loser is discarded); 0 disables hedging
+    fanout_hedge_ms: int = field(
+        default_factory=lambda: _env_int("P_FANOUT_HEDGE_MS", 1500)
+    )
+    # cap on concurrently in-flight pushdown requests; additional peers
+    # are scattered as earlier ones complete
+    fanout_max_inflight: int = field(
+        default_factory=lambda: _env_int("P_FANOUT_MAX_INFLIGHT", 8)
+    )
+
     # --- parallel scan pipeline (query/provider.py) ---------------------------
     # concurrent manifest-file fetch+decode workers; parquet decode releases
     # the GIL and object-store GETs are network-bound, so threads overlap well

@@ -167,6 +167,10 @@ class Parseable:
                 "node_type": node_type,
                 "domain_name": domain,
                 "mode": self.options.mode.to_str(),
+                # lets queriers split the manifest set by owner before the
+                # pushdown scatter; registry entries without it (older
+                # nodes) are served by central pull instead
+                "owner_tag": self.owner_tag,
                 "registered_at": rfc3339_now(),
             }
         )
@@ -177,6 +181,17 @@ class Parseable:
     def _node_suffix(self) -> str | None:
         """Ingestors write per-node stream jsons; all/query write the base."""
         return self.node_id if self.options.mode == Mode.INGEST else None
+
+    @property
+    def owner_tag(self) -> str:
+        """Basename prefix this node stamps on the parquet it stages
+        (`<hostname><ingestor_id>.`): file ownership survives in the object
+        key, so snapshot accounting (update_snapshot), partial-aggregate
+        pushdown (a peer scans only its own files) and the querier's
+        delegation filter (skip files a live peer will scan) all agree on
+        the same predicate. Registered with the node so queriers can
+        partition the manifest set before any peer responds."""
+        return _HOSTNAME + (self._node_suffix or "") + "."
 
     def create_stream_if_not_exists(
         self,
@@ -510,7 +525,7 @@ class Parseable:
                 # Filtering by owner matters in distributed mode: ingestors
                 # share minute manifests but keep per-node snapshots, and
                 # queriers sum stats across all nodes' stream jsons.
-                owner = _HOSTNAME + (self._node_suffix or "") + "."
+                owner = self.owner_tag
                 owned = [
                     f
                     for f in manifest.files

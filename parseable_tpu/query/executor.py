@@ -862,6 +862,11 @@ class QueryExecutor:
     # (CPU two-phase, classic hash aggregate, TPU dense fold) funnels its
     # interim through finalize_from_interim, so one hook covers them all.
     interim_sink = None
+    # distributed pushdown hook (query/fanout.py): called after the local
+    # scan's blocks have all reduced, returns the peers' partial tables to
+    # fold into the same merge — collection happens here, not earlier, so
+    # peer execution overlaps the local scan instead of preceding it
+    partials_source = None
 
     def __init__(self, plan: LogicalPlan):
         self.plan = plan
@@ -1203,6 +1208,10 @@ class QueryExecutor:
                 if pt is not None:
                     parts.append(pt)
                 link.record_cpu_agg(rows_scanned, _time.perf_counter() - t0)
+            if self.partials_source is not None:
+                # distributed pushdown: peers' combined partials join the
+                # local blocks in ONE merge (same funnel, exact avg/stddev)
+                parts.extend(self.partials_source())
             if parts:
                 interim = PT.merge_partials(parts, agg.specs, len(sel.group_by))
                 return self.finalize_from_interim(interim, rewritten)
@@ -1213,6 +1222,28 @@ class QueryExecutor:
             mask = self._where_mask(table)
             agg.update(table, mask)
         return self.finalize_aggregate(agg, rewritten, group_names)
+
+    def partial_tables(self, tables: Iterator[pa.Table]) -> list[pa.Table]:
+        """Scan -> per-block partial tables, no merge/finalize: the peer
+        half of distributed partial-aggregate pushdown (the node-local
+        scan reduces here, combine_partials folds the blocks into one
+        wire-ready partial). Applies the same bounds filter + WHERE mask
+        as _execute_aggregate's two-phase loop."""
+        from parseable_tpu.query import partials as PT
+
+        agg, _rewritten, _names = self.build_aggregator()
+        sel = self.plan.select
+        parts: list[pa.Table] = []
+        for table in tables:
+            self._check_deadline()
+            table = self._bounds_filter(table)
+            mask = self._where_mask(table)
+            if mask is not None:
+                table = table.filter(mask)
+            pt = PT.partial_from_block(table, sel.group_by, agg.specs)
+            if pt is not None:
+                parts.append(pt)
+        return parts
 
     def finalize_aggregate(
         self, agg: HashAggregator, rewritten: list[S.SelectItem], group_names: list[str]

@@ -1,0 +1,524 @@
+"""Distributed query fan-out: partial-aggregate pushdown + scatter-gather.
+
+Parity target (reference: handlers/http/cluster/mod.rs:1785-1964 querier
+fan-out + airplane.rs do_get): instead of pulling every ingestor's raw
+staging window and scanning all parquet centrally, the querier scatters the
+statement + resolved time bounds to live ingestor peers; each peer executes
+scan + PARTIAL aggregation over node-local data only — its own staging
+window plus the manifest files it owns (the PR 3 basename owner tag) — and
+returns ONE combined partial table (``__g*``/``__cnt``/``__pac``/``__sum``/
+``__sumsq``/``__min``/``__max``) as Arrow IPC. The querier folds peer
+partials into its own scan's per-block partials and finalizes through the
+existing `merge_partials` -> `finalize_from_interim` funnel, so avg/stddev
+stay exact (the wire carries (count, sum[, sumsq]) state, never finalized
+values) and a GROUP BY over N nodes costs one merge, not N raw transfers.
+
+Scatter-gather runtime:
+- completion-order streaming gather: each peer's partial is consumed as it
+  lands, never `f.result()` in submission order;
+- bounded in-flight fan-out (P_FANOUT_MAX_INFLIGHT): extra peers dispatch
+  as earlier requests resolve;
+- per-peer timeout (P_FANOUT_TIMEOUT_MS) + ONE retry on retryable errors;
+- hedging (P_FANOUT_HEDGE_MS): a duplicate request to a peer whose first
+  attempt is still outstanding; first answer wins, the loser is discarded
+  — a peer can never contribute twice (merge-side `done` gate);
+- per-peer fallback: a peer that 404s the endpoint (older build), rejects
+  the plan, times out, or answers with a mismatched owner tag is served by
+  the CENTRAL path for exactly its slice — bounded staging pull + a local
+  scan restricted to its owned manifest files — so failures degrade to the
+  old data plane without dropping or duplicating groups.
+
+Eligibility: single-stream GROUP BY aggregates whose specs are
+partializable (partials.PARTIALIZABLE_FUNCS); everything else stays on the
+central-pull path. The local merge runs the CPU executor regardless of the
+session engine — the distributed funnel is host-side; peers are free to
+use any engine for their node-local scan.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import queue as _queue
+import threading
+import time as _time
+import urllib.error
+from typing import TYPE_CHECKING
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from parseable_tpu.utils import telemetry
+from parseable_tpu.utils.metrics import (
+    CLUSTER_FANOUT_BYTES,
+    CLUSTER_FANOUT_LATENCY,
+    CLUSTER_FANOUT_REQUESTS,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.query.planner import LogicalPlan
+    from parseable_tpu.query.provider import StreamScan
+
+logger = logging.getLogger(__name__)
+
+PARTIAL_PATH = "/api/v1/internal/query/partial"
+
+# response headers carrying the peer's scan accounting + identity proof
+H_ROWS = "X-P-Rows-Scanned"
+H_ERRORS = "X-P-Scan-Errors"
+H_TAG = "X-P-Owner-Tag"
+
+
+class UnsupportedPartial(Exception):
+    """The statement can't execute as a node-local partial (not a GROUP BY,
+    un-partializable aggregate, composite query) — the peer answers 400 and
+    the querier keeps that peer on the central path."""
+
+
+def serialize_table(table: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def deserialize_table(data: bytes) -> pa.Table:
+    with ipc.open_stream(io.BytesIO(data)) as reader:
+        return reader.read_all()
+
+
+# --------------------------------------------------------------- peer side
+
+
+def execute_local_partial(
+    p: "Parseable", stream_name: str, sql: str, start: str | None, end: str | None
+) -> tuple[bytes, dict] | None:
+    """Run the node-local half of a pushed-down aggregate: scan this node's
+    staging window (arrows only — staged-but-uncommitted parquet mirrors the
+    central data plane's visibility, which serves staging_batches) plus the
+    manifest files this node owns, reduce to per-block partials, and combine
+    them into one wire-ready partial table.
+
+    Returns (ipc_payload, meta) — payload b"" when the node-local slice is
+    empty — or None when this node doesn't know the stream at all (nothing
+    node-local can exist). Raises UnsupportedPartial for plans the partial
+    protocol can't express."""
+    from parseable_tpu.query import partials as PT
+    from parseable_tpu.query import sql as S
+    from parseable_tpu.query.executor import QueryExecutor
+    from parseable_tpu.query.provider import StreamScan
+    from parseable_tpu.query.session import QueryError, QuerySession
+    from parseable_tpu.query.sql import SqlError
+
+    t0 = _time.monotonic()
+    try:
+        select = S.parse_sql(sql)
+    except SqlError as e:
+        raise UnsupportedPartial(f"unparseable statement: {e}") from e
+    if select.ctes or select.set_ops or select.joins or select.explain:
+        raise UnsupportedPartial("composite statements are not partializable")
+    if select.table != stream_name:
+        raise UnsupportedPartial("statement stream does not match the route")
+
+    sess = QuerySession(p, engine="cpu")
+    try:
+        lp = sess._plan_ast(select, start, end, None, t0)
+    except QueryError:
+        # unknown stream on this node: no staging, no owned files
+        return None
+
+    ex = QueryExecutor(lp)
+    agg, _rewritten, _names = ex.build_aggregator()
+    if not (
+        lp.is_aggregate
+        and lp.select.group_by
+        and PT.specs_partializable(agg.specs)
+    ):
+        raise UnsupportedPartial("plan is not a partializable GROUP BY aggregate")
+
+    tag = p.owner_tag
+    meta = {"owner_tag": tag, "rows_scanned": 0, "scan_errors": 0}
+    scan = StreamScan(
+        p,
+        lp,
+        file_filter=lambda basename: basename.startswith(tag),
+        staging_parquet=False,
+        fetch_remote_staging=False,
+    )
+    with telemetry.TRACER.span(
+        "query.partial", stream=stream_name, owner=tag
+    ) as sp:
+        tables = scan.tables()
+        rows_seen = [0]
+
+        def counted():
+            # staging blocks don't tick scan.stats.rows_scanned (only
+            # parquet reads do), so count what actually flowed through
+            for t in tables:
+                rows_seen[0] += t.num_rows
+                yield t
+
+        try:
+            parts = ex.partial_tables(counted())
+        finally:
+            tables.close()
+        meta["rows_scanned"] = rows_seen[0]
+        with scan._stats_lock:
+            meta["scan_errors"] = scan.stats.scan_errors
+        sp["rows"] = meta["rows_scanned"]
+        if not parts:
+            return b"", meta
+        combined = PT.combine_partials(parts, agg.specs, len(lp.select.group_by))
+        payload = serialize_table(combined)
+        sp["bytes"] = len(payload)
+    return payload, meta
+
+
+# ------------------------------------------------------------ querier side
+
+
+class _PeerState:
+    """Gather-side bookkeeping for one scattered peer. All fields are
+    mutated only by the collector thread (collect()) except via the queue;
+    attempt workers never touch state directly."""
+
+    def __init__(self, node: dict):
+        self.node = node
+        self.domain = node["domain_name"]
+        self.tag = node["owner_tag"]
+        self.issued = 0
+        self.resolved = 0
+        self.retried = False
+        self.hedged = False
+        self.first_sent_at: float | None = None
+        self.done = False  # a result was merged
+        self.failed = False  # exhausted -> central fallback
+        self.fail_reason: str | None = None
+        self.elapsed_ms: float | None = None
+        self.bytes = 0
+
+
+class DistributedRun:
+    """One query's scatter-gather. start() launches the bounded fan-out on
+    the cluster pool; collect() — invoked by the executor after the local
+    scan has reduced — gathers peer partials in completion order, applies
+    retry/hedge policy, runs the central fallback for failed peers, and
+    returns the partial tables to merge."""
+
+    def __init__(self, p: "Parseable", lp: "LogicalPlan", scan: "StreamScan",
+                 peers: list[dict], body: dict):
+        self.p = p
+        self.lp = lp
+        self.scan = scan
+        self.opts = p.options
+        self.body = json.dumps(body).encode()
+        self.peers = [_PeerState(n) for n in peers]
+        self._q: _queue.Queue = _queue.Queue()
+        self._deferred: list[_PeerState] = []
+        self.stats: dict = {
+            "mode": "pushdown",
+            "peers": len(peers),
+            "ok": 0,
+            "fallback": 0,
+            "hedged": 0,
+            "retries": 0,
+            "bytes": 0,
+            "fallback_fanin_bytes": 0,
+            "per_peer": {},
+        }
+
+    # ---------------------------------------------------------- dispatch
+
+    def start(self) -> None:
+        max_inflight = max(1, self.opts.fanout_max_inflight)
+        for st in self.peers[:max_inflight]:
+            self._submit(st, "initial")
+        self._deferred = list(self.peers[max_inflight:])
+
+    def _submit(self, st: _PeerState, kind: str) -> None:
+        st.issued += 1
+        if st.first_sent_at is None:
+            st.first_sent_at = _time.monotonic()
+        from parseable_tpu.server.cluster import get_cluster_pool
+
+        # propagate: attempts run inside the query's trace
+        get_cluster_pool().submit(telemetry.propagate(self._attempt), st, kind)
+
+    def _attempt(self, st: _PeerState, kind: str) -> None:
+        """Worker-side: one HTTP round trip; every outcome posts exactly one
+        queue record (the collector owns all state)."""
+        from parseable_tpu.server.cluster import _http
+
+        timeout = max(0.1, self.opts.fanout_timeout_ms / 1000.0)
+        url = f"{st.domain}{PARTIAL_PATH}/{self.lp.stream}"
+        t0 = _time.monotonic()
+        try:
+            with telemetry.TRACER.span(
+                "query.fanout", peer=st.domain, kind=kind
+            ) as sp:
+                with _http(self.p, "POST", url, self.body, timeout=timeout) as resp:
+                    data = resp.read()
+                    headers = {
+                        "rows_scanned": int(resp.headers.get(H_ROWS, 0) or 0),
+                        "scan_errors": int(resp.headers.get(H_ERRORS, 0) or 0),
+                        "owner_tag": resp.headers.get(H_TAG, ""),
+                        "status": resp.status,
+                    }
+                sp["bytes"] = len(data)
+            self._q.put((st, True, data, headers, _time.monotonic() - t0, kind))
+        except urllib.error.HTTPError as e:
+            # 404 = endpoint absent (older peer), 400 = plan rejected: both
+            # terminal for this query; 5xx is retryable
+            e.close()
+            self._q.put(
+                (st, False, e.code, None, _time.monotonic() - t0, kind)
+            )
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self._q.put((st, False, e, None, _time.monotonic() - t0, kind))
+
+    # ------------------------------------------------------------ gather
+
+    def collect(self) -> list[pa.Table]:
+        """Completion-order gather + central fallback. Called on the
+        executor thread once the local blocks have reduced; peers have been
+        computing since start(), overlapping the local scan."""
+        from parseable_tpu.query import partials as PT  # noqa: F401 (doc link)
+
+        tables: list[pa.Table] = []
+        timeout_s = max(0.1, self.opts.fanout_timeout_ms / 1000.0)
+        hedge_s = self.opts.fanout_hedge_ms / 1000.0
+        deadline = _time.monotonic() + 2 * timeout_s + max(hedge_s, 0.0) + 2.0
+        if self.lp.deadline is not None:
+            deadline = min(deadline, self.lp.deadline)
+
+        while True:
+            pending = [st for st in self.peers if not st.done and not st.failed]
+            if not pending:
+                break
+            now = _time.monotonic()
+            if now >= deadline:
+                for st in pending:
+                    self._fail(st, "timeout")
+                break
+            # hedging: duplicate the slowest outstanding peer(s) past the
+            # hedge delay; first answer wins, the loser is discarded
+            next_timer = deadline
+            if hedge_s > 0:
+                for st in pending:
+                    if st.first_sent_at is None or st.hedged:
+                        continue
+                    due = st.first_sent_at + hedge_s
+                    if now >= due:
+                        st.hedged = True
+                        self.stats["hedged"] += 1
+                        CLUSTER_FANOUT_REQUESTS.labels(st.domain, "hedged").inc()
+                        self._submit(st, "hedge")
+                    else:
+                        next_timer = min(next_timer, due)
+            try:
+                item = self._q.get(timeout=max(0.02, next_timer - now))
+            except _queue.Empty:
+                continue
+            self._handle(item, tables)
+
+        fallback = [st for st in self.peers if st.failed]
+        if fallback:
+            tables.extend(self._fallback_partials(fallback))
+        for st in self.peers:
+            self.stats["per_peer"][st.domain] = {
+                "result": "ok" if st.done else (st.fail_reason or "failed"),
+                "ms": round(st.elapsed_ms, 3) if st.elapsed_ms is not None else None,
+                "bytes": st.bytes,
+                "attempts": st.issued,
+                "hedged": st.hedged,
+            }
+        return tables
+
+    def _handle(self, item, tables: list[pa.Table]) -> None:
+        st, ok, payload, headers, elapsed, kind = item
+        st.resolved += 1
+        if st.done or st.failed:
+            # hedge/retry loser, or a straggler past the overall deadline
+            # whose slice the fallback already covered: discarding is what
+            # guarantees no duplicate groups
+            CLUSTER_FANOUT_REQUESTS.labels(st.domain, "discarded").inc()
+            return
+        if ok:
+            if headers["owner_tag"] != st.tag:
+                # the peer answered with a different identity than the
+                # registry promised: merging would double-count everything
+                # outside its real scope — treat as failure, fall back
+                logger.warning(
+                    "pushdown peer %s owner tag mismatch (%r != %r)",
+                    st.domain, headers["owner_tag"], st.tag,
+                )
+                self._fail(st, "tag_mismatch")
+                return
+            table = None
+            if payload:
+                try:
+                    table = deserialize_table(payload)
+                except pa.ArrowInvalid:
+                    logger.warning("bad partial payload from %s", st.domain)
+                    self._fail(st, "bad_payload")
+                    return
+            st.done = True
+            st.elapsed_ms = elapsed * 1000
+            st.bytes = len(payload)
+            self.stats["ok"] += 1
+            self.stats["bytes"] += len(payload)
+            CLUSTER_FANOUT_REQUESTS.labels(st.domain, "ok").inc()
+            CLUSTER_FANOUT_BYTES.labels(st.domain).inc(len(payload))
+            CLUSTER_FANOUT_LATENCY.labels(st.domain).observe(elapsed)
+            with self.scan._stats_lock:
+                self.scan.stats.rows_scanned += headers["rows_scanned"]
+                self.scan.stats.scan_errors += headers["scan_errors"]
+            if table is not None:
+                tables.append(table)
+            self._submit_deferred()
+            return
+        # error record: payload is an exception or an HTTP status code
+        terminal = isinstance(payload, int) and payload in (400, 404, 403, 401)
+        logger.warning(
+            "pushdown attempt (%s) to %s failed: %s", kind, st.domain, payload
+        )
+        if terminal:
+            self._fail(st, f"http_{payload}")
+        elif not st.retried:
+            st.retried = True
+            self.stats["retries"] += 1
+            CLUSTER_FANOUT_REQUESTS.labels(st.domain, "retried").inc()
+            self._submit(st, "retry")
+        elif st.resolved >= st.issued:
+            # nothing left outstanding and the retry budget is spent
+            self._fail(st, "error")
+        self._submit_deferred()
+
+    def _fail(self, st: _PeerState, reason: str) -> None:
+        st.failed = True
+        st.fail_reason = reason
+        self.stats["fallback"] += 1
+        CLUSTER_FANOUT_REQUESTS.labels(st.domain, "fallback").inc()
+        result = "timeout" if reason == "timeout" else "error"
+        CLUSTER_FANOUT_REQUESTS.labels(st.domain, result).inc()
+
+    def _submit_deferred(self) -> None:
+        if not self._deferred:
+            return
+        inflight = sum(
+            st.issued - st.resolved
+            for st in self.peers
+            if not st.done and not st.failed
+        )
+        while self._deferred and inflight < max(1, self.opts.fanout_max_inflight):
+            self._submit(self._deferred.pop(0), "initial")
+            inflight += 1
+
+    # ---------------------------------------------------------- fallback
+
+    def _fallback_partials(self, failed: list[_PeerState]) -> list[pa.Table]:
+        """Central-pull coverage for exactly the failed peers' slices: their
+        staging windows over the bounded fan-in, and their owned manifest
+        files scanned locally. Identical results to the pre-pushdown data
+        plane for those peers (an unreachable peer's staging window is
+        unavailable either way, and is logged + counted)."""
+        from parseable_tpu.query.executor import QueryExecutor
+        from parseable_tpu.query.provider import StreamScan
+        from parseable_tpu.server.cluster import fetch_staging_batches
+        from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+        parts: list[pa.Table] = []
+        ex = QueryExecutor(self.lp)
+        fanin: dict = {}
+        batches = fetch_staging_batches(
+            self.p,
+            self.lp.stream,
+            time_bounds=self.lp.time_bounds,
+            columns=self.lp.needed_columns,
+            nodes=[st.node for st in failed],
+            stats=fanin,
+        )
+        self.stats["fallback_fanin_bytes"] += fanin.get("bytes", 0)
+        with self.scan._stats_lock:
+            self.scan.stats.fanin_bytes += fanin.get("bytes", 0)
+            self.scan.stats.fanin_errors += fanin.get("errors", 0)
+        if batches:
+            schema = merge_schemas([b.schema for b in batches])
+            table = pa.Table.from_batches([adapt_batch(schema, b) for b in batches])
+            parts.extend(ex.partial_tables(iter([table])))
+
+        tags = tuple(st.tag for st in failed)
+        fscan = StreamScan(
+            self.p,
+            self.lp,
+            hot_tier_dir=self.scan.hot_tier_dir,
+            file_filter=lambda basename: basename.startswith(tags),
+            local_staging=False,
+            fetch_remote_staging=False,
+        )
+        tables = fscan.tables()
+        try:
+            parts.extend(ex.partial_tables(tables))
+        finally:
+            tables.close()
+        with fscan._stats_lock:
+            extra = (
+                fscan.stats.bytes_scanned,
+                fscan.stats.rows_scanned,
+                fscan.stats.scan_errors,
+                fscan.stats.bytes_saved_by_projection,
+            )
+        with self.scan._stats_lock:
+            self.scan.stats.bytes_scanned += extra[0]
+            self.scan.stats.rows_scanned += extra[1]
+            self.scan.stats.scan_errors += extra[2]
+            self.scan.stats.bytes_saved_by_projection += extra[3]
+        return parts
+
+
+def prepare(
+    p: "Parseable", lp: "LogicalPlan", scan: "StreamScan", sql_text: str
+) -> DistributedRun | None:
+    """Eligibility gate + scatter launch. Returns None when the query stays
+    on the central path: not a partializable GROUP BY, no live peers with a
+    registered owner tag (older nodes), or pushdown disabled. On success
+    the scan is re-scoped — remote staging fan-in off (peers serve their
+    own windows), manifest files owned by scattered peers delegated — and
+    peer requests are already in flight when this returns."""
+    from parseable_tpu.query import partials as PT
+    from parseable_tpu.query.executor import QueryExecutor
+    from parseable_tpu.server.cluster import live_ingestors
+
+    sel = lp.select
+    if not sel.group_by:
+        return None
+    agg, _rewritten, _names = QueryExecutor(lp).build_aggregator()
+    if not PT.specs_partializable(agg.specs):
+        return None
+    peers = [n for n in live_ingestors(p) if n.get("owner_tag")]
+    if not peers:
+        return None
+
+    body: dict = {
+        "query": sql_text,
+        "fingerprint": PT.plan_fingerprint(lp, "cpu"),
+    }
+    if lp.time_bounds.low is not None and lp.time_bounds.high is not None:
+        body["startTime"] = lp.time_bounds.low.isoformat()
+        body["endTime"] = lp.time_bounds.high.isoformat()
+
+    # re-scope the local scan: peers serve their own staging windows and
+    # owned files; the querier keeps unowned/historical manifests. The
+    # memoized manifest list is reset because the result-cache fingerprint
+    # intentionally covered the FULL set (the merged answer represents it).
+    scan.use_hot_stubs = False
+    scan.fetch_remote_staging = False
+    tags = tuple(n["owner_tag"] for n in peers)
+    scan.file_filter = lambda basename: not basename.startswith(tags)
+    scan._manifest_files = None
+
+    run = DistributedRun(p, lp, scan, peers, body)
+    run.start()
+    return run

@@ -471,9 +471,47 @@ def _merge_out(g: pa.Table, specs: list) -> dict[str, Any]:
     return cols
 
 
+def _combine_out(g: pa.Table, specs: list) -> dict[str, Any]:
+    """Re-emit the merged group table in PARTIAL format (``__cnt``/``__pac``/
+    ``__sum``/``__sumsq``/``__min``/``__max``) instead of finalized ``__agg``
+    slots: a per-node reduction that stays mergeable. Finalized avg/stddev
+    can't be re-merged across nodes (an avg of avgs weights nodes, not
+    rows), so distributed pushdown ships THIS shape over the wire and the
+    querier's merge_partials treats each peer's table as one more block."""
+    cols: dict[str, Any] = {"__cnt": g.column("__cnt_sum")}
+    for si, spec in enumerate(specs):
+        if spec.func == "count_star":
+            continue
+        cols[f"__pac{si}"] = g.column(f"__pac{si}_sum")
+        if spec.func in ("sum", "avg"):
+            cols[f"__sum{si}"] = g.column(f"__sum{si}_sum")
+        elif spec.func in ("stddev", "var"):
+            cols[f"__sum{si}"] = g.column(f"__sum{si}_sum")
+            cols[f"__sumsq{si}"] = g.column(f"__sumsq{si}_sum")
+        elif spec.func == "min":
+            cols[f"__min{si}"] = g.column(f"__min{si}_min")
+        elif spec.func == "max":
+            cols[f"__max{si}"] = g.column(f"__max{si}_max")
+    return cols
+
+
 def merge_partials(partials: list[pa.Table], specs: list, nkeys: int) -> pa.Table:
     """Final half: merge partial tables -> interim (__g/__agg) table for
     finalize_from_interim."""
+    return _merge_partial_tables(partials, specs, nkeys, _merge_out)
+
+
+def combine_partials(partials: list[pa.Table], specs: list, nkeys: int) -> pa.Table:
+    """Node-local reduction for distributed pushdown: merge this node's
+    per-block partials into ONE partial-format table (same columns as
+    partial_from_block output) that the querier can merge again. Keeps
+    avg/stddev/var exact — the carried state is (count, sum[, sumsq])."""
+    return _merge_partial_tables(partials, specs, nkeys, _combine_out)
+
+
+def _merge_partial_tables(
+    partials: list[pa.Table], specs: list, nkeys: int, out_fn
+) -> pa.Table:
     non_key = [
         c
         for t in partials
@@ -517,7 +555,7 @@ def merge_partials(partials: list[pa.Table], specs: list, nkeys: int) -> pa.Tabl
             cols: dict[str, Any] = {}
             for i, arr in enumerate(_group_codes_to_key_arrays(gcodes, dicts, sizes)):
                 cols[f"__g{i}"] = arr
-            cols.update(_merge_out(g, specs))
+            cols.update(out_fn(g, specs))
             return pa.table(cols)
         except _FastPathUnavailable:
             pass
@@ -530,5 +568,5 @@ def merge_partials(partials: list[pa.Table], specs: list, nkeys: int) -> pa.Tabl
     keys = [f"__g{i}" for i in range(nkeys)]
     g = t.group_by(keys, use_threads=False).aggregate(_merge_aggs(specs))
     cols = {f"__g{i}": g.column(f"__g{i}") for i in range(nkeys)}
-    cols.update(_merge_out(g, specs))
+    cols.update(out_fn(g, specs))
     return pa.table(cols)

@@ -81,6 +81,13 @@ class ScanStats:
     # cumulative time this query's scan tasks waited for a shared-pool
     # worker (enqueue -> dispatch): THE cross-query contention signal
     sched_wait_seconds: float = 0.0
+    # distributed data plane: raw staging bytes pulled from peers over
+    # Arrow IPC (central pull / pushdown fallback) + failed peer fetches
+    fanin_bytes: int = 0
+    fanin_errors: int = 0
+    # manifest files skipped because a live peer's pushdown scan owns them
+    # (they are NOT pruned — another node is scanning them)
+    files_delegated: int = 0
 
 
 # --------------------------------------------------------------------------
@@ -483,6 +490,10 @@ class StreamScan:
         plan: LogicalPlan,
         hot_tier_dir: Path | None = None,
         use_hot_stubs: bool = False,
+        file_filter: Callable[[str], bool] | None = None,
+        local_staging: bool = True,
+        staging_parquet: bool = True,
+        fetch_remote_staging: bool = True,
     ):
         self.p = parseable
         self.plan = plan
@@ -490,6 +501,19 @@ class StreamScan:
         # device-resident blocks skip the parquet read entirely: the scan
         # yields a stub the TPU executor resolves from the hot set
         self.use_hot_stubs = use_hot_stubs
+        # distributed pushdown scoping (query/fanout.py): predicate over a
+        # manifest file's BASENAME partitioning the scan by owner tag — a
+        # peer keeps only its own files, the querier skips files a live
+        # peer will scan, the fallback pass keeps only a failed peer's.
+        # Files it rejects count as files_delegated, not pruned.
+        self.file_filter = file_filter
+        # staging sources: this node's in-memory/arrow window, this node's
+        # staged-but-uncommitted parquet, and (queriers) the peers' windows
+        # over the cluster data plane — individually switchable because the
+        # peer partial scan and the fallback scan each cover a subset
+        self.local_staging = local_staging
+        self.staging_parquet = staging_parquet
+        self.fetch_remote_staging = fetch_remote_staging
         self._sources: dict[bytes, ManifestFile] = {}
         self._manifest_files: list[ManifestFile] | None = None
         # ordered source ids the scan stubbed (hot-set or enccache
@@ -573,6 +597,12 @@ class StreamScan:
                 seen.add(m.key)
                 with self._stats_lock:
                     self.stats.files_total += 1
+                if self.file_filter is not None and not self.file_filter(
+                    m.key.rsplit("/", 1)[-1]
+                ):
+                    with self._stats_lock:
+                        self.stats.files_delegated += 1
+                    continue
                 out.append(ManifestFile(file_path=m.key, num_rows=0, file_size=m.size))
         if errors == len(prefixes) and errors:
             # storage down must error, not masquerade as an empty stream
@@ -607,6 +637,12 @@ class StreamScan:
                 seen.add(f.file_path)
                 with self._stats_lock:
                     self.stats.files_total += 1
+                if self.file_filter is not None and not self.file_filter(
+                    f.file_path.rsplit("/", 1)[-1]
+                ):
+                    with self._stats_lock:
+                        self.stats.files_delegated += 1
+                    continue
                 if not self._file_overlaps_time(f):
                     with self._stats_lock:
                         self.stats.files_pruned += 1
@@ -815,10 +851,23 @@ class StreamScan:
         stream = self.p.streams.get(self.plan.stream)
         if stream is None:
             return
-        if self.p.options.mode == Mode.QUERY:
+        if self.p.options.mode == Mode.QUERY and self.fetch_remote_staging:
             from parseable_tpu.server.cluster import fetch_staging_batches
 
-            remote = fetch_staging_batches(self.p, self.plan.stream)
+            # bounded fan-in: the peer filters to the plan's time range and
+            # projects to the needed columns before serializing — a narrow
+            # dashboard query stops shipping every peer's full window
+            fanin: dict = {}
+            remote = fetch_staging_batches(
+                self.p,
+                self.plan.stream,
+                time_bounds=self.plan.time_bounds,
+                columns=self.plan.needed_columns,
+                stats=fanin,
+            )
+            with self._stats_lock:
+                self.stats.fanin_bytes += fanin.get("bytes", 0)
+                self.stats.fanin_errors += fanin.get("errors", 0)
             if remote:
                 from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
 
@@ -830,6 +879,8 @@ class StreamScan:
                 if cols is not None:
                     table = table.select(cols)
                 yield table
+        if not self.local_staging:
+            return
         batches = stream.staging_batches()
         if batches:
             with self._stats_lock:
@@ -839,6 +890,8 @@ class StreamScan:
             if cols is not None:
                 table = table.select(cols)
             yield table
+        if not self.staging_parquet:
+            return
         for f in stream.parquet_files():
             try:
                 with pq.ParquetFile(f) as pf:

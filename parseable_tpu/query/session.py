@@ -384,6 +384,12 @@ class QuerySession:
             use_hot_stubs=self.engine == "tpu" and lp.is_aggregate,
         )
         texec = _time.perf_counter()
+        self._fanout_stats = None
+        # pushdown ships the ORIGINAL statement text to peers (they re-plan
+        # it locally); only the top-level single-statement path has it —
+        # CTE bodies / resolved-subquery selects executed through here are
+        # derived statements with no faithful text, so they stay central
+        self._exec_sql = sql_key
         result, timer = self._execute(lp, scan)
         exec_s = _time.perf_counter() - texec
         elapsed = _time.monotonic() - t0
@@ -413,6 +419,10 @@ class QuerySession:
                     "sched_wait_ms": round(scan.stats.sched_wait_seconds * 1000, 3),
                     "plan_cache": getattr(self, "_plan_cache_state", None),
                     "result_cache": getattr(self, "_result_cache_state", None),
+                    # distributed data plane: pushdown scatter-gather
+                    # breakdown (per-peer latency/bytes, hedges, fallbacks)
+                    # or the central pull's raw fan-in accounting
+                    "fanout": self._fanout_stage(scan),
                     # tiering state for this process + this query's prefetch
                     # outcome (None on the CPU engine — no device tier)
                     "hotset": self._hotset_stage(result.stats.get("device_routes")),
@@ -421,6 +431,32 @@ class QuerySession:
         )
         self._maybe_log_slow(select, elapsed, result.stats)
         return result
+
+    def _fanout_stage(self, scan: StreamScan) -> dict | None:
+        """stats.stages.fanout: the distributed data plane's share of the
+        query — pushdown scatter-gather stats when it ran, otherwise the
+        central pull's raw staging fan-in bytes/errors (None on non-querier
+        nodes with nothing fetched)."""
+        dist = getattr(self, "_fanout_stats", None)
+        if dist is not None:
+            snap = dict(dist)
+            with scan._stats_lock:
+                snap["fanin_bytes"] = scan.stats.fanin_bytes
+                snap["fanin_errors"] = scan.stats.fanin_errors
+                snap["files_delegated"] = scan.stats.files_delegated
+            return snap
+        with scan._stats_lock:
+            fanin_bytes = scan.stats.fanin_bytes
+            fanin_errors = scan.stats.fanin_errors
+        from parseable_tpu.config import Mode as _Mode
+
+        if self.p.options.mode != _Mode.QUERY and not fanin_bytes and not fanin_errors:
+            return None
+        return {
+            "mode": "central",
+            "fanin_bytes": fanin_bytes,
+            "fanin_errors": fanin_errors,
+        }
 
     def _hotset_stage(self, routes: dict | None) -> dict | None:
         """stats.stages.hotset: first-class tier state (budget, residency,
@@ -1057,6 +1093,50 @@ class QuerySession:
                     timer,
                 )
             self._result_cache_state = "miss"
+
+        # distributed partial-aggregate pushdown (query/fanout.py): on a
+        # dedicated querier, scatter partializable GROUP BY aggregates to
+        # live ingestors — each scans its own staging + owned manifests and
+        # answers with one partial table — instead of pulling raw staging
+        # windows and scanning everything here. prepare() launches the
+        # fan-out (overlapping the local scan) and re-scopes `scan` to
+        # unowned/historical files; collection happens inside the
+        # executor's merge via partials_source. Falls through to the
+        # central path when ineligible (non-aggregate plans, no tagged
+        # live peers, knob off).
+        dist = None
+        from parseable_tpu.config import Mode as _Mode
+
+        exec_sql = getattr(self, "_exec_sql", None)
+        if (
+            self.p.options.mode == _Mode.QUERY
+            and self.p.options.query_pushdown
+            and lp.is_aggregate
+            and exec_sql is not None
+        ):
+            from parseable_tpu.query import fanout as FO
+
+            dist = FO.prepare(self.p, lp, scan, exec_sql)
+        if dist is not None:
+            # the distributed merge is host-side regardless of the session
+            # engine: peer partials fold into the CPU two-phase funnel
+            executor = QueryExecutor(lp)
+            executor.partials_source = dist.collect
+            if result_key is not None:
+                def _dist_sink(interim, _key=result_key, _cache=result_cache, _scan=scan):
+                    with _scan._stats_lock:
+                        errors = _scan.stats.scan_errors
+                    if errors == 0:
+                        _cache.put(_key, interim)
+
+                executor.interim_sink = _dist_sink
+            timer = _TimedIter(scan.tables())
+            try:
+                table = executor.execute(timer)
+            finally:
+                timer.close()
+            self._fanout_stats = dist.stats
+            return QueryResult(table, table.column_names, {}), timer
 
         use_tpu = self.engine == "tpu"
         fallback = False

@@ -256,6 +256,11 @@ class ServerState:
         from parseable_tpu.query.provider import shutdown_scan_scheduler
 
         shutdown_scan_scheduler()
+        # intra-cluster HTTP pool (staging fan-in, pushdown scatter,
+        # control-plane sync) — was an import-time pool with no stop path
+        from parseable_tpu.server.cluster import shutdown_cluster_pool
+
+        shutdown_cluster_pool(wait=False)
         self.query_workers.shutdown(wait=False)
         self.workers.shutdown(wait=False)
 
@@ -1363,35 +1368,126 @@ async def internal_staging(request: web.Request) -> web.Response:
     as Arrow IPC — the reference's querier->ingestor Flight do_get
     (airplane.rs:155-184) over HTTP. Guarded by stream-scoped QUERY
     permission (the reference uses an intra-cluster token; queriers here
-    authenticate with the shared cluster credentials, which are admin)."""
+    authenticate with the shared cluster credentials, which are admin).
+
+    Bounded fan-in params (all optional; absent = the old full-window
+    behavior, so older queriers keep working): `start`/`end` RFC3339
+    instants filter rows to [start, end) on the event timestamp, and
+    `fields` (comma-separated) projects columns before serialization —
+    the timestamp column always rides along so the querier can re-filter.
+    """
+    from parseable_tpu.utils.timeutil import parse_rfc3339
+
     state: ServerState = request.app["state"]
     name = request.match_info["name"]
     stream = state.p.streams.get(name)
     if stream is None:
         return web.Response(status=204)
+    try:
+        start = parse_rfc3339(request.query["start"]) if "start" in request.query else None
+        end = parse_rfc3339(request.query["end"]) if "end" in request.query else None
+    except TimeParseError as e:
+        return web.json_response({"error": f"bad time bound: {e}"}, status=400)
+    fields = None
+    if "fields" in request.query:
+        fields = {f for f in request.query["fields"].split(",") if f}
 
     def work() -> bytes:
         import io
 
         import pyarrow as pa
+        import pyarrow.compute as pc
         import pyarrow.ipc as ipc
 
         batches = stream.staging_batches()
         if not batches:
             return b""
-        sink = io.BytesIO()
         from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
 
         schema = merge_schemas([b.schema for b in batches])
-        with ipc.new_stream(sink, schema) as w:
-            for b in batches:
-                w.write_batch(adapt_batch(schema, b))
+        table = pa.Table.from_batches([adapt_batch(schema, b) for b in batches])
+        if (
+            (start is not None or end is not None)
+            and DEFAULT_TIMESTAMP_KEY in table.column_names
+        ):
+            col = table.column(DEFAULT_TIMESTAMP_KEY)
+            mask = None
+            if start is not None:
+                mask = pc.greater_equal(
+                    col, pa.scalar(start.replace(tzinfo=None), type=col.type)
+                )
+            if end is not None:
+                m2 = pc.less(col, pa.scalar(end.replace(tzinfo=None), type=col.type))
+                mask = m2 if mask is None else pc.and_(mask, m2)
+            table = table.filter(mask)
+        if fields is not None:
+            keep = [
+                c
+                for c in table.column_names
+                if c in fields or c == DEFAULT_TIMESTAMP_KEY
+            ]
+            table = table.select(keep)
+        if table.num_rows == 0:
+            return b""
+        sink = io.BytesIO()
+        with ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
         return sink.getvalue()
 
     data = await asyncio.get_running_loop().run_in_executor(state.workers, work)
     if not data:
         return web.Response(status=204)
     return web.Response(body=data, content_type="application/vnd.apache.arrow.stream")
+
+
+@require(Action.QUERY, "name")
+async def internal_query_partial(request: web.Request) -> web.Response:
+    """POST /api/v1/internal/query/partial/{name}: execute a pushed-down
+    GROUP BY aggregate over this node's LOCAL slice (own staging window +
+    manifest files it owns via the basename owner tag) and return one
+    combined partial table as Arrow IPC (query/fanout.py documents the
+    protocol). 204 = empty local slice; 400 = plan not partializable (the
+    querier keeps that query on the central path); response headers carry
+    scan accounting + this node's owner tag so the querier can verify the
+    delegation matches the registry."""
+    from parseable_tpu.query import fanout as FO
+
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    try:
+        body = await request.json()
+    except json.JSONDecodeError:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+    sql = body.get("query")
+    if not sql:
+        return web.json_response({"error": "missing 'query'"}, status=400)
+    start, end = body.get("startTime"), body.get("endTime")
+
+    def work():
+        return FO.execute_local_partial(state.p, name, sql, start, end)
+
+    try:
+        out = await _run_query_traced(state, work)
+    except FO.UnsupportedPartial as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except (SqlError, QueryError, TimeParseError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+    except Exception as e:
+        logger.exception("partial pushdown failed")
+        return web.json_response({"error": str(e)}, status=500)
+    headers = {FO.H_TAG: state.p.owner_tag}
+    if out is None:
+        return web.Response(status=204, headers=headers)
+    payload, meta = out
+    headers[FO.H_ROWS] = str(meta["rows_scanned"])
+    headers[FO.H_ERRORS] = str(meta["scan_errors"])
+    if not payload:
+        return web.Response(status=204, headers=headers)
+    return web.Response(
+        body=payload,
+        content_type="application/vnd.apache.arrow.stream",
+        headers=headers,
+    )
 
 
 async def logout(request: web.Request) -> web.Response:
@@ -1902,6 +1998,9 @@ def build_app(state: ServerState) -> web.Application:
         r.add_post("/api/v1/logstream/{name}", post_event)
         r.add_post("/v1/{kind}", otel_ingest)
         r.add_get("/api/v1/internal/staging/{name}", internal_staging)
+        # partial-aggregate pushdown: the querier scatters GROUP BY
+        # aggregates here instead of pulling the raw staging window
+        r.add_post("/api/v1/internal/query/partial/{name}", internal_query_partial)
 
     if mode in (Mode.ALL, Mode.QUERY):
         r.add_post("/api/v1/query", query)

@@ -17,16 +17,20 @@ from __future__ import annotations
 import base64
 import io
 import logging
+import math
+import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ThreadPoolExecutor, as_completed
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from parseable_tpu.core import Parseable
 from parseable_tpu.utils import telemetry
+from parseable_tpu.utils.metrics import CLUSTER_FANIN_BYTES, CLUSTER_FANIN_ERRORS
 
 logger = logging.getLogger(__name__)
 
@@ -35,7 +39,31 @@ STAGING_TIMEOUT = 10.0
 DEAD_NODE_TTL = 30.0
 
 _dead_nodes: dict[str, float] = {}
-_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cluster")
+
+# Process-wide intra-cluster HTTP pool, lazily built and re-creatable after
+# shutdown (matching the scan/sync pool lifecycle idiom): the old
+# import-time ThreadPoolExecutor had no stop path, so ServerState.stop
+# leaked its workers and tests could never assert a clean drain.
+_POOL: ThreadPoolExecutor | None = None
+_POOL_LOCK = threading.Lock()
+
+
+def get_cluster_pool() -> ThreadPoolExecutor:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cluster")
+        return _POOL
+
+
+def shutdown_cluster_pool(wait: bool = True) -> None:
+    """Deterministic stop, wired into ServerState.stop; the next
+    get_cluster_pool() re-roots a fresh pool (tests restart servers)."""
+    global _POOL
+    with _POOL_LOCK:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.shutdown(wait=wait)
 
 
 def _auth_header(p: Parseable) -> str:
@@ -77,8 +105,36 @@ def live_ingestors(p: Parseable) -> list[dict]:
     return [n for n in nodes if check_liveness(n["domain_name"], p)]
 
 
-def _fetch_one(p: Parseable, domain: str, stream: str) -> list[pa.RecordBatch]:
+def _staging_params(time_bounds=None, columns=None) -> str:
+    """Query string for the bounded staging fan-in: the peer filters its
+    window to [start, end) and projects to `fields` before serializing, so
+    a 5-minute dashboard query stops shipping the whole window. Older
+    peers ignore unknown params and return the full window — the querier
+    re-filters locally either way, so the bound is an optimization, never
+    a correctness dependency."""
+    params: list[tuple[str, str]] = []
+    if time_bounds is not None:
+        if time_bounds.low is not None:
+            params.append(("start", time_bounds.low.isoformat()))
+        if time_bounds.high is not None:
+            params.append(("end", time_bounds.high.isoformat()))
+    if columns is not None:
+        params.append(("fields", ",".join(sorted(columns))))
+    return urllib.parse.urlencode(params)
+
+
+def _fetch_one(
+    p: Parseable,
+    domain: str,
+    stream: str,
+    time_bounds=None,
+    columns=None,
+    stats: dict | None = None,
+) -> list[pa.RecordBatch]:
     url = f"{domain}/api/v1/internal/staging/{stream}"
+    qs = _staging_params(time_bounds, columns)
+    if qs:
+        url = f"{url}?{qs}"
     req = urllib.request.Request(url, headers={"Authorization": _auth_header(p)})
     try:
         with _urlopen(req, STAGING_TIMEOUT, p) as resp:
@@ -87,31 +143,63 @@ def _fetch_one(p: Parseable, domain: str, stream: str) -> list[pa.RecordBatch]:
             data = resp.read()
     except (urllib.error.URLError, OSError) as e:
         logger.warning("staging fan-in from %s failed: %s", domain, e)
+        CLUSTER_FANIN_ERRORS.labels(domain).inc()
+        if stats is not None:
+            stats["errors"] = stats.get("errors", 0) + 1
         _dead_nodes[domain] = time.monotonic()
         return []
     if not data:
         return []
+    CLUSTER_FANIN_BYTES.labels(domain).inc(len(data))
+    if stats is not None:
+        stats["bytes"] = stats.get("bytes", 0) + len(data)
     try:
-        return list(ipc.open_stream(io.BytesIO(data)))
+        with ipc.open_stream(io.BytesIO(data)) as reader:
+            return list(reader)
     except pa.ArrowInvalid as e:
         logger.warning("bad staging payload from %s: %s", domain, e)
+        CLUSTER_FANIN_ERRORS.labels(domain).inc()
+        if stats is not None:
+            stats["errors"] = stats.get("errors", 0) + 1
         return []
 
 
-def fetch_staging_batches(p: Parseable, stream: str) -> list[pa.RecordBatch]:
+def fetch_staging_batches(
+    p: Parseable,
+    stream: str,
+    time_bounds=None,
+    columns=None,
+    nodes: list[dict] | None = None,
+    stats: dict | None = None,
+) -> list[pa.RecordBatch]:
     """Pull the staging window of `stream` from every live ingestor
-    (reference: airplane.rs:155-184 fan-out, concurrently)."""
-    nodes = live_ingestors(p)
+    (reference: airplane.rs:155-184 fan-out, concurrently), bounded by the
+    query's time range + projected columns. `nodes` restricts the pull to
+    specific peers (the pushdown fallback path); `stats` accumulates
+    bytes/errors for the per-query fan-out stage breakdown. Results gather
+    in completion order so one slow peer never delays error accounting
+    for the rest."""
+    if nodes is None:
+        nodes = live_ingestors(p)
     if not nodes:
         return []
     # propagate: this runs inside a traced query — the per-node fetch spans
     # must parent under it, not detach into the pool's empty context
+    pool = get_cluster_pool()
     futures = [
-        _pool.submit(telemetry.propagate(_fetch_one), p, n["domain_name"], stream)
+        pool.submit(
+            telemetry.propagate(_fetch_one),
+            p,
+            n["domain_name"],
+            stream,
+            time_bounds,
+            columns,
+            stats,
+        )
         for n in nodes
     ]
     out: list[pa.RecordBatch] = []
-    for f in futures:
+    for f in as_completed(futures):
         out.extend(f.result())
     return out
 
@@ -173,7 +261,7 @@ def sync_with_ingestors(
             failed.append(domain)
 
     nodes = live_peers(p, kinds)
-    list(_pool.map(telemetry.propagate(one), [n["domain_name"] for n in nodes]))
+    list(get_cluster_pool().map(telemetry.propagate(one), [n["domain_name"] for n in nodes]))
     return failed
 
 
@@ -194,7 +282,9 @@ def get_available_querier(p: Parseable) -> dict | None:
         return None
     for i in range(len(queriers)):
         cand = queriers[(_rr_index + i) % len(queriers)]
-        if check_liveness(cand["domain_name"]):
+        # `p` carries the TLS client context + cluster credentials; probing
+        # without it ran unauthenticated/unconfigured against https peers
+        if check_liveness(cand["domain_name"], p):
             _rr_index = (_rr_index + i + 1) % len(queriers)
             return cand
     return None
@@ -244,18 +334,55 @@ def collect_node_metrics(p: Parseable) -> list[dict]:
 
 
 def parse_prometheus(text: str) -> dict[str, float]:
-    """Sum samples per metric family (enough for the cluster rollup)."""
+    """Sum samples per metric family (enough for the cluster rollup).
+    Non-finite samples (NaN from empty histograms, +Inf buckets) are
+    skipped — one NaN sample must not poison a family's billing total —
+    and malformed lines are ignored like the exposition spec asks."""
     totals: dict[str, float] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
         try:
             name_part, value = line.rsplit(" ", 1)
-            name = name_part.split("{", 1)[0]
-            totals[name] = totals.get(name, 0.0) + float(value)
+            v = float(value)
+            if not math.isfinite(v):
+                continue
+            name = name_part.split("{", 1)[0].strip()
+            if not name or any(c.isspace() for c in name):
+                continue  # names never contain whitespace: malformed line
+            totals[name] = totals.get(name, 0.0) + v
         except ValueError:
             continue
     return totals
+
+
+def _label_value(labels: str, key: str) -> str | None:
+    """Extract one label's value from a Prometheus label body, honoring
+    quoting and backslash escapes — a quoted value containing a comma
+    (`path="a,b"`) must not derail the scan (the old comma-split did)."""
+    i, n = 0, len(labels)
+    while i < n:
+        eq = labels.find("=", i)
+        if eq < 0:
+            return None
+        name = labels[i:eq].strip().strip(",").strip()
+        j = eq + 1
+        if j >= n or labels[j] != '"':
+            return None
+        j += 1
+        out: list[str] = []
+        while j < n and labels[j] != '"':
+            if labels[j] == "\\" and j + 1 < n:
+                esc = labels[j + 1]
+                out.append({"n": "\n", "t": "\t"}.get(esc, esc))
+                j += 2
+            else:
+                out.append(labels[j])
+                j += 1
+        if name == key:
+            return "".join(out)
+        i = j + 1
+    return None
 
 
 def parse_prometheus_dated(text: str) -> dict[tuple[str, str], float]:
@@ -267,19 +394,15 @@ def parse_prometheus_dated(text: str) -> dict[tuple[str, str], float]:
             continue
         try:
             name_part, value = line.rsplit(" ", 1)
+            v = float(value)
+            if not math.isfinite(v):
+                continue
             name, labels = name_part.split("{", 1)
-            labels = labels.rstrip("}")
-            date = None
-            for pair in labels.split(","):
-                if "=" not in pair:
-                    continue
-                k, v = pair.split("=", 1)
-                if k.strip() == "date":
-                    date = v.strip().strip('"')
+            date = _label_value(labels.rstrip().rstrip("}"), "date")
             if date is None:
                 continue
             key = (name, date)
-            out[key] = out.get(key, 0.0) + float(value)
+            out[key] = out.get(key, 0.0) + v
         except ValueError:
             continue
     return out

@@ -169,6 +169,35 @@ PREFETCH_EVENTS = _counter(
     "tpu_prefetch", "Query-aware prefetch outcomes", ["result"]
 )
 
+# --- distributed query fan-out (server/cluster.py, query/fanout.py) ------
+# fan-in = querier pulling raw staging windows over Arrow IPC (central
+# pull); fan-out = querier scattering partial-aggregate pushdown requests.
+# Peer label cardinality is bounded by cluster size. fanin_errors was the
+# counted-swallow gap: staging fetch failures were logged but invisible to
+# operators, so a flapping ingestor silently produced partial results.
+CLUSTER_FANIN_ERRORS = _counter(
+    "cluster_fanin_errors", "Staging fan-in fetch failures", ["peer"]
+)
+CLUSTER_FANIN_BYTES = _counter(
+    "cluster_fanin_bytes", "Raw staging bytes pulled over the cluster data plane", ["peer"]
+)
+CLUSTER_FANOUT_REQUESTS = _counter(
+    "cluster_fanout_requests",
+    "Partial-aggregate pushdown requests by outcome (ok/error/timeout/"
+    "fallback/hedged/retried/discarded)",
+    ["peer", "result"],
+)
+CLUSTER_FANOUT_BYTES = _counter(
+    "cluster_fanout_bytes", "Partial-aggregate result bytes received", ["peer"]
+)
+CLUSTER_FANOUT_LATENCY = Histogram(
+    "cluster_fanout_seconds",
+    "Per-peer partial-aggregate pushdown round-trip latency",
+    ["peer"],
+    namespace=METRICS_NAMESPACE,
+    registry=REGISTRY,
+)
+
 # errors a storage backend deliberately recovers from (credential-probe
 # fallbacks, best-effort session cancels): recoverable by design, but a
 # nonzero rate is the early signal of a flapping metadata server or a

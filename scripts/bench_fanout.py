@@ -1,0 +1,18 @@
+"""Battery hook: run the multi-process distributed fan-out bench standalone.
+
+`python scripts/bench_fanout.py` boots 1 querier per data plane + N ingestor
+processes (scripts/blackbox.py) and emits the bench_distributed_fanout line
+— the same emission bench.py produces inside the full battery, runnable on
+its own for the hardware-watch battery and for iterating on the cluster
+path without rebuilding datasets. Knobs: BENCH_DF_* (see bench.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import bench_distributed_fanout  # noqa: E402
+
+if __name__ == "__main__":
+    bench_distributed_fanout()

@@ -504,7 +504,7 @@ def test_cluster_staging_fanout_propagates_trace(monkeypatch):
 
     seen: list[str | None] = []
 
-    def fake_fetch(p, domain, stream):
+    def fake_fetch(p, domain, stream, *args, **kwargs):
         seen.append(telemetry.current_trace_id())
         return []
 

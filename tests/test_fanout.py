@@ -1,0 +1,603 @@
+"""Distributed query fan-out: partial-aggregate pushdown + scatter-gather.
+
+In-process cluster topology (real sockets, like test_distributed.py):
+ingest-mode servers serve the pushdown endpoint; a query-mode Parseable
+scatters to them. Covers the acceptance invariants: an all-pushdown
+aggregate transfers ZERO raw staging rows, unsupported plans / 404ing /
+erroring peers fall back to central pull with identical results, and
+hedged or dead peers never produce duplicate or dropped groups.
+"""
+
+import asyncio
+import base64
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from parseable_tpu.config import Mode, Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.query.session import QuerySession
+from parseable_tpu.server import cluster as C
+from parseable_tpu.server.app import ServerState, build_app
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+SQL = (
+    "SELECT host, count(*) c, sum(v) s, avg(v) a, min(v) mn, max(v) mx "
+    "FROM dist GROUP BY host ORDER BY host"
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cluster_state():
+    C._dead_nodes.clear()
+    C._rr_index = 0
+    yield
+    C._dead_nodes.clear()
+
+
+def make_parseable(tmp_path, node: str, mode: Mode) -> Parseable:
+    opts = Options()
+    opts.mode = mode
+    opts.local_staging_path = tmp_path / f"staging-{node}"
+    storage = StorageOptions(backend="local-store", root=tmp_path / "shared-store")
+    return Parseable(opts, storage)
+
+
+def run(coro):
+    asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def boot_ingestors(tmp_path, n=2, stream="dist", rows_per_node=10, prefix="ing"):
+    """N ingest-mode servers on real ports, each holding `rows_per_node`
+    staging rows for `stream`. `prefix` keeps staging dirs (and with them
+    the persisted node identities) distinct across separate boots."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    states, servers = [], []
+    for i in range(n):
+        p = make_parseable(tmp_path, f"{prefix}{i}", Mode.INGEST)
+        state = ServerState(p)
+        server = TestServer(build_app(state))
+        await server.start_server()
+        p.register_node(f"127.0.0.1:{server.port}")
+        states.append(state)
+        servers.append(server)
+    async with aiohttp.ClientSession() as http:
+        for i, server in enumerate(servers):
+            url = f"http://127.0.0.1:{server.port}/api/v1/ingest"
+            rows = [{"host": f"node{i}", "v": float(j)} for j in range(rows_per_node)]
+            async with http.post(
+                url, json=rows, headers={**AUTH, "X-P-Stream": stream}
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+    return states, servers
+
+
+async def teardown(states, servers):
+    for s in servers:
+        await s.close()
+    for st in states:
+        st._sync_stop.set()
+
+
+def query_on(tmp_path, node: str, sql: str = SQL, pushdown: bool = True, **opt_overrides):
+    q = make_parseable(tmp_path, node, Mode.QUERY)
+    q.options.query_pushdown = pushdown
+    for k, v in opt_overrides.items():
+        setattr(q.options, k, v)
+    res = QuerySession(q, engine="cpu").query(sql)
+    return res.to_json_rows(), res.stats
+
+
+EXPECTED = [
+    {"host": "node0", "c": 10, "s": 45.0, "a": 4.5, "mn": 0.0, "mx": 9.0},
+    {"host": "node1", "c": 10, "s": 45.0, "a": 4.5, "mn": 0.0, "mx": 9.0},
+]
+
+
+# ---------------------------------------------------------------- pushdown
+
+
+def test_pushdown_zero_raw_staging_rows(tmp_path, monkeypatch):
+    """An aggregate whose peers all support pushdown transfers ZERO raw
+    staging rows: the querier-side fetch never runs AND the peers'
+    instrumented staging endpoint is never hit."""
+    from parseable_tpu.server import app as A
+
+    staging_hits = []
+    orig_staging = A.internal_staging
+
+    async def counting_staging(request):
+        staging_hits.append(request.path)
+        return await orig_staging(request)
+
+    monkeypatch.setattr(A, "internal_staging", counting_staging)
+
+    fetches = []
+    orig_fetch = C._fetch_one
+
+    def counting_fetch(*args, **kwargs):
+        fetches.append(args)
+        return orig_fetch(*args, **kwargs)
+
+    monkeypatch.setattr(C, "_fetch_one", counting_fetch)
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path)
+        # one node also uploads: its owned manifests must be delegated too
+        states[0].p.local_sync(shutdown=True)
+        states[0].p.sync_all_streams()
+        rows, stats = await asyncio.get_running_loop().run_in_executor(
+            None, query_on, tmp_path, "q"
+        )
+        assert rows == EXPECTED
+        fan = stats["stages"]["fanout"]
+        assert fan["mode"] == "pushdown"
+        assert fan["ok"] == 2 and fan["fallback"] == 0
+        assert fan["bytes"] > 0
+        assert fan["files_delegated"] >= 1  # node0's uploaded parquet
+        assert fetches == [], "querier pulled raw staging despite pushdown"
+        assert staging_hits == [], "a peer served raw staging despite pushdown"
+        # the peers' scan accounting rode back on the response headers
+        assert stats["rows_scanned"] >= 20
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_pushdown_parity_with_central(tmp_path):
+    """Pushdown and central pull agree exactly — including avg and stddev,
+    which are only mergeable because the wire carries partial state."""
+    sql = (
+        "SELECT host, count(*) c, sum(v) s, avg(v) a, stddev(v) sd "
+        "FROM dist GROUP BY host ORDER BY host"
+    )
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path)
+        states[0].p.local_sync(shutdown=True)
+        states[0].p.sync_all_streams()
+
+        def both():
+            pushed, pstats = query_on(tmp_path, "qa", sql, pushdown=True)
+            central, cstats = query_on(tmp_path, "qb", sql, pushdown=False)
+            return pushed, pstats, central, cstats
+
+        pushed, pstats, central, cstats = await asyncio.get_running_loop().run_in_executor(
+            None, both
+        )
+        assert pstats["stages"]["fanout"]["ok"] == 2
+        assert cstats["stages"]["fanout"]["mode"] == "central"
+        assert cstats["stages"]["fanout"]["fanin_bytes"] > 0
+        assert len(pushed) == len(central) == 2
+        for pr, cr in zip(pushed, central):
+            assert pr["host"] == cr["host"] and pr["c"] == cr["c"]
+            for k in ("s", "a", "sd"):
+                assert pr[k] == pytest.approx(cr[k], rel=1e-9)
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_unsupported_plan_stays_central(tmp_path, monkeypatch):
+    """A plan the partial protocol can't express (no GROUP BY) never
+    scatters — it uses the bounded central pull."""
+    partial_hits = []
+    from parseable_tpu.server import app as A
+
+    orig = A.internal_query_partial
+
+    async def counting(request):
+        partial_hits.append(request.path)
+        return await orig(request)
+
+    monkeypatch.setattr(A, "internal_query_partial", counting)
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path)
+        rows, stats = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: query_on(tmp_path, "q", "SELECT count(*) c FROM dist WHERE v >= 0"),
+        )
+        assert rows[0]["c"] == 20
+        assert partial_hits == []
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_peer_404_falls_back_with_identical_results(tmp_path, monkeypatch):
+    """A peer running an older build (no partial endpoint -> 404) is served
+    by central pull for exactly its slice; results match the all-central
+    answer."""
+    from parseable_tpu.server import app as A
+
+    real_partial = A.internal_query_partial
+
+    async def legacy_partial(request):
+        return A.web.json_response({"error": "no such route"}, status=404)
+
+    async def scenario():
+        # first peer is legacy: build its app with the 404 stub
+        monkeypatch.setattr(A, "internal_query_partial", legacy_partial)
+        states0, servers0 = await boot_ingestors(tmp_path, n=1, prefix="legacy")
+        monkeypatch.setattr(A, "internal_query_partial", real_partial)
+        states1, servers1 = await boot_ingestors(tmp_path, n=1)
+        # distinct host on the modern peer so the groups differ per node
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            url = f"http://127.0.0.1:{servers1[0].port}/api/v1/ingest"
+            async with http.post(
+                url,
+                json=[{"host": "node1", "v": float(j)} for j in range(10)],
+                headers={**AUTH, "X-P-Stream": "dist"},
+            ) as resp:
+                assert resp.status == 200
+
+        def both():
+            pushed, pstats = query_on(tmp_path, "qa")
+            central, _ = query_on(tmp_path, "qb", pushdown=False)
+            return pushed, pstats, central
+
+        pushed, pstats, central = await asyncio.get_running_loop().run_in_executor(
+            None, both
+        )
+        fan = pstats["stages"]["fanout"]
+        assert fan["fallback"] == 1 and fan["ok"] == 1
+        assert [r["result"] for r in fan["per_peer"].values()].count("http_404") == 1
+        assert fan["fanin_bytes"] > 0  # the legacy peer's staging was pulled
+        assert pushed == central
+        await teardown(states0 + states1, servers0 + servers1)
+
+    run(scenario())
+
+
+def test_hedged_slow_peer_no_duplicate_or_dropped_groups(tmp_path, monkeypatch):
+    """A peer that answers slowly gets a hedged duplicate request; exactly
+    one of the two answers merges (counts stay exact), the other is
+    discarded."""
+    from parseable_tpu.server import app as A
+
+    orig = A.internal_query_partial
+    calls = []
+
+    async def slow_once(request):
+        calls.append(time.monotonic())
+        if len(calls) == 1:
+            await asyncio.sleep(1.0)
+        return await orig(request)
+
+    monkeypatch.setattr(A, "internal_query_partial", slow_once)
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+
+        rows, stats = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: query_on(tmp_path, "q", fanout_hedge_ms=120)
+        )
+        # duplicate merges would double c/s; drops would lose the group
+        assert rows == [EXPECTED[0]]
+        fan = stats["stages"]["fanout"]
+        assert fan["hedged"] >= 1
+        assert fan["ok"] == 1 and fan["fallback"] == 0
+        assert len(calls) >= 2, "hedge request never fired"
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_erroring_and_dead_peers_fall_back_without_dupes_or_drops(tmp_path):
+    """Merge parity with an injected always-500 peer (reachable, failing
+    pushdown — its slice is recovered over central pull) and an injected
+    dead peer (nothing listens — skipped by liveness everywhere, exactly
+    like the central path)."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    import pyarrow.ipc as ipc
+    import io
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        p0 = states[0].p
+
+        # reachable fake peer: live, owns nothing, 500s pushdown, serves
+        # 5 staging rows over the raw data plane
+        fake_rows = pa.table({"host": ["fake"] * 5, "v": [2.0] * 5})
+
+        async def liveness(request):
+            return web.Response(status=200)
+
+        async def partial(request):
+            return web.json_response({"error": "boom"}, status=500)
+
+        async def staging(request):
+            sink = io.BytesIO()
+            with ipc.new_stream(sink, fake_rows.schema) as w:
+                w.write_table(fake_rows)
+            return web.Response(body=sink.getvalue())
+
+        fake_app = web.Application()
+        fake_app.router.add_get("/api/v1/liveness", liveness)
+        fake_app.router.add_post(
+            "/api/v1/internal/query/partial/{name}", partial
+        )
+        fake_app.router.add_get("/api/v1/internal/staging/{name}", staging)
+        fake_server = TestServer(fake_app)
+        await fake_server.start_server()
+        p0.metastore.put_node(
+            {
+                "node_id": "fakenode",
+                "node_type": "ingestor",
+                "domain_name": f"http://127.0.0.1:{fake_server.port}",
+                "owner_tag": "fakehost-no-such-prefix.",
+            }
+        )
+        # dead peer: registered but nothing listens
+        p0.metastore.put_node(
+            {
+                "node_id": "deadnode",
+                "node_type": "ingestor",
+                "domain_name": "http://127.0.0.1:1",
+                "owner_tag": "deadhost-no-such-prefix.",
+            }
+        )
+
+        def both():
+            pushed, pstats = query_on(tmp_path, "qa", fanout_timeout_ms=3000)
+            C._dead_nodes.clear()  # independent probe state for the A/B
+            central, _ = query_on(tmp_path, "qb", pushdown=False)
+            return pushed, pstats, central
+
+        pushed, pstats, central = await asyncio.get_running_loop().run_in_executor(
+            None, both
+        )
+        fan = pstats["stages"]["fanout"]
+        # real peer ok; fake peer retried once, then fell back
+        assert fan["ok"] == 1 and fan["fallback"] == 1 and fan["retries"] == 1
+        # the fake peer's 5 staging rows arrived via fallback, once
+        assert {"host": "fake", "c": 5, "s": 10.0, "a": 2.0, "mn": 2.0, "mx": 2.0} in pushed
+        assert pushed == central
+        await fake_server.close()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+# ------------------------------------------------------- bounded fan-in
+
+
+def test_internal_staging_bounds_and_projection(tmp_path):
+    """The staging endpoint filters to [start, end) and projects columns
+    (timestamp always included) before serializing."""
+    import aiohttp
+    import pyarrow.ipc as ipc
+    import io
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        base = f"http://127.0.0.1:{servers[0].port}/api/v1/internal/staging/dist"
+        async with aiohttp.ClientSession() as http:
+            # full window
+            async with http.get(base, headers=AUTH) as resp:
+                assert resp.status == 200
+                full = await resp.read()
+            with ipc.open_stream(io.BytesIO(full)) as r:
+                t = r.read_all()
+            assert t.num_rows == 10
+            # range excluding everything -> 204
+            async with http.get(
+                base,
+                params={"start": "2000-01-01T00:00:00Z", "end": "2000-01-02T00:00:00Z"},
+                headers=AUTH,
+            ) as resp:
+                assert resp.status == 204
+            # projection: host only (+ timestamp rides along), fewer bytes
+            async with http.get(base, params={"fields": "host"}, headers=AUTH) as resp:
+                assert resp.status == 200
+                narrow = await resp.read()
+            with ipc.open_stream(io.BytesIO(narrow)) as r:
+                tn = r.read_all()
+            assert set(tn.column_names) == {"host", "p_timestamp"}
+            assert tn.num_rows == 10
+            assert len(narrow) < len(full)
+            # malformed bound -> 400, not a stack trace
+            async with http.get(base, params={"start": "not-a-time"}, headers=AUTH) as resp:
+                assert resp.status == 400
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_fetch_staging_batches_passes_bounds_and_stats(tmp_path):
+    from parseable_tpu.query.planner import TimeBounds
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+
+        def fetch():
+            stats: dict = {}
+            batches = C.fetch_staging_batches(
+                q, "dist", time_bounds=TimeBounds(), columns={"host"}, stats=stats
+            )
+            return batches, stats
+
+        batches, stats = await asyncio.get_running_loop().run_in_executor(None, fetch)
+        assert sum(b.num_rows for b in batches) == 10
+        assert set(batches[0].schema.names) == {"host", "p_timestamp"}
+        assert stats["bytes"] > 0 and "errors" not in stats
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_fanin_error_counted(tmp_path):
+    from parseable_tpu.utils.metrics import REGISTRY
+
+    p = make_parseable(tmp_path, "q", Mode.QUERY)
+    domain = "http://127.0.0.1:1"
+
+    def sample():
+        return (
+            REGISTRY.get_sample_value(
+                "parseable_cluster_fanin_errors_total", {"peer": domain}
+            )
+            or 0.0
+        )
+
+    before = sample()
+    stats: dict = {}
+    out = C._fetch_one(p, domain, "nope", stats=stats)
+    assert out == []
+    assert sample() == before + 1
+    assert stats["errors"] == 1
+
+
+# ------------------------------------------------------ partial merge math
+
+
+def test_combine_partials_matches_single_merge():
+    """Distributed shape (blocks -> per-node combine -> cross-node merge)
+    equals the single-node shape (all blocks -> one merge) exactly."""
+    import numpy as np
+
+    from parseable_tpu.query import partials as PT
+    from parseable_tpu.query.executor import QueryExecutor
+    from parseable_tpu.query.planner import plan as build_plan
+    from parseable_tpu.query.sql import parse_sql
+
+    rng = np.random.default_rng(5)
+    blocks = []
+    for _ in range(6):
+        n = 500
+        blocks.append(
+            pa.table(
+                {
+                    "k": pa.array([f"g{int(i) % 7}" for i in rng.integers(0, 1 << 20, n)]),
+                    "x": pa.array(rng.random(n) * 100),
+                }
+            )
+        )
+    lp = build_plan(
+        parse_sql(
+            "SELECT k, count(*) c, sum(x) s, avg(x) a, stddev(x) sd, "
+            "min(x) mn, max(x) mx FROM t GROUP BY k"
+        )
+    )
+    ex = QueryExecutor(lp)
+    agg, rewritten, _ = ex.build_aggregator()
+    group_exprs = lp.select.group_by
+    parts = [PT.partial_from_block(b, group_exprs, agg.specs) for b in blocks]
+
+    single = ex.finalize_from_interim(
+        PT.merge_partials(list(parts), agg.specs, 1), rewritten
+    )
+    # distributed: nodes hold blocks [0:2], [2:5], [5:6]
+    node_partials = [
+        PT.combine_partials(parts[lo:hi], agg.specs, 1)
+        for lo, hi in ((0, 2), (2, 5), (5, 6))
+    ]
+    dist = ex.finalize_from_interim(
+        PT.merge_partials(node_partials, agg.specs, 1), rewritten
+    )
+
+    key = lambda r: r["k"]
+    srows, drows = sorted(single.to_pylist(), key=key), sorted(dist.to_pylist(), key=key)
+    assert len(srows) == len(drows) == 7
+    for sr, dr in zip(srows, drows):
+        assert sr["k"] == dr["k"] and sr["c"] == dr["c"]
+        for col in ("s", "a", "sd", "mn", "mx"):
+            assert sr[col] == pytest.approx(dr[col], rel=1e-9)
+
+
+# -------------------------------------------------- satellites: cluster
+
+
+def test_parse_prometheus_skips_nonfinite_and_malformed():
+    text = "\n".join(
+        [
+            "# HELP x y",
+            "good_total 5",
+            'good_total{stream="a"} 7',
+            "bad_nan NaN",
+            "bad_inf +Inf",
+            "bad_neginf -Inf",
+            "malformed_line_without_value",
+            "trailing_garbage 1 2 3",
+            " 9",
+        ]
+    )
+    totals = C.parse_prometheus(text)
+    assert totals == {"good_total": 12.0}
+
+
+def test_parse_prometheus_dated_label_escaping():
+    text = "\n".join(
+        [
+            'billing{path="a,b",date="2024-01-02"} 3',
+            'billing{date="2024-01-02",note="quo\\"te"} 4',
+            'billing{date="2024-01-03"} 2',
+            'billing{date="2024-01-03"} NaN',
+            'other{stream="s"} 9',
+        ]
+    )
+    dated = C.parse_prometheus_dated(text)
+    assert dated == {
+        ("billing", "2024-01-02"): 7.0,
+        ("billing", "2024-01-03"): 2.0,
+    }
+
+
+def test_get_available_querier_probes_with_context(tmp_path, monkeypatch):
+    """The liveness probe must carry `p` (TLS context + credentials) — it
+    used to probe unconfigured."""
+    p = make_parseable(tmp_path, "ing", Mode.INGEST)
+    p.metastore.put_node(
+        {"node_id": "q1", "node_type": "querier", "domain_name": "http://q1"}
+    )
+    seen = []
+
+    def fake_liveness(domain, ctx=None):
+        seen.append(ctx)
+        return True
+
+    monkeypatch.setattr(C, "check_liveness", fake_liveness)
+    assert C.get_available_querier(p)["node_id"] == "q1"
+    assert seen == [p]
+
+
+def test_round_robin_skips_dead_then_resumes(tmp_path, monkeypatch):
+    p = make_parseable(tmp_path, "ing", Mode.INGEST)
+    for i in range(3):
+        p.metastore.put_node(
+            {"node_id": f"q{i}", "node_type": "querier", "domain_name": f"http://q{i}"}
+        )
+    order = [n["node_id"] for n in p.metastore.list_nodes("querier")]
+    dead = {f"http://{order[1]}"}
+    monkeypatch.setattr(
+        C, "check_liveness", lambda domain, ctx=None: domain not in dead
+    )
+    picks = [C.get_available_querier(p)["node_id"] for _ in range(4)]
+    live = [order[0], order[2]]
+    assert picks == [live[0], live[1], live[0], live[1]]
+    # the dead node recovers: rotation includes it again
+    dead.clear()
+    picks = [C.get_available_querier(p)["node_id"] for _ in range(3)]
+    assert set(picks) == set(order)
+
+
+def test_cluster_pool_lifecycle():
+    pool = C.get_cluster_pool()
+    assert pool is C.get_cluster_pool()
+    assert pool.submit(lambda: 41 + 1).result() == 42
+    C.shutdown_cluster_pool()
+    fresh = C.get_cluster_pool()
+    assert fresh is not pool
+    assert fresh.submit(lambda: "ok").result() == "ok"
+    C.shutdown_cluster_pool()
