@@ -1,0 +1,42 @@
+"""psan — parseable_tpu's runtime concurrency sanitizer.
+
+The dynamic sibling of plint: where `parseable_tpu.analysis` proves the
+annotated concurrency contracts statically (lexically/interprocedurally,
+necessarily conservative), psan enforces the *same* contracts under the
+real interleavings of a live run — Eraser-style lockset race detection
+over `# guarded-by:` attributes, lockdep-style runtime lock-order
+enforcement against the declared `# lock-order:` hierarchy with a
+deadlock watchdog, an event-loop blocking monitor, and per-test
+thread/executor leak accounting.
+
+Activate with `P_PSAN=1` on a pytest run (tests/conftest.py registers the
+plugin) or programmatically:
+
+    from parseable_tpu.analysis.psan import contracts, runtime
+    rt = runtime.get_runtime()
+    rt.enable(root=repo_root, extra_prefixes=("my_fixture_module",))
+    cs = contracts.build_contracts(repo_root, ["my_fixture_module.py"])
+    contracts.instrument(rt, cs)
+    ...  # run the workload
+    findings = rt.findings()
+    rt.disable()
+
+Findings share plint's fingerprints, `# plint: disable=` suppressions,
+and baseline policy (`.psan-baseline.json`, kept empty). See the README
+"Dynamic analysis (psan)" section for the detector catalog and knobs.
+"""
+
+from parseable_tpu.analysis.psan.contracts import ContractSet, build_contracts, instrument
+from parseable_tpu.analysis.psan.report import assemble_report, render_lines, write_report
+from parseable_tpu.analysis.psan.runtime import PsanRuntime, get_runtime
+
+__all__ = [
+    "ContractSet",
+    "PsanRuntime",
+    "assemble_report",
+    "build_contracts",
+    "get_runtime",
+    "instrument",
+    "render_lines",
+    "write_report",
+]

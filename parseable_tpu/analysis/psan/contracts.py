@@ -1,0 +1,197 @@
+"""psan contracts: one annotation source shared with plint.
+
+The static checker (analysis/rules.py) and the sanitizer enforce the same
+comments:
+
+- ``# guarded-by: <expr>`` on an attribute assignment declares which lock
+  protects it. plint checks the lexical `with` discipline; psan installs a
+  runtime access hook (`runtime._GuardedAttr`) on the class and applies
+  the Eraser lockset algorithm to real interleavings.
+- ``# lock-id: Name [reentrant]`` on a lock *creation* line names that
+  site's locks in the runtime lock-order graph (plint reads the same tag
+  on `with` lines for its static graph). Unannotated `self.<attr> =
+  threading.Lock()` sites auto-name as ``Class.attr`` and module-level
+  ones as ``module.name`` — the same scheme plint's callgraph uses — so
+  declared hierarchies match runtime observations without duplication.
+- ``# lock-order: A < B`` comments declare the hierarchy both checkers
+  verify: plint on the static acquisition graph, psan on the acquisitions
+  that actually happen.
+
+`build_contracts()` parses these from source with plint's `SourceFile`
+(same tokenizer comment map, same suppression syntax); `instrument()`
+imports the contract modules and installs the runtime hooks.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import (
+    SourceFile,
+    attr_chain,
+    is_self_attr,
+    iter_python_files,
+)
+
+import ast
+
+logger = logging.getLogger(__name__)
+
+# superset of plint's _GUARDED_BY_RE: capture the full dotted guard
+# expression (e.g. `self._lock`, `self._cond`, `sched._cond`)
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_LOCK_ID_RE = re.compile(r"lock-id:\s*([A-Za-z_][A-Za-z0-9_.]*)(\s+reentrant)?")
+_LOCK_ORDER_RE = re.compile(
+    r"lock-order:\s*([A-Za-z_][A-Za-z0-9_.]*)\s*<\s*([A-Za-z_][A-Za-z0-9_.]*)"
+)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_REENTRANT_CTORS = {"RLock", "Condition"}
+
+
+@dataclass
+class ContractSet:
+    """Everything the runtime needs, extracted from annotations."""
+
+    root: Path
+    # (dotted module, class name) -> {attr: (guard expr, decl line)}
+    guarded: dict[tuple[str, str], dict[str, tuple[str, int]]] = field(
+        default_factory=dict
+    )
+    # (absolute file path, line) -> (lock name, reentrant)
+    lock_sites: dict[tuple[str, int], tuple[str, bool]] = field(default_factory=dict)
+    # (before, after) -> (rel, line) of the declaration
+    declared_order: dict[tuple[str, str], tuple[str, int]] = field(
+        default_factory=dict
+    )
+    files: int = 0
+    parse_errors: list[str] = field(default_factory=list)
+
+
+def _dotted(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def _scan_file(cs: ContractSet, sf: SourceFile) -> None:
+    modtail = _dotted(sf.rel).rsplit(".", 1)[-1]
+    abspath = str((cs.root / sf.rel).resolve())
+
+    def note_lock_site(node: ast.Assign | ast.expr, default_name: str, ctor: str):
+        line = node.lineno
+        comment = sf.comments.get(line, "")
+        m = _LOCK_ID_RE.search(comment)
+        if m:
+            name, reentrant = m.group(1), bool(m.group(2))
+        else:
+            name, reentrant = default_name, ctor in _REENTRANT_CTORS
+        cs.lock_sites[(abspath, line)] = (name, reentrant)
+
+    def lock_ctor(value: ast.expr) -> str | None:
+        if isinstance(value, ast.Call):
+            chain = attr_chain(value.func)
+            if chain and chain[-1] in _LOCK_CTORS:
+                return chain[-1]
+        return None
+
+    for node in sf.tree.body:
+        # module-level `NAME = threading.Lock()` globals
+        if isinstance(node, ast.Assign):
+            ctor = lock_ctor(node.value)
+            if ctor:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        note_lock_site(node, f"{modtail}.{t.id}", ctor)
+
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        dotted = _dotted(sf.rel)
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            ctor = lock_ctor(value) if value is not None else None
+            if ctor:
+                for t in targets:
+                    if is_self_attr(t):
+                        note_lock_site(node, f"{cls.name}.{t.attr}", ctor)
+                    elif not isinstance(t, ast.Name):
+                        # dynamic holders (dicts of locks): name only via an
+                        # explicit creation-line `# lock-id:` tag
+                        comment = sf.comments.get(node.lineno, "")
+                        if _LOCK_ID_RE.search(comment):
+                            note_lock_site(node, f"{modtail}:{node.lineno}", ctor)
+            comment = sf.comments.get(node.lineno, "")
+            m = _GUARDED_BY_RE.search(comment)
+            if not m:
+                continue
+            for t in targets:
+                if is_self_attr(t):
+                    cs.guarded.setdefault((dotted, cls.name), {})[t.attr] = (
+                        m.group(1),
+                        node.lineno,
+                    )
+
+    # bare function-level lock creations with an explicit lock-id tag
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] in _LOCK_CTORS:
+                key = (abspath, node.lineno)
+                if key not in cs.lock_sites:
+                    comment = sf.comments.get(node.lineno, "")
+                    if _LOCK_ID_RE.search(comment):
+                        note_lock_site(node, f"{modtail}:{node.lineno}", chain[-1])
+
+    for line, comment in sf.comments.items():
+        m = _LOCK_ORDER_RE.search(comment)
+        if m:
+            cs.declared_order.setdefault(
+                (m.group(1), m.group(2)), (sf.rel, line)
+            )
+
+
+def build_contracts(root: Path, paths: list[str] | None = None) -> ContractSet:
+    """Parse the annotation contracts out of `paths` under `root`."""
+    root = Path(root).resolve()
+    cs = ContractSet(root=root)
+    for p in iter_python_files(root, paths or ["parseable_tpu"]):
+        try:
+            sf = SourceFile.from_path(root, p)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            cs.parse_errors.append(f"{p}: {e}")
+            continue
+        cs.files += 1
+        _scan_file(cs, sf)
+    return cs
+
+
+def instrument(runtime, contracts: ContractSet) -> int:
+    """Feed lock names/hierarchy into the runtime and install the guarded-
+    attribute hooks (importing each contract module). Returns the number
+    of instrumented attributes."""
+    runtime.lock_sites.update(contracts.lock_sites)
+    runtime.declared_order.update(contracts.declared_order)
+    installed = 0
+    for (dotted, clsname), attrs in sorted(contracts.guarded.items()):
+        try:
+            mod = importlib.import_module(dotted)
+        except Exception as e:  # optional deps may be absent in this env
+            logger.debug("psan: cannot import contract module %s: %s", dotted, e)
+            continue
+        cls = getattr(mod, clsname, None)
+        if not isinstance(cls, type):
+            logger.debug("psan: %s.%s is not a class; skipped", dotted, clsname)
+            continue
+        decl_path = str(
+            (contracts.root / (dotted.replace(".", "/") + ".py")).resolve()
+        )
+        for attr, (guard, line) in attrs.items():
+            runtime.install_guard(cls, attr, guard, decl_path, line)
+            installed += 1
+    return installed

@@ -1,0 +1,94 @@
+"""psan pytest plugin: every tier-1 run becomes a race/deadlock/leak hunt.
+
+Registered by tests/conftest.py when `P_PSAN=1`:
+
+- `pytest_configure` (historic hook, so late registration still fires it)
+  enables the runtime patches *before collection imports any
+  parseable_tpu module*, parses the annotation contracts, and installs
+  the guarded-attribute hooks.
+- each test runs inside a thread/executor snapshot; anything watched that
+  survives teardown plus the grace join is a psan-thread-leak.
+- `pytest_sessionfinish` assembles the plint-shaped report, writes the
+  gate artifact (`P_PSAN_JSON`, default /tmp/psan.json), and turns a
+  green exit red when unbaselined findings exist — the same contract as
+  the plint gate in scripts/check_green.sh.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import pytest
+
+import parseable_tpu
+from parseable_tpu.analysis.psan.runtime import get_runtime
+
+
+def _repo_root() -> Path:
+    return Path(parseable_tpu.__file__).resolve().parent.parent
+
+
+class PsanPytestPlugin:
+    def __init__(self):
+        self.rt = get_runtime()
+        self.root = _repo_root()
+        self.report: dict | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def pytest_configure(self, config):
+        from parseable_tpu.analysis.psan import contracts as _contracts
+
+        self.rt.enable(root=str(self.root))
+        cs = _contracts.build_contracts(self.root)
+        installed = _contracts.instrument(self.rt, cs)
+        config._psan_installed = installed  # introspectable in -q output
+
+    # ------------------------------------------------------------- per test
+
+    @pytest.hookimpl(hookwrapper=True)
+    def pytest_runtest_protocol(self, item, nextitem):
+        rt = self.rt
+        rt.test_context = item.nodeid
+        pre_threads = rt.thread_snapshot()
+        pre_executors = rt.executor_snapshot()
+        yield
+        try:
+            rt.check_leaks(pre_threads, pre_executors)
+        finally:
+            rt.test_context = ""
+
+    # ------------------------------------------------------------- wrap-up
+
+    def pytest_sessionfinish(self, session, exitstatus):
+        from parseable_tpu.analysis.psan import report as _report
+        from parseable_tpu.config import psan_options
+
+        rt = self.rt
+        # the gate judges THIS repository: findings in files outside the
+        # repo root (absolute paths — e.g. tmp-dir fixture modules from the
+        # sanitizer's own seeded-bug tests) are excluded from the verdict
+        in_repo = [f for f in rt.findings() if not os.path.isabs(f.path)]
+        self.report = _report.assemble_report(in_repo, rt.stats(), self.root)
+        out = psan_options()["json_path"] or "/tmp/psan.json"
+        try:
+            _report.write_report(self.report, out)
+        except OSError as e:  # pragma: no cover - artifact is best-effort
+            print(f"psan: cannot write report to {out}: {e}")
+        if not self.report["clean"] and session.exitstatus == 0:
+            session.exitstatus = 1
+
+    def pytest_terminal_summary(self, terminalreporter):
+        if self.report is None:
+            return
+        from parseable_tpu.analysis.psan import report as _report
+
+        terminalreporter.section("psan (runtime concurrency sanitizer)")
+        for line in _report.render_lines(self.report):
+            terminalreporter.write_line(line)
+        if not self.report["clean"]:
+            terminalreporter.write_line(
+                "psan: RED — fix the findings (or suppress a justified site "
+                "with `# plint: disable=<rule>`); the baseline stays empty."
+            )

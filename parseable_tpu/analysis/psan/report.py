@@ -1,0 +1,66 @@
+"""psan reporting: baseline gate + JSON artifact, plint-shaped.
+
+Findings carry plint `Finding` fingerprints, so the baseline file
+(`.psan-baseline.json`, same schema as `.plint-baseline.json`) and the
+JSON artifact (`/tmp/psan.json` by default, `P_PSAN_JSON` to move it) are
+diffable with the same tooling. Policy matches plint: the baseline stays
+EMPTY — a finding is either fixed or explicitly `# plint: disable=`-
+suppressed at the site with a justification, never parked.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import Finding, load_baseline
+
+DEFAULT_BASELINE = ".psan-baseline.json"
+
+
+def assemble_report(
+    findings: list[Finding],
+    stats: dict,
+    root: Path,
+    baseline: str = DEFAULT_BASELINE,
+) -> dict:
+    baseline_fps = load_baseline(Path(root) / baseline)
+    baselined = [
+        f
+        for f in findings
+        if f.fingerprint in baseline_fps or f.legacy_fingerprint in baseline_fps
+    ]
+    unbaselined = [
+        f
+        for f in findings
+        if f.fingerprint not in baseline_fps
+        and f.legacy_fingerprint not in baseline_fps
+    ]
+    return {
+        "tool": "psan",
+        "stats": stats,
+        "baselined": [f.to_json() for f in baselined],
+        "findings": [f.to_json() for f in unbaselined],
+        "clean": not unbaselined,
+    }
+
+
+def write_report(report: dict, path: str) -> None:
+    Path(path).write_text(json.dumps(report, indent=2) + "\n", encoding="utf-8")
+
+
+def render_lines(report: dict) -> list[str]:
+    lines = []
+    for f in report["findings"]:
+        ctx = f" [{f['context']}]" if f.get("context") else ""
+        lines.append(f"{f['path']}:{f['line']}: {f['rule']}{ctx}: {f['message']}")
+    stats = report.get("stats", {})
+    hits = stats.get("raw_hits", {})
+    n_base = len(report.get("baselined", []))
+    base_note = f" ({n_base} baselined)" if n_base else ""
+    lines.append(
+        f"psan: {len(report['findings'])} finding(s){base_note}; raw detector "
+        f"hits {hits or '{}'}, {stats.get('suppressed', 0)} suppressed, "
+        f"{stats.get('lock_order_edges', 0)} lock-order edges observed"
+    )
+    return lines

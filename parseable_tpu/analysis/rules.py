@@ -209,15 +209,25 @@ class LockDisciplineRule(Rule):
 
 
 class PoolLifecycleRule(Rule):
-    """Executors/threads stored on `self` need a reachable shutdown/join.
+    """Executors/threads stored on `self` need a reachable shutdown/join —
+    and bare `threading.Thread` spawns need one too.
 
-    Accepts a direct `self.<attr>.shutdown()`/`.join()` anywhere in the
-    class, or the unload-then-join idiom (`w, self._t = self._t, None` +
-    `w.join()`). Context-managed pools and fire-and-forget locals are out
-    of scope — only state that outlives the creating call is checked."""
+    Class attributes: accepts a direct `self.<attr>.shutdown()`/`.join()`
+    anywhere in the class, or the unload-then-join idiom
+    (`w, self._t = self._t, None` + `w.join()`). Context-managed pools are
+    out of scope — only state that outlives the creating call is checked.
+
+    Bare spawns (the psan-thread-leak detector's static sibling): a
+    `threading.Thread(...).start()` whose object is never bound is always
+    fire-and-forget — flagged. A thread bound to a plain name (local or
+    module global) must show a reachable stop path in its scope: a
+    `.join()` on the name (or an alias of it), storing it on `self`/into a
+    container, returning it, or handing it to another call all count as
+    transferring custody. `x = threading.Thread(...)` with none of those
+    is a thread nothing can ever stop."""
 
     name = "pool-lifecycle"
-    description = "executor/thread attribute with no shutdown/join path"
+    description = "executor/thread with no reachable shutdown/join path"
     rationale = (
         "a pool without a shutdown path leaks threads on every restart and "
         "turns clean process exit into a hang or lost writes"
@@ -230,6 +240,7 @@ class PoolLifecycleRule(Rule):
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.ClassDef):
                 yield from self._check_class(sf, node)
+        yield from self._check_bare_spawns(sf)
 
     def _is_ctor(self, value: ast.expr) -> bool:
         if not isinstance(value, ast.Call):
@@ -290,6 +301,134 @@ class PoolLifecycleRule(Rule):
                 for t, v in zip(target.elts, node.value.elts):
                     if isinstance(t, ast.Name) and is_self_attr(v):
                         aliases[t.id] = v.attr
+
+    # ------------------------------------------------- bare Thread spawns
+
+    def _is_thread_ctor(self, value: ast.expr) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        chain = attr_chain(value.func)
+        return bool(chain) and chain[-1] == "Thread"
+
+    def _check_bare_spawns(self, sf: SourceFile) -> Iterator[Finding]:
+        # pass 1: fire-and-forget `threading.Thread(...).start()` chains
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "start"
+                and self._is_thread_ctor(node.func.value)
+            ):
+                yield Finding(
+                    rule=self.name,
+                    path=sf.rel,
+                    line=node.lineno,
+                    context=enclosing_context(sf.tree, node),
+                    message=(
+                        "fire-and-forget threading.Thread(...).start(): the "
+                        "thread object is unreachable, so nothing can ever "
+                        "join or stop it — bind it and register a stop path"
+                    ),
+                )
+        # pass 2: threads bound to plain names with no custody transfer
+        scopes: list[ast.AST] = [sf.tree]
+        scopes += [
+            n
+            for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            yield from self._check_scope_spawns(sf, scope)
+
+    @staticmethod
+    def _own_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+        """Walk `scope` without descending into nested function bodies
+        (each function is its own scope in the scan)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _check_scope_spawns(self, sf: SourceFile, scope: ast.AST) -> Iterator[Finding]:
+        spawned: dict[str, int] = {}  # name -> ctor line
+        for node in self._own_nodes(scope):
+            if isinstance(node, ast.Assign) and self._is_thread_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        spawned.setdefault(t.id, node.lineno)
+        if not spawned:
+            return
+        # a name declared `global` is stopped (or not) at module scope
+        module_scoped = {
+            n
+            for node in ast.walk(scope)
+            if isinstance(node, ast.Global)
+            for n in node.names
+        }
+        for name, line in sorted(spawned.items(), key=lambda kv: kv[1]):
+            search: list[ast.AST] = [scope]
+            if name in module_scoped and scope is not sf.tree:
+                search = [sf.tree] + [
+                    n
+                    for n in ast.walk(sf.tree)
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ]
+            if any(self._custody_ok(s, name) for s in search):
+                continue
+            yield Finding(
+                rule=self.name,
+                path=sf.rel,
+                line=line,
+                context=enclosing_context(sf.tree, scope)
+                if scope is not sf.tree
+                else "",
+                message=(
+                    f"thread bound to {name!r} has no reachable join/stop in "
+                    "its scope and its custody is never transferred — join "
+                    "it, store it somewhere with a stop path, or use a "
+                    "managed pool"
+                ),
+            )
+
+    def _custody_ok(self, scope: ast.AST, name: str) -> bool:
+        aliases = {name}
+        for node in self._own_nodes(scope):
+            if isinstance(node, ast.Assign):
+                # alias chains: `t = _WARM_THREAD` / `q, t = _Q, _WARM_THREAD`
+                pairs = []
+                for target in node.targets:
+                    if isinstance(target, ast.Tuple) and isinstance(
+                        node.value, ast.Tuple
+                    ) and len(target.elts) == len(node.value.elts):
+                        pairs += list(zip(target.elts, node.value.elts))
+                    else:
+                        pairs.append((target, node.value))
+                for t, v in pairs:
+                    if isinstance(v, ast.Name) and v.id in aliases:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+                        else:
+                            return True  # stored on self / into a container
+        for node in self._own_nodes(scope):
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._CLEANUP_ATTRS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in aliases
+                ):
+                    return True
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in aliases:
+                        return True  # handed to another call (append, register)
+            elif isinstance(node, (ast.Return, ast.Yield)) and isinstance(
+                node.value, ast.Name
+            ):
+                if node.value.id in aliases:
+                    return True
+        return False
 
 
 # ---------------------------------------------------------------------------

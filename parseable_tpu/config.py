@@ -130,6 +130,29 @@ def env_bool(name: str, default: bool) -> bool:
     return _env_bool(name, default)
 
 
+def psan_options() -> dict:
+    """Knobs for the runtime concurrency sanitizer (analysis/psan).
+
+    Declared here — not inside analysis/ (which the config-drift rule
+    skips as the analyzer's own source) — so every P_PSAN* knob is
+    README-enforced like any other. P_PSAN itself is read by
+    tests/conftest.py before this package imports; it is listed here for
+    the same documentation guarantee."""
+    return {
+        "enabled": _env_bool("P_PSAN", False),
+        "watchdog_s": _env_float("P_PSAN_WATCHDOG_S", 20.0),
+        "loop_ms": _env_float("P_PSAN_LOOP_MS", 50.0),
+        "leak_grace_ms": _env_float("P_PSAN_LEAK_GRACE_MS", 500.0),
+        "max_findings": _env_int("P_PSAN_MAX_FINDINGS", 200),
+        "allow": tuple(
+            s.strip()
+            for s in (_env("P_PSAN_ALLOW", "") or "").split(",")
+            if s.strip()
+        ),
+        "json_path": _env("P_PSAN_JSON", "/tmp/psan.json"),
+    }
+
+
 @dataclass
 class Options:
     """All server options. Defaults mirror the reference (src/cli.rs:135-641)."""

@@ -585,8 +585,13 @@ class Parseable:
         a second flush+upload drains anything produced during the first
         (sync_all_streams drains the enrichment queue before returning).
         Then every write-path pool is stopped deterministically — no leaked
-        threads, no half-committed snapshots.
+        threads, no half-committed snapshots. Idempotent: a second call
+        (two ServerStates sharing one instance, test teardown after an
+        explicit stop) must not submit to already-shut pools.
         """
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         for _ in range(2):
             self.local_sync(shutdown=True)
             self.sync_all_streams()

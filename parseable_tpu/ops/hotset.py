@@ -166,8 +166,28 @@ class DeviceHotSet:
                 return None
             self.hits += 1
             QUERY_CACHE_HIT.labels("device_hotset").inc()
-            if not touch:
-                return slot.entry
+            entry = slot.entry
+        if touch:
+            self.touch(key)
+        return entry
+
+    def touch(self, key: tuple) -> None:
+        """Apply the reuse accounting of a hit: bump recency + frequency,
+        and promote a probationary entry with proven reuse into protected.
+
+        Standalone (not fused into `get`) on purpose: the prefetch consumer
+        always fetches with `touch=False` and decides AFTERWARDS whether
+        the hit was proven reuse (it asks the prefetcher via `consumed()`,
+        which answers atomically under its condvar). The old shape — peek
+        first, then `get(touch=not prefetched)` — had a window where a ship
+        completing between the two calls promoted a planned consumption
+        into the protected segment (psan seed: the hotset/prefetch claim()
+        interleaving). An entry evicted between a get and its touch is a
+        silent no-op."""
+        with self._lock:
+            slot = self._entries.get(key)
+            if slot is None:
+                return
             self._entries.move_to_end(key)
             slot.freq += 1
             slot.pri = self._priority(slot, self._clock)
@@ -190,7 +210,6 @@ class DeviceHotSet:
                             self._protected_bytes -= weakest.entry.nbytes
                             slot.probation = False
                             self._protected_bytes += nb
-            return slot.entry
 
     # ------------------------------------------------------------------- put
 

@@ -248,6 +248,7 @@ _WARM_QUEUE = None
 _WARM_THREAD: threading.Thread | None = None
 _WARM_PENDING: set = set()
 _WARM_LOCK = threading.Lock()
+_WARM_STOP = object()  # sentinel: drains the warmer loop deterministically
 
 
 def warm_async(key: tuple, fn) -> bool:
@@ -264,9 +265,14 @@ def warm_async(key: tuple, fn) -> bool:
         if _WARM_QUEUE is None:
             _WARM_QUEUE = _q.Queue(maxsize=64)
 
-            def loop():
+            def loop(q):
+                # the queue rides in as an argument (enccache-writer idiom):
+                # shutdown_warmer nulls the global, so the loop must keep
+                # draining ITS queue until the stop sentinel arrives
                 while True:
-                    k, f = _WARM_QUEUE.get()
+                    k, f = q.get()
+                    if k is _WARM_STOP:
+                        return
                     try:
                         f()
                     except Exception:
@@ -276,7 +282,7 @@ def warm_async(key: tuple, fn) -> bool:
                             _WARM_PENDING.discard(k)
 
             _WARM_THREAD = threading.Thread(
-                target=loop, name="device-warmer", daemon=True
+                target=loop, args=(_WARM_QUEUE,), name="device-warmer", daemon=True
             )
             _WARM_THREAD.start()
         try:
@@ -285,3 +291,24 @@ def warm_async(key: tuple, fn) -> bool:
             return False
         _WARM_PENDING.add(key)
         return True
+
+
+def shutdown_warmer(timeout: float = 10.0) -> None:
+    """Stop and join the device-warmer thread (pool-lifecycle: every thread
+    this module starts has a deterministic stop). Queued warms already
+    accepted still run before the sentinel; a fresh warm_async afterwards
+    starts a new warmer. Idempotent."""
+    global _WARM_QUEUE, _WARM_THREAD
+    with _WARM_LOCK:
+        q, t = _WARM_QUEUE, _WARM_THREAD
+        _WARM_QUEUE = None
+        _WARM_THREAD = None
+        _WARM_PENDING.clear()
+    if q is not None:
+        try:
+            q.put((_WARM_STOP, None), timeout=timeout)
+        except Exception:  # queue wedged full: the daemon flag is the backstop
+            logger.warning("device-warmer queue full at shutdown; not drained")
+            return
+    if t is not None:
+        t.join(timeout)

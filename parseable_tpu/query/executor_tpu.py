@@ -1382,18 +1382,20 @@ class TpuQueryExecutor(QueryExecutor):
             pf = self._prefetcher
             if pf is not None:
                 pf.on_block(source)
-            # a prefetched block's one planned consumption is not proven
-            # reuse: serve it untouched so it can't promote into protected
-            prefetched = pf is not None and pf.peek(key)
-            entry = hotset.get(key, touch=not prefetched)
+            # fetch untouched, then let the PREFETCHER decide (atomically,
+            # under its condvar) whether this hit was its own ship's one
+            # planned consumption — only a non-prefetch hit is proven reuse
+            # and touches. The old peek-then-get(touch=...) pair had a race:
+            # a ship completing between the two calls promoted prefetch
+            # cargo into the protected segment (psan seed candidate).
+            entry = hotset.get(key, touch=False)
             if entry is None and pf is not None and pf.claim(source):
                 # the prefetcher was mid-ship on exactly this block: it
                 # finished — re-check instead of shipping a second copy
-                prefetched = pf.peek(key)
-                entry = hotset.get(key, touch=not prefetched)
+                entry = hotset.get(key, touch=False)
             if entry is not None:
-                if pf is not None:
-                    pf.consumed(key)
+                if pf is None or not pf.consumed(key):
+                    hotset.touch(key)
                 self.route_stats["device_warm"] += 1
                 return entry.meta, entry.dev
             from parseable_tpu.ops.enccache import get_enccache

@@ -252,17 +252,36 @@ class RbacStore:
             return s.username
 
     # ----- auth -------------------------------------------------------------
-    def authenticate(self, username: str, password: str) -> User | None:
+    def try_cached_authenticate(self, username: str, password: str):
+        """Fast-path verdict from the verified-credential cache.
+
+        Returns `(user_or_None, True)` when the cache can answer (sha256 +
+        constant-time compare, microseconds), or `(None, False)` when the
+        slow scrypt verification is required. Split out so the HTTP auth
+        middleware can keep cache hits on the event loop but push scrypt
+        (~10^2 ms by design — and EVERY wrong-password attempt takes this
+        path, since failures never populate the cache) to a worker."""
         with self._lock:
             u = self.users.get(username)
             cached = self._cred_cache.get(username)
         if u is None or u.password_hash is None:
-            return None
+            return None, True
         fast = hashlib.sha256(f"{username}\x00{password}".encode()).digest()
         if cached is not None:
-            return u if hmac.compare_digest(cached, fast) else None
+            return (u if hmac.compare_digest(cached, fast) else None), True
+        return None, False
+
+    def authenticate(self, username: str, password: str) -> User | None:
+        user, decided = self.try_cached_authenticate(username, password)
+        if decided:
+            return user
+        with self._lock:
+            u = self.users.get(username)
+        if u is None or u.password_hash is None:
+            return None
         if not verify_password(password, u.password_hash):
             return None
+        fast = hashlib.sha256(f"{username}\x00{password}".encode()).digest()
         with self._lock:
             self._cred_cache[username] = fast
         return u

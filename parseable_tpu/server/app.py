@@ -178,6 +178,9 @@ class ServerState:
                         logger.exception("%s tick failed", name)
                     finally:
                         done.set()
+                        # the watchdog wakes immediately on set(); join so a
+                        # tick can never strand its watchdog thread
+                        w.join(timeout=5)
 
             t = threading.Thread(target=run, name=name, daemon=True)
             t.start()
@@ -234,6 +237,8 @@ class ServerState:
             loop(3600, lambda: analytics_tick(self), "analytics")
 
     def stop(self) -> None:
+        if self.shutting_down:
+            return  # idempotent: tests and signal paths may both stop
         self.shutting_down = True
         self._sync_stop.set()
         self.resources.stop()
@@ -242,6 +247,9 @@ class ServerState:
         # further spans should buffer against a stopping instance)
         telemetry.SPAN_SINK.flush()
         telemetry.SPAN_SINK.detach()
+        # join the (at most one) in-flight OTLP export and push leftovers —
+        # an unjoined exporter at exit strands the final spans mid-POST
+        telemetry.TRACER.drain()
         if self.p.options.profile_mode == "cpu":
             from parseable_tpu.utils.profiler import get_profiler
 
@@ -261,8 +269,18 @@ class ServerState:
         from parseable_tpu.server.cluster import shutdown_cluster_pool
 
         shutdown_cluster_pool(wait=False)
+        # device-warmer singleton (background hot-set warming)
+        from parseable_tpu.ops.link import shutdown_warmer
+
+        shutdown_warmer()
         self.query_workers.shutdown(wait=False)
         self.workers.shutdown(wait=False)
+        # sync loop threads exit on the next _sync_stop.wait() wake; join so
+        # stop() returns with no loop thread still ticking (a tick already
+        # in flight bounds the wait — threads are daemons as the backstop)
+        for t in self._sync_threads:
+            t.join(timeout=5)
+        self._sync_threads.clear()
 
 
 # ---------------------------------------------------------------- middleware
@@ -382,7 +400,18 @@ async def auth_middleware(request: web.Request, handler):
             user, _, pw = base64.b64decode(auth[6:]).decode().partition(":")
         except Exception:
             return _unauthorized("invalid basic auth")
-        if state.rbac.authenticate(user, pw) is None:
+        # cache hits answer inline (sha256); a miss needs scrypt, which is
+        # ~10^2 ms BY DESIGN and head-of-line blocks every in-flight request
+        # if run here — wrong-password probes never populate the cache, so
+        # the slow path is also attacker-reachable on every attempt
+        # (psan-loop-block finding: rbac/__init__.py hash_password blocked
+        # the loop 58ms under the fan-out suite)
+        authed, decided = state.rbac.try_cached_authenticate(user, pw)
+        if not decided:
+            authed = await asyncio.get_running_loop().run_in_executor(
+                state.workers, state.rbac.authenticate, user, pw
+            )
+        if authed is None:
             return _unauthorized()
         username = user
     elif auth.startswith("Bearer "):

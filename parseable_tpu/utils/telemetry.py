@@ -227,6 +227,10 @@ class Tracer:
         self.endpoint = endpoint or env_str("P_OTLP_ENDPOINT") or None
         self.service_name = service_name
         self._spans: list[dict] = []  # guarded-by: self._lock
+        # at most ONE in-flight background export, tracked so shutdown can
+        # join it (an unjoined per-flush daemon thread is exactly the leak
+        # psan's thread accounting flags)
+        self._export_thread: threading.Thread | None = None  # guarded-by: self._lock
         self._lock = threading.Lock()
         # flush() holds the export serializer while _flush_locked swaps the
         # buffer under the span lock; the reverse nesting would deadlock a
@@ -318,10 +322,33 @@ class Tracer:
             if len(self._spans) > MAX_BUFFER:
                 del self._spans[: len(self._spans) - MAX_BUFFER]
             should_flush = len(self._spans) >= EXPORT_BATCH
-        if should_flush and not self._flush_inflight.locked():
+        if should_flush:
             # export off the request path: a slow collector must never
             # add latency to the ingest/query that tipped the batch
-            threading.Thread(target=self.flush, name="otlp-export", daemon=True).start()
+            self._spawn_export()
+
+    def _spawn_export(self) -> None:
+        """Start the background exporter unless one is already in flight
+        (it will pick up the freshly tipped batch when it reruns or on
+        drain). The thread is tracked, never fire-and-forget: drain()
+        joins it, so process shutdown cannot strand an export mid-POST."""
+        with self._lock:
+            t = self._export_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self.flush, name="otlp-export", daemon=True)
+            self._export_thread = t
+        t.start()
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Join the in-flight export (at most one) and synchronously flush
+        whatever is still buffered. Shutdown hook — after this returns no
+        exporter thread is running on this tracer's behalf."""
+        with self._lock:
+            t, self._export_thread = self._export_thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self.flush()
 
     def flush(self) -> bool:
         """Export buffered spans (OTLP/HTTP JSON); failures drop the batch.

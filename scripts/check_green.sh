@@ -39,3 +39,25 @@ if ! python -m parseable_tpu.analysis "${plint_args[@]}"; then
   exit 1
 fi
 echo "check_green: plint GREEN (report: /tmp/plint.json)"
+
+# dynamic-analysis gate: the same tier-1 suite again under the psan runtime
+# concurrency sanitizer (P_PSAN=1) — Eraser lockset races on guarded-by
+# attrs, runtime lock-order vs the declared hierarchy, event-loop blocking,
+# per-test thread/executor leaks. Opt out with PSAN=0 (e.g. on a machine
+# where the double run is too slow); the JSON report lands at /tmp/psan.json
+# alongside /tmp/plint.json either way the pass runs. Like PLINT_FULL=1
+# above, running both full gates is the authoritative pre-snapshot check.
+if [ "${PSAN:-1}" != "0" ]; then
+  rm -f /tmp/_t1_psan.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu P_PSAN=1 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1_psan.log
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" -ne 0 ]; then
+    echo "check_green: PSAN RED (rc=$rc; findings above and in /tmp/psan.json)" >&2
+    exit "$rc"
+  fi
+  echo "check_green: psan GREEN (report: /tmp/psan.json)"
+else
+  echo "check_green: psan SKIPPED (PSAN=0)"
+fi

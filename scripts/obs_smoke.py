@@ -125,7 +125,13 @@ def run_smoke(workdir: Path) -> dict:
             await client.close()
             telemetry.SPAN_SINK.detach()
 
-    return asyncio.new_event_loop().run_until_complete(flow())
+    try:
+        return asyncio.new_event_loop().run_until_complete(flow())
+    finally:
+        # deterministic pool shutdown (ingest/query workers, sync/upload/
+        # enrichment) — psan's leak detector holds the smoke to the same
+        # standard as the server's own stop path
+        state.stop()
 
 
 def main() -> int:

@@ -29,6 +29,62 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# psan: the runtime concurrency sanitizer (parseable_tpu/analysis/psan/).
+# P_PSAN=1 turns this tier-1 run into a race/deadlock/leak hunt: the plugin
+# patches threading/asyncio seams in pytest_configure — a historic hook, so
+# registering here still fires it BEFORE collection imports any
+# parseable_tpu module, which is what lets every lock in the tree be
+# instrumented. Read via os.environ (not parseable_tpu.config) on purpose:
+# importing the package before the sanitizer decides to patch would be
+# exactly the ordering bug the comment above warns about for JAX.
+_PSAN = os.environ.get("P_PSAN", "").strip().lower() in ("1", "true", "yes", "on")
+
+
+def pytest_configure(config):
+    if _PSAN and not config.pluginmanager.has_plugin("psan"):
+        from parseable_tpu.analysis.psan.plugin import PsanPytestPlugin
+
+        config.pluginmanager.register(PsanPytestPlugin(), "psan")
+
+
+@pytest.fixture(autouse=True)
+def _reap_parseable_pools():
+    """Suite-wide backstop for psan's thread-leak detector: every Parseable
+    constructed during a test gets its pools (sync/upload/enrichment) shut
+    down at teardown. Pools only — no staging flush, no uploads — so
+    fault-injection and crash-simulation tests keep their on-disk
+    semantics; tests that shut down explicitly are unaffected (executor
+    shutdown is idempotent)."""
+    import weakref
+
+    from parseable_tpu.core import Parseable
+
+    created: list = []
+    orig_init = Parseable.__init__
+
+    def tracking_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        created.append(weakref.ref(self))
+
+    Parseable.__init__ = tracking_init
+    try:
+        yield
+    finally:
+        Parseable.__init__ = orig_init
+        for wr in created:
+            p = wr()
+            if p is None:
+                continue
+            for closer in (
+                p.enrichment.shutdown,
+                p.uploader.shutdown,
+                lambda p=p: p.sync_pool.shutdown(wait=True),
+            ):
+                try:
+                    closer()
+                except Exception:
+                    pass
+
 
 @pytest.fixture()
 def options(tmp_path):
@@ -41,11 +97,17 @@ def options(tmp_path):
 
 @pytest.fixture()
 def parseable(tmp_path):
-    """A fully wired local-store Parseable instance in a temp dir."""
+    """A fully wired local-store Parseable instance in a temp dir.
+
+    Teardown shuts the write-path pools down deterministically (sync,
+    upload, enrichment) — psan's thread-leak detector flags any test
+    leaving pool workers alive, and this fixture must not be the leak."""
     from parseable_tpu.config import Options, StorageOptions
     from parseable_tpu.core import Parseable
 
     opts = Options()
     opts.local_staging_path = tmp_path / "staging"
     storage = StorageOptions(backend="local-store", root=tmp_path / "data")
-    return Parseable(opts, storage)
+    p = Parseable(opts, storage)
+    yield p
+    p.shutdown()

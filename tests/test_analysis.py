@@ -164,6 +164,122 @@ def test_pool_lifecycle_context_managed_local_clean():
     assert check(PoolLifecycleRule(), code, "parseable_tpu/core.py") == []
 
 
+def test_pool_lifecycle_flags_fire_and_forget_thread():
+    """The pre-PR-9 otlp-export pattern: Thread(...).start() with the
+    object dropped on the floor — nothing can ever join or stop it."""
+    code = """
+        import threading
+
+        def kick(fn):
+            threading.Thread(target=fn, name="otlp-export", daemon=True).start()
+    """
+    out = check(PoolLifecycleRule(), code, "parseable_tpu/utils/telemetry.py")
+    assert len(out) == 1
+    assert "fire-and-forget" in out[0].message
+
+
+def test_pool_lifecycle_flags_unjoined_local_thread():
+    code = """
+        import threading
+
+        def kick(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+    """
+    out = check(PoolLifecycleRule(), code, "parseable_tpu/core.py")
+    assert len(out) == 1
+    assert "custody" in out[0].message
+
+
+def test_pool_lifecycle_local_bounded_join_clean():
+    """The devicecheck.py device-probe idiom: spawn, start, join(wait)."""
+    code = """
+        import threading
+
+        def probe(fn, wait):
+            t = threading.Thread(target=fn, name="device-probe", daemon=True)
+            t.start()
+            t.join(wait)
+    """
+    assert check(PoolLifecycleRule(), code, "parseable_tpu/utils/devicecheck.py") == []
+
+
+def test_pool_lifecycle_custody_transfer_clean():
+    """Storing on self, registering into a container, or returning the
+    thread all transfer custody to something that can stop it."""
+    code = """
+        import threading
+
+        class Svc:
+            def spawn_self(self, fn):
+                t = threading.Thread(target=fn)
+                self._t = t
+                t.start()
+
+            def stop(self):
+                self._t.join()
+
+        def spawn_registered(fn, registry):
+            t = threading.Thread(target=fn)
+            registry.append(t)
+            t.start()
+
+        def spawn_returned(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+    """
+    assert check(PoolLifecycleRule(), code, "parseable_tpu/core.py") == []
+
+
+def test_pool_lifecycle_global_with_module_stop_clean():
+    """The ops/link.py device-warmer idiom after the fix: a module-global
+    thread whose stop path joins it through a tuple-unload alias."""
+    code = """
+        import threading
+
+        _WORKER = None
+
+        def kick(fn):
+            global _WORKER
+            _WORKER = threading.Thread(target=fn, daemon=True)
+            _WORKER.start()
+
+        def shutdown():
+            global _WORKER
+            w, _WORKER = _WORKER, None
+            if w is not None:
+                w.join(5)
+    """
+    assert check(PoolLifecycleRule(), code, "parseable_tpu/ops/link.py") == []
+
+
+def test_pool_lifecycle_global_without_stop_flagged():
+    code = """
+        import threading
+
+        _WORKER = None
+
+        def kick(fn):
+            global _WORKER
+            _WORKER = threading.Thread(target=fn, daemon=True)
+            _WORKER.start()
+    """
+    out = check(PoolLifecycleRule(), code, "parseable_tpu/ops/link.py")
+    assert len(out) == 1
+    assert "_WORKER" in out[0].message
+
+
+def test_pool_lifecycle_bare_spawn_suppression():
+    code = """
+        import threading
+
+        def kick(fn):
+            threading.Thread(target=fn, daemon=True).start()  # plint: disable=pool-lifecycle
+    """
+    assert check(PoolLifecycleRule(), code, "parseable_tpu/core.py") == []
+
+
 # ---------------------------------------------------------------- rule 3
 
 

@@ -1,0 +1,98 @@
+"""Black-box multi-process cluster smoke (scripts/blackbox.py in test reach).
+
+ROADMAP: the cross-process unlock ("multi-process black-box cluster
+harness") was exercised only by scripts/bench_fanout.py until now — zero
+test coverage. This smoke boots REAL `python -m parseable_tpu.server`
+processes (1 querier + 1 ingestor over one LocalFS store), ingests over
+HTTP, waits for the sync tick to land parquet in the shared store, and
+queries over HTTP — counts, grouped aggregates, and post-sync visibility
+all asserted through the public API only, the way the reference tests
+against running containers (docker-compose-distributed-test).
+
+Runs in tier-1 (a few seconds on a warm page cache — the harness boots
+processes cheaply by design); generous poll deadlines keep it stable on a
+cold or loaded box.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _load_blackbox():
+    spec = importlib.util.spec_from_file_location(
+        "blackbox", REPO_ROOT / "scripts" / "blackbox.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_blackbox_cluster_ingest_sync_query(tmp_path):
+    bb = _load_blackbox()
+    with bb.ClusterHarness(tmp_path) as cluster:
+        ing = cluster.spawn(
+            "ingest",
+            "ing0",
+            env_extra={
+                "P_LOCAL_SYNC_INTERVAL": "1",
+                "P_STORAGE_UPLOAD_INTERVAL": "1",
+            },
+        )
+        q = cluster.spawn("query", "q0")
+        cluster.wait_live(ing)
+        cluster.wait_live(q)
+
+        rows = [{"host": f"h{i % 2}", "v": float(i)} for i in range(40)]
+        cluster.ingest(ing, "bb", rows)
+
+        # the querier must see every row over HTTP — first via the remote
+        # staging window (fan-in), then from synced parquet; poll because
+        # stream discovery + sync are asynchronous across processes
+        def count_rows() -> int:
+            try:
+                recs, _ = cluster.query(
+                    q, "SELECT count(*) c FROM bb", "10m", "now"
+                )
+            except RuntimeError:
+                return -1  # stream not discovered yet
+            return int(recs[0]["c"]) if recs else 0
+
+        deadline = time.monotonic() + 90
+        seen = count_rows()
+        while time.monotonic() < deadline and seen != 40:
+            time.sleep(0.5)
+            seen = count_rows()
+        assert seen == 40, f"querier saw {seen}/40 rows; logs: {ing.log_path}"
+
+        # grouped aggregate over the same HTTP surface
+        recs, stats = cluster.query(
+            q,
+            "SELECT host, count(*) c FROM bb GROUP BY host ORDER BY host",
+            "10m",
+            "now",
+        )
+        assert recs == [{"host": "h0", "c": 20}, {"host": "h1", "c": 20}]
+        assert stats, "query response carried no stats block"
+
+        # the sync tick must land parquet in the SHARED store (cross-process
+        # durability, not just staging fan-in)
+        deadline = time.monotonic() + 60
+        store = tmp_path / "shared-store"
+        while time.monotonic() < deadline:
+            if list(store.rglob("*.parquet")):
+                break
+            time.sleep(0.5)
+        assert list(store.rglob("*.parquet")), (
+            f"ingestor never uploaded parquet; logs: {ing.log_path.read_text()[-2000:]}"
+        )
+
+        # post-sync: counts still exact (no dupes from staging+parquet union)
+        assert count_rows() == 40
+
+        # both processes still healthy end-to-end
+        assert ing.alive() and q.alive()

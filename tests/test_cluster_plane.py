@@ -100,6 +100,8 @@ def test_querier_syncs_streams_and_rbac_to_ingestors(tmp_path):
 
         await q_client.close()
         await ing_server.close()
+        q_state.stop()  # pools must not outlive the test (psan-thread-leak)
+        ing_state.stop()
 
     run(scenario())
 
@@ -145,6 +147,8 @@ def test_cluster_metrics_and_node_removal(tmp_path):
         r = await q_client.delete("/api/v1/cluster/nope", headers=AUTH)
         assert r.status == 404
         await q_client.close()
+        q_state.stop()  # pools must not outlive the test (psan-thread-leak)
+        ing_state.stop()
 
     run(scenario())
 
@@ -206,6 +210,8 @@ def test_pmeta_billing_scrape_queryable(tmp_path):
         assert info and info[0]["pmeta_last_scrape"]["rows"] >= 1
         await q_client.close()
         await ing_server.close()
+        q_state.stop()  # pools must not outlive the test (psan-thread-leak)
+        ing_state.stop()
 
     run(scenario())
 

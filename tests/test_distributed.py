@@ -58,9 +58,14 @@ def test_two_ingestors_one_querier(tmp_path):
 
         def run_query():
             q = make_parseable(tmp_path, "query", Mode.QUERY)
-            sess = QuerySession(q, engine="cpu")
-            res = sess.query("SELECT host, count(*) c FROM dist GROUP BY host ORDER BY host")
-            return res.to_json_rows(), res.stats
+            try:
+                sess = QuerySession(q, engine="cpu")
+                res = sess.query(
+                    "SELECT host, count(*) c FROM dist GROUP BY host ORDER BY host"
+                )
+                return res.to_json_rows(), res.stats
+            finally:
+                q.shutdown()
 
         rows, stats = await asyncio.get_running_loop().run_in_executor(None, run_query)
         # both the uploaded parquet (node0) and the remote staging window
@@ -75,7 +80,7 @@ def test_two_ingestors_one_querier(tmp_path):
         for s in servers:
             await s.close()
         for st in ing_states:
-            st._sync_stop.set()
+            st.stop()  # full pool shutdown, not just the sync-loop flag
 
     asyncio.new_event_loop().run_until_complete(scenario())
 
@@ -107,13 +112,17 @@ def test_querier_skips_dead_ingestors(tmp_path):
 
         def run_query():
             q = make_parseable(tmp_path, "query", Mode.QUERY)
-            sess = QuerySession(q, engine="cpu")
-            return sess.query("SELECT count(*) c FROM ghost").to_json_rows()
+            try:
+                sess = QuerySession(q, engine="cpu")
+                return sess.query("SELECT count(*) c FROM ghost").to_json_rows()
+            finally:
+                q.shutdown()
 
         rows = await asyncio.get_running_loop().run_in_executor(None, run_query)
         assert rows[0]["c"] == 1  # live node's staging served; dead one skipped
 
         await server.close()
+        state.stop()  # pools must not outlive the test (psan-thread-leak)
 
     asyncio.new_event_loop().run_until_complete(scenario())
 
@@ -133,6 +142,7 @@ def test_querier_merges_uploaded_snapshots_from_two_ingestors(tmp_path):
         ev.process(stream, commit_schema=p.commit_schema)
         p.local_sync(shutdown=True)
         p.sync_all_streams()
+        p.shutdown()  # pools must not outlive the test (psan-thread-leak)
 
     q = make_parseable(tmp_path, "q", Mode.QUERY)
     rows = (
@@ -145,6 +155,7 @@ def test_querier_merges_uploaded_snapshots_from_two_ingestors(tmp_path):
     fmts = q.metastore.get_all_stream_jsons("merged")
     assert len(fmts) == 2
     assert sum(f.stats.events for f in fmts) == 50
+    q.shutdown()
 
 
 def test_ingestor_restart_recovers_staging(tmp_path):

@@ -189,6 +189,7 @@ def test_cold_query_serves_from_cache(tmp_path):
     assert got == expected
     assert cache.hits > hits_before, "cold query bypassed the encoded cache"
     assert reads["n"] == 0, "cold query still decoded parquet"
+    p.shutdown()  # pools must not outlive the test (psan-thread-leak)
 
 
 def test_concurrent_puts_no_corruption(tmp_path, table):

@@ -81,16 +81,19 @@ async def teardown(states, servers):
     for s in servers:
         await s.close()
     for st in states:
-        st._sync_stop.set()
+        st.stop()  # full pool shutdown (psan-thread-leak), not just the flag
 
 
 def query_on(tmp_path, node: str, sql: str = SQL, pushdown: bool = True, **opt_overrides):
     q = make_parseable(tmp_path, node, Mode.QUERY)
-    q.options.query_pushdown = pushdown
-    for k, v in opt_overrides.items():
-        setattr(q.options, k, v)
-    res = QuerySession(q, engine="cpu").query(sql)
-    return res.to_json_rows(), res.stats
+    try:
+        q.options.query_pushdown = pushdown
+        for k, v in opt_overrides.items():
+            setattr(q.options, k, v)
+        res = QuerySession(q, engine="cpu").query(sql)
+        return res.to_json_rows(), res.stats
+    finally:
+        q.shutdown()  # pools must not outlive the test (psan-thread-leak)
 
 
 EXPECTED = [

@@ -164,6 +164,8 @@ def test_full_pipeline_on_gcs(tmp_path):
         p2.load_streams_from_storage()
         res2 = QuerySession(p2, engine="cpu").query("SELECT count(*) FROM gcsweb")
         assert res2.to_json_rows()[0]["count(*)"] == 300
+        p.shutdown()  # pools must not outlive the test (psan-thread-leak)
+        p2.shutdown()
     finally:
         srv.shutdown()
 

@@ -39,7 +39,7 @@ def make_state(tmp_path, **opt_overrides):
     return ServerState(p)
 
 
-async def with_client(state, fn):
+async def with_client(state, fn, stop=True):
     app = build_app(state)
     client = TestClient(TestServer(app))
     await client.start_server()
@@ -47,6 +47,8 @@ async def with_client(state, fn):
         return await fn(client)
     finally:
         await client.close()
+        if stop:
+            state.stop()  # pools must not outlive the test (psan-thread-leak)
 
 
 @pytest.fixture(autouse=True)
@@ -104,7 +106,9 @@ def test_ingest_flush_sync_span_parentage(tmp_path):
         assert r.headers["X-P-Trace-Id"] == "ab" * 16
         return r.headers["X-P-Trace-Id"]
 
-    ingest_trace = run(with_client(state, fn))
+    # stop=False: the flush/sync tick below drives state.p AFTER the client
+    # closes; the test stops the state itself at the end
+    ingest_trace = run(with_client(state, fn, stop=False))
 
     spans = telemetry.recent_spans(ingest_trace)
     by_name = {s["name"]: s for s in spans}

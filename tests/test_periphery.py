@@ -169,6 +169,7 @@ def test_analytics_report(tmp_path):
         assert received[0]["total_events_count"] == 7
     finally:
         srv.shutdown()
+        p.shutdown()  # pools must not outlive the test (psan-thread-leak)
 
 
 # --------------------------------------------------- execution batch size
@@ -235,7 +236,8 @@ def test_debug_profile_endpoint(tmp_path):
         opts = Options()
         opts.local_staging_path = tmp_path / "staging"
         p = Parseable(opts, StorageOptions(backend="local-store", root=tmp_path / "data"))
-        client = TestClient(TestServer(build_app(ServerState(p))))
+        state = ServerState(p)
+        client = TestClient(TestServer(build_app(state)))
         await client.start_server()
         # busy thread so samples land somewhere deterministic-ish
         import threading
@@ -266,7 +268,9 @@ def test_debug_profile_endpoint(tmp_path):
             assert r4.status == 401
         finally:
             stop.set()
+            t.join(5)
             await client.close()
+            state.stop()  # pools must not outlive the test (psan-thread-leak)
 
     asyncio.new_event_loop().run_until_complete(scenario())
 

@@ -45,6 +45,7 @@ async def with_client(state, fn):
         return await fn(client)
     finally:
         await client.close()
+        state.stop()  # pools must not outlive the test (psan-thread-leak)
 
 
 def sample(name, labels=None):

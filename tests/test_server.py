@@ -32,7 +32,12 @@ def run(coro):
     return asyncio.new_event_loop().run_until_complete(coro)
 
 
-async def with_client(state, fn):
+async def with_client(state, fn, stop=True):
+    """Run `fn` against a live test client; by default the ServerState is
+    stopped afterwards so its pools (ingest/query workers, sync/upload)
+    never outlive the test — psan's thread-leak detector enforces this.
+    Pass stop=False when the test asserts pre-stop staging state or stops
+    explicitly itself."""
     app = build_app(state)
     client = TestClient(TestServer(app))
     await client.start_server()
@@ -40,6 +45,8 @@ async def with_client(state, fn):
         return await fn(client)
     finally:
         await client.close()
+        if stop:
+            state.stop()
 
 
 def test_health_and_about(tmp_path):

@@ -41,6 +41,27 @@ class FaultyStorage:
         return getattr(self.inner, name)
 
 
+_LIVE: list = []  # instances awaiting the post-test pool reap
+
+
+@pytest.fixture(autouse=True)
+def _reap_pools():
+    """Stop every make_p instance's pools after the test (psan-thread-leak):
+    pools only — a full shutdown() would sync through the INJECTED faults."""
+    yield
+    while _LIVE:
+        p = _LIVE.pop()
+        for closer in (
+            p.enrichment.shutdown,
+            p.uploader.shutdown,
+            lambda p=p: p.sync_pool.shutdown(wait=True),
+        ):
+            try:
+                closer()
+            except Exception:
+                pass
+
+
 def make_p(tmp_path, **overrides) -> tuple[Parseable, FaultyStorage]:
     opts = Options()
     opts.local_staging_path = tmp_path / "staging"
@@ -51,6 +72,7 @@ def make_p(tmp_path, **overrides) -> tuple[Parseable, FaultyStorage]:
     p.storage = faulty
     p.uploader.storage = faulty
     p.metastore.storage = faulty
+    _LIVE.append(p)
     return p, faulty
 
 
