@@ -506,9 +506,12 @@ def bench_json_ingest(p) -> None:
     """End-to-end HTTP JSON ingest line with an honest absolute yardstick
     (VERDICT r3 #7): vs_baseline is measured against the raw pyarrow C++
     JSON-reader floor over the SAME payload bytes — the fastest any
-    Python-hosted server could conceivably decode it, with zero event
-    model, schema commit, or staging. The native lane (fastpath.cpp
-    flatten -> NDJSON -> pyarrow reader) runs the whole pipeline."""
+    Python-hosted server could conceivably decode it with a reader, with
+    zero event model, schema commit, or staging. The native columnar lane
+    (fastpath.cpp single-pass parse -> Arrow-layout buffers -> zero-copy
+    import) runs the whole pipeline and can legitimately EXCEED 1.0x: it
+    parses the bytes once into final columns while read_json tokenizes
+    into its own intermediate representation first."""
     import io as _io
 
     import numpy as np
@@ -576,9 +579,10 @@ def bench_json_ingest(p) -> None:
         round(ours / floor, 4),
         {
             "note": (
-                "full pipeline (native C++ flatten -> arrow JSON reader -> "
-                "schema/staging) vs raw pyarrow read_json floor on the "
-                "same bytes; p50 over reps, never best-of"
+                "full pipeline (single-pass C++ columnar build -> zero-copy "
+                "Arrow import -> schema/staging; NDJSON+read_json as the "
+                "fallback tier) vs raw pyarrow read_json floor on the same "
+                "bytes; p50 over reps, never best-of"
             ),
             "repeats": reps,
             "latency_p50_s": round(percentile(ours_times, 0.50), 4),
@@ -1468,7 +1472,7 @@ def bench_otel_ingest(p) -> None:
         "otel_logs_ingest_rows_per_sec",
         total / t_fast,
         t_py / t_fast,
-        {"note": "native C++ OTel lane vs Python flattener pipeline, end-to-end incl. staging"},
+        {"note": "native C++ columnar OTel lane (single-pass -> Arrow buffers) vs Python flattener pipeline, end-to-end incl. staging"},
     )
 
 

@@ -405,6 +405,10 @@ def prepare_and_decode_fast(
         tbl = pa.Table.from_pylist(records)
     except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
         return None  # mixed-type column etc. -> slow path
+    except OverflowError:
+        # ints beyond int64 overflow Arrow's inference; the slow path
+        # stages them as float64 (previously an unhandled 500)
+        return None
     # from_pylist infers columns from the first record; sparse batches
     # (later records adding keys) need the per-record slow path
     union_keys = set()
@@ -461,9 +465,12 @@ def fast_columns_from_table(
         elif pa.types.is_timestamp(t):
             # read_json eagerly parses ISO-looking strings into timestamps
             # regardless of field name; the slow path only infers time for
-            # time-ish names — decline the mismatch instead of committing
+            # time-ish names AND only when the stream infers timestamps —
+            # decline the mismatch instead of committing (with inference
+            # off, a pre-typed ts column would stage where the Python path
+            # stages strings)
             if records is None and not (
-                _is_timestampy(name)
+                (infer_timestamp and _is_timestampy(name))
                 or (stored.get(name) is not None and pa.types.is_timestamp(stored[name].type))
             ):
                 return None

@@ -1,9 +1,12 @@
 """Native fastpath bindings (ctypes over libptpu_fastpath.so).
 
-Provides xxHash64 and a HyperLogLog sketch implemented in C++
+Provides the single-pass columnar ingest builders (JSON / OTel-logs
+payloads -> Arrow-layout buffers imported zero-copy), the NDJSON flatten
+fallback tier, xxHash64, and a HyperLogLog sketch, all implemented in C++
 (parseable_tpu/native/fastpath.cpp). The library auto-builds with g++ on
 first import when missing; every consumer has a pure-Python fallback, so
-absence of a toolchain never breaks the system.
+absence of a toolchain never breaks the system — unless P_NATIVE_REQUIRED=1,
+under which build/load failure raises instead of degrading.
 """
 
 from __future__ import annotations
@@ -34,11 +37,24 @@ def _build() -> bool:
         return False
 
 
+def _required() -> bool:
+    # P_NATIVE_REQUIRED=1: a missing/stale native library is an ERROR, not a
+    # silent Python fallback (check_green.sh sets it whenever g++ exists, so
+    # tier-1 can't go green on the fallback after a fastpath.cpp typo)
+    from parseable_tpu.config import env_bool
+
+    return env_bool("P_NATIVE_REQUIRED", False)
+
+
 def _load() -> ctypes.CDLL | None:
     global _lib, _load_failed
     if _lib is not None:
         return _lib
     if _load_failed:
+        if _required():
+            raise RuntimeError(
+                "P_NATIVE_REQUIRED=1 but the native fastpath failed to load"
+            )
         return None
     # rebuild BEFORE the first dlopen when the source is newer than the
     # library (an in-place upgrade leaves a stale .so whose missing newer
@@ -53,12 +69,20 @@ def _load() -> ctypes.CDLL | None:
         stale = False
     if (not _LIB_PATH.exists() or stale) and not _build() and not _LIB_PATH.exists():
         _load_failed = True
+        if _required():
+            raise RuntimeError(
+                "P_NATIVE_REQUIRED=1 but the native fastpath failed to build"
+            )
         return None
     try:
         lib = ctypes.CDLL(str(_LIB_PATH))
     except OSError as e:
         logger.warning("native fastpath load failed (%s)", e)
         _load_failed = True
+        if _required():
+            raise RuntimeError(
+                f"P_NATIVE_REQUIRED=1 but the native fastpath failed to load: {e}"
+            ) from e
         return None
     try:
         _bind(lib)
@@ -67,6 +91,10 @@ def _load() -> ctypes.CDLL | None:
         # Python fallbacks everywhere, never a crash
         logger.warning("native fastpath is stale (%s); using Python fallbacks", e)
         _load_failed = True
+        if _required():
+            raise RuntimeError(
+                f"P_NATIVE_REQUIRED=1 but the native fastpath is stale: {e}"
+            ) from e
         return None
     _lib = lib
     return lib
@@ -124,6 +152,43 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.ptpu_free.argtypes = [ctypes.c_void_p]
+    # columnar tier: single-pass parse -> Arrow-layout buffers
+    lib.ptpu_flatten_columnar.restype = ctypes.c_int
+    lib.ptpu_flatten_columnar.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ptpu_otel_logs_columnar.restype = ctypes.c_int
+    lib.ptpu_otel_logs_columnar.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ptpu_cols_nrows.restype = ctypes.c_uint64
+    lib.ptpu_cols_nrows.argtypes = [ctypes.c_void_p]
+    lib.ptpu_cols_ncols.restype = ctypes.c_uint32
+    lib.ptpu_cols_ncols.argtypes = [ctypes.c_void_p]
+    lib.ptpu_cols_name.restype = ctypes.c_char_p
+    lib.ptpu_cols_name.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_kind.restype = ctypes.c_int32
+    lib.ptpu_cols_kind.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_null_count.restype = ctypes.c_uint64
+    lib.ptpu_cols_null_count.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_validity.restype = ctypes.c_void_p
+    lib.ptpu_cols_validity.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_data.restype = ctypes.c_void_p
+    lib.ptpu_cols_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_data_len.restype = ctypes.c_uint64
+    lib.ptpu_cols_data_len.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_offsets.restype = ctypes.c_void_p
+    lib.ptpu_cols_offsets.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_free.argtypes = [ctypes.c_void_p]
+    lib.ptpu_cols_live.restype = ctypes.c_longlong
+    lib.ptpu_cols_live.argtypes = []
 
 
 def native_available() -> bool:
@@ -198,6 +263,132 @@ def otel_logs_ndjson(payload: bytes, ts_as_ms: bool = True) -> tuple[bytes, int]
     return data, int(nrows.value)
 
 
+# Column kinds crossing the ABI (mirrors fastpath.cpp's PT_COL_* enum).
+_COL_NULL, _COL_F64, _COL_BOOL, _COL_STR, _COL_TS_MS = 0, 1, 2, 3, 4
+
+
+class _ColumnarBufs:
+    """Ownership handoff for one native columnar result: every
+    pa.foreign_buffer wrapping the C++ buffers keeps this object as its
+    base, so the single ptpu_cols_free runs exactly when the LAST Arrow
+    array referencing any of the buffers is released."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self, h: int):
+        self._h = h
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and _lib is not None:
+            _lib.ptpu_cols_free(h)
+
+
+def columnar_live() -> int:
+    """Native columnar results not yet freed (leak-detector hook)."""
+    lib = _load()
+    return int(lib.ptpu_cols_live()) if lib is not None else 0
+
+
+def _import_columnar(lib, handle: int):
+    """Wrap one native columnar handle as (names, pa.Array list, nrows),
+    zero-copy. Returns None for kinds this binding doesn't know (stale
+    binding vs newer .so) — the handle is freed either way via the owner."""
+    import pyarrow as pa
+
+    owner = _ColumnarBufs(handle)
+    nrows = int(lib.ptpu_cols_nrows(handle))
+    ncols = int(lib.ptpu_cols_ncols(handle))
+    names: list[str] = []
+    arrays: list[pa.Array] = []
+    for i in range(ncols):
+        name = lib.ptpu_cols_name(handle, i).decode()
+        kind = lib.ptpu_cols_kind(handle, i)
+        if kind == _COL_NULL:
+            names.append(name)
+            arrays.append(pa.nulls(nrows))
+            continue
+        nullc = int(lib.ptpu_cols_null_count(handle, i))
+        vptr = lib.ptpu_cols_validity(handle, i)
+        validity = (
+            pa.foreign_buffer(vptr, (nrows + 7) // 8, owner)
+            if (nullc and vptr)
+            else None
+        )
+        dptr = lib.ptpu_cols_data(handle, i)
+        dlen = int(lib.ptpu_cols_data_len(handle, i))
+        data = pa.foreign_buffer(dptr, dlen, owner) if dptr else pa.allocate_buffer(0)
+        if kind == _COL_F64:
+            arr = pa.Array.from_buffers(pa.float64(), nrows, [validity, data], nullc)
+        elif kind == _COL_TS_MS:
+            arr = pa.Array.from_buffers(
+                pa.timestamp("ms"), nrows, [validity, data], nullc
+            )
+        elif kind == _COL_BOOL:
+            arr = pa.Array.from_buffers(pa.bool_(), nrows, [validity, data], nullc)
+        elif kind == _COL_STR:
+            optr = lib.ptpu_cols_offsets(handle, i)
+            offsets = pa.foreign_buffer(optr, 4 * (nrows + 1), owner)
+            arr = pa.Array.from_buffers(
+                pa.string(), nrows, [validity, offsets, data], nullc
+            )
+        else:
+            return None
+        names.append(name)
+        arrays.append(arr)
+    return names, arrays, nrows
+
+
+def flatten_columnar(payload: bytes, max_depth: int, separator: str = "_"):
+    """Tier-1 native ingest: parse+flatten a plain-JSON payload straight
+    into Arrow-layout column buffers in ONE pass (fastpath.cpp
+    ptpu_flatten_columnar) and import them zero-copy. Returns
+    (names, arrays, nrows) or None when the payload needs a lower tier
+    (the NDJSON lane, then Python) — arrays/mixed types/sparse keys/depth
+    exactly like the NDJSON lane, plus escaped keys, lone surrogates and
+    other columnar-only declines."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.c_void_p()
+    rc = lib.ptpu_flatten_columnar(
+        payload, len(payload), max_depth, separator.encode(), ctypes.byref(out)
+    )
+    if rc != 0:
+        return None
+    return _import_columnar(lib, out.value)
+
+
+def otel_logs_columnar(payload: bytes, ts_as_ms: bool = True):
+    """Tier-1 native OTel-logs ingest: walk the OTLP-JSON structure and
+    build the flattened rows as Arrow-layout columns in one pass
+    (fastpath.cpp ptpu_otel_logs_columnar), imported zero-copy. ts_as_ms
+    emits the time fields as timestamp(ms) columns directly. Returns
+    (names, arrays, nrows) or None when the payload needs a lower tier."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.c_void_p()
+    rc = lib.ptpu_otel_logs_columnar(
+        payload, len(payload), 1 if ts_as_ms else 0, ctypes.byref(out)
+    )
+    if rc != 0:
+        return None
+    return _import_columnar(lib, out.value)
+
+
+def _borrowed_ptr(buf: bytes | bytearray) -> ctypes.c_void_p:
+    """Borrowed pointer to a buffer WITHOUT copying: read-only `bytes` pass
+    as a const pointer (the C side never writes through these args), and
+    `bytearray` via the writable from_buffer view. The caller must keep
+    `buf` referenced for the duration of the FFI call."""
+    if isinstance(buf, bytes):
+        return ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p)
+    return ctypes.cast(
+        (ctypes.c_char * len(buf)).from_buffer(buf), ctypes.c_void_p
+    )
+
+
 def hll_idx_rank_batch(
     buf: bytes | bytearray, offsets: np.ndarray, p: int
 ) -> tuple[np.ndarray, np.ndarray] | None:
@@ -213,9 +404,7 @@ def hll_idx_rank_batch(
     rank = np.empty(n, dtype=np.int32)
     if n:
         lib.ptpu_hll_idx_rank_batch(
-            (ctypes.c_char * len(buf)).from_buffer(
-                buf if isinstance(buf, bytearray) else bytearray(buf)
-            ),
+            _borrowed_ptr(buf),
             np.ascontiguousarray(offsets, dtype=np.uint64).ctypes.data_as(
                 ctypes.c_void_p
             ),
@@ -276,7 +465,7 @@ class Hll:
         arr = np.asarray(offsets, dtype=np.uint64)
         _lib.ptpu_hll_add_batch(
             self._h,
-            (ctypes.c_char * len(buf)).from_buffer(buf),
+            _borrowed_ptr(buf),
             arr.ctypes.data_as(ctypes.c_void_p),
             n,
         )

@@ -6,4 +6,16 @@ cd "$(dirname "$0")"
 # while intra-library calls inline (interposition semantics cost ~6x on
 # the parse hot loops under -fPIC)
 g++ -O3 -march=native -fno-semantic-interposition -fPIC -shared -std=c++17 fastpath.cpp -o libptpu_fastpath.so
+# sanity: the columnar ingest ABI must be present — a truncated/stale build
+# would otherwise dlopen fine and silently push every request down a tier
+# (the Python binding's _bind() would catch it, but fail the build here,
+# where the error is actionable)
+if command -v nm >/dev/null 2>&1; then
+  for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free; do
+    nm -D libptpu_fastpath.so | grep -q " $sym\$" || {
+      echo "build.sh: missing export $sym" >&2
+      exit 1
+    }
+  done
+fi
 echo "built $(pwd)/libptpu_fastpath.so"

@@ -1246,3 +1246,965 @@ int ptpu_otel_logs_ndjson(const char* in, uint64_t len, int ts_as_ms,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------- columnar ingest (tier 1)
+//
+// Single-pass columnar builders: the same JSON walks as the NDJSON lanes
+// above, but values land in typed Arrow-layout column buffers (float64 /
+// bool / string+validity, and int64 epoch-ms timestamps for the OTel time
+// fields) DURING the one parse. The buffers export zero-copy across the
+// ctypes boundary (values + validity bitmap + string offsets, Arrow
+// physical layout exactly), so Python wraps them with pa.foreign_buffer /
+// pa.Array.from_buffers and never re-tokenizes anything. The NDJSON lanes
+// stay as the second tier: any shape the builders can't represent exactly
+// (mixed-type columns, escaped keys, lone surrogates, raw control chars)
+// returns FALLBACK and the caller walks down the ladder with identical
+// user-visible behavior.
+//
+// Numeric columns build as float64 directly: SchemaVersion::V1 stages every
+// number as float64 anyway (the NDJSON lane's int64 columns get cast right
+// after the reader), and decimal-string -> double parsing is correctly
+// rounded, so the values are bit-identical to the Python path's float().
+
+#include <atomic>
+#include <map>
+#include <unordered_map>
+
+namespace {
+namespace colb {
+
+using otelj::Cur;
+using otelj::Span;
+using otelj::Member;
+using otelj::Kind;
+using otelj::K_STR;
+using otelj::K_NUM;
+using otelj::K_OBJ;
+using otelj::K_ARR;
+using otelj::K_TRUE;
+using otelj::K_FALSE;
+using otelj::K_NULL;
+using otelj::K_BAD;
+using otelj::kind_of;
+using otelj::str_content;
+using otelj::collect;
+using otelj::find;
+using otelj::parse_i64;
+using otelj::num_is_integer;
+using otelj::is_json_number;
+using otelj::truthy;
+using otelj::floordiv;
+using otelj::fmt_rfc3339_us;
+using otelj::SEVERITY_TEXT;
+
+enum { OK = PTPU_FJ_OK, FB = PTPU_FJ_FALLBACK, INV = PTPU_FJ_INVALID };
+
+// Column kinds crossing the ABI (mirrored in native/__init__.py).
+enum : int32_t {
+    PT_COL_NULL = 0,     // no non-null value ever seen -> pa.nulls
+    PT_COL_FLOAT64 = 1,  // f64 values
+    PT_COL_BOOL = 2,     // bit-packed values (Arrow bool layout)
+    PT_COL_STRING = 3,   // int32 offsets + utf8 chars
+    PT_COL_TS_MS = 4,    // int64 epoch-milliseconds -> pa.timestamp("ms")
+};
+
+// locale-independent double parse over a strict-JSON number token (the
+// scanners above enforce the grammar, so strtod_l cannot under-consume)
+static double parse_double(const char* b, const char* e) {
+    static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    char buf[64];
+    size_t n = (size_t)(e - b);
+    if (n < sizeof(buf)) {
+        std::memcpy(buf, b, n);
+        buf[n] = 0;
+        return strtod_l(buf, nullptr, c_loc);
+    }
+    std::string tmp(b, e);
+    return strtod_l(tmp.c_str(), nullptr, c_loc);
+}
+
+// strict UTF-8 validation (surrogate and overlong rejecting): column chars
+// become Python str / Arrow utf8, which both require validity — the Python
+// json path would have raised its own error on undecodable payload bytes.
+static bool valid_utf8(const char* b, const char* e) {
+    const unsigned char* p = (const unsigned char*)b;
+    const unsigned char* q = (const unsigned char*)e;
+    while (p < q) {
+        unsigned char c = *p;
+        if (c < 0x80) { p++; continue; }
+        int cont;
+        unsigned char lo = 0x80, hi = 0xBF;
+        if (c >= 0xC2 && c <= 0xDF) cont = 1;
+        else if (c == 0xE0) { cont = 2; lo = 0xA0; }
+        else if (c >= 0xE1 && c <= 0xEC) cont = 2;
+        else if (c == 0xED) { cont = 2; hi = 0x9F; }  // no surrogates
+        else if (c >= 0xEE && c <= 0xEF) cont = 2;
+        else if (c == 0xF0) { cont = 3; lo = 0x90; }
+        else if (c >= 0xF1 && c <= 0xF3) cont = 3;
+        else if (c == 0xF4) { cont = 3; hi = 0x8F; }
+        else return false;  // C0/C1 overlong lead or F5+.
+        if (q - p <= cont) return false;
+        if (p[1] < lo || p[1] > hi) return false;
+        for (int i = 2; i <= cont; i++)
+            if (p[i] < 0x80 || p[i] > 0xBF) return false;
+        p += cont + 1;
+    }
+    return true;
+}
+
+static bool append_cp_utf8(std::string& dst, unsigned cp) {
+    if (cp < 0x80) {
+        dst += (char)cp;
+    } else if (cp < 0x800) {
+        dst += (char)(0xC0 | (cp >> 6));
+        dst += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+        dst += (char)(0xE0 | (cp >> 12));
+        dst += (char)(0x80 | ((cp >> 6) & 0x3F));
+        dst += (char)(0x80 | (cp & 0x3F));
+    } else {
+        dst += (char)(0xF0 | (cp >> 18));
+        dst += (char)(0x80 | ((cp >> 12) & 0x3F));
+        dst += (char)(0x80 | ((cp >> 6) & 0x3F));
+        dst += (char)(0x80 | (cp & 0x3F));
+    }
+    return true;
+}
+
+static int hex_nibble(char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+}
+
+static int parse_u16(const char* s, const char* e) {
+    if (e - s < 4) return -1;
+    int v = 0;
+    for (int i = 0; i < 4; i++) {
+        int n = hex_nibble(s[i]);
+        if (n < 0) return -1;
+        v = (v << 4) | n;
+    }
+    return v;
+}
+
+// Unescape JSON string content [s,e) (between the quotes) into dst.
+// Returns false — the caller declines to the NDJSON/Python tiers — on:
+// raw control chars (invalid JSON; Python raises), invalid \u sequences,
+// LONE SURROGATES (Python's json accepts them but the resulting str can't
+// encode to Arrow utf8 — the Python path owns that error), bad escapes,
+// and invalid UTF-8 in the raw segments.
+static bool unescape_append(const char* s, const char* e, std::string& dst) {
+    while (s < e) {
+        const char* bs = (const char*)std::memchr(s, '\\', (size_t)(e - s));
+        const char* seg = bs ? bs : e;
+        for (const char* t = s; t < seg; t++)
+            if ((unsigned char)*t < 0x20) return false;
+        if (!valid_utf8(s, seg)) return false;
+        dst.append(s, (size_t)(seg - s));
+        if (bs == nullptr) return true;
+        s = bs + 1;
+        if (s >= e) return false;
+        char c = *s++;
+        switch (c) {
+            case '"': dst += '"'; break;
+            case '\\': dst += '\\'; break;
+            case '/': dst += '/'; break;
+            case 'b': dst += '\b'; break;
+            case 'f': dst += '\f'; break;
+            case 'n': dst += '\n'; break;
+            case 'r': dst += '\r'; break;
+            case 't': dst += '\t'; break;
+            case 'u': {
+                int u1 = parse_u16(s, e);
+                if (u1 < 0) return false;
+                s += 4;
+                if (u1 >= 0xD800 && u1 <= 0xDBFF) {
+                    if (e - s < 6 || s[0] != '\\' || s[1] != 'u') return false;
+                    int u2 = parse_u16(s + 2, e);
+                    if (u2 < 0xDC00 || u2 > 0xDFFF) return false;
+                    s += 6;
+                    unsigned cp = 0x10000u + (((unsigned)(u1 - 0xD800)) << 10)
+                                  + (unsigned)(u2 - 0xDC00);
+                    append_cp_utf8(dst, cp);
+                } else if (u1 >= 0xDC00 && u1 <= 0xDFFF) {
+                    return false;  // lone low surrogate
+                } else {
+                    append_cp_utf8(dst, (unsigned)u1);
+                }
+                break;
+            }
+            default:
+                return false;
+        }
+    }
+    return true;
+}
+
+static inline void bm_push(std::vector<uint8_t>& bm, uint64_t idx, bool v) {
+    if ((idx & 7) == 0) bm.push_back(0);
+    if (v) bm[idx >> 3] |= (uint8_t)(1u << (idx & 7));
+}
+
+struct ColBuilder {
+    std::string name;
+    int32_t kind = PT_COL_NULL;
+    uint64_t rows = 0;        // values appended so far (incl. nulls)
+    uint64_t null_count = 0;
+    std::vector<uint8_t> validity;  // Arrow validity bitmap, LSB-first
+    std::vector<double> f64;
+    std::vector<int64_t> ts;
+    std::vector<uint8_t> bits;      // bool values bitmap
+    std::vector<int32_t> offsets;   // string offsets (rows + 1)
+    std::string chars;              // string data (raw utf8, unescaped)
+};
+
+// Shared by both lanes. The plain-JSON lane fills positionally (uniform
+// key sets, like the NDJSON tier); the OTel lane fills by name with null
+// backfill, matching read_json's sparse-key union over the NDJSON rows.
+// Every add_* returns false when the shape needs a lower tier: a value
+// landing in an already-filled column (duplicate key in one row) or a
+// kind mismatch (mixed-type column).
+struct ColumnarBatch {
+    std::vector<ColBuilder> cols;
+    std::map<std::string, uint32_t, std::less<>> index;
+    uint64_t nrows = 0;  // completed rows
+
+    int64_t find_col(std::string_view name) const {
+        auto it = index.find(name);
+        return it == index.end() ? -1 : (int64_t)it->second;
+    }
+
+    uint32_t create(std::string_view name) {
+        cols.emplace_back();
+        ColBuilder& c = cols.back();
+        c.name.assign(name);
+        for (uint64_t r = 0; r < nrows; r++) bm_push(c.validity, r, false);
+        c.null_count = nrows;
+        c.rows = nrows;
+        uint32_t i = (uint32_t)(cols.size() - 1);
+        index.emplace(c.name, i);
+        return i;
+    }
+
+    bool set_kind(ColBuilder& c, int32_t k) {
+        if (c.kind == k) return true;
+        if (c.kind != PT_COL_NULL) return false;  // mixed-type column
+        c.kind = k;
+        switch (k) {  // backfill typed storage for the null prefix
+            case PT_COL_FLOAT64: c.f64.assign(c.rows, 0.0); break;
+            case PT_COL_TS_MS: c.ts.assign(c.rows, 0); break;
+            case PT_COL_BOOL:
+                for (uint64_t r = 0; r < c.rows; r++) bm_push(c.bits, r, false);
+                break;
+            case PT_COL_STRING: c.offsets.assign(c.rows + 1, 0); break;
+            default: break;
+        }
+        return true;
+    }
+
+    bool add_null(ColBuilder& c) {
+        if (c.rows != nrows) return false;
+        bm_push(c.validity, c.rows, false);
+        c.null_count++;
+        switch (c.kind) {
+            case PT_COL_FLOAT64: c.f64.push_back(0.0); break;
+            case PT_COL_TS_MS: c.ts.push_back(0); break;
+            case PT_COL_BOOL: bm_push(c.bits, c.rows, false); break;
+            case PT_COL_STRING: c.offsets.push_back(c.offsets.back()); break;
+            default: break;
+        }
+        c.rows++;
+        return true;
+    }
+
+    bool add_f64(ColBuilder& c, double v) {
+        if (c.rows != nrows || !set_kind(c, PT_COL_FLOAT64)) return false;
+        bm_push(c.validity, c.rows, true);
+        c.f64.push_back(v);
+        c.rows++;
+        return true;
+    }
+
+    bool add_ts_ms(ColBuilder& c, int64_t ms) {
+        if (c.rows != nrows || !set_kind(c, PT_COL_TS_MS)) return false;
+        bm_push(c.validity, c.rows, true);
+        c.ts.push_back(ms);
+        c.rows++;
+        return true;
+    }
+
+    bool add_bool(ColBuilder& c, bool v) {
+        if (c.rows != nrows || !set_kind(c, PT_COL_BOOL)) return false;
+        bm_push(c.validity, c.rows, true);
+        bm_push(c.bits, c.rows, v);
+        c.rows++;
+        return true;
+    }
+
+    // escaped JSON content -> unescape straight into the column chars
+    bool add_str_unescape(ColBuilder& c, const char* b, const char* e) {
+        if (c.rows != nrows || !set_kind(c, PT_COL_STRING)) return false;
+        if (!unescape_append(b, e, c.chars)) return false;
+        if (c.chars.size() > (size_t)INT32_MAX) return false;
+        bm_push(c.validity, c.rows, true);
+        c.offsets.push_back((int32_t)c.chars.size());
+        c.rows++;
+        return true;
+    }
+
+    // already-unescaped, already-valid utf8 (synthesized values)
+    bool add_str_raw(ColBuilder& c, const char* b, size_t n) {
+        if (c.rows != nrows || !set_kind(c, PT_COL_STRING)) return false;
+        c.chars.append(b, n);
+        if (c.chars.size() > (size_t)INT32_MAX) return false;
+        bm_push(c.validity, c.rows, true);
+        c.offsets.push_back((int32_t)c.chars.size());
+        c.rows++;
+        return true;
+    }
+
+    // close the row: any column this row didn't touch gets null
+    bool end_row() {
+        for (auto& c : cols)
+            if (c.rows == nrows && !add_null(c)) return false;
+        nrows++;
+        return true;
+    }
+};
+
+}  // namespace colb
+}  // anonymous namespace
+
+#include <locale.h>
+
+namespace {
+namespace colb {
+
+// ---- plain-JSON lane: flatten straight to columns -------------------------
+//
+// Mirrors FlattenCtx exactly — same depth limit, same key-set uniformity
+// (positional match against record 0), same declines (arrays, sparse or
+// reordered or duplicate keys, NaN/Infinity, non-object records, empty
+// records) — plus the columnar-only declines (escaped keys, mixed-type
+// columns, invalid UTF-8). Every decline lands on the NDJSON tier first,
+// which re-decides with its own (identical or looser) rules.
+struct JsonColCtx {
+    Cur c;
+    ColumnarBatch b;
+    int max_depth;
+    const char* sep;
+    size_t seplen;
+    uint64_t nrec = 0;
+    size_t key_pos = 0;
+    int rc = OK;
+
+    bool fail(int code) { rc = code; return false; }
+
+    bool leaf(const std::string& name, const Span& v) {
+        uint32_t ci;
+        if (nrec == 0) {
+            int64_t found = b.find_col(name);
+            if (found >= 0) {
+                ci = (uint32_t)found;  // duplicate key: add_* below declines
+            } else {
+                if (!valid_utf8(name.data(), name.data() + name.size()))
+                    return fail(FB);
+                ci = b.create(name);
+            }
+        } else {
+            if (key_pos >= b.cols.size()) return fail(FB);  // extra key
+            if (b.cols[key_pos].name != name) return fail(FB);  // sparse/reordered
+            ci = (uint32_t)key_pos;
+        }
+        key_pos++;
+        ColBuilder& col = b.cols[ci];
+        bool ok;
+        switch (kind_of(v)) {
+            case K_STR: {
+                Span sc = str_content(v);
+                ok = b.add_str_unescape(col, sc.b, sc.e);
+                break;
+            }
+            case K_NUM: ok = b.add_f64(col, parse_double(v.b, v.e)); break;
+            case K_TRUE: ok = b.add_bool(col, true); break;
+            case K_FALSE: ok = b.add_bool(col, false); break;
+            case K_NULL: ok = b.add_null(col); break;
+            default: return fail(INV);
+        }
+        return ok ? true : fail(FB);
+    }
+
+    bool flatten_obj(std::string& prefix, int depth) {
+        if (depth > max_depth) return fail(FB);
+        if (c.p >= c.end || *c.p != '{') return fail(INV);
+        c.p++;
+        c.ws();
+        if (c.p < c.end && *c.p == '}') { c.p++; return true; }
+        while (true) {
+            c.ws();
+            Span k;
+            if (!c.str_span(k)) return fail(c.rc);
+            Span kc = str_content(k);
+            if (kc.len() && std::memchr(kc.b, '\\', kc.len()) != nullptr)
+                return fail(FB);  // escaped key: NDJSON tier handles
+            c.ws();
+            if (c.p >= c.end || *c.p != ':') return fail(INV);
+            c.p++;
+            c.ws();
+            size_t plen = prefix.size();
+            if (plen) prefix.append(sep, seplen);
+            prefix.append(kc.b, kc.len());
+            if (c.p < c.end && *c.p == '{') {
+                if (!flatten_obj(prefix, depth + 1)) return false;
+            } else if (c.p < c.end && *c.p == '[') {
+                return fail(FB);  // array semantics: lower tiers
+            } else {
+                Span v;
+                if (!c.value_span(v, 0)) return fail(c.rc);
+                if (!leaf(prefix, v)) return false;
+            }
+            prefix.resize(plen);
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (c.p < c.end && *c.p == '}') { c.p++; return true; }
+            return fail(INV);
+        }
+    }
+
+    bool record() {
+        c.ws();
+        if (c.p >= c.end || *c.p != '{') return fail(FB);  // non-object element
+        key_pos = 0;
+        std::string prefix;
+        if (!flatten_obj(prefix, 1)) return false;
+        if (key_pos == 0) return fail(FB);  // empty record
+        if (nrec > 0 && key_pos != b.cols.size()) return fail(FB);  // sparse
+        if (!b.end_row()) return fail(FB);
+        nrec++;
+        return true;
+    }
+
+    bool run() {
+        c.ws();
+        if (c.p >= c.end) return fail(INV);
+        if (*c.p == '[') {
+            c.p++;
+            c.ws();
+            if (c.p < c.end && *c.p == ']') { c.p++; }
+            else {
+                while (true) {
+                    if (!record()) return false;
+                    c.ws();
+                    if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+                    if (c.p < c.end && *c.p == ']') { c.p++; break; }
+                    return fail(INV);
+                }
+            }
+        } else if (*c.p == '{') {
+            if (!record()) return false;
+        } else {
+            return fail(FB);
+        }
+        c.ws();
+        if (c.p != c.end) return fail(INV);
+        return true;
+    }
+};
+
+// ---- OTel logs lane: flatten straight to columns --------------------------
+//
+// Mirrors otelj::Builder's walk and value semantics (same truthiness, same
+// severity synthesis, same dup-key declines), but rows land in the shared
+// ColumnarBatch by name with null backfill — exactly the sparse-key union
+// pyarrow's read_json performs over the NDJSON rows today.
+struct OtelColBuilder {
+    ColumnarBatch b;
+    std::vector<Member> ms_b, ms_c, ms_d;
+    int rc = OK;
+    bool ts_as_ms = false;
+
+    // one scope group's shared fields, fully materialized for per-record
+    // replay (spans into the payload stay valid for the whole call, but
+    // strings are unescaped once here instead of once per record)
+    struct Val {
+        int tag = PT_COL_NULL;  // NULL / FLOAT64 / BOOL / STRING
+        double d = 0.0;
+        bool bl = false;
+        std::string s;
+    };
+    struct BaseVal {
+        std::string name;  // column resolved lazily at first record replay:
+        Val v;             // a scope group with zero records must create NO
+        int64_t col = -1;  // columns (the Python flattener emits none)
+    };
+    std::vector<BaseVal> base;
+
+    bool fail(int c_) { rc = c_; return false; }
+
+    uint32_t col_of(std::string_view name) {
+        int64_t i = b.find_col(name);
+        return i >= 0 ? (uint32_t)i : b.create(name);
+    }
+
+    bool add_val(uint32_t ci, const Val& v) {
+        ColBuilder& c = b.cols[ci];
+        switch (v.tag) {
+            case PT_COL_FLOAT64: return b.add_f64(c, v.d);
+            case PT_COL_BOOL: return b.add_bool(c, v.bl);
+            case PT_COL_STRING: return b.add_str_raw(c, v.s.data(), v.s.size());
+            default: return b.add_null(c);
+        }
+    }
+
+    // verbatim scalar -> Val (the text lane's "append the token" emission);
+    // obj/array/bad shapes are the caller's decline
+    bool scalar_to_val(const Span& sp, Val& out) {
+        switch (kind_of(sp)) {
+            case K_STR: {
+                out.tag = PT_COL_STRING;
+                Span sc = str_content(sp);
+                out.s.clear();
+                return unescape_append(sc.b, sc.e, out.s) ? true : fail(FB);
+            }
+            case K_NUM:
+                out.tag = PT_COL_FLOAT64;
+                out.d = parse_double(sp.b, sp.e);
+                return true;
+            case K_TRUE: out.tag = PT_COL_BOOL; out.bl = true; return true;
+            case K_FALSE: out.tag = PT_COL_BOOL; out.bl = false; return true;
+            case K_NULL: out.tag = PT_COL_NULL; return true;
+            default: return fail(FB);
+        }
+    }
+
+    // AnyValue -> Val (mirrors Builder::anyvalue's accept/decline matrix)
+    bool anyvalue_to_val(const Span& v, Val& out) {
+        switch (kind_of(v)) {
+            case K_STR: case K_NUM: case K_TRUE: case K_FALSE: case K_NULL:
+                return scalar_to_val(v, out);
+            case K_OBJ: {
+                Cur c{v.b, v.e};
+                if (!collect(c, ms_d, 0)) return fail(c.rc);
+                if (ms_d.size() != 1) return fail(FB);
+                std::string_view k = ms_d[0].key.view();
+                Span inner = ms_d[0].val;
+                if (k == "stringValue" || k == "bytesValue") {
+                    Kind ik = kind_of(inner);
+                    if (ik == K_OBJ || ik == K_ARR || ik == K_BAD) return fail(FB);
+                    return scalar_to_val(inner, out);
+                }
+                if (k == "intValue") {
+                    long long iv;
+                    if (kind_of(inner) == K_STR) {
+                        if (!parse_i64(str_content(inner).view(), iv)) return fail(FB);
+                    } else if (kind_of(inner) == K_NUM) {
+                        if (!num_is_integer(inner.view())) return fail(FB);
+                        if (!parse_i64(inner.view(), iv)) return fail(FB);
+                    } else {
+                        return fail(FB);
+                    }
+                    out.tag = PT_COL_FLOAT64;
+                    out.d = (double)iv;
+                    return true;
+                }
+                if (k == "doubleValue") {
+                    if (kind_of(inner) == K_NUM) {
+                        out.tag = PT_COL_FLOAT64;
+                        out.d = parse_double(inner.b, inner.e);
+                        return true;
+                    }
+                    if (kind_of(inner) == K_STR) {
+                        Span sc = str_content(inner);
+                        if (!is_json_number(sc.view())) return fail(FB);
+                        out.tag = PT_COL_FLOAT64;
+                        out.d = parse_double(sc.b, sc.e);
+                        return true;
+                    }
+                    return fail(FB);
+                }
+                if (k == "boolValue") {
+                    Kind ik = kind_of(inner);
+                    if (ik != K_TRUE && ik != K_FALSE) return fail(FB);
+                    out.tag = PT_COL_BOOL;
+                    out.bl = ik == K_TRUE;
+                    return true;
+                }
+                return fail(FB);  // arrayValue / kvlistValue / unknown
+            }
+            default:
+                return fail(FB);
+        }
+    }
+
+    // build "<prefix><key>", validating the key bytes
+    bool build_name(std::string_view prefix, std::string_view key,
+                    std::string& out) {
+        if (key.find('\\') != std::string_view::npos) return fail(FB);
+        if (!valid_utf8(key.data(), key.data() + key.size())) return fail(FB);
+        out.assign(prefix);
+        out.append(key);
+        return true;
+    }
+
+    // attributes array -> base vals (to_base) or direct row adds
+    bool attributes(const Span& attrs, std::string_view prefix, bool to_base,
+                    std::string& scratch) {
+        Kind k = kind_of(attrs);
+        if (!attrs.present() || k == K_NULL) return true;
+        if (k != K_ARR) return fail(FB);
+        Cur c{attrs.b, attrs.e};
+        c.p++;
+        c.ws();
+        if (c.p < c.end && *c.p == ']') return true;
+        while (true) {
+            c.ws();
+            if (c.p >= c.end || *c.p != '{') return fail(FB);
+            if (!collect(c, ms_c, 0)) return fail(c.rc);
+            Span key = find(ms_c, "key");
+            std::string_view key_sv;
+            if (key.present()) {
+                if (kind_of(key) != K_STR) return fail(FB);
+                key_sv = str_content(key).view();
+            }
+            if (!build_name(prefix, key_sv, scratch)) return false;
+            Val val;
+            Span v = find(ms_c, "value");
+            if (v.present() && !anyvalue_to_val(v, val)) return false;
+            if (to_base) {
+                if (!push_base(std::string(scratch), std::move(val))) return false;
+            } else if (!add_val(col_of(scratch), val)) {
+                return fail(FB);  // dup key in row / mixed-type column
+            }
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (c.p < c.end && *c.p == ']') return true;
+            return fail(INV);
+        }
+    }
+
+    bool push_base(std::string&& name, Val&& v) {
+        for (const auto& bv : base)
+            if (bv.name == name) return fail(FB);  // dup base key in this group
+        base.push_back(BaseVal{std::move(name), std::move(v)});
+        return true;
+    }
+
+    // truthy scalar -> base or row field under `name` (emit_if_truthy)
+    bool emit_if_truthy(const Span& v, std::string_view name, bool to_base) {
+        if (!v.present()) return true;
+        int t = truthy(v);
+        if (t < 0) return fail(FB);
+        if (t == 0) return true;
+        Val val;
+        if (!scalar_to_val(v, val)) return false;
+        if (to_base) return push_base(std::string(name), std::move(val));
+        return add_val(col_of(name), val) ? true : fail(FB);
+    }
+
+    bool scope_group(const Span& resource, const std::vector<Member>& scope_log) {
+        base.clear();
+        std::string scratch;
+        if (resource.present()) {
+            Kind rk = kind_of(resource);
+            if (rk == K_OBJ) {
+                Cur c{resource.b, resource.e};
+                if (!collect(c, ms_b, 0)) return fail(c.rc);
+                if (!attributes(find(ms_b, "attributes"), "resource_", true, scratch))
+                    return false;
+                Span dropped = find(ms_b, "droppedAttributesCount");
+                if (dropped.present()) {  // `in` check: emitted even when 0/null
+                    Val val;
+                    if (!scalar_to_val(dropped, val)) return false;
+                    if (!push_base(std::string("resource_dropped_attributes_count"),
+                                   std::move(val)))
+                        return false;
+                }
+            } else if (truthy(resource) != 0) {
+                return fail(FB);  // truthy non-dict: Python raises
+            }
+        }
+        Span scope = find(scope_log, "scope");
+        if (scope.present()) {
+            Kind sk = kind_of(scope);
+            if (sk == K_OBJ) {
+                Cur c{scope.b, scope.e};
+                if (!collect(c, ms_b, 0)) return fail(c.rc);
+                if (!emit_if_truthy(find(ms_b, "name"), "scope_name", true))
+                    return false;
+                if (!emit_if_truthy(find(ms_b, "version"), "scope_version", true))
+                    return false;
+                if (!attributes(find(ms_b, "attributes"), "scope_", true, scratch))
+                    return false;
+            } else if (truthy(scope) != 0) {
+                return fail(FB);
+            }
+        }
+        if (!emit_if_truthy(find(scope_log, "schemaUrl"), "schema_url", true))
+            return false;
+        return true;
+    }
+
+    bool col_time(const Span& v, std::string_view name) {
+        uint32_t ci = col_of(name);
+        ColBuilder& col = b.cols[ci];
+        Kind k = kind_of(v);
+        if (!v.present() || k == K_NULL)
+            return b.add_null(col) ? true : fail(FB);
+        long long ns;
+        if (k == K_NUM) {
+            if (!num_is_integer(v.view())) return fail(FB);
+            if (!parse_i64(v.view(), ns)) return fail(FB);  // bigint: Python path
+            if (ns == 0) return b.add_null(col) ? true : fail(FB);
+        } else if (k == K_STR) {
+            std::string_view s = str_content(v).view();
+            if (s.empty() || s == "0") return b.add_null(col) ? true : fail(FB);
+            bool has_digit = false;
+            for (char ch : s) {
+                if (ch >= '0' && ch <= '9') has_digit = true;
+                if ((unsigned char)ch >= 0x80)
+                    return fail(FB);  // int() accepts unicode digits
+            }
+            if (!parse_i64(s, ns)) {
+                // int(s) raises -> None; digit-bearing oddities ("1_0",
+                // " 5", bigints) can still parse in Python
+                if (has_digit) return fail(FB);
+                return b.add_null(col) ? true : fail(FB);
+            }
+        } else {
+            return fail(FB);  // bool: int(True)=1 quirk, Python path
+        }
+        if (ts_as_ms)
+            return b.add_ts_ms(col, floordiv(ns, 1000000LL)) ? true : fail(FB);
+        std::string out;
+        out.reserve(34);
+        if (!fmt_rfc3339_us(ns, out)) return fail(FB);
+        // fmt emits the JSON-quoted token; strip the quotes for the column
+        return b.add_str_raw(col, out.data() + 1, out.size() - 2)
+                   ? true
+                   : fail(FB);
+    }
+
+    bool log_record(const std::vector<Member>& rec) {
+        for (auto& bv : base) {
+            if (bv.col < 0) bv.col = (int64_t)col_of(bv.name);
+            if (!add_val((uint32_t)bv.col, bv.v)) return fail(FB);
+        }
+        if (!col_time(find(rec, "timeUnixNano"), "time_unix_nano")) return false;
+        if (!col_time(find(rec, "observedTimeUnixNano"), "observed_time_unix_nano"))
+            return false;
+        Span sev_num = find(rec, "severityNumber");
+        Span sev_text = find(rec, "severityText");
+        if (sev_num.present() && kind_of(sev_num) != K_NULL) {
+            long long sv;
+            Kind sk = kind_of(sev_num);
+            if (sk == K_NUM) {
+                if (!num_is_integer(sev_num.view()) || !parse_i64(sev_num.view(), sv))
+                    return fail(FB);
+            } else if (sk == K_STR) {
+                if (!parse_i64(str_content(sev_num).view(), sv)) return fail(FB);
+            } else {
+                return fail(FB);
+            }
+            if (!b.add_f64(b.cols[col_of("severity_number")], (double)sv))
+                return fail(FB);
+            ColBuilder& st = b.cols[col_of("severity_text")];
+            int t = sev_text.present() ? truthy(sev_text) : 0;
+            if (t < 0) return fail(FB);
+            if (t == 1 && kind_of(sev_text) == K_STR) {
+                Span sc = str_content(sev_text);
+                if (!b.add_str_unescape(st, sc.b, sc.e)) return fail(FB);
+            } else if (t == 1) {
+                return fail(FB);  // truthy non-string severityText
+            } else if (sv >= 0 && sv <= 24) {
+                const char* txt = SEVERITY_TEXT[sv];
+                if (!b.add_str_raw(st, txt, std::strlen(txt))) return fail(FB);
+            } else {
+                char buf[24];
+                int n = std::snprintf(buf, sizeof(buf), "%lld", sv);
+                if (!b.add_str_raw(st, buf, (size_t)n)) return fail(FB);
+            }
+        } else if (!emit_if_truthy(sev_text, "severity_text", false)) {
+            return false;
+        }
+        // body (always present in the row, null when absent)
+        Val bodyv;
+        Span body = find(rec, "body");
+        if (body.present() && !anyvalue_to_val(body, bodyv)) return false;
+        if (!add_val(col_of("body"), bodyv)) return fail(FB);
+        std::string scratch;
+        if (!attributes(find(rec, "attributes"), "", false, scratch)) return false;
+        Span dropped = find(rec, "droppedAttributesCount");
+        if (dropped.present()) {
+            int t = truthy(dropped);
+            if (t < 0) return fail(FB);
+            if (t == 1) {
+                Val val;
+                if (!scalar_to_val(dropped, val)) return false;
+                if (!add_val(col_of("log_record_dropped_attributes_count"), val))
+                    return fail(FB);
+            }
+        }
+        Span flags = find(rec, "flags");
+        if (flags.present() && kind_of(flags) != K_NULL) {
+            Kind fk = kind_of(flags);
+            if (fk == K_OBJ || fk == K_ARR || fk == K_BAD) return fail(FB);
+            Val val;
+            if (!scalar_to_val(flags, val)) return false;
+            if (!add_val(col_of("flags"), val)) return fail(FB);
+        }
+        if (!emit_if_truthy(find(rec, "traceId"), "trace_id", false)) return false;
+        if (!emit_if_truthy(find(rec, "spanId"), "span_id", false)) return false;
+        return b.end_row() ? true : fail(FB);
+    }
+
+    template <typename Fn>
+    bool each_object(const Span& arr, std::vector<Member>& buf, Fn fn) {
+        Kind k = kind_of(arr);
+        if (!arr.present() || k == K_NULL) return true;
+        if (k != K_ARR) return fail(FB);
+        Cur c{arr.b, arr.e};
+        c.p++;
+        c.ws();
+        if (c.p < c.end && *c.p == ']') return true;
+        while (true) {
+            c.ws();
+            if (c.p >= c.end || *c.p != '{') return fail(FB);
+            if (!collect(c, buf, 0)) return fail(c.rc);
+            if (!fn(buf)) return false;
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (c.p < c.end && *c.p == ']') return true;
+            return fail(INV);
+        }
+    }
+
+    bool run(const char* in, uint64_t len) {
+        Cur c{in, in + len};
+        std::vector<Member> top;
+        if (!collect(c, top, 0)) return fail(c.rc);
+        c.ws();
+        if (c.p != c.end) return fail(INV);
+        Span rls = find(top, "resourceLogs");
+        std::vector<Member> rl_ms;
+        return each_object(rls, rl_ms, [&](const std::vector<Member>& rl) {
+            Span resource = find(rl, "resource");
+            Span scope_logs = find(rl, "scopeLogs");
+            std::vector<Member> sl_buf;
+            return each_object(scope_logs, sl_buf, [&](const std::vector<Member>& sl) {
+                if (!scope_group(resource, sl)) return false;
+                Span records = find(sl, "logRecords");
+                std::vector<Member> rec_buf;
+                return each_object(records, rec_buf,
+                                   [&](const std::vector<Member>& rec) {
+                                       return log_record(rec);
+                                   });
+            });
+        });
+    }
+};
+
+}  // namespace colb
+}  // anonymous namespace
+
+// live columnar handles — exported for the leak tests: every import must
+// pair with exactly one ptpu_cols_free once the Python arrays release
+static std::atomic<long long> g_cols_live{0};
+
+extern "C" {
+
+// Parse+flatten a plain-JSON ingest payload straight into Arrow-layout
+// column buffers. Returns PTPU_FJ_OK with an opaque handle in *out (read
+// via the ptpu_cols_* accessors, release with ptpu_cols_free),
+// PTPU_FJ_FALLBACK when the payload needs a lower tier, or
+// PTPU_FJ_INVALID for malformed JSON.
+int ptpu_flatten_columnar(const char* in, uint64_t len, int max_depth,
+                          const char* sep, void** out) {
+    colb::JsonColCtx ctx;
+    ctx.c = colb::Cur{in, in + len};
+    ctx.max_depth = max_depth;
+    ctx.sep = sep;
+    ctx.seplen = std::strlen(sep);
+    if (!ctx.run()) return ctx.rc == colb::OK ? PTPU_FJ_FALLBACK : ctx.rc;
+    auto* h = new colb::ColumnarBatch(std::move(ctx.b));
+    g_cols_live.fetch_add(1, std::memory_order_relaxed);
+    *out = h;
+    return PTPU_FJ_OK;
+}
+
+// Same, for OTLP-JSON logs payloads (ts_as_ms: time fields as int64
+// epoch-ms -> timestamp(ms) columns; else RFC3339-microsecond strings).
+int ptpu_otel_logs_columnar(const char* in, uint64_t len, int ts_as_ms,
+                            void** out) {
+    colb::OtelColBuilder builder;
+    builder.ts_as_ms = ts_as_ms != 0;
+    if (!builder.run(in, len))
+        return builder.rc == colb::OK ? PTPU_FJ_FALLBACK : builder.rc;
+    auto* h = new colb::ColumnarBatch(std::move(builder.b));
+    g_cols_live.fetch_add(1, std::memory_order_relaxed);
+    *out = h;
+    return PTPU_FJ_OK;
+}
+
+uint64_t ptpu_cols_nrows(void* h) { return ((colb::ColumnarBatch*)h)->nrows; }
+
+uint32_t ptpu_cols_ncols(void* h) {
+    return (uint32_t)((colb::ColumnarBatch*)h)->cols.size();
+}
+
+const char* ptpu_cols_name(void* h, uint32_t i) {
+    return ((colb::ColumnarBatch*)h)->cols[i].name.c_str();
+}
+
+int32_t ptpu_cols_kind(void* h, uint32_t i) {
+    return ((colb::ColumnarBatch*)h)->cols[i].kind;
+}
+
+uint64_t ptpu_cols_null_count(void* h, uint32_t i) {
+    return ((colb::ColumnarBatch*)h)->cols[i].null_count;
+}
+
+const uint8_t* ptpu_cols_validity(void* h, uint32_t i) {
+    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    return c.validity.empty() ? nullptr : c.validity.data();
+}
+
+const uint8_t* ptpu_cols_data(void* h, uint32_t i) {
+    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    switch (c.kind) {
+        case colb::PT_COL_FLOAT64: return (const uint8_t*)c.f64.data();
+        case colb::PT_COL_TS_MS: return (const uint8_t*)c.ts.data();
+        case colb::PT_COL_BOOL: return c.bits.data();
+        case colb::PT_COL_STRING: return (const uint8_t*)c.chars.data();
+        default: return nullptr;
+    }
+}
+
+uint64_t ptpu_cols_data_len(void* h, uint32_t i) {
+    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    switch (c.kind) {
+        case colb::PT_COL_FLOAT64: return c.f64.size() * 8;
+        case colb::PT_COL_TS_MS: return c.ts.size() * 8;
+        case colb::PT_COL_BOOL: return c.bits.size();
+        case colb::PT_COL_STRING: return c.chars.size();
+        default: return 0;
+    }
+}
+
+const int32_t* ptpu_cols_offsets(void* h, uint32_t i) {
+    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    return c.kind == colb::PT_COL_STRING ? c.offsets.data() : nullptr;
+}
+
+void ptpu_cols_free(void* h) {
+    if (h == nullptr) return;
+    delete (colb::ColumnarBatch*)h;
+    g_cols_live.fetch_sub(1, std::memory_order_relaxed);
+}
+
+long long ptpu_cols_live(void) {
+    return g_cols_live.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"

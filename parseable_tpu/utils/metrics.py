@@ -41,6 +41,17 @@ EVENTS_INGESTED_DATE = _gauge(
 EVENTS_INGESTED_SIZE_DATE = _gauge(
     "events_ingested_size_date", "Events ingested size on date", ["stream", "format", "date"]
 )
+# native ingest lane outcomes (server/ingest_utils.py): which tier served
+# each request — columnar (single-pass C++ -> Arrow buffers), ndjson
+# (C++ flatten -> pyarrow reader), or python (both native tiers declined).
+# A rising declined rate means production payloads stopped matching the
+# builders' shape assumptions — the fast path silently became the slow one.
+INGEST_NATIVE = _counter(
+    "ingest_native",
+    "Native ingest lane outcomes (lane: columnar/ndjson/python; "
+    "result: hit/declined)",
+    ["lane", "result"],
+)
 
 # --- storage -------------------------------------------------------------
 STORAGE_SIZE = _gauge("storage_size", "Storage size bytes", ["type", "stream", "format"])

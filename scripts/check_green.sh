@@ -7,6 +7,14 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
+# With a toolchain present, a native fastpath that fails to compile must be
+# a test failure, not a silent pure-Python-fallback green (the columnar
+# ingest tier, xxh64, and HLL would all quietly degrade). Tests read this
+# in conftest pytest_sessionstart; native/__init__.py also hard-raises.
+if command -v g++ >/dev/null 2>&1; then
+  export P_NATIVE_REQUIRED=1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

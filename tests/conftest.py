@@ -47,6 +47,23 @@ def pytest_configure(config):
         config.pluginmanager.register(PsanPytestPlugin(), "psan")
 
 
+def pytest_sessionstart(session):
+    # P_NATIVE_REQUIRED=1 (check_green.sh sets it whenever g++ is present):
+    # a native fastpath that fails to build or load is a hard SESSION
+    # failure, not a silent pure-Python-fallback green. Read via os.environ
+    # for the same import-ordering reason as P_PSAN above; the import here
+    # is safe because psan's patching (if any) already ran in
+    # pytest_configure. native_available() itself raises under the knob.
+    if os.environ.get("P_NATIVE_REQUIRED", "").strip().lower() in ("1", "true", "yes", "on"):
+        from parseable_tpu.native import native_available
+
+        if not native_available():
+            raise pytest.UsageError(
+                "P_NATIVE_REQUIRED=1 but the native fastpath failed to "
+                "build/load — tier-1 must not go green on the Python fallback"
+            )
+
+
 @pytest.fixture(autouse=True)
 def _reap_parseable_pools():
     """Suite-wide backstop for psan's thread-leak detector: every Parseable

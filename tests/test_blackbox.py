@@ -96,3 +96,91 @@ def test_blackbox_cluster_ingest_sync_query(tmp_path):
 
         # both processes still healthy end-to-end
         assert ing.alive() and q.alive()
+
+
+def test_blackbox_kill_ingestor_recover_orphans(tmp_path):
+    """Failure scenario (ROADMAP item 1): SIGKILL an ingestor mid-ingest,
+    restart it on the SAME staging dir, and assert the restarted node's
+    `recover_orphans` salvage makes every row acked before the kill
+    queryable over HTTP again.
+
+    The kill lands in the narrow crash window the salvage branch exists
+    for — the writer closed its IPC footer but died before the
+    `.part.arrows` -> `.arrows` rename. A SIGKILL can't be scheduled
+    inside that microsecond window from outside, so the scenario
+    reconstructs the exact on-disk state the window leaves behind:
+    flush over HTTP (the staging fan-in route forces IPC footers), kill
+    -9, then rename the finished files back to `.part.arrows`."""
+    bb = _load_blackbox()
+    with bb.ClusterHarness(tmp_path) as cluster:
+        # long sync intervals: nothing leaves staging on its own
+        frozen = {
+            "P_LOCAL_SYNC_INTERVAL": "3600",
+            "P_STORAGE_UPLOAD_INTERVAL": "3600",
+        }
+        ing = cluster.spawn("ingest", "ing0", env_extra=frozen)
+        cluster.wait_live(ing)
+
+        rows = [{"host": f"h{i % 2}", "v": float(i)} for i in range(30)]
+        cluster.ingest(ing, "bb", rows)  # 30 rows ACKED over HTTP
+
+        # force the staging flush over HTTP (the querier fan-in route calls
+        # staging_batches -> flush(forced=True)): IPC footers land on disk.
+        # The response body is Arrow IPC, so read it raw rather than as JSON.
+        import urllib.request
+
+        req = urllib.request.Request(f"{ing.url}/api/v1/internal/staging/bb")
+        for k, v in bb.AUTH_HEADER.items():
+            req.add_header(k, v)
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            assert resp.status in (200, 204)
+            resp.read()
+
+        ing.kill()  # SIGKILL: no shutdown hooks, no sync
+        assert not ing.alive()
+
+        staging = tmp_path / "staging-ing0"
+        finished = [
+            f for f in staging.rglob("*.arrows")
+            if not f.name.endswith(".part.arrows")
+        ]
+        assert finished, "flush left no finished staging files"
+        # reconstruct the close-before-rename crash window state
+        for f in finished:
+            f.rename(f.with_name(f.name[: -len("arrows")] + "part.arrows"))
+        assert not list(staging.rglob("*.data.arrows"))
+
+        # restart on the SAME staging dir, with fast sync so salvaged rows
+        # convert + upload; discovery via the stream-list route triggers
+        # load_streams_from_storage -> get_or_create -> recover_orphans
+        ing2 = cluster.spawn(
+            "ingest",
+            "ing0",
+            env_extra={
+                "P_LOCAL_SYNC_INTERVAL": "1",
+                "P_STORAGE_UPLOAD_INTERVAL": "1",
+            },
+        )
+        q = cluster.spawn("query", "q0")
+        cluster.wait_live(ing2)
+        cluster.wait_live(q)
+        status, _ = bb.http_json("GET", f"{ing2.url}/api/v1/logstream")
+        assert status == 200
+
+        def count_rows() -> int:
+            try:
+                recs, _ = cluster.query(q, "SELECT count(*) c FROM bb", "10m", "now")
+            except RuntimeError:
+                return -1
+            return int(recs[0]["c"]) if recs else 0
+
+        deadline = time.monotonic() + 120
+        seen = count_rows()
+        while time.monotonic() < deadline and seen != 30:
+            time.sleep(0.5)
+            seen = count_rows()
+        assert seen == 30, (
+            f"post-restart count {seen} != 30 acked pre-kill; "
+            f"logs: {ing2.log_path.read_text()[-2000:]}"
+        )
+        assert ing2.alive() and q.alive()

@@ -15,7 +15,7 @@ import pytest
 from parseable_tpu.config import Options, StorageOptions
 from parseable_tpu.core import Parseable
 from parseable_tpu.event.format import LogSource
-from parseable_tpu.native import flatten_ndjson, native_available
+from parseable_tpu.native import flatten_columnar, flatten_ndjson, native_available
 from parseable_tpu.server.ingest_utils import flatten_and_push_logs
 
 
@@ -166,7 +166,35 @@ def test_flatten_ndjson_depth_boundary():
         for max_level in range(1, 8):
             py_rejects = has_more_than_max_allowed_levels(payload, max_level)
             native = flatten_ndjson(body, max_level - 1)
+            columnar = flatten_columnar(body, max_level - 1)
             if not py_rejects:
                 assert native is not None, (levels, max_level)
+                assert columnar is not None, (levels, max_level)
             else:
                 assert native is None, (levels, max_level)
+                assert columnar is None, (levels, max_level)
+
+
+def test_columnar_zero_copy_buffers_freed(tmp_path):
+    """The zero-copy import must free the native buffers exactly when the
+    LAST array referencing them is released — no leaks, no double free."""
+    import gc
+
+    from parseable_tpu.native import columnar_live
+
+    gc.collect()
+    base = columnar_live()
+    r = flatten_columnar(b'[{"a": 1.5, "s": "xyz"}, {"a": null, "s": "w"}]', 9)
+    assert r is not None
+    names, arrays, nrows = r
+    assert nrows == 2
+    assert columnar_live() == base + 1
+    # values must stay readable while only ONE array survives
+    keep = arrays[names.index("s")]
+    del r, names, arrays
+    gc.collect()
+    assert columnar_live() == base + 1, "buffers freed while still referenced"
+    assert keep.to_pylist() == ["xyz", "w"]
+    del keep
+    gc.collect()
+    assert columnar_live() == base, "buffers leaked after release"

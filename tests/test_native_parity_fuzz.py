@@ -1,0 +1,394 @@
+"""Native-vs-Python ingest parity fuzz (columnar tentpole).
+
+The native ingest ladder is three tiers — columnar (single-pass C++ ->
+Arrow buffers), NDJSON (C++ flatten -> pyarrow reader), Python — and the
+contract is that ALL THREE stage byte-identical tables for any payload,
+with every decline falling through to the next tier with identical
+user-visible behavior. This suite drives randomized payloads (nested
+dicts, nulls, unicode keys and values, escapes, mixed-type columns,
+arrays, deep nesting, sparse keys, timestampy strings, empty batches)
+through all three lanes and diffs the staged results, asserts declines
+land on the expected tier via the ingest_native{lane,result} counter, and
+checks the zero-copy buffer handoff leaks nothing.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import random
+
+import pyarrow as pa
+import pytest
+
+from parseable_tpu import native
+from parseable_tpu.config import Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.event.format import LogSource
+from parseable_tpu.server.ingest_utils import IngestError, flatten_and_push_logs
+from parseable_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native fastpath unavailable"
+)
+
+
+def lane_total(lane: str, result: str) -> float:
+    return (
+        REGISTRY.get_sample_value(
+            "parseable_ingest_native_total", {"lane": lane, "result": result}
+        )
+        or 0.0
+    )
+
+
+def mk(tmp_path, tag: str) -> Parseable:
+    opts = Options()
+    opts.local_staging_path = tmp_path / f"staging-{tag}"
+    return Parseable(
+        opts, StorageOptions(backend="local-store", root=tmp_path / f"data-{tag}")
+    )
+
+
+def staged(p: Parseable, stream: str):
+    batches = p.streams.get(stream).staging_batches()
+    if not batches:
+        return None
+    return pa.Table.from_batches(batches).drop_columns(["p_timestamp"])
+
+
+def run_three_lanes(trio, stream: str, body: bytes, monkeypatch, source=LogSource.JSON):
+    """Ingest `body` through native-default, NDJSON-forced, and pure-Python
+    and return (counts, tables, lane) — every lane must agree on errors."""
+    p_nat, p_ndj, p_py = trio
+    for p in trio:
+        p.create_stream_if_not_exists(stream)
+    outcomes = []
+    before = {
+        (ln, r): lane_total(ln, r)
+        for ln in ("columnar", "ndjson", "python")
+        for r in ("hit", "declined")
+    }
+    for kind, p in (("nat", p_nat), ("ndj", p_ndj), ("py", p_py)):
+        with monkeypatch.context() as m:
+            if kind == "ndj":
+                m.setattr(native, "flatten_columnar", lambda *a, **k: None)
+                m.setattr(native, "otel_logs_columnar", lambda *a, **k: None)
+            try:
+                if kind == "py":
+                    count = flatten_and_push_logs(
+                        p, stream, json.loads(body), source, {}
+                    )
+                else:
+                    count = flatten_and_push_logs(
+                        p, stream, None, source, {}, raw_body=body
+                    )
+                outcomes.append(("ok", count))
+            except IngestError:
+                outcomes.append(("err", None))
+    kinds = {o[0] for o in outcomes}
+    assert len(kinds) == 1, f"lanes disagree on error-vs-ok: {outcomes}"
+    lane = None
+    for ln in ("columnar", "ndjson", "python"):
+        for r in ("hit", "declined"):
+            if lane_total(ln, r) > before[(ln, r)]:
+                lane = lane or (ln, r)
+    if "err" in kinds:
+        return None, None, lane
+    counts = [o[1] for o in outcomes]
+    assert counts[0] == counts[1] == counts[2], counts
+    tables = [staged(p, stream) for p in trio]
+    if tables[2] is None:
+        assert tables[0] is None and tables[1] is None
+        return counts[0], None, lane
+    for i, t in enumerate(tables[:2]):
+        assert t is not None, f"lane {i} staged nothing, python staged rows"
+        assert t.schema.equals(tables[2].schema), (
+            f"lane {i} schema drift:\n{t.schema}\nvs python\n{tables[2].schema}"
+        )
+        assert t.equals(tables[2]), f"lane {i} values drift"
+    return counts[0], tables[2], lane
+
+
+@pytest.fixture()
+def trio(tmp_path):
+    ps = [mk(tmp_path, t) for t in ("nat", "ndj", "py")]
+    yield ps
+    for p in ps:
+        p.shutdown()
+
+
+# ---------------------------------------------------------------- generators
+
+STRINGS = [
+    "plain",
+    "uni é 漢字",
+    'q"uote',
+    "back\\slash",
+    "nl\nnl",
+    "tab\twhee",
+    "",
+    "2024-05-01T10:00:00Z",
+    "2024-05-01T10:00:00.123456Z",
+    "not a time",
+    "🚀 emoji",
+    "é́ combining",
+]
+
+
+def gen_scalar(rng: random.Random):
+    roll = rng.random()
+    if roll < 0.2:
+        return rng.randrange(-(10**12), 10**12)
+    if roll < 0.4:
+        return rng.uniform(-1e6, 1e6)
+    if roll < 0.5:
+        return bool(rng.getrandbits(1))
+    if roll < 0.6:
+        return None
+    return rng.choice(STRINGS)
+
+
+def gen_value(rng: random.Random, depth: int):
+    roll = rng.random()
+    if depth < 4 and roll < 0.15:
+        return {
+            f"n{j}": gen_value(rng, depth + 1) for j in range(rng.randrange(1, 3))
+        }
+    if roll < 0.22:
+        return [gen_scalar(rng) for _ in range(rng.randrange(0, 3))]
+    return gen_scalar(rng)
+
+
+def gen_payload(rng: random.Random):
+    nrec = rng.randrange(0, 7)
+    ncol = rng.randrange(1, 6)
+    names = []
+    makers = []
+    for i in range(ncol):
+        suffix = rng.choice(["k", "time", "é key", "created_at", "x"])
+        names.append(f"c{i}_{suffix}")
+        if rng.random() < 0.75:
+            # column-typed: uniform batches that should hit the fast tiers
+            proto = gen_scalar(rng)
+
+            def maker(rng, proto=proto):
+                if isinstance(proto, bool):
+                    return bool(rng.getrandbits(1))
+                if isinstance(proto, int):
+                    return rng.randrange(-(10**9), 10**9)
+                if isinstance(proto, float):
+                    return rng.uniform(-1e9, 1e9)
+                if isinstance(proto, str):
+                    return rng.choice(STRINGS)
+                return None
+
+        else:
+
+            def maker(rng):
+                return gen_value(rng, 1)
+
+        makers.append(maker)
+    recs = []
+    for _ in range(nrec):
+        rec = {}
+        for name, maker in zip(names, makers):
+            rec[name] = maker(rng)
+        if rec and rng.random() < 0.08:
+            rec.pop(rng.choice(list(rec)))  # sparse keys -> Python tier
+        recs.append(rec)
+    if nrec == 1 and rng.random() < 0.3:
+        return recs[0]  # single-object payload
+    return recs
+
+
+def gen_otel_payload(rng: random.Random):
+    def any_value(depth=0):
+        roll = rng.random()
+        if roll < 0.25:
+            return {"stringValue": rng.choice(STRINGS)}
+        if roll < 0.45:
+            return {"intValue": str(rng.randrange(-(10**15), 10**15))}
+        if roll < 0.6:
+            return {"doubleValue": rng.uniform(-1e9, 1e9)}
+        if roll < 0.7:
+            return {"boolValue": bool(rng.getrandbits(1))}
+        if roll < 0.78 and depth == 0:
+            return {"arrayValue": {"values": [any_value(1)]}}  # Python tier
+        if roll < 0.88:
+            return rng.choice(STRINGS)  # bare scalar AnyValue
+        return None
+
+    def record(i):
+        rec = {}
+        if rng.random() < 0.9:
+            rec["timeUnixNano"] = rng.choice(
+                [
+                    str(1714521600000000000 + i),
+                    1714521600000000000 + i,
+                    "0",
+                    "",
+                    "not-a-number",
+                ]
+            )
+        if rng.random() < 0.5:
+            rec["observedTimeUnixNano"] = str(1714521700000000000 + i)
+        if rng.random() < 0.6:
+            rec["severityNumber"] = rng.choice([9, 13, "17", 0, 99])
+        if rng.random() < 0.4:
+            rec["severityText"] = rng.choice(["WARN", "", "sev é"])
+        if rng.random() < 0.8:
+            rec["body"] = any_value()
+        if rng.random() < 0.6:
+            rec["attributes"] = [
+                {"key": f"a{j}", "value": any_value()}
+                for j in range(rng.randrange(0, 3))
+            ]
+        if rng.random() < 0.3:
+            rec["traceId"] = f"{i:032x}"
+        if rng.random() < 0.2:
+            rec["flags"] = rng.choice([0, 1, None])
+        return rec
+
+    groups = []
+    for g in range(rng.randrange(1, 3)):
+        scope_logs = []
+        for _s in range(rng.randrange(1, 3)):
+            sl = {"logRecords": [record(i) for i in range(rng.randrange(0, 4))]}
+            if rng.random() < 0.6:
+                sl["scope"] = {"name": f"scope{g}", "version": "1.0"}
+            if rng.random() < 0.3:
+                sl["schemaUrl"] = "https://example/schema"
+            scope_logs.append(sl)
+        rl = {"scopeLogs": scope_logs}
+        if rng.random() < 0.7:
+            rl["resource"] = {
+                "attributes": [
+                    {"key": "service.name", "value": {"stringValue": f"svc{g}"}}
+                ],
+            }
+            if rng.random() < 0.3:
+                rl["resource"]["droppedAttributesCount"] = rng.choice([0, 2, None])
+        groups.append(rl)
+    return {"resourceLogs": groups}
+
+
+# ---------------------------------------------------------------------- fuzz
+
+
+def test_fuzz_json_three_lane_parity(tmp_path, trio, monkeypatch):
+    rng = random.Random(0xC0FFEE)
+    for i in range(60):
+        payload = gen_payload(rng)
+        body = json.dumps(payload).encode()
+        run_three_lanes(trio, f"s{i}", body, monkeypatch)
+    gc.collect()
+    assert native.columnar_live() == 0, "leaked native columnar buffers"
+
+
+def test_fuzz_json_schema_evolution_across_lanes(tmp_path, trio, monkeypatch):
+    """Consecutive batches into ONE stream, each batch through all lanes:
+    schema widening and stored-schema overrides must agree regardless of
+    which lane each batch took."""
+    rng = random.Random(42)
+    for i in range(12):
+        stream = f"evo{i}"
+        for _batch in range(3):
+            payload = gen_payload(rng)
+            body = json.dumps(payload).encode()
+            run_three_lanes(trio, stream, body, monkeypatch)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_fuzz_otel_three_lane_parity(tmp_path, trio, monkeypatch):
+    rng = random.Random(0xBEEF)
+    for i in range(40):
+        payload = gen_otel_payload(rng)
+        body = json.dumps(payload).encode()
+        run_three_lanes(
+            trio, f"o{i}", body, monkeypatch, source=LogSource.OTEL_LOGS
+        )
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+# ------------------------------------------------------------- decline tiers
+
+
+def expect_lane(trio, stream, payload, monkeypatch, expected, source=LogSource.JSON):
+    body = json.dumps(payload).encode()
+    before_hit = {ln: lane_total(ln, "hit") for ln in ("columnar", "ndjson")}
+    before_decl = lane_total("python", "declined")
+    _count, _tbl, _lane = run_three_lanes(trio, stream, body, monkeypatch, source)
+    if expected == "python":
+        assert lane_total("python", "declined") > before_decl
+    else:
+        assert lane_total(expected, "hit") > before_hit[expected], (
+            f"expected {expected} hit for {payload!r}"
+        )
+
+
+def test_declines_land_on_expected_tier(tmp_path, trio, monkeypatch):
+    cases = [
+        ([{"a": 1.5, "b": "x"}, {"a": 2.0, "b": "y"}], "columnar"),
+        ([{"a\nb": 1}], "ndjson"),  # escaped key: columnar declines
+        ([{"a": [1, 2]}], "python"),  # array semantics
+        ([{"a": 1}, {"b": 2}], "python"),  # sparse keys
+        ([{"a": 1}, {"a": "x"}], "python"),  # mixed-type column
+    ]
+    # depth over P_MAX_FLATTEN_LEVEL: every lane declines AND the Python
+    # path raises the same depth error the native lanes defer to
+    deep: dict = {"leaf": 1}
+    for j in range(12):
+        deep = {f"l{j}": deep}
+    cases.append(([deep], "python"))
+    for i, (payload, expected) in enumerate(cases):
+        expect_lane(trio, f"d{i}", payload, monkeypatch, expected)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_non_timestampy_iso_string_hits_columnar(tmp_path, trio, monkeypatch):
+    """The NDJSON tier must decline this shape (read_json eagerly types the
+    ISO string as a timestamp; the dict path stages a string) — but the
+    columnar tier represents it exactly and serves it natively."""
+    payload = [{"note": "2024-05-01T10:00:00Z", "v": 1.0}]
+    before = lane_total("columnar", "hit")
+    _count, tbl, _ = run_three_lanes(
+        trio, "iso", json.dumps(payload).encode(), monkeypatch
+    )
+    assert lane_total("columnar", "hit") > before
+    assert pa.types.is_string(tbl.schema.field("note").type)
+
+
+def test_otel_declines(tmp_path, trio, monkeypatch):
+    base = {
+        "resourceLogs": [
+            {
+                "scopeLogs": [
+                    {
+                        "logRecords": [
+                            {
+                                "timeUnixNano": "1714521600000000000",
+                                "body": {"stringValue": "x"},
+                            }
+                        ]
+                    }
+                ]
+            }
+        ]
+    }
+    expect_lane(trio, "oc", base, monkeypatch, "columnar", LogSource.OTEL_LOGS)
+    nested = json.loads(json.dumps(base))
+    nested["resourceLogs"][0]["scopeLogs"][0]["logRecords"][0]["body"] = {
+        "kvlistValue": {"values": []}
+    }
+    expect_lane(trio, "on", nested, monkeypatch, "python", LogSource.OTEL_LOGS)
+    esc = json.loads(json.dumps(base))
+    esc["resourceLogs"][0]["scopeLogs"][0]["logRecords"][0]["attributes"] = [
+        {"key": 'we"ird\nkey', "value": {"stringValue": "v"}}
+    ]
+    expect_lane(trio, "oe", esc, monkeypatch, "ndjson", LogSource.OTEL_LOGS)
+    gc.collect()
+    assert native.columnar_live() == 0
