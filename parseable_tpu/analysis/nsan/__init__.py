@@ -1,0 +1,115 @@
+"""nsan: the native-code safety gate for the C++ fast path.
+
+The native sibling of plint (static, PR 4/5) and psan (runtime, PR 9),
+covering the one layer those two cannot see: `native/fastpath.cpp` and the
+ctypes FFI surface over it. Three passes, one plint-shaped artifact
+(`/tmp/nsan.json`), one empty-baseline policy:
+
+- **ABI drift** (`abicheck.py`): parse the `extern "C"` declarations out of
+  fastpath.cpp and diff them against the ctypes `restype`/`argtypes`
+  declarations in `native/__init__.py` — missing restype (ctypes defaults
+  to c_int, truncating 64-bit pointers), arity/type mismatches,
+  exported-but-unbound and bound-but-unexported symbols.
+- **Sanitizers** (`build.sh SAN=asan|ubsan` -> libptpu_fastpath_{mode}.so):
+  a `P_NSAN=1` pytest mode runs the native-touching test set against the
+  instrumented library, UBSan-instrumented by default. UBSan is the only
+  mode that is SOUND under late dlopen: ASan's inlined operator delete
+  false-aborts ("not malloc()-ed") on std::string buffers that libstdc++'s
+  out-of-line _M_create allocated with plain malloc — allocator identity
+  is only consistent under a full LD_PRELOAD, which jax's import does not
+  survive. So the pytest pass gets UBSan at full fidelity plus a
+  `ptpu_cols_live == 0` leak gate; ASan/LSan fidelity lives in the
+  preloaded jax-free fuzz child.
+- **Structured fuzzing** (`fuzz.py`): adversarial JSON/OTel payloads driven
+  through the real Python wrappers in a jax-free subprocess under FULL
+  LD_PRELOAD ASan+UBSan+LSan; the minimized regression corpus lives in
+  `tests/corpus/nsan/` and replays in tier-1.
+
+CLI: `python -m parseable_tpu.analysis.nsan` (gate mode; check_green.sh
+runs it), `--fuzz` for the open-ended campaign.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+
+def repo_root() -> Path:
+    import parseable_tpu
+
+    return Path(parseable_tpu.__file__).resolve().parent.parent
+
+
+def native_dir(root: Path) -> Path:
+    return root / "parseable_tpu" / "native"
+
+
+def san_lib_path(root: Path, mode: str = "asan") -> Path:
+    """Mode-specific file name: the mtime cache in build_san_lib could not
+    otherwise tell an asan build from a ubsan build of the same path."""
+    return native_dir(root) / f"libptpu_fastpath_{mode}.so"
+
+
+def corpus_dir(root: Path) -> Path:
+    return root / "tests" / "corpus" / "nsan"
+
+
+def toolchain_available() -> bool:
+    return shutil.which("g++") is not None
+
+
+def asan_runtime() -> str | None:
+    """Path to the toolchain's libasan.so for LD_PRELOAD, or None when the
+    toolchain has no (usable) ASan runtime."""
+    if not toolchain_available():
+        return None
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    path = out.stdout.strip()
+    # an unknown file name is echoed back verbatim (no directory component)
+    if not path or "/" not in path:
+        return None
+    resolved = Path(path).resolve()
+    return str(resolved) if resolved.is_file() else None
+
+
+def build_san_lib(root: Path, mode: str = "asan") -> Path | None:
+    """Build (or reuse) the sanitizer-instrumented library. Returns its
+    path, or None when the toolchain is absent or the build fails. Cached
+    on mtime like the production lib: a san lib newer than fastpath.cpp
+    and build.sh is reused as-is."""
+    if not toolchain_available():
+        return None
+    lib = san_lib_path(root, mode)
+    src_dir = native_dir(root)
+    try:
+        if lib.exists():
+            lib_m = lib.stat().st_mtime
+            if all(
+                (src_dir / dep).stat().st_mtime <= lib_m
+                for dep in ("fastpath.cpp", "build.sh")
+            ):
+                return lib
+    except OSError:
+        pass
+    try:
+        subprocess.run(
+            ["sh", str(src_dir / "build.sh")],
+            check=True,
+            capture_output=True,
+            timeout=300,
+            env={**os.environ, "SAN": mode},
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return lib if lib.exists() else None

@@ -1,0 +1,180 @@
+"""nsan CLI: `python -m parseable_tpu.analysis.nsan`.
+
+Gate mode (default — what scripts/check_green.sh runs):
+  1. ABI drift check (abicheck.py)           — always
+  2. clang-tidy over fastpath.cpp (tidy.py)  — when clang-tidy exists
+  3. corpus replay under the sanitized build + full ASan preload
+     (fuzz.py)                               — when the toolchain exists
+  4. fold tests/corpus/nsan/FUZZ_LOG.json (the recorded fuzz-campaign
+     ledger) into the artifact stats
+
+Findings gate against the shared empty baseline (`.nsan-baseline.json`);
+the artifact (`--json-out`, default P_NSAN_JSON=/tmp/nsan.json) is
+plint-shaped. The `P_NSAN=1` pytest run merges its own section into the
+same artifact afterwards.
+
+`--fuzz` runs the open-ended campaign instead: generated payloads in
+preloaded children for `--seconds` (default P_NSAN_FUZZ_S), minimizing
+and banking any reproducer, and appending a run record to FUZZ_LOG.json.
+
+Exit codes: 0 = clean, 1 = unbaselined findings, 2 = usage/setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import write_baseline
+
+from . import abicheck, build_san_lib, corpus_dir, fuzz, repo_root, tidy
+from .report import DEFAULT_BASELINE, assemble_report, render_lines, write_report
+
+FUZZ_LOG = "FUZZ_LOG.json"
+
+
+def _load_fuzz_log(root: Path) -> dict:
+    path = corpus_dir(root) / FUZZ_LOG
+    try:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+        if isinstance(doc, dict):
+            return doc
+    except (OSError, ValueError):
+        pass
+    return {"runs": [], "total_cpu_seconds": 0.0, "findings": 0}
+
+
+def _append_fuzz_log(root: Path, record: dict) -> dict:
+    doc = _load_fuzz_log(root)
+    doc["runs"].append(record)
+    doc["total_cpu_seconds"] = round(
+        sum(r.get("cpu_seconds", 0.0) for r in doc["runs"]), 1
+    )
+    doc["findings"] = sum(r.get("findings", 0) for r in doc["runs"])
+    path = corpus_dir(root) / FUZZ_LOG
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+    return doc
+
+
+def run_gate(root: Path, baseline: str) -> tuple[dict, list]:
+    findings = []
+    stats: dict = {}
+
+    abi_findings, abi_stats = abicheck.run_abicheck(root)
+    findings += abi_findings
+    stats["abi"] = abi_stats
+
+    tidy_findings, tidy_stats = tidy.run_tidy(root)
+    findings += tidy_findings
+    stats["tidy"] = tidy_stats
+
+    lib = build_san_lib(root, "asan")
+    replay_findings, fuzz_stats = fuzz.replay_corpus(root, lib)
+    findings += replay_findings
+    fuzz_stats["san_lib_built"] = lib is not None
+    stats["fuzz"] = fuzz_stats
+
+    stats["fuzz_campaign"] = _load_fuzz_log(root)
+    return assemble_report(findings, stats, root, baseline), findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m parseable_tpu.analysis.nsan",
+        description="nsan: native-code safety gate (ABI drift, sanitizers, fuzzing)",
+    )
+    p.add_argument("--root", default=None, help="repository root (default: detect)")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--json-out",
+        metavar="FILE",
+        default=None,
+        help="write the JSON artifact to FILE (default: P_NSAN_JSON)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help=f"baseline file relative to --root (default: {DEFAULT_BASELINE})",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="acknowledge every current finding into the baseline file",
+    )
+    p.add_argument(
+        "--fuzz",
+        action="store_true",
+        help="run the open-ended fuzz campaign instead of the gate",
+    )
+    p.add_argument(
+        "--seconds",
+        type=float,
+        default=None,
+        help="fuzz time budget (default: P_NSAN_FUZZ_S)",
+    )
+    p.add_argument(
+        "--seed", type=int, default=None, help="fuzz seed (default: P_NSAN_FUZZ_SEED)"
+    )
+    args = p.parse_args(argv)
+
+    from parseable_tpu.config import nsan_options
+
+    opts = nsan_options()
+    root = Path(args.root).resolve() if args.root else repo_root()
+    json_out = args.json_out or opts["json_path"]
+
+    if args.fuzz:
+        seconds = args.seconds if args.seconds is not None else opts["fuzz_seconds"]
+        seed = args.seed if args.seed is not None else opts["fuzz_seed"]
+        started = time.monotonic()
+        findings, stats = fuzz.fuzz_campaign(root, seconds=seconds, seed=seed)
+        if stats.get("skipped"):
+            print(f"nsan --fuzz: skipped ({stats.get('skip_reason')})", file=sys.stderr)
+            return 2
+        record = {
+            "seed": seed,
+            "seconds_budget": seconds,
+            "wall_seconds": round(time.monotonic() - started, 1),
+            "cpu_seconds": round(stats["cpu_seconds"], 1),
+            "batches": stats["batches"],
+            "executed": stats["executed"],
+            "findings": len(findings),
+            "banked": stats["banked"],
+        }
+        ledger = _append_fuzz_log(root, record)
+        for f in findings:
+            print(f"{f.path}:{f.line}: {f.rule}: {f.message}")
+        print(
+            f"nsan --fuzz: {stats['executed']} payloads in {stats['batches']} "
+            f"batch(es), {len(findings)} finding(s); campaign total "
+            f"{ledger['total_cpu_seconds']}s CPU across {len(ledger['runs'])} run(s)"
+        )
+        return 1 if findings else 0
+
+    report, findings = run_gate(root, args.baseline)
+
+    if args.write_baseline:
+        write_baseline(root / args.baseline, findings)
+        print(f"baseline written: {len(findings)} finding(s) -> {root / args.baseline}")
+        return 0
+
+    if json_out:
+        try:
+            write_report(report, json_out)
+        except OSError as e:
+            print(f"nsan: cannot write artifact to {json_out}: {e}", file=sys.stderr)
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for line in render_lines(report):
+            print(line)
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

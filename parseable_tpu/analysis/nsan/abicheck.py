@@ -1,0 +1,411 @@
+"""nsan ABI-drift checker: fastpath.cpp extern "C" decls vs ctypes bindings.
+
+The FFI boundary is enforced by nothing at runtime: ctypes happily calls a
+function with the wrong arity, the wrong pointer width, or — the classic —
+no declared `restype`, which silently defaults to c_int and truncates
+64-bit pointers/lengths to 32 bits on this ABI. This pass makes the two
+sides of the boundary diff-able:
+
+- `parse_exports`  — regex+brace scan of fastpath.cpp's `extern "C"`
+  blocks into (name, return type, arg types) declarations;
+- `parse_bindings` — AST scan of native/__init__.py's `_bind*` functions
+  into (name, restype, argtypes) declarations;
+- `run_abicheck`   — the diff, as plint `Finding`s gated against the
+  shared empty baseline (`.nsan-baseline.json`).
+
+Rules emitted: nsan-abi-unbound-export, nsan-abi-unexported-binding,
+nsan-abi-missing-restype, nsan-abi-missing-argtypes, nsan-abi-arity,
+nsan-abi-type.
+
+Type compatibility is deliberately coarse where ctypes itself is coarse:
+`c_void_p` may stand in for any C pointer (that is how opaque handles and
+numpy `.ctypes.data_as` buffers cross), `c_char_p` only for byte pointers
+(char/uint8_t/int8_t), `POINTER(T)` must match the pointee width, and
+scalars must match width and signedness exactly. A void return REQUIRES an
+explicit `restype = None` — an absent restype is a finding even for
+int-returning functions, because "explicit everywhere" is the only policy
+a checker can hold the line on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import Finding, normalize_snippet
+
+CPP_REL = "parseable_tpu/native/fastpath.cpp"
+PY_REL = "parseable_tpu/native/__init__.py"
+
+# ---------------------------------------------------------------- C side
+
+
+@dataclass
+class CDecl:
+    name: str
+    ret: str  # canonical type token (see _canon_c_type)
+    args: list[str]
+    line: int
+    raw: str = ""  # first declaration line, for snippets/messages
+
+
+_SCALARS = {
+    "void": "void",
+    "char": "i8",
+    "int8_t": "i8",
+    "uint8_t": "u8",
+    "int32_t": "i32",
+    "uint32_t": "u32",
+    "int64_t": "i64",
+    "uint64_t": "u64",
+    "int": "int",
+    "unsigned": "uint",
+    "unsigned int": "uint",
+    "long long": "i64",
+    "unsigned long long": "u64",
+    "double": "double",
+    "float": "float",
+}
+
+
+def _canon_c_type(text: str) -> str:
+    """Canonical token for one C type: scalars map through _SCALARS, one
+    level of pointer becomes `ptr:<pointee>`, two or more become
+    `ptr:ptr`. const and whitespace are erased."""
+    stars = text.count("*")
+    base = re.sub(r"\bconst\b", " ", text.replace("*", " "))
+    base = " ".join(base.split())
+    tok = _SCALARS.get(base, base or "?")
+    if stars == 0:
+        return tok
+    if stars == 1:
+        return f"ptr:{tok}"
+    return "ptr:ptr"
+
+
+def _split_params(params: str) -> list[str]:
+    params = params.strip()
+    if not params or params == "void":
+        return []
+    out = []
+    for piece in params.split(","):
+        piece = " ".join(piece.split())
+        # strip the trailing parameter name (an identifier not part of the
+        # type); "void** out" -> "void**", "uint64_t n" -> "uint64_t"
+        m = re.match(r"^(.*?[\s*])([A-Za-z_][A-Za-z0-9_]*)$", piece)
+        ty = m.group(1) if m else piece
+        out.append(_canon_c_type(ty))
+    return out
+
+
+def _extern_c_blocks(text: str) -> list[tuple[int, int]]:
+    """(start_offset, end_offset) of every `extern "C" { ... }` body,
+    brace-depth matched (the blocks contain nested braces throughout)."""
+    blocks = []
+    for m in re.finditer(r'extern\s+"C"\s*\{', text):
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        blocks.append((m.end(), i - 1))
+    return blocks
+
+
+_FUNC_RE = re.compile(
+    r"^[ \t]*((?:const[ \t]+)?[A-Za-z_][A-Za-z0-9_]*(?:[ \t]+[A-Za-z_][A-Za-z0-9_]*)*[ \t*]*?)"
+    r"[ \t]+\**[ \t]*(ptpu_[A-Za-z0-9_]+)[ \t]*\(([^)]*)\)[ \t\n]*\{",
+    re.M | re.S,
+)
+
+
+def parse_exports(text: str) -> dict[str, CDecl]:
+    """Every `ptpu_*` function DEFINED inside an extern "C" block. static
+    helpers are skipped (not exported); so is anything outside a block."""
+    blocks = _extern_c_blocks(text)
+    decls: dict[str, CDecl] = {}
+    for m in _FUNC_RE.finditer(text):
+        if not any(s <= m.start() < e for s, e in blocks):
+            continue
+        head = " ".join(m.group(1).split())
+        if head.startswith("static") or "inline" in head.split():
+            continue
+        # pointer stars can attach to the head or the name side; count all
+        stars_src = m.group(0)[: m.group(0).index(m.group(2))]
+        ret_text = head + "*" * (stars_src.count("*") - head.count("*"))
+        name = m.group(2)
+        line = text.count("\n", 0, m.start()) + 1
+        first_line = m.group(0).splitlines()[0].strip()
+        decls[name] = CDecl(
+            name=name,
+            ret=_canon_c_type(ret_text),
+            args=_split_params(m.group(3)),
+            line=line,
+            raw=first_line,
+        )
+    return decls
+
+
+# ----------------------------------------------------------- Python side
+
+
+@dataclass
+class PyDecl:
+    name: str
+    restype: str | None = None  # "None"/"c_uint64"/... ; None = undeclared
+    argtypes: list[str] | None = None
+    restype_line: int = 0
+    argtypes_line: int = 0
+    lines: list[int] = field(default_factory=list)  # every reference
+
+
+def _ctype_token(node: ast.AST) -> str:
+    """Textual token for one ctypes expression: `ctypes.c_uint64` ->
+    "c_uint64", `ctypes.POINTER(ctypes.c_void_p)` -> "POINTER(c_void_p)",
+    `None` -> "None"."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        fn = _ctype_token(node.func)
+        inner = ", ".join(_ctype_token(a) for a in node.args)
+        return f"{fn}({inner})"
+    return "?"
+
+
+def parse_bindings(text: str) -> dict[str, PyDecl]:
+    """Every `<obj>.ptpu_*` attribute touched anywhere in the module, with
+    its declared restype/argtypes. Declarations are recognized from
+    `X.ptpu_N.restype = ...` / `X.ptpu_N.argtypes = [...]` assignments in
+    any function (the `_bind*` family in practice)."""
+    tree = ast.parse(text)
+    decls: dict[str, PyDecl] = {}
+
+    def decl(name: str) -> PyDecl:
+        if name not in decls:
+            decls[name] = PyDecl(name=name)
+        return decls[name]
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Attribute)
+                and t.attr in ("restype", "argtypes")
+                and isinstance(t.value, ast.Attribute)
+                and t.value.attr.startswith("ptpu_")
+            ):
+                d = decl(t.value.attr)
+                d.lines.append(node.lineno)
+                if t.attr == "restype":
+                    d.restype = _ctype_token(node.value)
+                    d.restype_line = node.lineno
+                else:
+                    elems = (
+                        node.value.elts
+                        if isinstance(node.value, (ast.List, ast.Tuple))
+                        else []
+                    )
+                    d.argtypes = [_ctype_token(e) for e in elems]
+                    d.argtypes_line = node.lineno
+                continue
+        if isinstance(node, ast.Attribute) and node.attr.startswith("ptpu_"):
+            d = decl(node.attr)
+            if getattr(node, "lineno", 0):
+                d.lines.append(node.lineno)
+    return decls
+
+
+# ------------------------------------------------------------- the diff
+
+_BYTE_PTRS = {"ptr:i8", "ptr:u8"}
+
+# restype tokens acceptable per canonical C return type
+_RET_OK: dict[str, set[str]] = {
+    "void": {"None"},
+    "u64": {"c_uint64"},
+    "u32": {"c_uint32"},
+    "i32": {"c_int32"},
+    "i64": {"c_longlong", "c_int64"},
+    "int": {"c_int"},
+    "uint": {"c_uint"},
+    "double": {"c_double"},
+    "float": {"c_float"},
+}
+
+_SCALAR_ARG_OK = {
+    "u64": {"c_uint64"},
+    "u32": {"c_uint32"},
+    "i32": {"c_int32"},
+    "i64": {"c_longlong", "c_int64"},
+    "int": {"c_int"},
+    "uint": {"c_uint"},
+    "double": {"c_double"},
+    "float": {"c_float"},
+}
+
+_PTR_POINTEE_OK = {
+    "u64": "POINTER(c_uint64)",
+    "i64": "POINTER(c_longlong)",
+    "u32": "POINTER(c_uint32)",
+    "i32": "POINTER(c_int32)",
+    "void": "POINTER(None)",
+    "ptr": "POINTER(c_void_p)",
+}
+
+
+def _ret_compatible(c_ret: str, restype: str) -> bool:
+    if c_ret.startswith("ptr:"):
+        if restype == "c_void_p":
+            return True
+        return restype == "c_char_p" and c_ret in _BYTE_PTRS
+    return restype in _RET_OK.get(c_ret, set())
+
+
+def _arg_compatible(c_arg: str, pytype: str) -> bool:
+    if c_arg.startswith("ptr:"):
+        if pytype == "c_void_p":
+            return True  # opaque handle / raw buffer address
+        if pytype == "c_char_p":
+            return c_arg in _BYTE_PTRS
+        pointee = c_arg.split(":", 1)[1]
+        return pytype == _PTR_POINTEE_OK.get(pointee, "?")
+    return pytype in _SCALAR_ARG_OK.get(c_arg, set())
+
+
+def _finding(rule: str, path: str, line: int, msg: str, snippet: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=path,
+        line=line,
+        message=msg,
+        context="",
+        snippet=normalize_snippet(snippet),
+    )
+
+
+def diff_abi(
+    exports: dict[str, CDecl], bindings: dict[str, PyDecl], py_lines: list[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def py_snip(line: int) -> str:
+        return py_lines[line - 1] if 1 <= line <= len(py_lines) else ""
+
+    for name, c in sorted(exports.items()):
+        b = bindings.get(name)
+        if b is None:
+            findings.append(
+                _finding(
+                    "nsan-abi-unbound-export",
+                    CPP_REL,
+                    c.line,
+                    f"extern \"C\" {name} is exported but never bound in "
+                    f"{PY_REL} — dead ABI surface, or a binding someone "
+                    "forgot (a later caller would get implicit c_int "
+                    "defaults)",
+                    c.raw,
+                )
+            )
+            continue
+        ref_line = b.restype_line or b.argtypes_line or (b.lines[0] if b.lines else 1)
+        if b.restype is None:
+            findings.append(
+                _finding(
+                    "nsan-abi-missing-restype",
+                    PY_REL,
+                    ref_line,
+                    f"{name} has no declared restype: ctypes defaults to "
+                    f"c_int, truncating the C return ({c.ret}) to 32 bits; "
+                    "declare it explicitly (None for void)",
+                    py_snip(ref_line),
+                )
+            )
+        elif not _ret_compatible(c.ret, b.restype):
+            findings.append(
+                _finding(
+                    "nsan-abi-type",
+                    PY_REL,
+                    b.restype_line,
+                    f"{name} restype {b.restype} is incompatible with the "
+                    f"C return type ({c.ret})",
+                    py_snip(b.restype_line),
+                )
+            )
+        if b.argtypes is None:
+            findings.append(
+                _finding(
+                    "nsan-abi-missing-argtypes",
+                    PY_REL,
+                    ref_line,
+                    f"{name} has no declared argtypes: ctypes will accept "
+                    "any arity and guess conversions per call site",
+                    py_snip(ref_line),
+                )
+            )
+        else:
+            if len(b.argtypes) != len(c.args):
+                findings.append(
+                    _finding(
+                        "nsan-abi-arity",
+                        PY_REL,
+                        b.argtypes_line,
+                        f"{name} declares {len(b.argtypes)} argtypes but the "
+                        f"C signature takes {len(c.args)}",
+                        py_snip(b.argtypes_line),
+                    )
+                )
+            else:
+                for i, (ca, pa) in enumerate(zip(c.args, b.argtypes)):
+                    if not _arg_compatible(ca, pa):
+                        findings.append(
+                            _finding(
+                                "nsan-abi-type",
+                                PY_REL,
+                                b.argtypes_line,
+                                f"{name} argtypes[{i}] is {pa}, incompatible "
+                                f"with the C parameter type ({ca})",
+                                py_snip(b.argtypes_line),
+                            )
+                        )
+    for name, b in sorted(bindings.items()):
+        if name not in exports:
+            line = b.restype_line or b.argtypes_line or (b.lines[0] if b.lines else 1)
+            findings.append(
+                _finding(
+                    "nsan-abi-unexported-binding",
+                    PY_REL,
+                    line,
+                    f"{name} is bound/called in {PY_REL} but fastpath.cpp "
+                    "exports no such symbol — the dlopen-time AttributeError "
+                    "will disable a whole lane at runtime",
+                    py_snip(line),
+                )
+            )
+    return findings
+
+
+def run_abicheck(root: Path) -> tuple[list[Finding], dict]:
+    cpp = (root / CPP_REL).read_text(encoding="utf-8")
+    py = (root / PY_REL).read_text(encoding="utf-8")
+    exports = parse_exports(cpp)
+    bindings = parse_bindings(py)
+    findings = diff_abi(exports, bindings, py.splitlines())
+    stats = {
+        "exports": len(exports),
+        "bindings": len(bindings),
+        "extern_c_blocks": len(_extern_c_blocks(cpp)),
+        "declaration_sites": sum(
+            (1 if b.restype is not None else 0) + (1 if b.argtypes is not None else 0)
+            for b in bindings.values()
+        ),
+    }
+    return findings, stats

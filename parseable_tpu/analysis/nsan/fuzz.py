@@ -1,0 +1,662 @@
+"""nsan structured fuzzer: adversarial payloads through the real FFI.
+
+Two halves, one module:
+
+- **Child** (`python -m parseable_tpu.analysis.nsan.fuzz --lib ... `):
+  a jax-free interpreter that imports `parseable_tpu.native` against the
+  sanitizer-instrumented library (via P_NSAN_LIB) and drives every parse
+  entry point — flatten_ndjson, otel_logs_ndjson, both columnar lanes
+  (including the zero-copy pyarrow import and its ownership machinery),
+  and the HLL/xxh64 batch kernels — with each payload. The parent runs it
+  under FULL `LD_PRELOAD=libasan.so`, which jax's import machinery cannot
+  survive but this child (numpy + pyarrow only) can: heap redzones, UAF
+  detection and LSan all at full fidelity. After every payload the child
+  asserts `ptpu_cols_live() == 0` (exit 78 on drift) and, with
+  `--leak-check`, finishes with `__lsan_do_recoverable_leak_check` (exit
+  77 on leak; libpython's own arenas are suppressed via lsan.supp).
+
+- **Parent** helpers (`replay_corpus`, `fuzz_campaign`, `minimize`): build
+  the preload environment, spawn children, classify failures into plint
+  `Finding`s (nsan-fuzz-crash / nsan-fuzz-leak / nsan-fuzz-cols-live),
+  shrink crashing payloads with a bounded halve-removal loop, and bank
+  them in `tests/corpus/nsan/` for tier-1 replay.
+
+Payload generation is seeded (`random.Random(seed)`) and family-based:
+every adversarial class the C scanner has to survive gets its own
+generator, and a mutation family cross-breeds them with raw byte noise.
+The child writes each payload to a scratch file *before* executing it, so
+a SIGSEGV/SIGABRT leaves the offending input on disk for minimization.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from hashlib import sha1
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import Finding
+
+from . import asan_runtime, corpus_dir, san_lib_path
+
+CHILD_TIMEOUT = 120  # seconds per child invocation
+EXIT_LSAN_LEAK = 77
+EXIT_COLS_LIVE = 78
+EXIT_ASAN_ERROR = 99  # set via ASAN_OPTIONS exitcode=
+
+# ------------------------------------------------------------ generators
+
+
+def _rand_scalar(rng: random.Random):
+    pick = rng.randrange(6)
+    if pick == 0:
+        return rng.randrange(-(10**6), 10**6)
+    if pick == 1:
+        return rng.random() * 10 ** rng.randrange(-300, 300)
+    if pick == 2:
+        return rng.choice([True, False, None])
+    if pick == 3:
+        return "".join(chr(rng.randrange(32, 0x2FFF)) for _ in range(rng.randrange(24)))
+    if pick == 4:
+        return "x" * rng.randrange(0, 300)
+    return rng.choice(["", " ", "\t", "null", "true", "-0", "1e999"])
+
+
+def _rand_record(rng: random.Random, depth: int = 0) -> dict:
+    rec = {}
+    for _ in range(rng.randrange(1, 8)):
+        key = rng.choice(["a", "b", "msg", "ts", "level", "кл", "k" * 40, ""])
+        if depth < 3 and rng.random() < 0.25:
+            rec[key] = _rand_record(rng, depth + 1)
+        elif rng.random() < 0.15:
+            rec[key] = [_rand_scalar(rng) for _ in range(rng.randrange(5))]
+        else:
+            rec[key] = _rand_scalar(rng)
+    return rec
+
+
+def gen_valid_ndjson(rng: random.Random) -> bytes:
+    lines = [json.dumps(_rand_record(rng)) for _ in range(rng.randrange(1, 12))]
+    return "\n".join(lines).encode()
+
+
+def gen_truncated_utf8(rng: random.Random) -> bytes:
+    base = json.dumps({"msg": "päyload-☃-" + "é" * rng.randrange(1, 20)}).encode()
+    # cut inside a multibyte sequence
+    cut = rng.randrange(1, len(base))
+    while cut > 1 and (base[cut] & 0xC0) != 0x80:
+        cut -= 1
+    return base[:cut]
+
+
+def gen_lone_surrogate(rng: random.Random) -> bytes:
+    esc = rng.choice(["\\ud800", "\\udfff", "\\ud83d", "\\ude00\\ud800"])
+    return ('{"msg": "pre' + esc + 'post", "n": 1}').encode()
+
+
+def gen_deep_nesting(rng: random.Random) -> bytes:
+    depth = rng.randrange(20, 120)
+    opener = rng.choice(['{"a":', "["])
+    closer = "}" if opener.startswith("{") else "]"
+    return (opener * depth + "1" + closer * depth).encode()
+
+
+def gen_huge_numbers(rng: random.Random) -> bytes:
+    nums = [
+        "1" * rng.randrange(20, 400),
+        "-" + "9" * 309,
+        "1e" + str(rng.randrange(300, 9999)),
+        "-1e-" + str(rng.randrange(300, 9999)),
+        "0." + "0" * 400 + "1",
+        "-0",
+        str(2**63),
+        str(-(2**63) - 1),
+    ]
+    rec = ",".join(f'"n{i}": {v}' for i, v in enumerate(nums))
+    return ("{" + rec + "}").encode()
+
+
+def gen_nul_bytes(rng: random.Random) -> bytes:
+    body = json.dumps({"msg": "a\\u0000b", "k": 1}).encode()
+    out = bytearray(body)
+    for _ in range(rng.randrange(1, 4)):
+        out.insert(rng.randrange(len(out)), 0)
+    return bytes(out)
+
+
+def gen_pathological_escapes(rng: random.Random) -> bytes:
+    runs = [
+        "\\\\" * rng.randrange(1, 200),
+        "\\u00" + rng.choice(["4", "zz", "GG", ""]),
+        "\\" + rng.choice(["q", "x41", "u12", "u", ""]),
+        "\\n\\t\\r\\f\\b\\/" * rng.randrange(1, 40),
+    ]
+    return ('{"s": "' + rng.choice(runs) + '"}').encode()
+
+
+def gen_boundary_split(rng: random.Random) -> bytes:
+    full = gen_valid_ndjson(rng)
+    if len(full) < 2:
+        return full
+    return full[: rng.randrange(1, len(full))]
+
+
+def gen_otel_shaped(rng: random.Random) -> bytes:
+    rec = {
+        "resourceLogs": [
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name", "value": {"stringValue": "svc"}},
+                        {"key": rng.choice(["", "k"]), "value": rng.choice([{}, 1, None])},
+                    ]
+                },
+                "scopeLogs": [
+                    {
+                        "logRecords": [
+                            {
+                                "timeUnixNano": rng.choice(
+                                    ["1700000000000000000", 17e17, "", None, "-1", "x"]
+                                ),
+                                "severityText": rng.choice(["INFO", "", None, 3]),
+                                "body": rng.choice(
+                                    [
+                                        {"stringValue": "hello"},
+                                        {"kvlistValue": {"values": []}},
+                                        {},
+                                        None,
+                                        "bare",
+                                    ]
+                                ),
+                                "attributes": rng.choice(
+                                    [[], None, [{"key": "a"}], "notalist"]
+                                ),
+                            }
+                        ]
+                    }
+                ],
+            }
+        ]
+    }
+    # structural mutations: drop/retype a random key by round-tripping text
+    text = json.dumps(rec)
+    if rng.random() < 0.5:
+        victim = rng.choice(
+            ['"resourceLogs"', '"scopeLogs"', '"logRecords"', '"value"', '"body"']
+        )
+        text = text.replace(victim, rng.choice(['"x"', victim.upper(), '""']), 1)
+    return text.encode()
+
+
+def gen_byte_mutation(rng: random.Random) -> bytes:
+    base = bytearray(rng.choice([gen_valid_ndjson, gen_otel_shaped])(rng))
+    for _ in range(rng.randrange(1, 1 + max(1, len(base) // 16))):
+        op = rng.randrange(3)
+        pos = rng.randrange(len(base)) if base else 0
+        if op == 0 and base:
+            base[pos] = rng.randrange(256)
+        elif op == 1 and base:
+            del base[pos]
+        else:
+            base.insert(pos, rng.randrange(256))
+    return bytes(base)
+
+
+FAMILIES = [
+    ("valid_ndjson", gen_valid_ndjson),
+    ("truncated_utf8", gen_truncated_utf8),
+    ("lone_surrogate", gen_lone_surrogate),
+    ("deep_nesting", gen_deep_nesting),
+    ("huge_numbers", gen_huge_numbers),
+    ("nul_bytes", gen_nul_bytes),
+    ("pathological_escapes", gen_pathological_escapes),
+    ("boundary_split", gen_boundary_split),
+    ("otel_shaped", gen_otel_shaped),
+    ("byte_mutation", gen_byte_mutation),
+]
+
+
+def gen_payload(rng: random.Random) -> tuple[str, bytes]:
+    name, fn = FAMILIES[rng.randrange(len(FAMILIES))]
+    return name, fn(rng)
+
+
+# ------------------------------------------------------------ child mode
+
+
+def _drive_payload(native, np, payload: bytes) -> int:
+    """Push one payload through every native entry point; returns
+    ptpu_cols_live after releasing everything."""
+    import gc
+
+    native.flatten_ndjson(payload, 6)
+    native.flatten_ndjson(payload, 1, separator=".")
+    native.otel_logs_ndjson(payload)
+    native.otel_logs_ndjson(payload, ts_as_ms=False)
+    r1 = native.flatten_columnar(payload, 6)
+    r2 = native.otel_logs_columnar(payload)
+    del r1, r2
+
+    lines = payload.split(b"\n")[:256] or [b""]
+    buf = bytearray()
+    offs = [0]
+    for ln in lines:
+        buf += ln
+        offs.append(len(buf))
+    p = 4 + (payload[0] % 15) if payload else 14
+    native.hll_idx_rank_batch(bytes(buf), np.asarray(offs, dtype=np.uint64), p)
+    h = native.Hll(p)
+    h.add_strings(ln.decode("utf-8", "replace") for ln in lines)
+    h.add(payload)
+    h.estimate()
+    blob = h.serialize()
+    native.Hll.deserialize(blob, p).estimate()
+    native.xxh64(payload, seed=p)
+    del h
+
+    gc.collect()
+    return native.columnar_live()
+
+
+def child_main(argv: list[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="nsan.fuzz(child)")
+    ap.add_argument("--lib", required=True)
+    ap.add_argument("--replay", nargs="*", default=[])
+    ap.add_argument("--iters", type=int, default=0)
+    ap.add_argument("--seconds", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scratch", default="")
+    ap.add_argument("--leak-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    # the library choice must land before parseable_tpu.native imports
+    os.environ["P_NSAN_LIB"] = args.lib
+    import ctypes
+
+    import numpy as np
+
+    import parseable_tpu.native as native
+
+    if not native.native_available():
+        print(json.dumps({"error": "native library failed to load"}))
+        return 2
+
+    executed = 0
+    deadline = time.monotonic() + args.seconds if args.seconds else None
+    rng = random.Random(args.seed)
+
+    def run_one(payload: bytes) -> int | None:
+        nonlocal executed
+        if args.scratch:
+            Path(args.scratch).write_bytes(payload)
+        live = _drive_payload(native, np, payload)
+        executed += 1
+        if live != 0:
+            print(json.dumps({"executed": executed, "cols_live": live}))
+            return EXIT_COLS_LIVE
+        return None
+
+    for rel in args.replay:
+        rc = run_one(Path(rel).read_bytes())
+        if rc is not None:
+            return rc
+    i = 0
+    while i < args.iters or (deadline and time.monotonic() < deadline):
+        _, payload = gen_payload(rng)
+        rc = run_one(payload)
+        if rc is not None:
+            return rc
+        i += 1
+
+    if args.leak_check:
+        # under the preload, libasan is in the flat namespace
+        try:
+            rt = ctypes.CDLL(None)
+            rc = rt.__lsan_do_recoverable_leak_check()
+        except (OSError, AttributeError):
+            rc = 0  # no LSan runtime loaded: nothing to check
+        if rc != 0:
+            print(json.dumps({"executed": executed, "lsan": "leaked"}))
+            return EXIT_LSAN_LEAK
+    print(json.dumps({"executed": executed, "cols_live": 0}))
+    return 0
+
+
+# ----------------------------------------------------------- parent side
+
+
+def child_env(root: Path, preload: bool = True) -> dict[str, str] | None:
+    """Environment for a fuzz child: full ASan preload + LSan suppressions
+    for the interpreter's own arenas. None when no ASan runtime exists."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("LD_PRELOAD", "ASAN_OPTIONS", "LSAN_OPTIONS", "PYTHONMALLOC")
+    }
+    asan_opts = [
+        "halt_on_error=1",
+        "abort_on_error=0",
+        f"exitcode={EXIT_ASAN_ERROR}",
+        "detect_leaks=1",
+        "leak_check_at_exit=0",  # only the explicit mid-run check gates
+        "allocator_may_return_null=1",
+    ]
+    if preload:
+        rt = asan_runtime()
+        if rt is None:
+            return None
+        env["LD_PRELOAD"] = rt
+    else:
+        asan_opts.append("verify_asan_link_order=0")
+    env["ASAN_OPTIONS"] = ":".join(asan_opts)
+    supp = Path(__file__).parent / "lsan.supp"
+    if supp.is_file():
+        env["LSAN_OPTIONS"] = f"suppressions={supp}"
+    env["PYTHONMALLOC"] = "malloc"  # route CPython allocs through ASan's malloc
+    return env
+
+
+def run_child(
+    root: Path,
+    lib: Path,
+    *,
+    replay: list[Path] | None = None,
+    iters: int = 0,
+    seconds: float = 0.0,
+    seed: int = 0,
+    scratch: Path | None = None,
+    leak_check: bool = True,
+    env: dict[str, str] | None = None,
+) -> subprocess.CompletedProcess | None:
+    if env is None:
+        env = child_env(root)
+    if env is None:
+        return None
+    cmd = [
+        sys.executable,
+        "-m",
+        "parseable_tpu.analysis.nsan.fuzz",
+        "--lib",
+        str(lib),
+        "--seed",
+        str(seed),
+    ]
+    if replay:
+        cmd += ["--replay", *[str(p) for p in replay]]
+    if iters:
+        cmd += ["--iters", str(iters)]
+    if seconds:
+        cmd += ["--seconds", str(seconds)]
+    if scratch:
+        cmd += ["--scratch", str(scratch)]
+    if leak_check:
+        cmd += ["--leak-check"]
+    try:
+        return subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=CHILD_TIMEOUT + seconds,
+            cwd=str(root),
+            env=env,
+        )
+    except subprocess.TimeoutExpired as exc:
+        return subprocess.CompletedProcess(
+            cmd, returncode=-1, stdout=str(exc.stdout or ""), stderr="child timeout"
+        )
+    except OSError:
+        return None
+
+
+def classify_failure(rc: int, stderr: str) -> tuple[str, str] | None:
+    """(rule, short message) for a failing child exit, None when clean."""
+    if rc == 0:
+        return None
+    if rc == EXIT_LSAN_LEAK:
+        return "nsan-fuzz-leak", "LSan reported a native leak after the payload run"
+    if rc == EXIT_COLS_LIVE:
+        return (
+            "nsan-fuzz-cols-live",
+            "ptpu_cols_live drifted above zero after releasing all batches",
+        )
+    if rc == EXIT_ASAN_ERROR or "AddressSanitizer" in stderr:
+        # "CHECK failed" is ASan's INTERNAL assertion (no "ERROR:" prefix) —
+        # it still dies with the configured exitcode, so grab it too or the
+        # headline degrades to the useless fallback
+        head = next(
+            (
+                ln.strip()
+                for ln in stderr.splitlines()
+                if "ERROR: AddressSanitizer" in ln
+                or "CHECK failed" in ln
+                or "runtime error:" in ln
+            ),
+            "AddressSanitizer error",
+        )
+        return "nsan-fuzz-crash", head
+    if "runtime error:" in stderr:
+        head = next(
+            ln.strip() for ln in stderr.splitlines() if "runtime error:" in ln
+        )
+        return "nsan-fuzz-crash", f"UBSan: {head}"
+    if rc < 0:
+        return "nsan-fuzz-crash", f"child died with signal {-rc}"
+    return "nsan-fuzz-crash", f"child exited {rc}"
+
+
+def _payload_fails(root: Path, lib: Path, payload: bytes, env: dict) -> bool:
+    tmp = root / "tests" / "corpus" / ".min-probe.bin"
+    tmp.write_bytes(payload)
+    try:
+        proc = run_child(root, lib, replay=[tmp], leak_check=True, env=env)
+        return proc is None or proc.returncode != 0
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def minimize(root: Path, lib: Path, payload: bytes, budget: int = 48) -> bytes:
+    """Bounded halve-removal shrink: repeatedly try dropping chunks while
+    the child still fails. `budget` caps total child invocations."""
+    env = child_env(root)
+    if env is None:
+        return payload
+    best = payload
+    runs = 0
+    chunk = max(1, len(best) // 2)
+    while chunk >= 1 and runs < budget:
+        i = 0
+        shrunk = False
+        while i < len(best) and runs < budget:
+            cand = best[:i] + best[i + chunk :]
+            runs += 1
+            if cand and _payload_fails(root, lib, cand, env):
+                best = cand
+                shrunk = True
+            else:
+                i += chunk
+        if not shrunk:
+            chunk //= 2
+    # a flaky child exit during the shrink (e.g. an ASan-internal abort
+    # under memory pressure) would have "validated" a bogus removal — only
+    # trust a shrunk payload that still fails on a confirming run
+    if best is not payload and not _payload_fails(root, lib, best, env):
+        return payload
+    return best
+
+
+def bank_case(root: Path, payload: bytes) -> Path:
+    cdir = corpus_dir(root)
+    cdir.mkdir(parents=True, exist_ok=True)
+    name = f"case-{sha1(payload).hexdigest()[:12]}.bin"
+    path = cdir / name
+    path.write_bytes(payload)
+    return path
+
+
+def iter_corpus(root: Path) -> list[Path]:
+    cdir = corpus_dir(root)
+    if not cdir.is_dir():
+        return []
+    return sorted(p for p in cdir.iterdir() if p.suffix == ".bin")
+
+
+def replay_corpus(
+    root: Path, lib: Path | None = None
+) -> tuple[list[Finding], dict]:
+    """Replay the banked corpus under the sanitized build + full preload.
+    One child for the whole corpus; on failure, per-case children assign
+    blame. Skips (with a stats note) when the ASan runtime is absent."""
+    cases = iter_corpus(root)
+    stats: dict = {"corpus_replayed": 0, "corpus_skipped": False}
+    if not cases:
+        return [], stats
+    if lib is None:
+        lib = san_lib_path(root, "asan")
+    env = child_env(root)
+    if env is None or not lib.is_file():
+        stats["corpus_skipped"] = True
+        stats["corpus_skip_reason"] = (
+            "no ASan runtime" if env is None else "sanitized library not built"
+        )
+        return [], stats
+    proc = run_child(root, lib, replay=cases, env=env)
+    stats["corpus_replayed"] = len(cases)
+    if proc is not None and proc.returncode == 0:
+        return [], stats
+    findings: list[Finding] = []
+    for case in cases:
+        p = run_child(root, lib, replay=[case], env=env)
+        rc = -2 if p is None else p.returncode
+        verdict = classify_failure(rc, "" if p is None else p.stderr)
+        if verdict:
+            rule, msg = verdict
+            rel = case.relative_to(root).as_posix()
+            findings.append(
+                Finding(
+                    rule=rule,
+                    path=rel,
+                    line=1,
+                    message=f"corpus case {case.name} failed under the "
+                    f"sanitized build: {msg}",
+                    context="",
+                    snippet=case.name,
+                )
+            )
+    if not findings:
+        # whole-corpus run failed but cases pass individually (ordering /
+        # accumulation effect) — still a finding, pinned to the corpus dir
+        verdict = classify_failure(
+            proc.returncode if proc else -2, proc.stderr if proc else ""
+        )
+        rule, msg = verdict or ("nsan-fuzz-crash", "corpus replay failed")
+        findings.append(
+            Finding(
+                rule=rule,
+                path="tests/corpus/nsan",
+                line=1,
+                message=f"corpus replay failed as a batch but no single case "
+                f"reproduces: {msg}",
+                context="",
+                snippet="batch",
+            )
+        )
+    return findings, stats
+
+
+def fuzz_campaign(
+    root: Path,
+    *,
+    seconds: float = 60.0,
+    seed: int = 0,
+    batch_iters: int = 400,
+) -> tuple[list[Finding], dict]:
+    """Open-ended campaign: batches of generated payloads in preloaded
+    children until the time budget runs out. Crashing payloads are
+    recovered from the scratch file, minimized, and banked in the corpus.
+    Returns findings + bookkeeping (cpu seconds, batches, cases banked)."""
+    from . import build_san_lib
+
+    stats: dict = {
+        "batches": 0,
+        "executed": 0,
+        "cpu_seconds": 0.0,
+        "banked": [],
+        "skipped": False,
+    }
+    lib = build_san_lib(root, "asan")
+    env = child_env(root)
+    if lib is None or env is None:
+        stats["skipped"] = True
+        stats["skip_reason"] = "toolchain or ASan runtime unavailable"
+        return [], stats
+    findings: list[Finding] = []
+    scratch = corpus_dir(root).parent / ".nsan-scratch.bin"
+    scratch.parent.mkdir(parents=True, exist_ok=True)
+    deadline = time.monotonic() + seconds
+    batch_seed = seed
+    while time.monotonic() < deadline:
+        t0 = time.process_time()
+        w0 = time.monotonic()
+        proc = run_child(
+            root,
+            lib,
+            iters=batch_iters,
+            seed=batch_seed,
+            scratch=scratch,
+            env=env,
+        )
+        stats["batches"] += 1
+        # children burn their own CPU; wall time of the child is the
+        # honest lower bound we can account from here
+        stats["cpu_seconds"] += (time.monotonic() - w0) + (time.process_time() - t0)
+        batch_seed += 1
+        if proc is None:
+            stats["skipped"] = True
+            stats["skip_reason"] = "child failed to spawn"
+            break
+        try:
+            tail = json.loads(proc.stdout.strip().splitlines()[-1])
+            stats["executed"] += int(tail.get("executed", 0))
+        except (ValueError, IndexError):
+            pass
+        if proc.returncode == 0:
+            continue
+        verdict = classify_failure(proc.returncode, proc.stderr)
+        if not verdict:
+            continue
+        rule, msg = verdict
+        payload = scratch.read_bytes() if scratch.exists() else b""
+        if payload:
+            payload = minimize(root, lib, payload)
+            banked = bank_case(root, payload)
+            stats["banked"].append(banked.name)
+            loc = banked.relative_to(root).as_posix()
+            # the child's full sanitizer report, next to the reproducer —
+            # triaging a crash that only fired once is hopeless without it
+            # (iter_corpus replays *.bin only, so the .txt never runs)
+            banked.with_suffix(".stderr.txt").write_text(proc.stderr or "")
+        else:
+            loc = "tests/corpus/nsan"
+        findings.append(
+            Finding(
+                rule=rule,
+                path=loc,
+                line=1,
+                message=f"fuzzer (seed {batch_seed - 1}) hit: {msg}; minimized "
+                "reproducer banked in the corpus",
+                context="",
+                snippet=msg,
+            )
+        )
+    scratch.unlink(missing_ok=True)
+    return findings, stats
+
+
+if __name__ == "__main__":
+    sys.exit(child_main(sys.argv[1:]))

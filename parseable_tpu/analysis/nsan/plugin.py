@@ -1,0 +1,132 @@
+"""nsan pytest plugin: run the native-touching test set against the
+sanitizer-instrumented library.
+
+Registered by tests/conftest.py when `P_NSAN=1`:
+
+- `pytest_configure` builds (or reuses) the instrumented library
+  (`libptpu_fastpath_ubsan.so` by default) and points
+  `parseable_tpu.native` at it via P_NSAN_LIB *before collection imports
+  anything native*. In this mode jax can stay loaded: UBSan checks run at
+  full fidelity in-process, and the build's -fno-sanitize-recover makes
+  UB fatal. UBSan is the default because it is the only mode SOUND under
+  late dlopen — ASan's allocator interposition false-aborts on
+  std::string buffers allocated by libstdc++'s out-of-line code (see the
+  package docstring); ASan/LSan fidelity lives in the preloaded jax-free
+  fuzz child (fuzz.py), not here. P_NSAN_SAN=asan remains available for
+  targeted stack/global-redzone hunts, with that caveat.
+- `pytest_sessionfinish` gc-collects, reads `ptpu_cols_live()` and turns
+  a nonzero count into an `nsan-columnar-leak` finding, then MERGES its
+  section into the gate artifact (`P_NSAN_JSON`, default /tmp/nsan.json —
+  the CLI gate writes the ABI/corpus sections first in check_green.sh),
+  flipping a green exit red on unbaselined findings.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import parseable_tpu
+from parseable_tpu.analysis.framework import Finding
+
+
+def _repo_root() -> Path:
+    return Path(parseable_tpu.__file__).resolve().parent.parent
+
+
+class NsanPytestPlugin:
+    def __init__(self):
+        self.root = _repo_root()
+        self.report: dict | None = None
+        self.san_lib: Path | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def pytest_configure(self, config):
+        from parseable_tpu.analysis.nsan import build_san_lib
+        from parseable_tpu.config import nsan_options
+
+        opts = nsan_options()
+        self.san_lib = build_san_lib(self.root, opts["san_mode"])
+        if self.san_lib is None:
+            raise RuntimeError(
+                "nsan: cannot build the sanitized native library "
+                "(toolchain missing?) — run without P_NSAN=1"
+            )
+        os.environ["P_NSAN_LIB"] = str(self.san_lib)
+        # asan mode late-dlopens a library whose runtime needs
+        # verify_asan_link_order=0 in the PROCESS environment: libasan
+        # reads /proc/self/environ, so a mutation here would be invisible —
+        # tests/conftest.py re-execs the interpreter with the option before
+        # anything imports. If that didn't happen (custom runner), fail
+        # fast instead of aborting at first dlopen.
+        if opts["san_mode"] == "asan" and "verify_asan_link_order" not in os.environ.get(
+            "ASAN_OPTIONS", ""
+        ):
+            raise RuntimeError(
+                "nsan: P_NSAN_SAN=asan but ASAN_OPTIONS lacks "
+                "verify_asan_link_order=0 — the sanitized library cannot "
+                "dlopen into this process. Run via tests/conftest.py (it "
+                "re-execs with the right environment) or set "
+                "ASAN_OPTIONS=verify_asan_link_order=0:detect_leaks=0 "
+                "before starting pytest."
+            )
+        config._nsan_lib = str(self.san_lib)
+
+    # ------------------------------------------------------------- wrap-up
+
+    def pytest_sessionfinish(self, session, exitstatus):
+        import gc
+
+        from parseable_tpu import native
+        from parseable_tpu.analysis.nsan import report as _report
+        from parseable_tpu.config import nsan_options
+
+        findings: list[Finding] = []
+        gc.collect()
+        live = native.columnar_live()
+        if live != 0:
+            findings.append(
+                Finding(
+                    rule="nsan-columnar-leak",
+                    path="parseable_tpu/native/fastpath.cpp",
+                    line=1,
+                    message=f"ptpu_cols_live() == {live} after the sanitized "
+                    "test session (expected 0): a ColumnarBatch handle was "
+                    "never released through ptpu_cols_free",
+                    context="",
+                    snippet=f"cols_live={live}",
+                )
+            )
+        stats = {
+            "sanitized_session": {
+                "lib": str(self.san_lib),
+                "tests_exitstatus": int(session.exitstatus),
+                "cols_live": int(live),
+                "native_loaded": bool(native.native_available()),
+            }
+        }
+        self.report = _report.assemble_report(findings, stats, self.root)
+        out = nsan_options()["json_path"] or "/tmp/nsan.json"
+        try:
+            self.report = _report.merge_report(self.report, out)
+        except OSError as e:  # pragma: no cover - artifact is best-effort
+            print(f"nsan: cannot write report to {out}: {e}")
+        if findings and session.exitstatus == 0:
+            # judge only THIS session's findings: merged CLI sections were
+            # already gated by the CLI process itself
+            fresh = {f.fingerprint for f in findings}
+            unbaselined = [
+                f for f in self.report["findings"] if f["fingerprint"] in fresh
+            ]
+            if unbaselined:
+                session.exitstatus = 1
+
+    def pytest_terminal_summary(self, terminalreporter):
+        if self.report is None:
+            return
+        from parseable_tpu.analysis.nsan import report as _report
+
+        terminalreporter.section("nsan (native safety gate, sanitized build)")
+        for line in _report.render_lines(self.report):
+            terminalreporter.write_line(line)

@@ -1,0 +1,98 @@
+"""nsan reporting: baseline gate + JSON artifact, plint-shaped.
+
+Findings carry plint `Finding` fingerprints, so the baseline file
+(`.nsan-baseline.json`, same schema as `.plint-baseline.json`) and the
+JSON artifact (`/tmp/nsan.json` by default, `P_NSAN_JSON` to move it) are
+diffable with the same tooling. Policy matches plint and psan: the
+baseline stays EMPTY — an ABI-drift or sanitizer finding is either fixed
+or explicitly suppressed at the site with a justification, never parked.
+
+One artifact, two writers: the CLI gate (`python -m parseable_tpu.analysis
+.nsan`) writes it first in check_green.sh, and the `P_NSAN=1` pytest run
+merges its own section in afterwards (`merge_report`), so the artifact
+carries the whole picture — ABI diff, corpus replay, fuzz-campaign
+bookkeeping, and the sanitized in-process test session.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import Finding, load_baseline
+
+DEFAULT_BASELINE = ".nsan-baseline.json"
+
+
+def assemble_report(
+    findings: list[Finding],
+    stats: dict,
+    root: Path,
+    baseline: str = DEFAULT_BASELINE,
+) -> dict:
+    baseline_fps = load_baseline(Path(root) / baseline)
+    baselined = [
+        f
+        for f in findings
+        if f.fingerprint in baseline_fps or f.legacy_fingerprint in baseline_fps
+    ]
+    unbaselined = [
+        f
+        for f in findings
+        if f.fingerprint not in baseline_fps
+        and f.legacy_fingerprint not in baseline_fps
+    ]
+    return {
+        "tool": "nsan",
+        "stats": stats,
+        "baselined": [f.to_json() for f in baselined],
+        "findings": [f.to_json() for f in unbaselined],
+        "clean": not unbaselined,
+    }
+
+
+def write_report(report: dict, path: str) -> None:
+    Path(path).write_text(json.dumps(report, indent=2) + "\n", encoding="utf-8")
+
+
+def merge_report(report: dict, path: str) -> dict:
+    """Fold `report` into an existing artifact at `path` (if any): findings
+    and baselined concatenate, stats nest under the writer's `section`
+    key, `clean` ANDs. Returns the merged dict (also written back)."""
+    merged = report
+    p = Path(path)
+    if p.is_file():
+        try:
+            prior = json.loads(p.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            prior = None
+        if isinstance(prior, dict) and prior.get("tool") == "nsan":
+            merged = {
+                "tool": "nsan",
+                "stats": {**prior.get("stats", {}), **report.get("stats", {})},
+                "baselined": prior.get("baselined", []) + report.get("baselined", []),
+                "findings": prior.get("findings", []) + report.get("findings", []),
+                "clean": bool(prior.get("clean", True)) and bool(report.get("clean")),
+            }
+    write_report(merged, path)
+    return merged
+
+
+def render_lines(report: dict) -> list[str]:
+    lines = []
+    for f in report["findings"]:
+        ctx = f" [{f['context']}]" if f.get("context") else ""
+        lines.append(f"{f['path']}:{f['line']}: {f['rule']}{ctx}: {f['message']}")
+    stats = report.get("stats", {})
+    n_base = len(report.get("baselined", []))
+    base_note = f" ({n_base} baselined)" if n_base else ""
+    abi = stats.get("abi", {})
+    fuzz = stats.get("fuzz", {})
+    lines.append(
+        f"nsan: {len(report['findings'])} finding(s){base_note}; "
+        f"{abi.get('exports', 0)} exports vs {abi.get('bindings', 0)} bindings "
+        f"diffed, corpus replayed {fuzz.get('corpus_replayed', 0)} case(s), "
+        f"campaign {stats.get('fuzz_campaign', {}).get('total_cpu_seconds', 0):.0f}s "
+        "CPU recorded"
+    )
+    return lines

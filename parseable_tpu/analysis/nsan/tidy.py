@@ -1,0 +1,74 @@
+"""clang-tidy pass over fastpath.cpp (best-effort, toolchain-gated).
+
+The checks ride the repo's `.clang-tidy` (bugprone-*, cert-*,
+clang-analyzer-*). This container ships g++ only, so the pass degrades to
+a stats note when `clang-tidy` is absent — the .clang-tidy file is still
+authoritative config for any environment that has it, and findings gate
+against the same empty `.nsan-baseline.json` as every other nsan pass.
+"""
+
+from __future__ import annotations
+
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import Finding, normalize_snippet
+
+from .abicheck import CPP_REL
+
+# clang-tidy diagnostic: /abs/path.cpp:LINE:COL: warning: message [check-name]
+_DIAG_RE = re.compile(
+    r"^(?P<path>[^:\n]+):(?P<line>\d+):\d+:\s+(?:warning|error):\s+"
+    r"(?P<msg>.*?)\s+\[(?P<check>[A-Za-z0-9.,_-]+)\]\s*$",
+    re.M,
+)
+
+
+def tidy_available() -> bool:
+    return shutil.which("clang-tidy") is not None
+
+
+def run_tidy(root: Path) -> tuple[list[Finding], dict]:
+    stats: dict = {"ran": False}
+    if not tidy_available():
+        stats["skip_reason"] = "clang-tidy not installed"
+        return [], stats
+    cpp = root / CPP_REL
+    try:
+        proc = subprocess.run(
+            ["clang-tidy", str(cpp), "--quiet", "--", "-std=c++17"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=str(root),
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        stats["skip_reason"] = f"clang-tidy failed to run: {e}"
+        return [], stats
+    stats["ran"] = True
+    lines = cpp.read_text(encoding="utf-8").splitlines()
+    findings: list[Finding] = []
+    for m in _DIAG_RE.finditer(proc.stdout):
+        try:
+            if Path(m.group("path")).resolve() != cpp.resolve():
+                continue  # headers outside the repo are not ours to gate
+        except OSError:
+            continue
+        line = int(m.group("line"))
+        snippet = lines[line - 1] if 1 <= line <= len(lines) else ""
+        # the [check] list can name several; the first is the primary
+        check = m.group("check").split(",")[0]
+        findings.append(
+            Finding(
+                rule=f"nsan-tidy-{check}",
+                path=CPP_REL,
+                line=line,
+                message=m.group("msg"),
+                context="",
+                snippet=normalize_snippet(snippet),
+            )
+        )
+    stats["diagnostics"] = len(findings)
+    return findings, stats

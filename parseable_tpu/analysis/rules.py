@@ -766,6 +766,7 @@ class BlockingInAsyncRule(Rule):
         return None
 
 
+from parseable_tpu.analysis.rules_ffi import FFI_RULES  # noqa: E402
 from parseable_tpu.analysis.rules_interproc import (  # noqa: E402
     INTERPROC_RULES,
     EscapingExceptionRule,
@@ -782,4 +783,5 @@ DEFAULT_RULES = [
     ConfigDriftRule,
     BlockingInAsyncRule,
     *INTERPROC_RULES,
+    *FFI_RULES,
 ]

@@ -1,0 +1,215 @@
+"""plint FFI rules: the ctypes boundary's contracts, enforced statically.
+
+PR 12's nsan gate diffs the declared ABI (abicheck) and beats on the
+native code itself (sanitizers + fuzzing); these two rules close the
+remaining gap — Python-side *usage* of the boundary:
+
+- ffi-restype    no ctypes call on a `ptpu_*` symbol the same module has
+                 not declared BOTH `restype` and `argtypes` for. An
+                 undeclared restype silently defaults to c_int and
+                 truncates 64-bit pointers/lengths; undeclared argtypes
+                 let every call site guess its own conversions.
+- ffi-ownership  native columnar buffers have exactly one custody story:
+                 the producer handle must flow into the `_ColumnarBufs`
+                 owner machinery (or be freed), every `pa.foreign_buffer`
+                 must carry an owner base (a bare foreign_buffer is a
+                 use-after-free the moment the GC drops the handle), and
+                 `ptpu_cols_free` may only run from the owner's __del__ —
+                 anywhere else is a double-free in waiting.
+
+Both are lexical per-file checks, matching the rest of plint: cheap,
+conservative, and specific to the invariants fastpath.cpp's comments can
+state but not enforce.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from parseable_tpu.analysis.framework import (
+    Finding,
+    Rule,
+    SourceFile,
+    attr_chain,
+    enclosing_context,
+)
+
+# the sinks that take custody of a raw columnar handle
+_CUSTODY_SINKS = {"_ColumnarBufs", "_import_columnar", "ptpu_cols_free"}
+_COLUMNAR_PRODUCERS = {"ptpu_flatten_columnar", "ptpu_otel_logs_columnar"}
+
+
+def _enclosing_function(
+    tree: ast.Module, target: ast.AST
+) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+    """Innermost function whose body contains `target`."""
+    best: ast.FunctionDef | ast.AsyncFunctionDef | None = None
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            self._consider(node)
+
+        def visit_AsyncFunctionDef(self, node):
+            self._consider(node)
+
+        def _consider(self, node):
+            nonlocal best
+            for sub in ast.walk(node):
+                if sub is target:
+                    best = node  # keep descending: innermost wins
+                    break
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return best
+
+
+class FfiRestypeRule(Rule):
+    """Every ctypes call on a `ptpu_*` symbol needs the module to have
+    declared that symbol's `restype` AND `argtypes` (the `_bind*` family
+    in native/__init__.py). ctypes' restype default is c_int: on this ABI
+    a 64-bit pointer or length returned through an undeclared symbol comes
+    back truncated — the bug works on small heaps and corrupts memory on
+    big ones, the worst possible failure mode to find dynamically."""
+
+    name = "ffi-restype"
+    description = (
+        "ctypes calls on ptpu_* symbols require declared restype + argtypes"
+    )
+    rationale = (
+        "an undeclared restype defaults to c_int and truncates 64-bit "
+        "returns; undeclared argtypes make every call site guess conversions"
+    )
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        declared_restype: set[str] = set()
+        declared_argtypes: set[str] = set()
+        calls: list[tuple[str, ast.Call]] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Attribute)
+                    and t.value.attr.startswith("ptpu_")
+                ):
+                    if t.attr == "restype":
+                        declared_restype.add(t.value.attr)
+                    elif t.attr == "argtypes":
+                        declared_argtypes.add(t.value.attr)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and node.func.attr.startswith(
+                    "ptpu_"
+                ):
+                    calls.append((node.func.attr, node))
+        for name, call in calls:
+            missing = []
+            if name not in declared_restype:
+                missing.append("restype")
+            if name not in declared_argtypes:
+                missing.append("argtypes")
+            if missing:
+                yield Finding(
+                    rule=self.name,
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        f"call to {name} without declared {' or '.join(missing)} "
+                        "in this module — ctypes falls back to c_int returns "
+                        "and per-call-site argument guessing"
+                    ),
+                    context=enclosing_context(sf.tree, call),
+                )
+
+
+class FfiOwnershipRule(Rule):
+    """Columnar buffer custody: one producer handle, one owner, one free.
+
+    Three checks on the zero-copy import path:
+    - `pa.foreign_buffer(ptr, size)` without the third `base` argument
+      gives Arrow a raw pointer with no liveness anchor — the native batch
+      can be freed while the Array still reads it;
+    - a function that calls a columnar producer (`ptpu_flatten_columnar`,
+      `ptpu_otel_logs_columnar`) must hand the handle to the custody
+      machinery (`_ColumnarBufs` / `_import_columnar`) or free it —
+      otherwise the handle leaks (ptpu_cols_live drifts, the nsan session
+      gate goes red at runtime; this catches it at review time);
+    - `ptpu_cols_free` belongs to `_ColumnarBufs.__del__` alone: a second
+      call site is a double-free the moment both run."""
+
+    name = "ffi-ownership"
+    description = (
+        "native columnar buffers need an owner base and exactly one free path"
+    )
+    rationale = (
+        "a foreign_buffer without a base is a use-after-free; a producer "
+        "handle that skips the owner leaks; a second ptpu_cols_free site "
+        "is a double-free"
+    )
+
+    def check(self, sf: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            tail = chain[-1] if chain else ""
+            if tail == "foreign_buffer":
+                has_base = len(node.args) >= 3 or any(
+                    kw.arg == "base" for kw in node.keywords
+                )
+                if not has_base:
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            "pa.foreign_buffer without an owner base: the "
+                            "Arrow buffer holds a raw pointer with nothing "
+                            "keeping the native allocation alive"
+                        ),
+                        context=enclosing_context(sf.tree, node),
+                    )
+            elif tail in _COLUMNAR_PRODUCERS:
+                fn = _enclosing_function(sf.tree, node)
+                scope_names = {
+                    n
+                    for sub in ast.walk(fn if fn is not None else sf.tree)
+                    for n in (
+                        [sub.id]
+                        if isinstance(sub, ast.Name)
+                        else [sub.attr]
+                        if isinstance(sub, ast.Attribute)
+                        else []
+                    )
+                }
+                if not (scope_names & _CUSTODY_SINKS):
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{tail} produces an owned columnar handle but "
+                            "this function never passes it to _ColumnarBufs/"
+                            "_import_columnar or ptpu_cols_free — the batch "
+                            "leaks (ptpu_cols_live will drift)"
+                        ),
+                        context=enclosing_context(sf.tree, node),
+                    )
+            elif tail == "ptpu_cols_free":
+                ctx = enclosing_context(sf.tree, node)
+                if not ctx.endswith("_ColumnarBufs.__del__"):
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            "ptpu_cols_free outside _ColumnarBufs.__del__: "
+                            "the owner already frees on last release, so a "
+                            "second call site is a double-free in waiting"
+                        ),
+                        context=ctx,
+                    )
+
+
+FFI_RULES = [FfiRestypeRule, FfiOwnershipRule]

@@ -153,6 +153,30 @@ def psan_options() -> dict:
     }
 
 
+def nsan_options() -> dict:
+    """Knobs for the native-code safety gate (analysis/nsan).
+
+    Same placement rationale as psan_options: declared here so every
+    P_NSAN* knob rides the config-drift rule's README guarantee. P_NSAN
+    itself is read by tests/conftest.py before this package imports;
+    P_NSAN_LIB is read by parseable_tpu.native._lib_path through env_str
+    (the nsan driver points the binding at the instrumented library with
+    it — auto-build and staleness checks are the driver's job for that
+    path, not the binding's)."""
+    return {
+        "enabled": _env_bool("P_NSAN", False),
+        "lib": _env("P_NSAN_LIB"),
+        # ubsan is the only sound default for the in-process pytest pass:
+        # ASan's allocator interposition false-aborts under late dlopen
+        # (see analysis/nsan/__init__.py) — asan stays available for the
+        # preloaded fuzz children, which build it explicitly
+        "san_mode": _env("P_NSAN_SAN", "ubsan"),
+        "fuzz_seconds": _env_float("P_NSAN_FUZZ_S", 60.0),
+        "fuzz_seed": _env_int("P_NSAN_FUZZ_SEED", 0),
+        "json_path": _env("P_NSAN_JSON", "/tmp/nsan.json"),
+    }
+
+
 @dataclass
 class Options:
     """All server options. Defaults mirror the reference (src/cli.rs:135-641)."""

@@ -24,6 +24,11 @@ _DIR = Path(__file__).parent
 _LIB_PATH = _DIR / "libptpu_fastpath.so"
 _lib = None
 _load_failed = False  # negative cache: never retry build/dlopen per call
+# columnar lane bound? A stale .so can carry the core ABI (hash/HLL/NDJSON)
+# but predate the columnar exports — that must disable ONLY the columnar
+# tier (counted, so a fleet quietly running one lane down is visible in
+# metrics), not the whole library.
+_columnar_ok = False
 
 
 def _build() -> bool:
@@ -46,8 +51,19 @@ def _required() -> bool:
     return env_bool("P_NATIVE_REQUIRED", False)
 
 
+def _lib_path() -> Path:
+    # P_NSAN_LIB (analysis/nsan): load the sanitizer-instrumented build
+    # instead of the production library. The nsan driver owns that
+    # artifact's build/staleness, so _load() skips the auto-(re)build for
+    # it — a missing instrumented lib is a plain load failure.
+    from parseable_tpu.config import env_str
+
+    alt = env_str("P_NSAN_LIB")
+    return Path(alt) if alt else _LIB_PATH
+
+
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed
+    global _lib, _load_failed, _columnar_ok
     if _lib is not None:
         return _lib
     if _load_failed:
@@ -56,26 +72,28 @@ def _load() -> ctypes.CDLL | None:
                 "P_NATIVE_REQUIRED=1 but the native fastpath failed to load"
             )
         return None
-    # rebuild BEFORE the first dlopen when the source is newer than the
-    # library (an in-place upgrade leaves a stale .so whose missing newer
-    # exports would otherwise break symbol binding) — after dlopen the
-    # loader caches the mapping, so rebuild-and-reload can't be trusted
-    try:
-        stale = (
-            _LIB_PATH.exists()
-            and (_DIR / "fastpath.cpp").stat().st_mtime > _LIB_PATH.stat().st_mtime
-        )
-    except OSError:
-        stale = False
-    if (not _LIB_PATH.exists() or stale) and not _build() and not _LIB_PATH.exists():
-        _load_failed = True
-        if _required():
-            raise RuntimeError(
-                "P_NATIVE_REQUIRED=1 but the native fastpath failed to build"
+    lib_path = _lib_path()
+    if lib_path == _LIB_PATH:
+        # rebuild BEFORE the first dlopen when the source is newer than the
+        # library (an in-place upgrade leaves a stale .so whose missing newer
+        # exports would otherwise break symbol binding) — after dlopen the
+        # loader caches the mapping, so rebuild-and-reload can't be trusted
+        try:
+            stale = (
+                lib_path.exists()
+                and (_DIR / "fastpath.cpp").stat().st_mtime > lib_path.stat().st_mtime
             )
-        return None
+        except OSError:
+            stale = False
+        if (not lib_path.exists() or stale) and not _build() and not lib_path.exists():
+            _load_failed = True
+            if _required():
+                raise RuntimeError(
+                    "P_NATIVE_REQUIRED=1 but the native fastpath failed to build"
+                )
+            return None
     try:
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = ctypes.CDLL(str(lib_path))
     except OSError as e:
         logger.warning("native fastpath load failed (%s)", e)
         _load_failed = True
@@ -85,9 +103,9 @@ def _load() -> ctypes.CDLL | None:
             ) from e
         return None
     try:
-        _bind(lib)
+        _bind_core(lib)
     except AttributeError as e:
-        # a stale .so lacking ANY current export (no hand-picked sentinel):
+        # a stale .so lacking ANY core export (no hand-picked sentinel):
         # Python fallbacks everywhere, never a crash
         logger.warning("native fastpath is stale (%s); using Python fallbacks", e)
         _load_failed = True
@@ -96,25 +114,72 @@ def _load() -> ctypes.CDLL | None:
                 f"P_NATIVE_REQUIRED=1 but the native fastpath is stale: {e}"
             ) from e
         return None
+    try:
+        _bind_columnar(lib)
+        _columnar_ok = True
+    except AttributeError as e:
+        # the .so predates the columnar ABI: ONLY that tier degrades (the
+        # NDJSON lane and hash/HLL still run native). Counted so a lane
+        # quietly running degraded shows up in the ingest metrics, and a
+        # hard failure under P_NATIVE_REQUIRED=1 — a toolchain is present,
+        # so a partial library is a build bug, not an environment fact.
+        _columnar_ok = False
+        logger.warning(
+            "native fastpath lacks the columnar ABI (%s); columnar lane disabled",
+            e,
+        )
+        if _required():
+            raise RuntimeError(
+                f"P_NATIVE_REQUIRED=1 but the native fastpath lacks the "
+                f"columnar ABI: {e}"
+            ) from e
+        from parseable_tpu.utils.metrics import INGEST_NATIVE
+
+        INGEST_NATIVE.labels("columnar", "bind-failed").inc()
     _lib = lib
     return lib
 
 
-def _bind(lib: ctypes.CDLL) -> None:
-    """Declare every export's signature; raises AttributeError when the
-    loaded library predates any of them."""
+def _bind_core(lib: ctypes.CDLL) -> None:
+    """Declare the hash/HLL/NDJSON exports' signatures; raises
+    AttributeError when the loaded library predates any of them.
+
+    Every binding declares BOTH restype and argtypes, explicitly — void
+    functions get `restype = None`. ctypes defaults a missing restype to
+    c_int, which silently truncates 64-bit returns to 32 bits on this ABI;
+    the nsan ABI-drift checker (analysis/nsan/abicheck.py) diffs these
+    declarations against fastpath.cpp's extern "C" blocks and fails the
+    gate on any omission or mismatch."""
     lib.ptpu_xxh64.restype = ctypes.c_uint64
     lib.ptpu_xxh64.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.ptpu_xxh64_batch.restype = None
+    lib.ptpu_xxh64_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+    ]
     lib.ptpu_hll_create.restype = ctypes.c_void_p
     lib.ptpu_hll_create.argtypes = [ctypes.c_uint32]
+    lib.ptpu_hll_free.restype = None
     lib.ptpu_hll_free.argtypes = [ctypes.c_void_p]
+    lib.ptpu_hll_add.restype = None
     lib.ptpu_hll_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_hll_add_batch.restype = None
     lib.ptpu_hll_add_batch.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
         ctypes.c_void_p,
         ctypes.c_uint64,
     ]
+    lib.ptpu_hll_add_hashes.restype = None
+    lib.ptpu_hll_add_hashes.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+    ]
+    lib.ptpu_hll_idx_rank_batch.restype = None
     lib.ptpu_hll_idx_rank_batch.argtypes = [
         ctypes.c_void_p,
         ctypes.c_void_p,
@@ -129,6 +194,7 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ptpu_hll_estimate.argtypes = [ctypes.c_void_p]
     lib.ptpu_hll_bytes.restype = ctypes.c_uint64
     lib.ptpu_hll_bytes.argtypes = [ctypes.c_void_p]
+    lib.ptpu_hll_serialize.restype = None
     lib.ptpu_hll_serialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptpu_hll_deserialize.restype = ctypes.c_int
     lib.ptpu_hll_deserialize.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
@@ -151,8 +217,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64),
     ]
+    lib.ptpu_free.restype = None
     lib.ptpu_free.argtypes = [ctypes.c_void_p]
-    # columnar tier: single-pass parse -> Arrow-layout buffers
+
+
+def _bind_columnar(lib: ctypes.CDLL) -> None:
+    """Declare the columnar-tier exports (single-pass parse -> Arrow-layout
+    buffers); raises AttributeError when the library predates the tier —
+    _load() then disables only this lane, never the whole library."""
     lib.ptpu_flatten_columnar.restype = ctypes.c_int
     lib.ptpu_flatten_columnar.argtypes = [
         ctypes.c_char_p,
@@ -186,6 +258,7 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ptpu_cols_data_len.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.ptpu_cols_offsets.restype = ctypes.c_void_p
     lib.ptpu_cols_offsets.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ptpu_cols_free.restype = None
     lib.ptpu_cols_free.argtypes = [ctypes.c_void_p]
     lib.ptpu_cols_live.restype = ctypes.c_longlong
     lib.ptpu_cols_live.argtypes = []
@@ -287,7 +360,9 @@ class _ColumnarBufs:
 def columnar_live() -> int:
     """Native columnar results not yet freed (leak-detector hook)."""
     lib = _load()
-    return int(lib.ptpu_cols_live()) if lib is not None else 0
+    if lib is None or not _columnar_ok:
+        return 0
+    return int(lib.ptpu_cols_live())
 
 
 def _import_columnar(lib, handle: int):
@@ -348,7 +423,7 @@ def flatten_columnar(payload: bytes, max_depth: int, separator: str = "_"):
     exactly like the NDJSON lane, plus escaped keys, lone surrogates and
     other columnar-only declines."""
     lib = _load()
-    if lib is None:
+    if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
     rc = lib.ptpu_flatten_columnar(
@@ -366,7 +441,7 @@ def otel_logs_columnar(payload: bytes, ts_as_ms: bool = True):
     emits the time fields as timestamp(ms) columns directly. Returns
     (names, arrays, nrows) or None when the payload needs a lower tier."""
     lib = _load()
-    if lib is None:
+    if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
     rc = lib.ptpu_otel_logs_columnar(
@@ -396,10 +471,15 @@ def hll_idx_rank_batch(
     crossing for a whole dictionary (ops/hll_sketch.py cold-block LUTs).
     offsets: uint64[n+1]. Returns (idx int32[n], rank int32[n]) or None
     when the native library is unavailable."""
+    # nsan finding (UBSan shift-exponent): p outside [4, 18] shifted a
+    # uint64 by >= 64 in the C kernel. The C side now zero-fills instead of
+    # invoking UB, but a bad p here is a caller bug — refuse loudly.
+    if not 4 <= p <= 18:
+        raise ValueError(f"hll_idx_rank_batch: p={p} outside [4, 18]")
     lib = _load()
     if lib is None:
         return None
-    n = len(offsets) - 1
+    n = max(0, len(offsets) - 1)
     idx = np.empty(n, dtype=np.int32)
     rank = np.empty(n, dtype=np.int32)
     if n:

@@ -1,21 +1,64 @@
 #!/bin/sh
 # Build the native fastpath shared library (no external deps).
+#
+# SAN=none (default) builds the production libptpu_fastpath.so.
+# SAN=asan / SAN=ubsan build the instrumented libptpu_fastpath_asan.so /
+# libptpu_fastpath_ubsan.so the nsan gate loads (analysis/nsan):
+# ASan+UBSan or UBSan-only, -O1 -g with frame pointers so sanitizer
+# reports carry real frames, and -fno-sanitize-recover=undefined so UB
+# halts instead of logging past the first corruption (the runtime
+# halt_on_error side lives in the nsan driver's ASAN_OPTIONS). The two
+# modes get DISTINCT file names on purpose: nsan's mtime cache could not
+# otherwise tell which mode a cached .so was built in.
 set -e
 cd "$(dirname "$0")"
-# -fno-semantic-interposition: exported C symbols stay overridable-safe
-# while intra-library calls inline (interposition semantics cost ~6x on
-# the parse hot loops under -fPIC)
-g++ -O3 -march=native -fno-semantic-interposition -fPIC -shared -std=c++17 fastpath.cpp -o libptpu_fastpath.so
+
+SAN="${SAN:-none}"
+case "$SAN" in
+  none)
+    OUT=libptpu_fastpath.so
+    # -fno-semantic-interposition: exported C symbols stay overridable-safe
+    # while intra-library calls inline (interposition semantics cost ~6x on
+    # the parse hot loops under -fPIC)
+    FLAGS="-O3 -march=native -fno-semantic-interposition"
+    ;;
+  asan)
+    OUT=libptpu_fastpath_asan.so
+    FLAGS="-O1 -g -fsanitize=address,undefined -fno-sanitize-recover=undefined -fno-omit-frame-pointer"
+    ;;
+  ubsan)
+    OUT=libptpu_fastpath_ubsan.so
+    FLAGS="-O1 -g -fsanitize=undefined -fno-sanitize-recover=undefined -fno-omit-frame-pointer"
+    ;;
+  *)
+    echo "build.sh: unknown SAN=$SAN (expected asan|ubsan|none)" >&2
+    exit 2
+    ;;
+esac
+
+g++ $FLAGS -fPIC -shared -std=c++17 fastpath.cpp -o "$OUT"
+
 # sanity: the columnar ingest ABI must be present — a truncated/stale build
 # would otherwise dlopen fine and silently push every request down a tier
 # (the Python binding's _bind() would catch it, but fail the build here,
-# where the error is actionable)
+# where the error is actionable). nm -D first, objdump -T when nm is
+# missing or prints nothing; an empty symbol table from both is a hard
+# failure, never a vacuous pass.
+syms=""
 if command -v nm >/dev/null 2>&1; then
-  for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free; do
-    nm -D libptpu_fastpath.so | grep -q " $sym\$" || {
-      echo "build.sh: missing export $sym" >&2
-      exit 1
-    }
-  done
+  syms="$(nm -D "$OUT" 2>/dev/null || true)"
 fi
-echo "built $(pwd)/libptpu_fastpath.so"
+if [ -z "$syms" ] && command -v objdump >/dev/null 2>&1; then
+  syms="$(objdump -T "$OUT" 2>/dev/null || true)"
+fi
+if [ -z "$syms" ]; then
+  echo "build.sh: cannot read the dynamic symbol table of $OUT (nm -D and objdump -T both unavailable or empty) — refusing to pass vacuously" >&2
+  exit 1
+fi
+for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free; do
+  printf '%s\n' "$syms" | grep -q "[[:space:]]$sym\$" || {
+    echo "build.sh: missing export $sym" >&2
+    exit 1
+  }
+done
+echo "built $(pwd)/$OUT"

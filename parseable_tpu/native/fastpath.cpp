@@ -202,6 +202,18 @@ double ptpu_hll_estimate(const void* ptr) {
 void ptpu_hll_idx_rank_batch(const uint8_t* buf, const uint64_t* offsets,
                              uint64_t n, uint32_t p, int32_t* idx_out,
                              int32_t* rank_out) {
+    // nsan finding (UBSan shift-exponent): p outside the register-sketch
+    // range made `h >> (64 - p)` / `h << p` shift by >= 64. Accept only the
+    // same [4, 18] window as ptpu_hll_create; anything else zero-fills the
+    // outputs deterministically (the Python binding validates first and
+    // never issues such a call — this is the ABI-level backstop).
+    if (p < 4 || p > 18) {
+        for (uint64_t i = 0; i < n; i++) {
+            idx_out[i] = 0;
+            rank_out[i] = 0;
+        }
+        return;
+    }
     for (uint64_t i = 0; i < n; i++) {
         uint64_t h = ptpu_xxh64(buf + offsets[i], offsets[i + 1] - offsets[i], 0);
         idx_out[i] = (int32_t)(h >> (64 - p));
@@ -469,9 +481,12 @@ int ptpu_flatten_ndjson(const char* in, uint64_t len, int max_depth,
     ctx.seplen = std::strlen(sep);
     ctx.out.reserve((size_t)(len + len / 4));
     if (!ctx.run()) return ctx.rc;
-    char* buf = (char*)std::malloc(ctx.out.size());
+    // nsan finding (UBSan nonnull): malloc(0) may return nullptr, and
+    // memcpy with a null pointer is UB even for zero bytes — allocate at
+    // least one byte and copy only when there is output.
+    char* buf = (char*)std::malloc(ctx.out.empty() ? 1 : ctx.out.size());
     if (!buf) return PTPU_FJ_FALLBACK;
-    std::memcpy(buf, ctx.out.data(), ctx.out.size());
+    if (!ctx.out.empty()) std::memcpy(buf, ctx.out.data(), ctx.out.size());
     *out = buf;
     *out_len = ctx.out.size();
     *nrows = ctx.nrows;
@@ -1236,9 +1251,13 @@ int ptpu_otel_logs_ndjson(const char* in, uint64_t len, int ts_as_ms,
     b.ts_as_ms = ts_as_ms != 0;
     b.out.reserve((size_t)(len + len / 4));
     if (!b.run(in, len)) return b.rc == otelj::OK ? PTPU_FJ_FALLBACK : b.rc;
-    char* buf = (char*)std::malloc(b.out.size());
-    if (buf == nullptr && b.out.size() > 0) return PTPU_FJ_FALLBACK;
-    std::memcpy(buf, b.out.data(), b.out.size());
+    // nsan finding (UBSan nonnull): an empty-output payload (e.g.
+    // {"resourceLogs":[]}) hit memcpy(nullptr, nullptr, 0) — UB on both
+    // pointer arguments. Allocate at least one byte so the returned
+    // pointer is always freeable, and copy only when there is output.
+    char* buf = (char*)std::malloc(b.out.empty() ? 1 : b.out.size());
+    if (buf == nullptr) return PTPU_FJ_FALLBACK;
+    if (!b.out.empty()) std::memcpy(buf, b.out.data(), b.out.size());
     *out = buf;
     *out_len = b.out.size();
     *nrows = b.nrows;
@@ -2147,6 +2166,17 @@ int ptpu_otel_logs_columnar(const char* in, uint64_t len, int ts_as_ms,
     return PTPU_FJ_OK;
 }
 
+// nsan hardening: the per-column accessors indexed `cols[i]` unchecked —
+// a stale binding (or any ABI misuse) reading one column past ncols walked
+// off the vector into adjacent heap. They are called O(ncols) per batch,
+// never per row, so the bound check is free; out-of-range reads return the
+// same null/zero values an absent buffer does.
+static inline const colb::ColBuilder* cols_at(void* h, uint32_t i) {
+    if (h == nullptr) return nullptr;
+    auto* b = (colb::ColumnarBatch*)h;
+    return i < b->cols.size() ? &b->cols[i] : nullptr;
+}
+
 uint64_t ptpu_cols_nrows(void* h) { return ((colb::ColumnarBatch*)h)->nrows; }
 
 uint32_t ptpu_cols_ncols(void* h) {
@@ -2154,24 +2184,31 @@ uint32_t ptpu_cols_ncols(void* h) {
 }
 
 const char* ptpu_cols_name(void* h, uint32_t i) {
-    return ((colb::ColumnarBatch*)h)->cols[i].name.c_str();
+    const colb::ColBuilder* c = cols_at(h, i);
+    return c ? c->name.c_str() : nullptr;
 }
 
 int32_t ptpu_cols_kind(void* h, uint32_t i) {
-    return ((colb::ColumnarBatch*)h)->cols[i].kind;
+    const colb::ColBuilder* c = cols_at(h, i);
+    return c ? c->kind : colb::PT_COL_NULL;
 }
 
 uint64_t ptpu_cols_null_count(void* h, uint32_t i) {
-    return ((colb::ColumnarBatch*)h)->cols[i].null_count;
+    const colb::ColBuilder* c = cols_at(h, i);
+    return c ? c->null_count : 0;
 }
 
 const uint8_t* ptpu_cols_validity(void* h, uint32_t i) {
-    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    const colb::ColBuilder* cp = cols_at(h, i);
+    if (cp == nullptr) return nullptr;
+    const auto& c = *cp;
     return c.validity.empty() ? nullptr : c.validity.data();
 }
 
 const uint8_t* ptpu_cols_data(void* h, uint32_t i) {
-    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    const colb::ColBuilder* cp = cols_at(h, i);
+    if (cp == nullptr) return nullptr;
+    const auto& c = *cp;
     switch (c.kind) {
         case colb::PT_COL_FLOAT64: return (const uint8_t*)c.f64.data();
         case colb::PT_COL_TS_MS: return (const uint8_t*)c.ts.data();
@@ -2182,7 +2219,9 @@ const uint8_t* ptpu_cols_data(void* h, uint32_t i) {
 }
 
 uint64_t ptpu_cols_data_len(void* h, uint32_t i) {
-    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    const colb::ColBuilder* cp = cols_at(h, i);
+    if (cp == nullptr) return 0;
+    const auto& c = *cp;
     switch (c.kind) {
         case colb::PT_COL_FLOAT64: return c.f64.size() * 8;
         case colb::PT_COL_TS_MS: return c.ts.size() * 8;
@@ -2193,7 +2232,9 @@ uint64_t ptpu_cols_data_len(void* h, uint32_t i) {
 }
 
 const int32_t* ptpu_cols_offsets(void* h, uint32_t i) {
-    const auto& c = ((colb::ColumnarBatch*)h)->cols[i];
+    const colb::ColBuilder* cp = cols_at(h, i);
+    if (cp == nullptr) return nullptr;
+    const auto& c = *cp;
     return c.kind == colb::PT_COL_STRING ? c.offsets.data() : nullptr;
 }
 

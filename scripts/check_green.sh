@@ -69,3 +69,39 @@ if [ "${PSAN:-1}" != "0" ]; then
 else
   echo "check_green: psan SKIPPED (PSAN=0)"
 fi
+
+# native gate: nsan (parseable_tpu/analysis/nsan/) — ABI drift between
+# fastpath.cpp's extern "C" surface and the ctypes bindings, clang-tidy
+# when installed, and the fuzz-corpus replay under the ASan/UBSan
+# instrumented build; then the native-touching test files again with
+# P_NSAN=1 (the same tests, loaded against the sanitized library, with a
+# ptpu_cols_live==0 session gate). Opt out with NSAN=0. The CLI writes
+# /tmp/nsan.json first; the pytest pass merges its section into it.
+if [ "${NSAN:-1}" != "0" ]; then
+  if ! python -m parseable_tpu.analysis.nsan --json-out /tmp/nsan.json; then
+    echo "check_green: NSAN RED (unbaselined findings; see above and /tmp/nsan.json)" >&2
+    exit 1
+  fi
+  # the sanitized pytest pass runs UBSan-instrumented (the only mode sound
+  # under late dlopen; see analysis/nsan/__init__.py) — probe that the
+  # toolchain's UBSan actually links instead of guessing from `command -v`
+  if echo 'int main(){return 0;}' | g++ -fsanitize=undefined -x c++ - -o /tmp/_nsan_probe 2>/dev/null; then
+    rm -f /tmp/_nsan_probe /tmp/_t1_nsan.log
+    timeout -k 10 600 env JAX_PLATFORMS=cpu P_NSAN=1 python -m pytest -q -m 'not slow' \
+      tests/test_native_ingest.py tests/test_native_otel.py \
+      tests/test_native_parity_fuzz.py tests/test_native_and_formats.py \
+      tests/test_hll_distinct.py tests/test_nsan_fuzz.py \
+      --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+      2>&1 | tee /tmp/_t1_nsan.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+      echo "check_green: NSAN RED (sanitized test run rc=$rc; see /tmp/nsan.json)" >&2
+      exit "$rc"
+    fi
+    echo "check_green: nsan GREEN (report: /tmp/nsan.json)"
+  else
+    echo "check_green: nsan GREEN — ABI+corpus only (no UBSan-capable toolchain for the sanitized test pass)"
+  fi
+else
+  echo "check_green: nsan SKIPPED (NSAN=0)"
+fi

@@ -39,12 +39,77 @@ import pytest  # noqa: E402
 # exactly the ordering bug the comment above warns about for JAX.
 _PSAN = os.environ.get("P_PSAN", "").strip().lower() in ("1", "true", "yes", "on")
 
+# nsan: the native safety gate (parseable_tpu/analysis/nsan/). P_NSAN=1
+# points parseable_tpu.native at the sanitizer-instrumented library for
+# this whole session — the plugin's pytest_configure must therefore run
+# before collection imports anything that loads the native library, hence
+# the same os.environ read and historic-hook registration as psan.
+_NSAN = os.environ.get("P_NSAN", "").strip().lower() in ("1", "true", "yes", "on")
+
 
 def pytest_configure(config):
     if _PSAN and not config.pluginmanager.has_plugin("psan"):
         from parseable_tpu.analysis.psan.plugin import PsanPytestPlugin
 
         config.pluginmanager.register(PsanPytestPlugin(), "psan")
+    if (
+        _NSAN
+        and os.environ.get("P_NSAN_SAN", "ubsan") == "asan"
+        and "verify_asan_link_order" not in os.environ.get("ASAN_OPTIONS", "")
+    ):
+        # P_NSAN_SAN=asan dlopens an ASan-instrumented library into an
+        # already-running interpreter, which needs verify_asan_link_order=0
+        # (and no exit-time leak pass — heap interception is inert in
+        # late-dlopen mode). libasan reads ASAN_OPTIONS from
+        # /proc/self/environ, NOT the libc environ, so an os.environ
+        # mutation here is invisible to it — the only way to inject the
+        # option from inside the process is to re-exec the interpreter once
+        # with the corrected environment. pytest's global fd capture is
+        # already active, so restore the real stdout/stderr first or the
+        # re-exec'd run inherits a capture temp file and the whole session
+        # goes silent. (The default ubsan mode needs none of this: libubsan
+        # has no allocator/link-order constraints.)
+        import sys as _sys
+
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            capman.stop_global_capturing()
+        os.environ["ASAN_OPTIONS"] = (
+            "verify_asan_link_order=0:detect_leaks=0:halt_on_error=1"
+        )
+        os.execv(_sys.executable, [_sys.executable, "-m", "pytest", *_sys.argv[1:]])
+    if _NSAN and not config.pluginmanager.has_plugin("nsan"):
+        from parseable_tpu.analysis.nsan.plugin import NsanPytestPlugin
+
+        config.pluginmanager.register(NsanPytestPlugin(), "nsan")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # Universal columnar leak gate, sanitized build or not: every tier-1
+    # session must end with ptpu_cols_live() == 0 — a nonzero count means
+    # some test's zero-copy batch skipped the _ColumnarBufs owner and the
+    # native allocation leaked. Checked only when the library is already
+    # loaded (never triggers a load) so native-free runs stay untouched.
+    try:
+        import sys as _sys
+
+        native = _sys.modules.get("parseable_tpu.native")
+        if native is None or getattr(native, "_lib", None) is None:
+            return
+        import gc
+
+        gc.collect()
+        live = native.columnar_live()
+        if live != 0:
+            print(
+                f"\nconftest: ptpu_cols_live() == {live} at session end "
+                "(expected 0) — a native columnar batch leaked",
+                file=_sys.stderr,
+            )
+            if session.exitstatus == 0:
+                session.exitstatus = 1
+    except Exception:
+        pass  # the gate must never turn an unrelated failure into a crash
 
 
 def pytest_sessionstart(session):

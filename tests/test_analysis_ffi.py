@@ -1,0 +1,190 @@
+"""plint FFI rule tests (analysis/rules_ffi.py): ffi-restype, ffi-ownership.
+
+Same shape as test_analysis.py — seeded violation, idiomatic clean,
+suppression — plus the live-tree gate (native/__init__.py must satisfy
+both rules; it is the module these rules were distilled from).
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import SourceFile
+from parseable_tpu.analysis.rules_ffi import FfiOwnershipRule, FfiRestypeRule
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def check(rule, code: str, rel: str = "parseable_tpu/native/__init__.py") -> list:
+    if not rule.applies(rel):
+        return []
+    sf = SourceFile(rel, textwrap.dedent(code))
+    return [f for f in rule.check(sf) if not sf.is_suppressed(f.rule, f.line)]
+
+
+# ------------------------------------------------------------ ffi-restype
+
+
+def test_restype_flags_call_without_declarations():
+    findings = check(
+        FfiRestypeRule(),
+        """
+        def use(lib):
+            return lib.ptpu_mystery(1, 2)
+        """,
+    )
+    assert len(findings) == 1
+    assert findings[0].rule == "ffi-restype"
+    assert "restype or argtypes" in findings[0].message
+
+
+def test_restype_flags_partial_declaration():
+    findings = check(
+        FfiRestypeRule(),
+        """
+        import ctypes
+
+        def _bind(lib):
+            lib.ptpu_thing.restype = ctypes.c_uint64
+
+        def use(lib):
+            return lib.ptpu_thing(b"x")
+        """,
+    )
+    assert len(findings) == 1
+    assert "argtypes" in findings[0].message
+    assert "restype" not in findings[0].message.split("without declared ")[1][:10]
+
+
+def test_restype_clean_when_both_declared():
+    findings = check(
+        FfiRestypeRule(),
+        """
+        import ctypes
+
+        def _bind(lib):
+            lib.ptpu_thing.restype = ctypes.c_uint64
+            lib.ptpu_thing.argtypes = [ctypes.c_char_p]
+
+        def use(lib):
+            return lib.ptpu_thing(b"x")
+        """,
+    )
+    assert findings == []
+
+
+def test_restype_suppression():
+    findings = check(
+        FfiRestypeRule(),
+        """
+        def use(lib):
+            return lib.ptpu_mystery(1)  # plint: disable=ffi-restype
+        """,
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------- ffi-ownership
+
+
+def test_ownership_flags_bare_foreign_buffer():
+    findings = check(
+        FfiOwnershipRule(),
+        """
+        import pyarrow as pa
+
+        def wrap(ptr, size):
+            return pa.foreign_buffer(ptr, size)
+        """,
+    )
+    assert len(findings) == 1
+    assert "owner base" in findings[0].message
+
+
+def test_ownership_clean_with_owner_base():
+    findings = check(
+        FfiOwnershipRule(),
+        """
+        import pyarrow as pa
+
+        def wrap(ptr, size, owner):
+            a = pa.foreign_buffer(ptr, size, owner)
+            b = pa.foreign_buffer(ptr, size, base=owner)
+            return a, b
+        """,
+    )
+    assert findings == []
+
+
+def test_ownership_flags_producer_without_custody():
+    findings = check(
+        FfiOwnershipRule(),
+        """
+        import ctypes
+
+        def leaky(lib, payload):
+            out = ctypes.c_void_p()
+            rc = lib.ptpu_flatten_columnar(payload, len(payload), 6, b"_", ctypes.byref(out))
+            return rc  # handle dropped: the batch leaks
+        """,
+    )
+    assert len(findings) == 1
+    assert "leaks" in findings[0].message
+
+
+def test_ownership_clean_when_handle_reaches_importer():
+    findings = check(
+        FfiOwnershipRule(),
+        """
+        import ctypes
+
+        def ok(lib, payload):
+            out = ctypes.c_void_p()
+            rc = lib.ptpu_flatten_columnar(payload, len(payload), 6, b"_", ctypes.byref(out))
+            if rc != 0:
+                return None
+            return _import_columnar(lib, out.value)
+        """,
+    )
+    assert findings == []
+
+
+def test_ownership_flags_free_outside_owner_del():
+    findings = check(
+        FfiOwnershipRule(),
+        """
+        def cleanup(lib, h):
+            lib.ptpu_cols_free(h)
+        """,
+    )
+    assert len(findings) == 1
+    assert "double-free" in findings[0].message
+
+
+def test_ownership_clean_free_inside_owner_del():
+    findings = check(
+        FfiOwnershipRule(),
+        """
+        class _ColumnarBufs:
+            def __del__(self):
+                h, self._h = self._h, None
+                if h and _lib is not None:
+                    _lib.ptpu_cols_free(h)
+        """,
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------- live-tree gate
+
+
+def test_live_native_binding_satisfies_both_rules():
+    sf = SourceFile.from_path(
+        REPO_ROOT, REPO_ROOT / "parseable_tpu" / "native" / "__init__.py"
+    )
+    for rule in (FfiRestypeRule(), FfiOwnershipRule()):
+        findings = [
+            f for f in rule.check(sf) if not sf.is_suppressed(f.rule, f.line)
+        ]
+        assert findings == [], [f.render() for f in findings]

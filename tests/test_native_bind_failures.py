@@ -1,0 +1,165 @@
+"""Binding failure modes of the native loader (native/__init__.py _load).
+
+A stale/partial .so must degrade by LANE, never by crash:
+
+- missing a COLUMNAR export -> only the columnar tier disables, counted
+  in `ingest_native{lane="columnar",result="bind-failed"}`; hash/HLL and
+  the NDJSON lane keep running native. Under P_NATIVE_REQUIRED=1 the
+  partial library is a hard RuntimeError instead (a toolchain exists, so
+  a partial build is a bug, not an environment fact).
+- missing a CORE export -> the whole library disables (Python fallbacks
+  everywhere) under P_NATIVE_REQUIRED=0, hard-fails under =1.
+
+Each scenario runs in a subprocess: the loader's module-level negative
+caches (_lib/_load_failed/_columnar_ok) and the dlopen mapping are
+process-wide, so in-process simulation would leak state into other tests.
+The stub libraries are generated from abicheck's own export inventory —
+the test stays correct when fastpath.cpp grows a new symbol.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from parseable_tpu.analysis.nsan.abicheck import CPP_REL, parse_exports
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="stub .so needs a C++ toolchain"
+)
+
+
+def _export_names() -> tuple[set[str], set[str]]:
+    """(core, columnar) export names from the real fastpath.cpp."""
+    exports = set(parse_exports((REPO_ROOT / CPP_REL).read_text()))
+    columnar = {
+        n for n in exports if n.startswith("ptpu_cols_") or n.endswith("_columnar")
+    }
+    return exports - columnar, columnar
+
+
+def _build_stub(tmp_path: Path, names: set[str]) -> Path:
+    """Compile a .so exporting exactly `names` (as no-op void functions —
+    dlsym only checks presence, which is all binding needs)."""
+    src = tmp_path / "stub.cpp"
+    out = tmp_path / "libstub.so"
+    body = "\n".join(f'extern "C" void {n}() {{}}' for n in sorted(names))
+    src.write_text(body + "\n")
+    subprocess.run(
+        ["g++", "-shared", "-fPIC", str(src), "-o", str(out)],
+        check=True,
+        capture_output=True,
+        timeout=120,
+    )
+    return out
+
+
+def _probe(stub: Path, required: bool, script: str) -> subprocess.CompletedProcess:
+    """Run `script` in a fresh interpreter with the loader pointed at the
+    stub (P_NSAN_LIB skips auto-build/staleness, exactly the knob's job)."""
+    req = "1" if required else "0"
+    prelude = textwrap.dedent(
+        f"""
+        import os
+        os.environ["P_NSAN_LIB"] = {str(stub)!r}
+        os.environ["P_NATIVE_REQUIRED"] = {req!r}
+        """
+    )
+    return subprocess.run(
+        [sys.executable, "-c", prelude + textwrap.dedent(script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=str(REPO_ROOT),
+    )
+
+
+def test_missing_columnar_symbol_disables_only_that_lane(tmp_path):
+    core, columnar = _export_names()
+    assert columnar, "inventory lost the columnar exports"
+    stub = _build_stub(tmp_path, core | columnar - {"ptpu_flatten_columnar"})
+    proc = _probe(
+        stub,
+        required=False,
+        script="""
+        import parseable_tpu.native as native
+        assert native.native_available(), "core lanes must stay native"
+        assert not native._columnar_ok
+        assert native.flatten_columnar(b'{"a": 1}', 6) is None
+        assert native.otel_logs_columnar(b'{}') is None
+        assert native.columnar_live() == 0
+        from parseable_tpu.utils.metrics import INGEST_NATIVE
+        v = INGEST_NATIVE.labels("columnar", "bind-failed")._value.get()
+        assert v == 1, f"bind failure must be counted, got {v}"
+        print("OK")
+        """,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_missing_columnar_symbol_hard_fails_when_required(tmp_path):
+    core, columnar = _export_names()
+    stub = _build_stub(tmp_path, core | columnar - {"ptpu_cols_free"})
+    proc = _probe(
+        stub,
+        required=True,
+        script="""
+        import parseable_tpu.native as native
+        try:
+            native.native_available()
+        except RuntimeError as e:
+            assert "columnar ABI" in str(e), e
+            print("RAISED")
+        else:
+            raise SystemExit("expected RuntimeError under P_NATIVE_REQUIRED=1")
+        """,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "RAISED" in proc.stdout
+
+
+def test_missing_core_symbol_disables_whole_library(tmp_path):
+    core, columnar = _export_names()
+    stub = _build_stub(tmp_path, (core - {"ptpu_xxh64"}) | columnar)
+    proc = _probe(
+        stub,
+        required=False,
+        script="""
+        import parseable_tpu.native as native
+        assert not native.native_available()
+        # fallbacks still serve: xxh64 degrades to the keyed-blake2b path
+        assert isinstance(native.xxh64(b"x"), int)
+        print("OK")
+        """,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_missing_core_symbol_hard_fails_when_required(tmp_path):
+    core, columnar = _export_names()
+    stub = _build_stub(tmp_path, (core - {"ptpu_flatten_ndjson"}) | columnar)
+    proc = _probe(
+        stub,
+        required=True,
+        script="""
+        import parseable_tpu.native as native
+        try:
+            native.native_available()
+        except RuntimeError as e:
+            assert "stale" in str(e), e
+            print("RAISED")
+        else:
+            raise SystemExit("expected RuntimeError under P_NATIVE_REQUIRED=1")
+        """,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "RAISED" in proc.stdout

@@ -1,0 +1,214 @@
+"""nsan ABI-drift checker tests (analysis/nsan/abicheck.py).
+
+Per rule a seeded-drift fixture proves detection, then the live-tree gate:
+fastpath.cpp's extern "C" surface and native/__init__.py's ctypes
+declarations must diff clean — that IS the check_green nsan contract, so a
+regression here is a regression in the shipped gate.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from parseable_tpu.analysis.nsan.abicheck import (
+    diff_abi,
+    parse_bindings,
+    parse_exports,
+    run_abicheck,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------------------- C parsing
+
+
+def test_parse_exports_basic_and_pointers():
+    cpp = """
+extern "C" {
+uint64_t ptpu_hash(const uint8_t* data, uint64_t len, uint64_t seed) {
+    return 0;
+}
+const char* ptpu_name(void* h, uint32_t i) { return 0; }
+void ptpu_sink(void) {}
+long long ptpu_live(void) { return 0; }
+}
+"""
+    ex = parse_exports(cpp)
+    assert set(ex) == {"ptpu_hash", "ptpu_name", "ptpu_sink", "ptpu_live"}
+    assert ex["ptpu_hash"].ret == "u64"
+    assert ex["ptpu_hash"].args == ["ptr:u8", "u64", "u64"]
+    assert ex["ptpu_name"].ret == "ptr:i8"
+    assert ex["ptpu_name"].args == ["ptr:void", "u32"]
+    assert ex["ptpu_sink"].args == []
+    assert ex["ptpu_live"].ret == "i64"
+
+
+def test_parse_exports_skips_static_and_outside_blocks():
+    cpp = """
+static uint64_t ptpu_helper(uint64_t x) { return x; }
+uint64_t ptpu_outside(void) { return 0; }
+extern "C" {
+static inline int ptpu_inline_helper(int x) { return x; }
+int ptpu_real(int x) { return x; }
+}
+"""
+    ex = parse_exports(cpp)
+    assert set(ex) == {"ptpu_real"}
+
+
+def test_parse_exports_nested_braces_stay_in_block():
+    cpp = """
+extern "C" {
+int ptpu_a(int x) {
+    if (x) { while (x) { x--; } }
+    return x;
+}
+int ptpu_b(void) { return 0; }
+}
+int ptpu_after(void) { return 0; }
+"""
+    ex = parse_exports(cpp)
+    assert set(ex) == {"ptpu_a", "ptpu_b"}
+
+
+def test_parse_exports_double_pointer():
+    cpp = 'extern "C" {\nint ptpu_out(char** out, uint64_t* n) { return 0; }\n}'
+    ex = parse_exports(cpp)
+    assert ex["ptpu_out"].args == ["ptr:ptr", "ptr:u64"]
+
+
+# -------------------------------------------------------- python parsing
+
+
+def test_parse_bindings_collects_declarations_and_calls():
+    py = """
+import ctypes
+
+def _bind(lib):
+    lib.ptpu_a.restype = ctypes.c_uint64
+    lib.ptpu_a.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_b.restype = None
+    lib.ptpu_c.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
+
+def use(lib):
+    return lib.ptpu_d(1)
+"""
+    b = parse_bindings(py)
+    assert b["ptpu_a"].restype == "c_uint64"
+    assert b["ptpu_a"].argtypes == ["c_char_p", "c_uint64"]
+    assert b["ptpu_b"].restype == "None"
+    assert b["ptpu_b"].argtypes is None
+    assert b["ptpu_c"].argtypes == ["POINTER(c_void_p)"]
+    assert "ptpu_d" in b  # referenced without declarations
+
+
+# --------------------------------------------------------------- diffing
+
+
+def _diff(cpp: str, py: str):
+    return diff_abi(parse_exports(cpp), parse_bindings(py), py.splitlines())
+
+
+def test_diff_missing_restype_and_argtypes():
+    cpp = 'extern "C" {\nuint64_t ptpu_n(uint64_t x) { return x; }\n}'
+    py = "def f(lib):\n    lib.ptpu_n(1)\n"
+    rules = {f.rule for f in _diff(cpp, py)}
+    assert "nsan-abi-missing-restype" in rules
+    assert "nsan-abi-missing-argtypes" in rules
+
+
+def test_diff_arity_mismatch():
+    cpp = 'extern "C" {\nint ptpu_n(int a, int b) { return a; }\n}'
+    py = (
+        "import ctypes\n"
+        "def f(lib):\n"
+        "    lib.ptpu_n.restype = ctypes.c_int\n"
+        "    lib.ptpu_n.argtypes = [ctypes.c_int]\n"
+    )
+    rules = [f.rule for f in _diff(cpp, py)]
+    assert rules == ["nsan-abi-arity"]
+
+
+def test_diff_type_mismatch_scalar_width():
+    # u64 length declared as c_uint32: truncation on this ABI
+    cpp = 'extern "C" {\nvoid ptpu_n(uint64_t len) {}\n}'
+    py = (
+        "import ctypes\n"
+        "def f(lib):\n"
+        "    lib.ptpu_n.restype = None\n"
+        "    lib.ptpu_n.argtypes = [ctypes.c_uint32]\n"
+    )
+    rules = [f.rule for f in _diff(cpp, py)]
+    assert rules == ["nsan-abi-type"]
+
+
+def test_diff_restype_truncation_on_pointer_return():
+    cpp = 'extern "C" {\nvoid* ptpu_n(void) { return 0; }\n}'
+    py = (
+        "import ctypes\n"
+        "def f(lib):\n"
+        "    lib.ptpu_n.restype = ctypes.c_int\n"
+        "    lib.ptpu_n.argtypes = []\n"
+    )
+    rules = [f.rule for f in _diff(cpp, py)]
+    assert rules == ["nsan-abi-type"]
+
+
+def test_diff_unbound_and_unexported():
+    cpp = 'extern "C" {\nvoid ptpu_orphan(void) {}\n}'
+    py = "def f(lib):\n    lib.ptpu_ghost.restype = None\n"
+    rules = {f.rule for f in _diff(cpp, py)}
+    assert rules == {"nsan-abi-unbound-export", "nsan-abi-unexported-binding"}
+
+
+def test_diff_compatible_pointer_forms_pass():
+    cpp = (
+        'extern "C" {\n'
+        "int ptpu_n(const char* s, uint64_t n, void** out, uint64_t* m) { return 0; }\n"
+        "}"
+    )
+    py = (
+        "import ctypes\n"
+        "def f(lib):\n"
+        "    lib.ptpu_n.restype = ctypes.c_int\n"
+        "    lib.ptpu_n.argtypes = [ctypes.c_char_p, ctypes.c_uint64, "
+        "ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]\n"
+    )
+    assert _diff(cpp, py) == []
+
+
+def test_diff_void_return_requires_explicit_none():
+    cpp = 'extern "C" {\nvoid ptpu_n(void) {}\n}'
+    py = (
+        "import ctypes\n"
+        "def f(lib):\n"
+        "    lib.ptpu_n.restype = ctypes.c_int\n"
+        "    lib.ptpu_n.argtypes = []\n"
+    )
+    rules = [f.rule for f in _diff(cpp, py)]
+    assert rules == ["nsan-abi-type"]
+
+
+# --------------------------------------------------------- live-tree gate
+
+
+def test_live_tree_diffs_clean():
+    """The shipped gate contract: the real fastpath.cpp / native binding
+    pair has zero ABI drift. If this fails, either a new export needs a
+    binding (with restype AND argtypes) or a binding went stale."""
+    findings, stats = run_abicheck(REPO_ROOT)
+    assert findings == [], [f.render() for f in findings]
+    # the surface is substantial — a parser regression that silently sees
+    # nothing must not pass as "no drift"
+    assert stats["exports"] >= 25
+    assert stats["bindings"] >= 25
+    assert stats["extern_c_blocks"] >= 4
+    assert stats["declaration_sites"] == 2 * stats["bindings"]
+
+
+def test_live_tree_every_binding_has_both_declarations():
+    py = (REPO_ROOT / "parseable_tpu/native/__init__.py").read_text()
+    for name, b in parse_bindings(py).items():
+        assert b.restype is not None, f"{name} missing restype"
+        assert b.argtypes is not None, f"{name} missing argtypes"

@@ -1,0 +1,135 @@
+"""nsan fuzzer tests: corpus replay in tier-1 + harness unit coverage.
+
+The banked regression corpus (tests/corpus/nsan/*.bin — minimized
+reproducers plus seed payloads per adversarial family) is replayed here
+IN-PROCESS against the production library on every tier-1 run: seconds,
+no toolchain needed, and any payload that once crashed the C++ stays
+exercised forever. The full-fidelity replay (sanitized build, ASan
+preload, LSan) runs in the check_green nsan gate via
+`python -m parseable_tpu.analysis.nsan`.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import random
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from parseable_tpu import native
+from parseable_tpu.analysis.nsan import fuzz
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+CORPUS = REPO_ROOT / "tests" / "corpus" / "nsan"
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native library unavailable"
+)
+
+
+# ------------------------------------------------------------- generators
+
+
+def test_generators_are_deterministic():
+    for name, fn in fuzz.FAMILIES:
+        a = fn(random.Random(123))
+        b = fn(random.Random(123))
+        assert a == b, f"family {name} is not seed-deterministic"
+
+
+def test_generators_produce_bytes_for_many_seeds():
+    rng = random.Random(7)
+    for _ in range(200):
+        name, payload = fuzz.gen_payload(rng)
+        assert isinstance(payload, bytes), name
+
+
+def test_family_coverage_over_a_campaign_seed():
+    rng = random.Random(0)
+    seen = {fuzz.gen_payload(rng)[0] for _ in range(400)}
+    assert len(seen) == len(fuzz.FAMILIES), f"families never drawn: {seen}"
+
+
+# ---------------------------------------------------------- corpus replay
+
+
+def test_corpus_exists_and_is_banked():
+    cases = fuzz.iter_corpus(REPO_ROOT)
+    assert len(cases) >= 10, "the seed corpus must ship with the repo"
+
+
+def test_corpus_replays_clean_in_process():
+    """Every banked payload through every native entry point — the
+    tier-1-speed regression replay. Any crash/exception here means a
+    previously-fixed native bug came back."""
+    for case in fuzz.iter_corpus(REPO_ROOT):
+        fuzz._drive_payload(native, np, case.read_bytes())
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_adversarial_families_replay_clean_in_process():
+    """Fresh payloads from every generator family, same in-process drive —
+    catches regressions in inputs the banked corpus doesn't pin."""
+    rng = random.Random(31337)
+    for _, fn in fuzz.FAMILIES:
+        for _ in range(5):
+            fuzz._drive_payload(native, np, fn(rng))
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_fuzz_log_schema():
+    log = CORPUS / "FUZZ_LOG.json"
+    assert log.is_file(), "the campaign ledger ships with the corpus"
+    doc = json.loads(log.read_text())
+    assert doc["runs"], "at least one recorded campaign"
+    assert doc["total_cpu_seconds"] >= 600, (
+        "the acceptance criterion is >= 10 CPU-minutes of recorded fuzzing"
+    )
+    for run in doc["runs"]:
+        assert {"seed", "cpu_seconds", "executed", "findings"} <= set(run)
+
+
+# -------------------------------------------------------- harness plumbing
+
+
+def test_classify_failure():
+    assert fuzz.classify_failure(0, "") is None
+    rule, _ = fuzz.classify_failure(fuzz.EXIT_LSAN_LEAK, "")
+    assert rule == "nsan-fuzz-leak"
+    rule, _ = fuzz.classify_failure(fuzz.EXIT_COLS_LIVE, "")
+    assert rule == "nsan-fuzz-cols-live"
+    rule, msg = fuzz.classify_failure(
+        fuzz.EXIT_ASAN_ERROR,
+        "==1==ERROR: AddressSanitizer: heap-buffer-overflow on x\nmore",
+    )
+    assert rule == "nsan-fuzz-crash" and "heap-buffer-overflow" in msg
+    rule, msg = fuzz.classify_failure(1, "f.cpp:3:2: runtime error: shift exponent")
+    assert rule == "nsan-fuzz-crash" and "UBSan" in msg
+    rule, msg = fuzz.classify_failure(-11, "")
+    assert rule == "nsan-fuzz-crash" and "signal 11" in msg
+
+
+def test_bank_case_is_content_addressed(tmp_path):
+    (tmp_path / "tests").mkdir()
+    a = fuzz.bank_case(tmp_path, b"payload-a")
+    b = fuzz.bank_case(tmp_path, b"payload-a")
+    c = fuzz.bank_case(tmp_path, b"payload-b")
+    assert a == b and a != c
+    assert a.read_bytes() == b"payload-a"
+    assert fuzz.iter_corpus(tmp_path) == sorted([a, c])
+
+
+def test_child_env_shape():
+    env = fuzz.child_env(REPO_ROOT)
+    if env is None:
+        pytest.skip("no ASan runtime on this machine")
+    assert "LD_PRELOAD" in env and "asan" in env["LD_PRELOAD"].lower()
+    assert "detect_leaks=1" in env["ASAN_OPTIONS"]
+    assert "leak_check_at_exit=0" in env["ASAN_OPTIONS"]
+    assert env["PYTHONMALLOC"] == "malloc"
+    assert "lsan.supp" in env.get("LSAN_OPTIONS", "")
