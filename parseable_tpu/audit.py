@@ -1,0 +1,400 @@
+"""Conservation-law auditor: continuous cross-layer row accounting.
+
+The distributed write path promises one conservation law — every row acked
+at ingest is EXACTLY once in staging (memory buffer, finished `.arrows`,
+staged parquet) or in this node's owned slice of the manifest, and at
+quiesce the queryable count over the whole cluster equals the sum of both.
+Nothing in the pipeline checked that promise end to end: a dropped ack, a
+double-counted fallback slice, or a snapshot commit that lost a delta
+would all go unnoticed until a user diffed their own counts.
+
+This module keeps a per-process `Ledger` (attached as `Parseable.audit`)
+fed by the ingest path, and audits three invariant families:
+
+- ``rows_conserved``   — per stream: rows acked since the ledger's baseline
+  == (staging rows + node-owned manifest rows) - baseline. The continuous
+  loop enforces it only "at rest" (the sampled triple unchanged since the
+  previous tick — no observed flux means the books must balance); the
+  on-demand quiesce check enforces it unconditionally.
+- ``snapshot_monotonic`` — per stream: the summed ``lifetime_events``
+  across every node's stream json never decreases between observations.
+- ``gauges_zero``      — at quiesce: inflight/queued work gauges
+  (query admission, scan pool, enccache, enrichment) reconcile to zero.
+
+A querier additionally closes the loop with ``queryable_count``: at
+quiesce, ``SELECT count(*)`` over a wide window must equal the sum of all
+nodes' manifest rows plus all nodes' reported staging rows.
+
+Every violation ticks ``parseable_audit_violations_total{invariant}`` and
+lands in a structured report served by ``GET /api/v1/cluster/audit``
+(scope=local for one node, scope=cluster to fan out over live peers) —
+the invariant substrate the chaos/soak battery asserts against.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+import pyarrow.parquet as pq
+
+from parseable_tpu.config import Mode
+from parseable_tpu.metastore import MetastoreError
+from parseable_tpu.storage import rfc3339_now
+from parseable_tpu.utils import telemetry
+from parseable_tpu.utils.metrics import AUDIT_VIOLATIONS, REGISTRY
+
+logger = logging.getLogger(__name__)
+
+_INTERNAL = {"pmeta", "pstats"}
+
+# unlabeled work gauges that must read zero once the system is drained
+_QUIESCE_GAUGES = (
+    "parseable_query_inflight",
+    "parseable_query_queued",
+    "parseable_query_scan_pool_queue_depth",
+    "parseable_tpu_enccache_queue_depth",
+    "parseable_enrichment_queue_depth",
+)
+
+
+def _violation(
+    invariant: str, stream: str, node: str, detail: str, expected, actual
+) -> dict:
+    return {
+        "invariant": invariant,
+        "stream": stream,
+        "node": node,
+        "detail": detail,
+        "expected": expected,
+        "actual": actual,
+    }
+
+
+class Ledger:
+    """Per-process audit ledger (one per Parseable instance, NOT a module
+    singleton — tests boot many instances per process and their books must
+    not bleed into each other).
+
+    The baseline is what makes the conservation check possible mid-life:
+    a stream usually predates this process (restarts, peers' rows in the
+    shared store), so acked-since-boot can't equal absolute staging+manifest.
+    `ensure_stream` snapshots staging+manifest ONCE, before the first
+    tracked ack touches the stream; from then on the *delta* must balance.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._acked: dict[str, int] = {}  # guarded-by: self._lock
+        self._baseline: dict[str, int] = {}  # guarded-by: self._lock
+        self._watermark: dict[str, int] = {}  # guarded-by: self._lock
+        self._last_sample: dict[str, tuple] = {}  # guarded-by: self._lock
+        self.last_report: dict | None = None
+
+    def ensure_stream(self, p, name: str) -> None:
+        """Establish the stream's baseline before its first tracked ack.
+        Called on the ingest path BEFORE rows are pushed — the first batch
+        must not count itself into its own baseline. Cheap after the first
+        call (one dict probe)."""
+        if name in _INTERNAL:
+            return
+        with self._lock:
+            if name in self._baseline:
+                return
+        stream = p.streams.get(name)
+        base = (staging_rows(stream) if stream is not None else 0) + owned_manifest_rows(p, name)
+        # first writer wins: a concurrent request that raced past the probe
+        # computed its baseline before either pushed rows, so both are valid
+        with self._lock:
+            self._baseline.setdefault(name, base)
+
+    def record_acked(self, name: str, n: int) -> None:
+        if name in _INTERNAL or n <= 0:
+            return
+        with self._lock:
+            self._acked[name] = self._acked.get(name, 0) + n
+
+    def counters(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {
+                name: {"acked": self._acked.get(name, 0), "baseline": base}
+                for name, base in self._baseline.items()
+            }
+
+    def observe_sample(self, name: str, sample: tuple) -> bool:
+        """Record this tick's (acked, staging, manifest) triple; True when
+        it matches the previous tick's — the at-rest gate for the
+        continuous conservation check."""
+        with self._lock:
+            prev = self._last_sample.get(name)
+            self._last_sample[name] = sample
+        return prev == sample
+
+    def advance_watermark(self, name: str, lifetime: int) -> int | None:
+        """Returns the previous watermark (None on first observation) and
+        ratchets it up to `lifetime` when higher."""
+        with self._lock:
+            prev = self._watermark.get(name)
+            if prev is None or lifetime > prev:
+                self._watermark[name] = lifetime
+        return prev
+
+
+# ------------------------------------------------------------- measurements
+
+
+def staging_rows(stream) -> int:
+    """Rows currently staged for one stream: open disk-writer buffers +
+    finished `.arrows` + staged parquet awaiting upload/commit. Reads
+    footers, never forces a flush — the auditor must observe the pipeline,
+    not perturb it."""
+    with stream.lock:
+        total = sum(
+            w.rows_written + w._pending_rows
+            for w in stream.writer.disk.values()
+            if not w.finished
+        )
+        arrows = stream.arrow_files()
+        parquet = stream.parquet_files()
+    for f in arrows:
+        try:
+            with pa.OSFile(str(f), "rb") as src, ipc.open_file(src) as r:
+                total += sum(
+                    r.get_batch(i).num_rows for i in range(r.num_record_batches)
+                )
+        except (OSError, pa.ArrowInvalid) as e:
+            # mid-rename/compaction window: the file is counted (in the
+            # manifest or a fresh arrows) on the next at-rest tick
+            logger.debug("audit: unreadable arrows %s: %s", f, e)
+    for f in parquet:
+        try:
+            total += pq.read_metadata(str(f)).num_rows
+        except (OSError, pa.ArrowInvalid) as e:
+            logger.debug("audit: unreadable parquet %s: %s", f, e)
+    return total
+
+
+def owned_manifest_rows(p, name: str) -> int:
+    """Committed rows this node owns: its per-node stream json's
+    `stats.events`, which update_snapshot keeps equal to the owner-filtered
+    manifest totals."""
+    try:
+        fmt = p.metastore.get_stream_json(name, p._node_suffix)
+    except MetastoreError:
+        return 0
+    return int(fmt.stats.events)
+
+
+def _lifetime_events(p, name: str) -> int | None:
+    """Summed monotonic lifetime_events across every node's stream json,
+    or None when the metastore can't answer (no check on a blind tick)."""
+    try:
+        fmts = p.metastore.get_all_stream_jsons(name)
+    except MetastoreError:
+        return None
+    return sum(int(f.stats.lifetime_events) for f in fmts)
+
+
+# ------------------------------------------------------------------ reports
+
+
+def local_report(p, quiesce: bool = False) -> dict:
+    """Audit this node's books. `quiesce=True` asserts the system is
+    drained: conservation enforced unconditionally and work gauges must
+    read zero. `quiesce=False` (the continuous loop) only enforces
+    conservation for streams at rest since the previous tick."""
+    led = p.audit
+    counters = led.counters()
+    violations: list[dict] = []
+    streams_out: dict[str, dict] = {}
+    for name in sorted(set(p.streams.list_names()) | set(counters)):
+        stream = p.streams.get(name)
+        if stream is None or name in _INTERNAL or stream.metadata.stream_type == "Internal":
+            continue
+        staging = staging_rows(stream)
+        manifest = owned_manifest_rows(p, name)
+        entry: dict = {"staging": staging, "manifest": manifest}
+        c = counters.get(name)
+        if c is not None:
+            entry.update(acked=c["acked"], baseline=c["baseline"])
+            expected = c["acked"]
+            actual = staging + manifest - c["baseline"]
+            at_rest = led.observe_sample(name, (c["acked"], staging, manifest))
+            if (quiesce or at_rest) and actual != expected:
+                violations.append(
+                    _violation(
+                        "rows_conserved",
+                        name,
+                        p.node_id,
+                        f"acked {expected} != staging {staging} + manifest "
+                        f"{manifest} - baseline {c['baseline']}",
+                        expected,
+                        actual,
+                    )
+                )
+        lifetime = _lifetime_events(p, name)
+        if lifetime is not None:
+            entry["lifetime"] = lifetime
+            prev = led.advance_watermark(name, lifetime)
+            if prev is not None and lifetime < prev:
+                violations.append(
+                    _violation(
+                        "snapshot_monotonic",
+                        name,
+                        p.node_id,
+                        f"lifetime_events fell {prev} -> {lifetime}",
+                        prev,
+                        lifetime,
+                    )
+                )
+        streams_out[name] = entry
+    if quiesce:
+        for gname in _QUIESCE_GAUGES:
+            v = REGISTRY.get_sample_value(gname)
+            if v:
+                violations.append(
+                    _violation(
+                        "gauges_zero", "", p.node_id, f"{gname} = {v} at quiesce", 0, v
+                    )
+                )
+    for v in violations:
+        AUDIT_VIOLATIONS.labels(v["invariant"]).inc()
+        logger.warning("audit violation: %s", v)
+    report = {
+        "node": p.node_id,
+        "role": p.options.mode.to_str(),
+        "generated_at": rfc3339_now(),
+        "quiesce": quiesce,
+        "reachable": True,
+        "streams": streams_out,
+        "violations": violations,
+    }
+    led.last_report = report
+    return report
+
+
+def _peer_audit(p, node: dict, quiesce: bool) -> dict:
+    """One peer's local report over the management plane; unreachable
+    peers report as such rather than as violations (liveness churn is the
+    membership plane's problem, not a conservation breach)."""
+    import json as _json
+    import urllib.error
+
+    from parseable_tpu.server import cluster as C
+
+    domain = node["domain_name"]
+    url = f"{domain}/api/v1/cluster/audit?scope=local&quiesce={1 if quiesce else 0}"
+    try:
+        with C._http(p, "GET", url, timeout=30.0) as resp:
+            rep = _json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        logger.warning("audit fetch from %s failed: %s", domain, e)
+        return {
+            "node": node.get("node_id"),
+            "role": node.get("node_type", ""),
+            "reachable": False,
+            "streams": {},
+            "violations": [],
+        }
+    rep["reachable"] = True
+    return rep
+
+
+def _queryable_count_check(p, node_reports: list[dict]) -> list[dict]:
+    """Close the loop at quiesce: the count a user would get must equal
+    what the books say exists — all nodes' manifest rows plus all nodes'
+    reported staging rows."""
+    from parseable_tpu.query.session import QuerySession
+
+    violations: list[dict] = []
+    try:
+        names = p.metastore.list_streams()
+    except MetastoreError:
+        return violations
+    for name in names:
+        if name in _INTERNAL:
+            continue
+        try:
+            fmts = p.metastore.get_all_stream_jsons(name)
+        except MetastoreError:
+            continue
+        expected = sum(int(f.stats.events) for f in fmts)
+        expected += sum(
+            int(rep.get("streams", {}).get(name, {}).get("staging", 0))
+            for rep in node_reports
+        )
+        try:
+            rows = (
+                QuerySession(p)
+                .query(f"SELECT count(*) AS c FROM {name}", "365d", "now")
+                .to_json_rows()
+            )
+            actual = int(rows[0]["c"]) if rows else 0
+        except Exception as e:
+            # hyphenated names the SQL layer can't address, engines mid-
+            # bootstrap: unchecked is not a violation, but say so
+            logger.warning("audit count query for %s failed: %s", name, e)
+            continue
+        if actual != expected:
+            violations.append(
+                _violation(
+                    "queryable_count",
+                    name,
+                    p.node_id,
+                    f"count(*) {actual} != manifest+staging {expected}",
+                    expected,
+                    actual,
+                )
+            )
+            AUDIT_VIOLATIONS.labels("queryable_count").inc()
+            logger.warning("audit violation: %s", violations[-1])
+    return violations
+
+
+def cluster_report(p, quiesce: bool = True, count_check: bool = True) -> dict:
+    """Local report + every live peer's, aggregated. `count_check` adds the
+    queryable_count closure (quiesce-only semantics: in-flight ingest makes
+    the count a moving target)."""
+    from parseable_tpu.server import cluster as C
+
+    nodes = [local_report(p, quiesce=quiesce)]
+    peers = C.live_peers(p, ("ingestor", "querier", "all"))
+    if peers:
+        pool = C.get_cluster_pool()
+        futures = [
+            pool.submit(telemetry.propagate(_peer_audit), p, n, quiesce)
+            for n in peers
+        ]
+        nodes.extend(f.result() for f in futures)
+    violations = [v for rep in nodes for v in rep.get("violations", [])]
+    if count_check and p.options.mode in (Mode.QUERY, Mode.ALL):
+        violations += _queryable_count_check(
+            p, [rep for rep in nodes if rep.get("reachable")]
+        )
+    return {
+        "scope": "cluster",
+        "generated_at": rfc3339_now(),
+        "quiesce": quiesce,
+        "nodes": nodes,
+        "violations": violations,
+        "total_violations": len(violations),
+    }
+
+
+def run_audit(p, scope: str = "cluster", quiesce: bool = True) -> dict:
+    """Entry point for GET /api/v1/cluster/audit."""
+    if scope == "local":
+        return local_report(p, quiesce=quiesce)
+    return cluster_report(p, quiesce=quiesce, count_check=quiesce)
+
+
+def audit_tick(p) -> None:
+    """P_AUDIT_INTERVAL_S loop body: ingest nodes audit their own books;
+    query/all nodes roll up the cluster (without the count closure — the
+    cluster is rarely at quiesce on a timer)."""
+    if p.options.mode == Mode.INGEST:
+        local_report(p, quiesce=False)
+    else:
+        cluster_report(p, quiesce=False, count_check=False)

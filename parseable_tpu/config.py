@@ -456,6 +456,13 @@ class Options:
     # OTLP/HTTP span export endpoint (utils/telemetry.py); spans also land
     # in the internal pmeta stream regardless
     otlp_endpoint: str | None = field(default_factory=lambda: _env("P_OTLP_ENDPOINT"))
+    # conservation-law audit loop interval (parseable_tpu/audit.py): each
+    # tick balances acked rows against staging+manifest and checks snapshot
+    # monotonicity; 0 disables the loop (the /api/v1/cluster/audit endpoint
+    # still audits on demand)
+    audit_interval_secs: int = field(
+        default_factory=lambda: _env_int("P_AUDIT_INTERVAL_S", 300)
+    )
 
     # --- misc -----------------------------------------------------------------
     collect_dataset_stats: bool = field(

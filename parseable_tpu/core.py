@@ -110,6 +110,12 @@ class Parseable:
         )
         # post-upload enccache seed + field stats, off the critical path
         self.enrichment = EnrichmentQueue(self, self.options.enrich_queue_depth)
+        # per-instance conservation-law ledger (parseable_tpu/audit.py):
+        # the ingest path records acks here, the auditor balances them
+        # against staging+manifest (lazy import — audit reads this module)
+        from parseable_tpu.audit import Ledger
+
+        self.audit = Ledger()
         self.hot_tier = None  # set by the server when hot tier is enabled
         self._json_locks: dict[str, threading.Lock] = {}  # guarded-by: self._json_locks_guard
         self._json_locks_guard = threading.Lock()

@@ -96,9 +96,9 @@ def execute_local_partial(
     p: "Parseable", stream_name: str, sql: str, start: str | None, end: str | None
 ) -> tuple[bytes, dict] | None:
     """Run the node-local half of a pushed-down aggregate: scan this node's
-    staging window (arrows only — staged-but-uncommitted parquet mirrors the
-    central data plane's visibility, which serves staging_batches) plus the
-    manifest files this node owns, reduce to per-block partials, and combine
+    staging window (arrows AND flushed-but-unuploaded parquet — the querier
+    delegated this node's whole slice, so nothing else covers those rows)
+    plus the manifest files this node owns, reduce to per-block partials, and combine
     them into one wire-ready partial table.
 
     Returns (ipc_payload, meta) — payload b"" when the node-local slice is
@@ -140,11 +140,14 @@ def execute_local_partial(
 
     tag = p.owner_tag
     meta = {"owner_tag": tag, "rows_scanned": 0, "scan_errors": 0}
+    # staging_parquet=True: the querier delegated this node's WHOLE slice,
+    # so flushed-but-not-yet-uploaded parquet must be served here — nobody
+    # else can see it. The scan dedupes staged copies against the committed
+    # manifest, so a file mid-upload is never counted twice.
     scan = StreamScan(
         p,
         lp,
         file_filter=lambda basename: basename.startswith(tag),
-        staging_parquet=False,
         fetch_remote_staging=False,
     )
     with telemetry.TRACER.span(
@@ -198,6 +201,7 @@ class _PeerState:
         self.fail_reason: str | None = None
         self.elapsed_ms: float | None = None
         self.bytes = 0
+        self.rows = 0  # peer-reported rows scanned (H_ROWS)
 
 
 class DistributedRun:
@@ -331,6 +335,7 @@ class DistributedRun:
                 "result": "ok" if st.done else (st.fail_reason or "failed"),
                 "ms": round(st.elapsed_ms, 3) if st.elapsed_ms is not None else None,
                 "bytes": st.bytes,
+                "rows": st.rows,
                 "attempts": st.issued,
                 "hedged": st.hedged,
             }
@@ -367,6 +372,7 @@ class DistributedRun:
             st.done = True
             st.elapsed_ms = elapsed * 1000
             st.bytes = len(payload)
+            st.rows = headers["rows_scanned"]
             self.stats["ok"] += 1
             self.stats["bytes"] += len(payload)
             CLUSTER_FANOUT_REQUESTS.labels(st.domain, "ok").inc()

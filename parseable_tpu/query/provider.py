@@ -892,7 +892,20 @@ class StreamScan:
             yield table
         if not self.staging_parquet:
             return
-        for f in stream.parquet_files():
+        # a staged parquet that has already been uploaded and committed is
+        # served by the manifest scan — reading the lingering local copy
+        # (commit -> unlink is not atomic) would double-count its rows.
+        # The memoized manifest list gives one consistent committed set for
+        # both sides of the dedupe.
+        staged = stream.parquet_files()
+        committed = (
+            {m.file_path.rsplit("/", 1)[-1] for m in self.manifest_files()}
+            if staged
+            else set()
+        )
+        for f in staged:
+            if f.name in committed:
+                continue
             try:
                 with pq.ParquetFile(f) as pf:
                     cols = self._columns_for_read(pf.schema_arrow.names)
@@ -900,6 +913,10 @@ class StreamScan:
                 with self._stats_lock:
                     self.stats.rows_scanned += t.num_rows
                 yield t
+            except FileNotFoundError:
+                # committed + unlinked between listing and read; its rows
+                # are (or are about to be) visible via the manifest
+                logger.debug("staged parquet %s vanished (uploaded)", f)
             except Exception:
                 logger.exception("failed reading staged parquet %s", f)
                 self._record_error()

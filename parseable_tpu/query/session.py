@@ -566,7 +566,17 @@ class QuerySession:
                 plans.append(f"(plan unavailable: {e})")
 
         if mode == "analyze":
-            res = self._query_ast(select, start_time, end_time, allowed_streams)
+            # sql_key: single non-composite statements have faithful text,
+            # so the analyzed run is pushdown-eligible exactly like the
+            # real query it profiles (without it _exec_sql stays None and
+            # EXPLAIN ANALYZE silently measured the central path only)
+            res = self._query_ast(
+                select,
+                start_time,
+                end_time,
+                allowed_streams,
+                sql_key=None if _is_composite(select) else S.format_statement(select),
+            )
             st = res.stats
             plan_types.append("analyze")
             parts = [f"rows_out={res.table.num_rows}"]
@@ -585,13 +595,45 @@ class QuerySession:
             plans.append(" ".join(parts))
             stages = st.get("stages")
             if stages:
-                # per-stage wall-time split (parse/plan/scan/execute)
+                # per-stage wall-time split (parse/plan/scan/execute);
+                # nested stage dicts (fanout/hotset) get their own rows
                 plan_types.append("stage_timing")
                 plans.append(
                     " ".join(
-                        f"{k}={v}" for k, v in stages.items() if v is not None
+                        f"{k}={v}"
+                        for k, v in stages.items()
+                        if v is not None and not isinstance(v, dict)
                     )
                 )
+            fanout = (stages or {}).get("fanout")
+            if fanout:
+                # distributed data plane: scatter totals + one line per peer
+                plan_types.append("fanout")
+                lines = [
+                    " ".join(
+                        f"{k}={fanout[k]}"
+                        for k in (
+                            "mode",
+                            "peers",
+                            "ok",
+                            "fallback",
+                            "hedged",
+                            "retries",
+                            "bytes",
+                            "fanin_bytes",
+                            "fanin_errors",
+                        )
+                        if fanout.get(k) is not None
+                    )
+                ]
+                for domain, pp in sorted((fanout.get("per_peer") or {}).items()):
+                    lines.append(
+                        f"peer {domain}: " + " ".join(
+                            f"{k}={pp.get(k)}"
+                            for k in ("result", "ms", "rows", "bytes", "attempts", "hedged")
+                        )
+                    )
+                plans.append("\n".join(lines))
             routes = st.get("device_routes")
             if routes is not None:
                 # adaptive dispatch, observable without a profiler

@@ -64,6 +64,10 @@ class ServerState:
 
     def __init__(self, p: Parseable):
         self.p = p
+        # stamp this process's cluster identity onto every span it records
+        # (node = the owner tag files/snapshots already carry), so a
+        # stitched cross-node trace can attribute spans to nodes
+        telemetry.set_node_identity(p.owner_tag.rstrip("."), p.options.mode.to_str())
         self.rbac = self._load_rbac()
         self.workers = ThreadPoolExecutor(max_workers=8, thread_name_prefix="ingest")
         # dedicated bounded executor for query CPU work: scans/aggregation
@@ -191,6 +195,16 @@ class ServerState:
         # stack sampler (reference: the hotpath profiling feature)
         telemetry.SPAN_SINK.attach(self.p)
         loop(10, telemetry.SPAN_SINK.flush, "span-flush")
+        # conservation-law audit: every node balances its own books on a
+        # timer; query/all nodes roll up peers (audit.py decides per mode)
+        if self.p.options.audit_interval_secs > 0:
+            from parseable_tpu import audit as _audit
+
+            loop(
+                self.p.options.audit_interval_secs,
+                lambda: _audit.audit_tick(self.p),
+                "audit",
+            )
         if self.p.options.profile_mode == "cpu":
             from parseable_tpu.utils.profiler import get_profiler
 
@@ -329,11 +343,18 @@ _TRACED_POST_PATHS = ("/api/v1/ingest", "/api/v1/query", "/api/v1/counts", "/v1/
 
 
 def _should_trace(request: web.Request) -> bool:
+    path = request.path
+    if request.method == "GET":
+        # intra-cluster staging fan-in: the peer's serving span must join
+        # the querier's propagated trace, not root a fresh per-node one
+        return path.startswith("/api/v1/internal/staging/")
     if request.method != "POST":
         return False
-    path = request.path
-    return path.startswith(_TRACED_POST_PATHS) or (
-        path.startswith("/api/v1/logstream/") and path.count("/") == 4
+    return (
+        path.startswith(_TRACED_POST_PATHS)
+        # partial-aggregate pushdown + control-plane sync hops
+        or path.startswith("/api/v1/internal/")
+        or (path.startswith("/api/v1/logstream/") and path.count("/") == 4)
     )
 
 
@@ -342,17 +363,40 @@ async def trace_middleware(request: web.Request, handler):
     """One trace per ingest/query request (reference: telemetry.rs tracing
     layer around the actix handlers). Honors an incoming W3C `traceparent`
     so spans parent under the caller's trace; the assigned trace id is
-    echoed back in X-P-Trace-Id for /api/v1/debug/spans lookups."""
+    echoed back in X-P-Trace-Id for /api/v1/debug/spans lookups — on the
+    error paths too, where trace lookup matters most: an HTTPException
+    (aiohttp's 4xx/5xx idiom) gets the header and an errored span before
+    it propagates, and an unexpected raise becomes a 500 that still
+    carries the trace id."""
     if not _should_trace(request):
         return await handler(request)
     with telemetry.trace_context(request.headers.get("traceparent")) as trace_id:
-        with telemetry.TRACER.span(
-            "http.request", method=request.method, path=request.path
-        ) as sp:
-            resp = await handler(request)
-            sp["status_code"] = resp.status
-            if resp.status >= 500:
-                sp["status"] = "error"
+        try:
+            with telemetry.TRACER.span(
+                "http.request", method=request.method, path=request.path
+            ) as sp:
+                try:
+                    resp = await handler(request)
+                except web.HTTPException as e:
+                    sp["status_code"] = e.status
+                    if e.status >= 400:
+                        sp["status"] = "error"
+                    e.headers["X-P-Trace-Id"] = trace_id
+                    raise
+                sp["status_code"] = resp.status
+                if resp.status >= 500:
+                    sp["status"] = "error"
+        except web.HTTPException:
+            raise  # already stamped above; aiohttp renders it as a response
+        except Exception:
+            # CancelledError is BaseException (py3.8+), so shutdown/client
+            # aborts pass through untouched
+            logger.exception("unhandled error in %s %s", request.method, request.path)
+            return web.json_response(
+                {"error": "internal server error"},
+                status=500,
+                headers={"X-P-Trace-Id": trace_id},
+            )
         resp.headers["X-P-Trace-Id"] = trace_id
         return resp
 
@@ -557,12 +601,31 @@ async def debug_spans(request: web.Request) -> web.Response:
     also lands in the `pmeta` stream. Pair with the X-P-Trace-Id response
     header to pull one request's full span tree."""
     trace_id = request.query.get("trace_id")
+    if trace_id is not None:
+        trace_id = trace_id.strip().lower()
+        if len(trace_id) != 32 or any(c not in "0123456789abcdef" for c in trace_id):
+            return web.json_response(
+                {"error": "trace_id must be 32 hex characters"}, status=400
+            )
     try:
         limit = int(request.query.get("limit", "1000"))
     except ValueError:
         return web.json_response({"error": "limit must be an integer"}, status=400)
-    spans = telemetry.recent_spans(trace_id, max(1, min(limit, telemetry.SPAN_RING_SIZE)))
-    return web.json_response({"count": len(spans), "spans": spans})
+    if limit <= 0:
+        return web.json_response({"error": "limit must be positive"}, status=400)
+    spans = telemetry.recent_spans(trace_id, min(limit, telemetry.SPAN_RING_SIZE))
+    ident = telemetry.node_identity()
+    # node_time: this node's wall clock mid-response, read by the cluster
+    # trace assembler for its NTP-style per-peer clock-offset estimate
+    return web.json_response(
+        {
+            "count": len(spans),
+            "spans": spans,
+            "node_time": time.time(),
+            "node": ident["node"],
+            "role": ident["role"],
+        }
+    )
 
 
 async def login(request: web.Request) -> web.Response:
@@ -664,7 +727,10 @@ async def _do_ingest(
         state.p.create_stream_if_not_exists(
             stream_name, log_source=log_source, telemetry_type=telemetry_type
         )
-        return flatten_and_push_logs(
+        # baseline BEFORE the push: the first tracked batch must not count
+        # itself into its own conservation baseline (audit.py Ledger)
+        state.p.audit.ensure_stream(state.p, stream_name)
+        n = flatten_and_push_logs(
             state.p,
             stream_name,
             payload,
@@ -674,6 +740,8 @@ async def _do_ingest(
             log_source_name=log_source_name,
             raw_body=body,
         )
+        state.p.audit.record_acked(stream_name, n)
+        return n
 
     try:
         count = await _run_traced(state, work)
@@ -1427,8 +1495,20 @@ async def internal_staging(request: web.Request) -> web.Response:
         import pyarrow as pa
         import pyarrow.compute as pc
         import pyarrow.ipc as ipc
+        import pyarrow.parquet as pq
 
         batches = stream.staging_batches()
+        # flushed-but-not-yet-uploaded parquet is part of this node's
+        # staging window too — without it, rows are invisible to remote
+        # queriers for a whole upload interval. Unclaimed == not yet
+        # committed, so the querier's manifest scan can't double-count.
+        for f in stream.unclaimed_parquet_files():
+            try:
+                batches.extend(pq.read_table(f).to_batches())
+            except FileNotFoundError:
+                continue
+            except Exception:
+                logger.exception("staging fan-in: unreadable staged parquet %s", f)
         if not batches:
             return b""
         from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
@@ -1982,6 +2062,43 @@ async def cluster_metrics(request: web.Request) -> web.Response:
     return web.json_response(data)
 
 
+@require(Action.METRICS)
+async def cluster_trace(request: web.Request) -> web.Response:
+    """GET /api/v1/cluster/trace/{trace_id}: fan out to every live peer's
+    span ring and return ONE stitched, skew-corrected span tree with
+    critical-path attribution — the cluster-wide view of the trace id a
+    query response echoed in X-P-Trace-Id."""
+    state: ServerState = request.app["state"]
+    trace_id = request.match_info["trace_id"].strip().lower()
+    if len(trace_id) != 32 or any(c not in "0123456789abcdef" for c in trace_id):
+        return web.json_response(
+            {"error": "trace_id must be 32 hex characters"}, status=400
+        )
+    from parseable_tpu.server import cluster as C
+
+    data = await _run_traced(state, C.assemble_cluster_trace, state.p, trace_id)
+    return web.json_response(data)
+
+
+@require(Action.LIST_CLUSTER_METRICS)
+async def cluster_audit(request: web.Request) -> web.Response:
+    """GET /api/v1/cluster/audit[?scope=local|cluster&quiesce=0|1]: run the
+    conservation-law audit on demand (audit.py). Defaults assert quiesce —
+    call it after draining to check the books balance; quiesce=0 applies
+    only the at-rest/monotonicity checks safe under load."""
+    state: ServerState = request.app["state"]
+    scope = request.query.get("scope", "cluster")
+    if scope not in ("local", "cluster"):
+        return web.json_response(
+            {"error": "scope must be 'local' or 'cluster'"}, status=400
+        )
+    quiesce = request.query.get("quiesce", "1") not in ("0", "false")
+    from parseable_tpu import audit as A
+
+    report = await _run_traced(state, A.run_audit, state.p, scope, quiesce)
+    return web.json_response(report)
+
+
 @require(Action.DELETE_NODE)
 async def remove_node_handler(request: web.Request) -> web.Response:
     """DELETE /api/v1/cluster/{node_id}: deregister a dead node
@@ -2101,6 +2218,11 @@ def build_app(state: ServerState) -> web.Application:
     _oidc.register(r)
     r.add_get("/api/v1/cluster/info", cluster_info)
     r.add_get("/api/v1/cluster/metrics", cluster_metrics)
+    # sub-resources before the generic /cluster/{node_id} delete (aiohttp
+    # matches in registration order); every mode serves both — an ingestor
+    # answers scope=local audits and contributes spans to stitched traces
+    r.add_get("/api/v1/cluster/trace/{trace_id}", cluster_trace)
+    r.add_get("/api/v1/cluster/audit", cluster_audit)
     r.add_delete("/api/v1/cluster/{node_id}", remove_node_handler)
     r.add_post("/api/v1/internal/rbac/reload", internal_rbac_reload)
 

@@ -71,6 +71,15 @@ def _auth_header(p: Parseable) -> str:
     return "Basic " + base64.b64encode(cred).decode()
 
 
+def _inject_trace(req: urllib.request.Request) -> None:
+    """Stamp the caller's W3C traceparent onto an intra-cluster request so
+    the peer's `http.request` span parents under this node's trace instead
+    of rooting a fresh per-node one. No ambient trace -> no header."""
+    tp = telemetry.current_traceparent()
+    if tp is not None:
+        req.add_header("traceparent", tp)
+
+
 def _urlopen(req, timeout: float, p: Parseable | None = None):
     """Intra-cluster urlopen: https peers get the cluster client context
     (trusted-CA dir + P_TLS_SKIP_VERIFY for IP-dialed nodes — reference
@@ -88,9 +97,13 @@ def check_liveness(domain: str, p: Parseable | None = None) -> bool:
     if cached is not None and time.monotonic() - cached < DEAD_NODE_TTL:
         return False
     try:
-        req = urllib.request.Request(f"{domain}/api/v1/liveness", method="GET")
-        with _urlopen(req, LIVENESS_TIMEOUT, p) as resp:
-            ok = resp.status == 200
+        with telemetry.TRACER.span("cluster.liveness", peer=domain) as sp:
+            req = urllib.request.Request(f"{domain}/api/v1/liveness", method="GET")
+            _inject_trace(req)  # inside the span: peer parents under it
+            with _urlopen(req, LIVENESS_TIMEOUT, p) as resp:
+                ok = resp.status == 200
+            if not ok:
+                sp["status"] = "error"
     except (urllib.error.URLError, OSError):
         ok = False
     if not ok:
@@ -135,33 +148,40 @@ def _fetch_one(
     qs = _staging_params(time_bounds, columns)
     if qs:
         url = f"{url}?{qs}"
-    req = urllib.request.Request(url, headers={"Authorization": _auth_header(p)})
-    try:
-        with _urlopen(req, STAGING_TIMEOUT, p) as resp:
-            if resp.status == 204:
-                return []
-            data = resp.read()
-    except (urllib.error.URLError, OSError) as e:
-        logger.warning("staging fan-in from %s failed: %s", domain, e)
-        CLUSTER_FANIN_ERRORS.labels(domain).inc()
+    with telemetry.TRACER.span(
+        "cluster.fanin", peer=domain, stream=stream
+    ) as sp:
+        req = urllib.request.Request(url, headers={"Authorization": _auth_header(p)})
+        _inject_trace(req)
+        try:
+            with _urlopen(req, STAGING_TIMEOUT, p) as resp:
+                if resp.status == 204:
+                    return []
+                data = resp.read()
+        except (urllib.error.URLError, OSError) as e:
+            logger.warning("staging fan-in from %s failed: %s", domain, e)
+            CLUSTER_FANIN_ERRORS.labels(domain).inc()
+            sp["status"] = "error"
+            if stats is not None:
+                stats["errors"] = stats.get("errors", 0) + 1
+            _dead_nodes[domain] = time.monotonic()
+            return []
+        if not data:
+            return []
+        CLUSTER_FANIN_BYTES.labels(domain).inc(len(data))
+        sp["bytes"] = len(data)
         if stats is not None:
-            stats["errors"] = stats.get("errors", 0) + 1
-        _dead_nodes[domain] = time.monotonic()
-        return []
-    if not data:
-        return []
-    CLUSTER_FANIN_BYTES.labels(domain).inc(len(data))
-    if stats is not None:
-        stats["bytes"] = stats.get("bytes", 0) + len(data)
-    try:
-        with ipc.open_stream(io.BytesIO(data)) as reader:
-            return list(reader)
-    except pa.ArrowInvalid as e:
-        logger.warning("bad staging payload from %s: %s", domain, e)
-        CLUSTER_FANIN_ERRORS.labels(domain).inc()
-        if stats is not None:
-            stats["errors"] = stats.get("errors", 0) + 1
-        return []
+            stats["bytes"] = stats.get("bytes", 0) + len(data)
+        try:
+            with ipc.open_stream(io.BytesIO(data)) as reader:
+                return list(reader)
+        except pa.ArrowInvalid as e:
+            logger.warning("bad staging payload from %s: %s", domain, e)
+            CLUSTER_FANIN_ERRORS.labels(domain).inc()
+            sp["status"] = "error"
+            if stats is not None:
+                stats["errors"] = stats.get("errors", 0) + 1
+            return []
 
 
 def fetch_staging_batches(
@@ -213,6 +233,7 @@ def fetch_staging_batches(
 def _http(p: Parseable, method: str, url: str, body: bytes | None = None, headers=None, timeout=10.0):
     req = urllib.request.Request(url, data=body, method=method)
     req.add_header("Authorization", _auth_header(p))
+    _inject_trace(req)  # every management-plane hop joins the caller's trace
     for k, v in (headers or {}).items():
         req.add_header(k, v)
     if body is not None and "Content-Type" not in (headers or {}):
@@ -252,13 +273,18 @@ def sync_with_ingestors(
     failed: list[str] = []
 
     def one(domain: str) -> None:
-        try:
-            with _http(p, method, f"{domain}{path}", body, headers) as resp:
-                if resp.status >= 300:
-                    failed.append(domain)
-        except (urllib.error.URLError, OSError) as e:
-            logger.warning("ingestor sync %s %s to %s failed: %s", method, path, domain, e)
-            failed.append(domain)
+        with telemetry.TRACER.span(
+            "cluster.sync", peer=domain, method=method, path=path
+        ) as sp:
+            try:
+                with _http(p, method, f"{domain}{path}", body, headers) as resp:
+                    if resp.status >= 300:
+                        sp["status"] = "error"
+                        failed.append(domain)
+            except (urllib.error.URLError, OSError) as e:
+                logger.warning("ingestor sync %s %s to %s failed: %s", method, path, domain, e)
+                sp["status"] = "error"
+                failed.append(domain)
 
     nodes = live_peers(p, kinds)
     list(get_cluster_pool().map(telemetry.propagate(one), [n["domain_name"] for n in nodes]))
@@ -323,12 +349,14 @@ def collect_node_metrics(p: Parseable) -> list[dict]:
                 "metrics": {},
             }
             if alive:
-                try:
-                    with _http(p, "GET", f"{domain}/api/v1/metrics", timeout=5.0) as resp:
-                        entry["metrics"] = parse_prometheus(resp.read().decode())
-                except (urllib.error.URLError, OSError) as e:
-                    logger.warning("metrics scrape of %s failed: %s", domain, e)
-                    entry["reachable"] = False
+                with telemetry.TRACER.span("cluster.scrape", peer=domain) as sp:
+                    try:
+                        with _http(p, "GET", f"{domain}/api/v1/metrics", timeout=5.0) as resp:
+                            entry["metrics"] = parse_prometheus(resp.read().decode())
+                    except (urllib.error.URLError, OSError) as e:
+                        logger.warning("metrics scrape of %s failed: %s", domain, e)
+                        sp["status"] = "error"
+                        entry["reachable"] = False
             out.append(entry)
     return out
 
@@ -451,8 +479,9 @@ def ingest_cluster_metrics(p: Parseable) -> int:
             if n.get("node_id") != p.node_id and not check_liveness(domain):
                 continue
             try:
-                with _http(p, "GET", f"{domain}/api/v1/metrics", timeout=5.0) as resp:
-                    text = resp.read().decode()
+                with telemetry.TRACER.span("cluster.scrape", peer=domain):
+                    with _http(p, "GET", f"{domain}/api/v1/metrics", timeout=5.0) as resp:
+                        text = resp.read().decode()
             except (urllib.error.URLError, OSError) as e:
                 logger.warning("pmeta scrape of %s failed: %s", domain, e)
                 continue
@@ -491,6 +520,101 @@ def ingest_cluster_metrics(p: Parseable) -> int:
         {"at": _time.time(), "nodes": scraped_nodes, "rows": len(rows)}
     )
     return len(rows)
+
+
+# ------------------------------------------------- cluster trace assembly
+# (this build's analogue of the reference's central cluster metrics rollup,
+#  applied to traces: the querier pulls every peer's span ring for one
+#  trace id and stitches a single skew-corrected tree)
+
+SPAN_FETCH_TIMEOUT = 5.0
+
+
+def _peer_spans(p: Parseable, node: dict, trace_id: str) -> tuple[dict, list[dict]]:
+    """One peer's span rows for `trace_id`, skew-corrected. The peer's
+    clock offset is estimated NTP-style from one round trip: the peer
+    reports its wall clock (`node_time`) mid-request, so
+    offset = node_time - (t0 + t3)/2 — exact when the path is symmetric,
+    bounded by rtt/2 when it is not. Peer span timestamps are shifted by
+    the offset so the stitched tree is on THIS node's clock."""
+    import json as _json
+
+    domain = node["domain_name"]
+    entry = {
+        "node_id": node.get("node_id"),
+        "domain_name": domain,
+        "role": "",
+        "offset_ms": 0.0,
+        "rtt_ms": 0.0,
+        "span_count": 0,
+        "reachable": False,
+    }
+    url = f"{domain}/api/v1/debug/spans?trace_id={trace_id}&limit={telemetry.SPAN_RING_SIZE}"
+    t0 = time.time()
+    try:
+        with _http(p, "GET", url, timeout=SPAN_FETCH_TIMEOUT) as resp:
+            payload = _json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        logger.warning("span fetch from %s failed: %s", domain, e)
+        return entry, []
+    t3 = time.time()
+    node_time = payload.get("node_time")
+    offset = (
+        float(node_time) - (t0 + t3) / 2.0
+        if isinstance(node_time, (int, float))
+        else 0.0
+    )
+    spans = [telemetry.shift_span_ts(s, offset) for s in payload.get("spans", [])]
+    entry.update(
+        role=payload.get("role") or "",
+        reachable=True,
+        offset_ms=round(offset * 1000.0, 3),
+        rtt_ms=round((t3 - t0) * 1000.0, 3),
+        span_count=len(spans),
+    )
+    return entry, spans
+
+
+def assemble_cluster_trace(p: Parseable, trace_id: str) -> dict:
+    """Fan out to every live peer's span ring and stitch ONE tree for
+    `trace_id`: local spans as-recorded, peer spans shifted onto this
+    node's clock, deduped by span id, nested by parentage. `orphans`
+    counts spans whose recorded parent is missing from the assembled set —
+    zero when propagation covered every hop."""
+    ident = telemetry.node_identity()
+    local = telemetry.recent_spans(trace_id, telemetry.SPAN_RING_SIZE)
+    nodes = [
+        {
+            "node_id": p.node_id,
+            "domain_name": "local",
+            "role": ident.get("role") or p.options.mode.to_str(),
+            "offset_ms": 0.0,
+            "rtt_ms": 0.0,
+            "span_count": len(local),
+            "reachable": True,
+        }
+    ]
+    spans = list(local)
+    peers = live_peers(p, ("ingestor", "querier", "all"))
+    if peers:
+        pool = get_cluster_pool()
+        futures = [
+            pool.submit(telemetry.propagate(_peer_spans), p, n, trace_id)
+            for n in peers
+        ]
+        for f in as_completed(futures):
+            entry, peer_spans = f.result()
+            nodes.append(entry)
+            spans.extend(peer_spans)
+    tree, orphans = telemetry.build_span_tree(spans)
+    return {
+        "trace_id": trace_id,
+        "span_count": len({s.get("span_id") for s in spans if s.get("span_id")}),
+        "nodes": nodes,
+        "tree": tree,
+        "orphans": orphans,
+        "critical_path": telemetry.critical_path(tree),
+    }
 
 
 def remove_node(p: Parseable, node_id: str) -> bool:

@@ -211,7 +211,10 @@ class LocalFS(ObjectStorage):
         with timed(self.name, "PUT"):
             p = self._abs(key)
             p.parent.mkdir(parents=True, exist_ok=True)
-            tmp = p.with_name(p.name + ".tmp")
+            # tmp name must be writer-unique: multiple nodes share this
+            # store, and two concurrent puts of the same key with one tmp
+            # name race — the first os.replace consumes the other's tmp
+            tmp = p.with_name(f"{p.name}.{os.getpid()}.{threading.get_ident()}.tmp")
             tmp.write_bytes(data)
             os.replace(tmp, p)
 
@@ -264,7 +267,10 @@ class LocalFS(ObjectStorage):
         with timed(self.name, "PUT"):
             dest = self._abs(key)
             dest.parent.mkdir(parents=True, exist_ok=True)
-            tmp = dest.with_name(dest.name + ".tmp")
+            # writer-unique tmp: the store is shared across node processes
+            tmp = dest.with_name(
+                f"{dest.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+            )
             shutil.copyfile(path, tmp)
             os.replace(tmp, dest)
 

@@ -152,6 +152,15 @@ class Stream:
             if p.suffix == ".parquet" and not p.name.endswith(".part.parquet")
         )
 
+    def unclaimed_parquet_files(self) -> list[Path]:
+        """Staged parquet no upload cycle has claimed: provably not yet
+        committed to the manifest (claims release only after commit+unlink
+        or a failure that leaves the file uncommitted), so the staging
+        fan-in can serve these rows without double-counting the snapshot."""
+        files = self.parquet_files()
+        with self.lock:
+            return [f for f in files if f not in self._claimed_parquet]
+
     def staging_batches(self) -> list[pa.RecordBatch]:
         """Query-visible recent data: memory buffer, else on-disk arrows.
 

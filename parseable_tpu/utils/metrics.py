@@ -209,6 +209,16 @@ CLUSTER_FANOUT_LATENCY = Histogram(
     registry=REGISTRY,
 )
 
+# conservation-law auditor (parseable_tpu/audit.py): each detected
+# invariant breach ticks once, labeled by invariant name (rows_conserved /
+# snapshot_monotonic / gauges_zero / queryable_count) — the soak battery's
+# "did we lose or double-count rows" alarm
+AUDIT_VIOLATIONS = _counter(
+    "audit_violations",
+    "Conservation-law audit violations by invariant",
+    ["invariant"],
+)
+
 # errors a storage backend deliberately recovers from (credential-probe
 # fallbacks, best-effort session cancels): recoverable by design, but a
 # nonzero rate is the early signal of a flapping metadata server or a

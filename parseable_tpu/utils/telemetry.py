@@ -70,6 +70,32 @@ def current_span_id() -> str | None:
     return ctx[1] if ctx else None
 
 
+def current_traceparent() -> str | None:
+    """Outgoing W3C traceparent for the executing context, or None when
+    there is no ambient trace or no current span to parent under. Injected
+    into every intra-cluster HTTP hop (server/cluster.py) so peer spans
+    join the caller's trace instead of rooting fresh per-node traces."""
+    ctx = _TRACE_CTX.get()
+    if ctx is None or ctx[1] is None:
+        return None
+    return f"00-{ctx[0]}-{ctx[1]}-01"
+
+
+# this process's cluster identity, stamped onto every finished span row so
+# a stitched cross-node trace can attribute each span to the node that
+# recorded it (node = the owner tag files/snapshots already carry)
+_NODE_IDENTITY: dict[str, str] = {"node": "", "role": ""}
+
+
+def set_node_identity(node: str, role: str) -> None:
+    _NODE_IDENTITY["node"] = node
+    _NODE_IDENTITY["role"] = role
+
+
+def node_identity() -> dict[str, str]:
+    return dict(_NODE_IDENTITY)
+
+
 def parse_traceparent(header: str | None) -> tuple[str, str] | None:
     """W3C traceparent `00-<32x trace>-<16x span>-<2x flags>` ->
     (trace_id, parent_span_id), or None when absent/malformed/all-zero."""
@@ -297,7 +323,10 @@ class Tracer:
             "duration_ms": round((end_ns - start_ns) / 1e6, 3),
             "bytes": int(attrs.get("bytes", 0) or 0),
             "status": "error" if err else str(attrs.get("status", "ok")),
+            "status_code": int(attrs.get("status_code", 0) or 0),
             "ts": _rfc3339_ns(start_ns),
+            "node": _NODE_IDENTITY["node"],
+            "role": _NODE_IDENTITY["role"],
         }
         _SPAN_RING.append(row)
         SPAN_SINK.record(row)
@@ -404,6 +433,94 @@ def _rfc3339_ns(ns: int) -> str:
         .isoformat(timespec="milliseconds")
         .replace("+00:00", "Z")
     )
+
+
+# ------------------------------------------------- cross-node trace stitching
+# Pure functions over span ROWS (the ring/pmeta shape) — the cluster trace
+# endpoint (server/cluster.py assemble_cluster_trace) gathers rows from every
+# peer, skew-corrects their timestamps, and stitches ONE tree here.
+
+
+def span_window(span: dict) -> tuple[float, float]:
+    """(start_epoch_s, end_epoch_s) of a span row, from its RFC3339 `ts`
+    and `duration_ms`."""
+    from datetime import datetime
+
+    ts = str(span.get("ts", ""))
+    start = datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+    return start, start + float(span.get("duration_ms", 0.0)) / 1000.0
+
+
+def shift_span_ts(span: dict, offset_s: float) -> dict:
+    """Copy of `span` with `ts` shifted by offset_s (peer clock-skew
+    correction; positive offset = the peer's clock runs ahead of ours,
+    so its timestamps move back)."""
+    if not offset_s:
+        return dict(span)
+    from datetime import UTC, datetime
+
+    out = dict(span)
+    ts = str(span.get("ts", ""))
+    start = datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+    out["ts"] = (
+        datetime.fromtimestamp(start - offset_s, UTC)
+        .isoformat(timespec="milliseconds")
+        .replace("+00:00", "Z")
+    )
+    return out
+
+
+def build_span_tree(spans: list[dict]) -> tuple[list[dict], int]:
+    """Stitch span rows into nested trees: each node is a copy with a
+    `children` list (ordered by start time). Returns (roots, orphans) —
+    an orphan is a span claiming a parent that is not in the set (it is
+    promoted to a root so nothing is dropped, but counted: a fully
+    propagated trace has zero orphans)."""
+    by_id: dict[str, dict] = {}
+    for s in spans:
+        sid = s.get("span_id", "")
+        if sid and sid not in by_id:  # dedupe (a span is recorded on one node)
+            by_id[sid] = dict(s, children=[])
+    roots: list[dict] = []
+    orphans = 0
+    for node in by_id.values():
+        parent = node.get("parent_span_id") or ""
+        if parent and parent in by_id:
+            by_id[parent]["children"].append(node)
+        else:
+            if parent:
+                orphans += 1
+            roots.append(node)
+    for node in by_id.values():
+        node["children"].sort(key=span_window)
+    roots.sort(key=span_window)
+    return roots, orphans
+
+
+def critical_path(roots: list[dict]) -> list[dict]:
+    """Latest-finisher walk from the latest-ending root: at each level,
+    descend into the child that finishes last (the one the parent actually
+    waited for). `self_ms` is the slice of each span not covered by the
+    next hop — where the wall-clock time was actually spent."""
+    if not roots:
+        return []
+    node = max(roots, key=lambda s: span_window(s)[1])
+    path: list[dict] = []
+    while node is not None:
+        nxt = max(node["children"], key=lambda s: span_window(s)[1]) if node["children"] else None
+        dur = float(node.get("duration_ms", 0.0))
+        self_ms = max(0.0, dur - float(nxt.get("duration_ms", 0.0))) if nxt else dur
+        path.append(
+            {
+                "name": node.get("name", ""),
+                "node": node.get("node", ""),
+                "span_id": node.get("span_id", ""),
+                "duration_ms": round(dur, 3),
+                "self_ms": round(self_ms, 3),
+            }
+        )
+        node = nxt
+    return path
 
 
 TRACER = Tracer()

@@ -94,6 +94,30 @@ def http_json(
             return resp.status, None
 
 
+def http_json_headers(
+    method: str,
+    url: str,
+    body: dict | list | None = None,
+    headers: dict | None = None,
+    timeout: float = 30.0,
+):
+    """Like http_json but also returns response headers — trace-stitching
+    scenarios need X-P-Trace-Id off the query response."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    for k, v in {**AUTH_HEADER, **(headers or {})}.items():
+        req.add_header(k, v)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+        try:
+            parsed = json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            parsed = None
+        return resp.status, parsed, dict(resp.headers)
+
+
 class ClusterHarness:
     """Spawn + drive a real multi-process cluster over one LocalFS store."""
 
@@ -112,7 +136,9 @@ class ClusterHarness:
         port = port or free_port()
         staging = self.workdir / f"staging-{name}"
         staging.mkdir(parents=True, exist_ok=True)
-        log_path = self.workdir / f"{name}.log"
+        log_dir = self.workdir / "logs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        log_path = log_dir / f"{name}.log"
         env = dict(os.environ)
         env.update(
             {
@@ -128,7 +154,11 @@ class ClusterHarness:
             }
         )
         env.update(env_extra or {})
-        log = open(log_path, "wb")
+        # append: a re-spawned node (rolling restart, crash-recovery
+        # scenarios) keeps its pre-kill log instead of truncating it
+        log = open(log_path, "ab")
+        log.write(f"--- spawn {name} mode={mode} port={port} ---\n".encode())
+        log.flush()
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "parseable_tpu.server"],
@@ -192,6 +222,68 @@ class ClusterHarness:
             raise RuntimeError(f"query on :{node.port} failed: {status} {out}")
         return out["records"], out.get("stats", {})
 
+    def query_traced(
+        self,
+        node: Node,
+        sql: str,
+        start: str | None = None,
+        end: str | None = None,
+        timeout: float = 60.0,
+    ) -> tuple[list[dict], dict, str]:
+        """query() + the X-P-Trace-Id the server minted for this request."""
+        body: dict = {"query": sql, "fields": True}
+        if start:
+            body["startTime"] = start
+        if end:
+            body["endTime"] = end
+        status, out, headers = http_json_headers(
+            "POST", f"{node.url}/api/v1/query", body, timeout=timeout
+        )
+        if status != 200 or out is None:
+            raise RuntimeError(f"query on :{node.port} failed: {status} {out}")
+        return out["records"], out.get("stats", {}), headers.get("X-P-Trace-Id", "")
+
+    def cluster_trace(self, node: Node, trace_id: str, timeout: float = 30.0) -> dict:
+        """GET the stitched cross-node span tree for one trace."""
+        status, out = http_json(
+            "GET", f"{node.url}/api/v1/cluster/trace/{trace_id}", timeout=timeout
+        )
+        if status != 200 or out is None:
+            raise RuntimeError(f"cluster trace on :{node.port} failed: {status} {out}")
+        return out
+
+    def audit(
+        self,
+        node: Node,
+        scope: str = "cluster",
+        quiesce: bool = True,
+        timeout: float = 60.0,
+    ) -> dict:
+        """Run the conservation-law audit and return its report."""
+        url = (
+            f"{node.url}/api/v1/cluster/audit"
+            f"?scope={scope}&quiesce={'1' if quiesce else '0'}"
+        )
+        status, out = http_json("GET", url, timeout=timeout)
+        if status != 200 or out is None:
+            raise RuntimeError(f"audit on :{node.port} failed: {status} {out}")
+        return out
+
+    def log_tails(self, limit: int = 2000) -> str:
+        """Per-node log tails, for attaching to failure reports."""
+        chunks = []
+        seen: set[Path] = set()
+        for node in self.nodes:
+            if node.log_path in seen:
+                continue
+            seen.add(node.log_path)
+            try:
+                text = node.log_path.read_text(errors="replace")[-limit:]
+            except OSError as e:
+                text = f"(log unreadable: {e})"
+            chunks.append(f"--- {node.log_path.name} ({node.mode}:{node.port}) ---\n{text}")
+        return "\n".join(chunks)
+
     def stop_all(self) -> None:
         for node in self.nodes:
             node.stop()
@@ -200,5 +292,13 @@ class ClusterHarness:
     def __enter__(self) -> "ClusterHarness":
         return self
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None and self.nodes:
+            # scenario failed: surface what every node was doing before
+            # teardown destroys the processes (logs stay on disk under
+            # workdir/logs/ either way)
+            sys.stderr.write(
+                f"\n[blackbox] scenario failed ({exc_type.__name__}); "
+                f"node log tails:\n{self.log_tails()}\n"
+            )
         self.stop_all()

@@ -105,3 +105,17 @@ if [ "${NSAN:-1}" != "0" ]; then
 else
   echo "check_green: nsan SKIPPED (NSAN=0)"
 fi
+
+# observability gate: the multi-process cluster smoke — distributed trace
+# stitching (one cross-node span tree per query) and the conservation-law
+# audit (zero violations at quiesce) over REAL server processes. Opt out
+# with OBS_CLUSTER=0 (boots 3 processes; ~half a minute on a warm cache).
+if [ "${OBS_CLUSTER:-1}" != "0" ]; then
+  if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py --cluster; then
+    echo "check_green: OBS CLUSTER RED (trace stitching / audit smoke failed)" >&2
+    exit 1
+  fi
+  echo "check_green: obs cluster GREEN"
+else
+  echo "check_green: obs cluster SKIPPED (OBS_CLUSTER=0)"
+fi

@@ -10,7 +10,13 @@ Asserts the self-observability pipeline is actually wired end to end:
 - `SELECT count(*) FROM pmeta` > 0 through the normal SQL path after the
   span sink flushes.
 
-Runnable standalone (`python scripts/obs_smoke.py`) and from
+`--cluster` runs the multi-process variant on top (scripts/blackbox.py):
+a real 1-querier + 2-ingestor cluster, a distributed query whose
+X-P-Trace-Id stitches into ONE cross-node span tree via
+GET /api/v1/cluster/trace/{id}, an EXPLAIN ANALYZE with a per-peer fanout
+row, and a conservation-law audit reporting zero violations at quiesce.
+
+Runnable standalone (`python scripts/obs_smoke.py [--cluster]`) and from
 tests/test_observability.py as a `not slow` test.
 """
 
@@ -134,10 +140,118 @@ def run_smoke(workdir: Path) -> dict:
         state.stop()
 
 
-def main() -> int:
+def _load_blackbox():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "blackbox", Path(__file__).resolve().parent / "blackbox.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_cluster_smoke(workdir: Path) -> dict:
+    """Multi-process observability smoke: distributed trace stitching +
+    conservation audit over a REAL 1-querier / 2-ingestor cluster.
+    Raises AssertionError on any broken link."""
+    import time
+
+    bb = _load_blackbox()
+    # frozen sync: rows stay in staging, so the distributed query MUST
+    # touch both peers (fan-out/fan-in) and the audit books carry a
+    # nonzero staging term on both ingestors
+    frozen = {
+        "P_LOCAL_SYNC_INTERVAL": "3600",
+        "P_STORAGE_UPLOAD_INTERVAL": "3600",
+    }
+    with bb.ClusterHarness(workdir) as cluster:
+        ing0 = cluster.spawn("ingest", "ing0", env_extra=frozen)
+        ing1 = cluster.spawn("ingest", "ing1", env_extra=frozen)
+        q = cluster.spawn("query", "q0")
+        for node in (ing0, ing1, q):
+            cluster.wait_live(node)
+
+        for ing in (ing0, ing1):
+            cluster.ingest(
+                ing, "csmoke", [{"host": f"h{i % 2}", "v": float(i)} for i in range(30)]
+            )
+
+        # distributed visibility first: discovery + fan-in are async
+        def count_rows() -> int:
+            try:
+                recs, _ = cluster.query(q, "SELECT count(*) c FROM csmoke", "10m", "now")
+            except RuntimeError:
+                return -1
+            return int(recs[0]["c"]) if recs else 0
+
+        deadline = time.monotonic() + 90
+        seen = count_rows()
+        while time.monotonic() < deadline and seen != 60:
+            time.sleep(0.5)
+            seen = count_rows()
+        assert seen == 60, f"querier saw {seen}/60 rows"
+
+        # one distributed query -> ONE stitched cross-node trace
+        recs, stats, trace_id = cluster.query_traced(
+            q,
+            "SELECT host, count(*) c FROM csmoke GROUP BY host ORDER BY host",
+            "10m",
+            "now",
+        )
+        assert recs == [{"host": "h0", "c": 30}, {"host": "h1", "c": 30}], recs
+        assert len(trace_id) == 32, f"bad X-P-Trace-Id {trace_id!r}"
+        fanout = (stats.get("stages") or {}).get("fanout") or {}
+        assert fanout.get("per_peer"), f"no per-peer fanout breakdown: {stats}"
+
+        tree = cluster.cluster_trace(q, trace_id)
+        assert tree["orphans"] == 0, tree
+        assert tree["span_count"] > 0 and tree["tree"], tree
+        contributing = [n for n in tree["nodes"] if n["span_count"] > 0]
+        assert len(contributing) >= 3, (
+            f"expected querier + both ingestors in the trace, got {tree['nodes']}"
+        )
+        assert tree["critical_path"], tree
+
+        # EXPLAIN ANALYZE surfaces the same breakdown as a plan row
+        plan, _ = cluster.query(
+            q,
+            "EXPLAIN ANALYZE SELECT host, count(*) c FROM csmoke GROUP BY host",
+            "10m",
+            "now",
+        )
+        plan_types = {r.get("plan_type") for r in plan}
+        assert "fanout" in plan_types, f"no fanout plan row: {plan}"
+
+        # conservation audit: zero violations once the cluster is at rest
+        deadline = time.monotonic() + 60
+        report = cluster.audit(q, scope="cluster", quiesce=True)
+        while time.monotonic() < deadline and report["total_violations"]:
+            time.sleep(1.0)
+            report = cluster.audit(q, scope="cluster", quiesce=True)
+        assert report["total_violations"] == 0, report["violations"]
+        assert len(report["nodes"]) == 3 and all(
+            n.get("reachable") for n in report["nodes"]
+        ), report["nodes"]
+        return {
+            "trace_id": trace_id,
+            "trace_nodes": len(contributing),
+            "span_count": tree["span_count"],
+            "critical_path": [s["name"] for s in tree["critical_path"]],
+            "audit_nodes": len(report["nodes"]),
+            "violations": report["total_violations"],
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
     with tempfile.TemporaryDirectory(prefix="obs-smoke-") as d:
         result = run_smoke(Path(d))
     print("obs smoke OK:", result)
+    if "--cluster" in argv:
+        with tempfile.TemporaryDirectory(prefix="obs-smoke-cluster-") as d:
+            cluster_result = run_cluster_smoke(Path(d))
+        print("obs cluster smoke OK:", cluster_result)
     return 0
 
 

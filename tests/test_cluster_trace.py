@@ -1,0 +1,243 @@
+"""Cross-node trace stitching: traceparent propagation over every internal
+hop (control-plane sync, staging fan-in), skew-corrected span-tree assembly,
+and the GET /api/v1/cluster/trace/{trace_id} surface.
+
+In-process variant of what scripts/obs_smoke.py --cluster asserts over real
+processes: the peer here is a real aiohttp TestServer, so propagation runs
+over actual HTTP — but both sides share one span ring, which is exactly
+what lets recent_spans() see the whole stitched story synchronously.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from parseable_tpu.config import Mode, Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.server import cluster as C
+from parseable_tpu.server.app import ServerState, build_app
+from parseable_tpu.utils import telemetry
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+
+def make_parseable(tmp_path, node: str, mode: Mode) -> Parseable:
+    opts = Options()
+    opts.mode = mode
+    opts.query_engine = "cpu"
+    opts.local_staging_path = tmp_path / f"staging-{node}"
+    storage = StorageOptions(backend="local-store", root=tmp_path / "shared-store")
+    return Parseable(opts, storage)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.clear_recent_spans()
+    yield
+    telemetry.clear_recent_spans()
+
+
+# ----------------------------------------------------- pure stitching helpers
+
+
+def _span(sid, parent, name="s", ts="2026-08-05T00:00:00.000Z", dur=10.0, node="n0"):
+    return {
+        "span_id": sid,
+        "parent_span_id": parent,
+        "name": name,
+        "ts": ts,
+        "duration_ms": dur,
+        "node": node,
+    }
+
+
+def test_build_span_tree_nests_dedupes_and_counts_orphans():
+    spans = [
+        _span("a" * 16, None, "root"),
+        _span("b" * 16, "a" * 16, "child", ts="2026-08-05T00:00:00.002Z", dur=5.0),
+        _span("b" * 16, "a" * 16, "dupe-from-peer-fetch"),  # deduped by id
+        _span("c" * 16, "f" * 16, "orphan"),  # parent absent -> promoted root
+    ]
+    roots, orphans = telemetry.build_span_tree(spans)
+    assert orphans == 1
+    by_name = {r["name"]: r for r in roots}
+    assert set(by_name) == {"root", "orphan"}
+    assert [c["name"] for c in by_name["root"]["children"]] == ["child"]
+
+
+def test_critical_path_walks_latest_finisher_with_self_ms():
+    spans = [
+        _span("a" * 16, None, "root", dur=100.0),
+        _span("b" * 16, "a" * 16, "fast", ts="2026-08-05T00:00:00.001Z", dur=10.0),
+        _span("c" * 16, "a" * 16, "slow", ts="2026-08-05T00:00:00.005Z", dur=80.0),
+    ]
+    roots, _ = telemetry.build_span_tree(spans)
+    path = telemetry.critical_path(roots)
+    assert [p["name"] for p in path] == ["root", "slow"]
+    assert path[0]["self_ms"] == pytest.approx(20.0)
+    assert path[1]["self_ms"] == pytest.approx(80.0)
+
+
+def test_shift_span_ts_corrects_peer_clock_skew():
+    s = _span("a" * 16, None, ts="2026-08-05T12:00:01.500Z")
+    shifted = telemetry.shift_span_ts(s, 1.5)  # peer clock 1.5s ahead
+    assert shifted["ts"] == "2026-08-05T12:00:00.000Z"
+    assert telemetry.shift_span_ts(s, 0.0)["ts"] == s["ts"]
+    # window math follows the shift
+    start0, _ = telemetry.span_window(s)
+    start1, _ = telemetry.span_window(shifted)
+    assert start0 - start1 == pytest.approx(1.5)
+
+
+# ------------------------------------------------- propagation over real HTTP
+
+
+def test_sync_and_fanin_spans_join_caller_trace(tmp_path):
+    """The two internal data/control hops — sync_with_ingestors and the
+    staging fan-in — must propagate traceparent: the peer's serving span
+    parents under the caller's hop span in ONE trace."""
+
+    async def scenario():
+        ing = make_parseable(tmp_path, "ing", Mode.INGEST)
+        ing_state = ServerState(ing)
+        ing_server = TestServer(build_app(ing_state))
+        await ing_server.start_server()
+        ing.register_node(f"127.0.0.1:{ing_server.port}")
+
+        q = make_parseable(tmp_path, "query", Mode.QUERY)
+
+        # seed the ingestor's staging over its public API
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://127.0.0.1:{ing_server.port}/api/v1/ingest",
+                json=[{"k": i} for i in range(10)],
+                headers={**AUTH, "X-P-Stream": "ct"},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+
+        loop = asyncio.get_running_loop()
+        # blocking intra-cluster HTTP must leave the loop thread; the
+        # cluster pool (8 workers) has room for the nested fan-out submits
+        pool = C.get_cluster_pool()
+
+        def control_hop():
+            with telemetry.trace_context() as tid:
+                failed = C.sync_with_ingestors(
+                    q, "POST", "/api/v1/internal/rbac/reload"
+                )
+            assert failed == []
+            return tid
+
+        def data_hop():
+            with telemetry.trace_context() as tid:
+                batches = C.fetch_staging_batches(q, "ct")
+            assert sum(b.num_rows for b in batches) == 10
+            return tid
+
+        sync_tid = await loop.run_in_executor(pool, control_hop)
+        fanin_tid = await loop.run_in_executor(pool, data_hop)
+
+        sync_spans = telemetry.recent_spans(sync_tid)
+        by_name = {s["name"]: s for s in sync_spans}
+        assert "cluster.sync" in by_name, {s["name"] for s in sync_spans}
+        # the ingestor's serving span joined the SAME trace, parented
+        # under the querier's hop span (W3C propagation over real HTTP)
+        serving = [s for s in sync_spans if s["name"] == "http.request"]
+        assert serving and all(
+            s["parent_span_id"] == by_name["cluster.sync"]["span_id"] for s in serving
+        )
+
+        fanin_spans = telemetry.recent_spans(fanin_tid)
+        by_name = {s["name"]: s for s in fanin_spans}
+        assert "cluster.fanin" in by_name
+        assert by_name["cluster.fanin"]["stream"] == "ct"
+        serving = [s for s in fanin_spans if s["name"] == "http.request"]
+        assert serving and all(
+            s["parent_span_id"] == by_name["cluster.fanin"]["span_id"] for s in serving
+        )
+        # every span carries the producing node's identity tags
+        assert all(s.get("role") for s in fanin_spans)
+
+        await ing_server.close()
+        ing_state.stop()
+        return fanin_tid
+
+    run(scenario())
+
+
+def test_cluster_trace_endpoint_stitches_one_tree(tmp_path):
+    async def scenario():
+        ing = make_parseable(tmp_path, "ing", Mode.INGEST)
+        ing_state = ServerState(ing)
+        ing_server = TestServer(build_app(ing_state))
+        await ing_server.start_server()
+        ing.register_node(f"127.0.0.1:{ing_server.port}")
+
+        q = make_parseable(tmp_path, "query", Mode.QUERY)
+        q_state = ServerState(q)
+        q_client = TestClient(TestServer(build_app(q_state)))
+        await q_client.start_server()
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://127.0.0.1:{ing_server.port}/api/v1/ingest",
+                json=[{"k": 1}] * 5,
+                headers={**AUTH, "X-P-Stream": "ct"},
+            ) as resp:
+                assert resp.status == 200
+
+        loop = asyncio.get_running_loop()
+
+        def make_trace():
+            with telemetry.trace_context() as tid:
+                C.fetch_staging_batches(q, "ct")
+            return tid
+
+        tid = await loop.run_in_executor(C.get_cluster_pool(), make_trace)
+
+        r = await q_client.get(f"/api/v1/cluster/trace/{tid}", headers=AUTH)
+        assert r.status == 200, await r.text()
+        tree = await r.json()
+        assert tree["trace_id"] == tid
+        assert tree["span_count"] >= 2  # cluster.fanin + peer http.request
+        assert tree["orphans"] == 0
+        assert tree["critical_path"], tree
+        # local + the peer both contributed (the peer over its span ring
+        # endpoint, reachable, with a finite clock-offset estimate)
+        assert len(tree["nodes"]) == 2
+        assert all(n["reachable"] for n in tree["nodes"])
+        peer = next(n for n in tree["nodes"] if n["domain_name"] != "local")
+        assert peer["span_count"] > 0 and peer["rtt_ms"] >= 0
+        names = set()
+
+        def walk(nodes):
+            for n in nodes:
+                names.add(n["name"])
+                walk(n["children"])
+
+        walk(tree["tree"])
+        assert {"cluster.fanin", "http.request"} <= names
+
+        # validation surface
+        r = await q_client.get("/api/v1/cluster/trace/nope", headers=AUTH)
+        assert r.status == 400
+        assert (await q_client.get(f"/api/v1/cluster/trace/{tid}")).status == 401
+
+        await q_client.close()
+        await ing_server.close()
+        q_state.stop()
+        ing_state.stop()
+
+    run(scenario())

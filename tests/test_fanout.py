@@ -186,6 +186,84 @@ def test_pushdown_parity_with_central(tmp_path):
     run(scenario())
 
 
+def test_staged_parquet_visible_before_upload(tmp_path):
+    """Conservation across the staging lifecycle: rows flushed to staging
+    parquet but not yet uploaded/committed must stay queryable — via the
+    peer's pushed-down partial AND via the central staging fan-in — and
+    must not double-count once the upload commits them to the manifest.
+    (Regression: the peer partial skipped staged parquet while the querier
+    had delegated the whole slice, so those rows vanished for a full
+    upload interval.)"""
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path)
+        # flush node0's arrows to staging parquet WITHOUT uploading: the
+        # rows now exist only as flushed-but-uncommitted parquet
+        states[0].p.local_sync(shutdown=True)
+        assert states[0].p.streams.get("dist").parquet_files()
+
+        def both():
+            pushed, pstats = query_on(tmp_path, "qsp", pushdown=True)
+            central, cstats = query_on(tmp_path, "qsc", pushdown=False)
+            return pushed, pstats, central, cstats
+
+        loop = asyncio.get_running_loop()
+        pushed, pstats, central, cstats = await loop.run_in_executor(None, both)
+        assert pstats["stages"]["fanout"]["ok"] == 2
+        assert pushed == EXPECTED
+        assert cstats["stages"]["fanout"]["mode"] == "central"
+        assert central == EXPECTED
+
+        # commit the staged parquet; books must still balance (no doubles)
+        states[0].p.sync_all_streams()
+        pushed, pstats, central, _ = await loop.run_in_executor(None, both)
+        assert pstats["stages"]["fanout"]["ok"] == 2
+        assert pushed == EXPECTED
+        assert central == EXPECTED
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_committed_staged_copy_not_double_counted(tmp_path):
+    """The commit -> unlink window: a staged parquet whose basename is
+    already in the manifest (upload committed, local copy still on disk)
+    must be served by the manifest scan only — the peer partial skips the
+    lingering copy."""
+    import shutil
+
+    from parseable_tpu.query import fanout as FO
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        p = states[0].p
+        p.local_sync(shutdown=True)
+        p.sync_all_streams()  # upload + commit + unlink
+        stream = p.streams.get("dist")
+        assert stream.parquet_files() == []
+        # resurrect the committed file in staging, as if unlink hadn't
+        # happened yet
+        store = tmp_path / "shared-store"
+        committed = [f for f in store.rglob("*.parquet") if "dist" in str(f)]
+        assert committed
+        for f in committed:
+            shutil.copy2(f, stream.data_path / f.name)
+
+        def partial():
+            return FO.execute_local_partial(p, "dist", SQL, None, None)
+
+        out = await asyncio.get_running_loop().run_in_executor(None, partial)
+        assert out is not None
+        payload, meta = out
+        assert meta["rows_scanned"] == 10, meta  # 20 would mean a double count
+        table = FO.deserialize_table(payload)
+        # one partial row per group, carrying a count partial of 10 total
+        assert table.num_rows == 1
+        await teardown(states, servers)
+
+    run(scenario())
+
+
 def test_unsupported_plan_stays_central(tmp_path, monkeypatch):
     """A plan the partial protocol can't express (no GROUP BY) never
     scatters — it uses the bounded central pull."""

@@ -304,10 +304,60 @@ def test_debug_spans_endpoint(tmp_path):
         body = await r.json()
         assert body["count"] >= 2  # http.request + ingest
         assert {s["name"] for s in body["spans"]} >= {"http.request", "ingest"}
+        # every span carries the producing node's identity; the response
+        # carries the node's wall clock for cross-node skew estimation
+        assert isinstance(body["node_time"], float)
+        assert body["role"] and all(s["role"] == body["role"] for s in body["spans"])
         # unauthenticated access is refused (METRICS action guard)
         assert (await client.get("/api/v1/debug/spans")).status == 401
-        r = await client.get("/api/v1/debug/spans?limit=bogus", headers=AUTH)
+        # malformed params are a clean 400, not a 500
+        for qs in (
+            "limit=bogus",
+            "limit=0",
+            "limit=-5",
+            "trace_id=zz",
+            f"trace_id={'a' * 31}",
+        ):
+            r = await client.get(f"/api/v1/debug/spans?{qs}", headers=AUTH)
+            assert r.status == 400, qs
+            assert "error" in await r.json()
+        # trace_id is normalized (upper-case hex accepted)
+        r = await client.get(
+            f"/api/v1/debug/spans?trace_id={trace_id.upper()}", headers=AUTH
+        )
+        assert r.status == 200 and (await r.json())["count"] >= 2
+
+    run(with_client(state, fn))
+    state.stop()
+
+
+def test_trace_middleware_error_paths(tmp_path):
+    """Error responses keep their trace: an HTTPException on a traced route
+    still carries X-P-Trace-Id and records an errored http.request span —
+    where trace lookup matters most."""
+    state = make_state(tmp_path)
+
+    async def fn(client):
+        # unmatched traced path: the router raises HTTPNotFound through the
+        # middleware (aiohttp's HTTPException idiom for 4xx)
+        r = await client.post(
+            "/api/v1/internal/not-a-route", json={},
+            headers={**AUTH, "traceparent": TRACEPARENT},
+        )
+        assert r.status == 404
+        assert r.headers["X-P-Trace-Id"] == "ab" * 16
+        spans = telemetry.recent_spans("ab" * 16)
+        http_spans = [s for s in spans if s["name"] == "http.request"]
+        assert http_spans, spans
+        assert http_spans[0]["status"] == "error"
+        assert http_spans[0]["status_code"] == 404
+        # ordinary handler-returned 4xx responses keep the header too
+        r = await client.post(
+            "/api/v1/ingest", json=[{"x": 1}],
+            headers={**AUTH, "traceparent": TRACEPARENT},
+        )
         assert r.status == 400
+        assert r.headers["X-P-Trace-Id"] == "ab" * 16
 
     run(with_client(state, fn))
     state.stop()
