@@ -552,13 +552,33 @@ def bench_json_ingest(p) -> None:
     # bans best-of: a best-of hides the tail variance the latency north
     # star exists to capture, and it biased this line's vs_baseline
     reps = max(3, int(os.environ.get("BENCH_REPEATS", "3")))
-    ours_times: list[float] = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for b in bodies:
-            flatten_and_push_logs(p, "ingbench", None, LogSource.JSON, {}, raw_body=b)
-        ours_times.append(time.perf_counter() - t0)
+    cores = os.cpu_count() or 1
+    shards_n = min(cores, 4)
+    payload_gb = sum(len(b) for b in bodies) / 1e9
+
+    def run_ours(shards: int) -> list[float]:
+        # pin the shard count (and drop the byte threshold so every chunk
+        # actually shards) for the duration of the measured loop
+        os.environ["P_INGEST_PARSE_SHARDS"] = str(shards)
+        os.environ["P_INGEST_SHARD_MIN_BYTES"] = "0"
+        try:
+            times: list[float] = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for b in bodies:
+                    flatten_and_push_logs(
+                        p, "ingbench", None, LogSource.JSON, {}, raw_body=b
+                    )
+                times.append(time.perf_counter() - t0)
+            return times
+        finally:
+            os.environ.pop("P_INGEST_PARSE_SHARDS", None)
+            os.environ.pop("P_INGEST_SHARD_MIN_BYTES", None)
+
+    shard1_times = run_ours(1)
+    ours_times = run_ours(shards_n) if shards_n > 1 else shard1_times
     ours = n / percentile(ours_times, 0.50)
+    shard1 = n / percentile(shard1_times, 0.50)
 
     floor_times: list[float] = []
     for _ in range(reps):
@@ -567,10 +587,17 @@ def bench_json_ingest(p) -> None:
             pj.read_json(_io.BytesIO(b))
         floor_times.append(time.perf_counter() - t0)
     floor = n / percentile(floor_times, 0.50)
+    gb_per_sec = payload_gb / percentile(ours_times, 0.50)
     print(
         f"# json ingest: {ours:,.0f} rows/s end-to-end (p50; p95 "
         f"{n / percentile(ours_times, 0.95):,.0f}) | pyarrow floor {floor:,.0f} rows/s | "
-        f"{ours / floor:.2f}x of floor",
+        f"{ours / floor:.2f}x of floor | {gb_per_sec:.3f} GB/s",
+        file=sys.stderr,
+    )
+    print(
+        f"# json ingest sharding: shards=1 {shard1:,.0f} rows/s vs "
+        f"shards={shards_n} {ours:,.0f} rows/s ({ours / shard1:.2f}x on a "
+        f"{cores}-core box; {ours / shards_n:,.0f} rows/s/core)",
         file=sys.stderr,
     )
     emit(
@@ -579,10 +606,11 @@ def bench_json_ingest(p) -> None:
         round(ours / floor, 4),
         {
             "note": (
-                "full pipeline (single-pass C++ columnar build -> zero-copy "
-                "Arrow import -> schema/staging; NDJSON+read_json as the "
-                "fallback tier) vs raw pyarrow read_json floor on the same "
-                "bytes; p50 over reps, never best-of"
+                "full pipeline (sharded single-pass C++ columnar build -> "
+                "ordered stitch -> zero-copy Arrow import -> schema/staging "
+                "with direct-to-IPC; NDJSON+read_json as the fallback tier) "
+                "vs raw pyarrow read_json floor on the same bytes; p50 over "
+                "reps, never best-of"
             ),
             "repeats": reps,
             "latency_p50_s": round(percentile(ours_times, 0.50), 4),
@@ -590,6 +618,12 @@ def bench_json_ingest(p) -> None:
             "pyarrow_floor_rows_per_sec": round(floor, 1),
             "pyarrow_floor_p50_s": round(percentile(floor_times, 0.50), 4),
             "pyarrow_floor_p95_s": round(percentile(floor_times, 0.95), 4),
+            "gb_per_sec": round(gb_per_sec, 4),
+            "rows_per_sec_per_core": round(ours / shards_n, 1),
+            "cores": cores,
+            "parse_shards": shards_n,
+            "shards1_rows_per_sec": round(shard1, 1),
+            "shard_scaling_x": round(ours / shard1, 4),
         },
     )
 
@@ -1443,13 +1477,19 @@ def bench_otel_ingest(p) -> None:
     from parseable_tpu.event.format import LogSource
     from parseable_tpu.server.ingest_utils import flatten_and_push_logs
 
-    def ingest_native() -> float:
-        t0 = time.perf_counter()
-        n = flatten_and_push_logs(
-            p, "otelbench", None, LogSource.OTEL_LOGS, {}, raw_body=body
-        )
-        assert n == total
-        return time.perf_counter() - t0
+    def ingest_native(shards: int) -> float:
+        os.environ["P_INGEST_PARSE_SHARDS"] = str(shards)
+        os.environ["P_INGEST_SHARD_MIN_BYTES"] = "0"
+        try:
+            t0 = time.perf_counter()
+            n = flatten_and_push_logs(
+                p, "otelbench", None, LogSource.OTEL_LOGS, {}, raw_body=body
+            )
+            assert n == total
+            return time.perf_counter() - t0
+        finally:
+            os.environ.pop("P_INGEST_PARSE_SHARDS", None)
+            os.environ.pop("P_INGEST_SHARD_MIN_BYTES", None)
 
     def ingest_python() -> float:
         # the exact-semantics fallback pipeline over the same bytes
@@ -1460,19 +1500,42 @@ def bench_otel_ingest(p) -> None:
         assert n == total
         return time.perf_counter() - t0
 
-    ingest_native()  # warm (library load, stream schema, reader import)
-    t_fast = min(ingest_native() for _ in range(3))
+    cores = os.cpu_count() or 1
+    shards_n = min(cores, 4)
+    ingest_native(1)  # warm (library load, stream schema, reader import)
+    fast_times = [ingest_native(shards_n) for _ in range(3)]
+    t_fast = percentile(fast_times, 0.50)
+    t_fast_p95 = percentile(fast_times, 0.95)
+    t_1 = percentile([ingest_native(1) for _ in range(3)], 0.50) if shards_n > 1 else t_fast
     t_py = min(ingest_python() for _ in range(2))
+    gb_per_sec = len(body) / 1e9 / t_fast
     print(
-        f"# otel ingest: native {t_fast:.3f}s ({total/t_fast:,.0f} r/s) | "
-        f"python {t_py:.3f}s ({total/t_py:,.0f} r/s) | {t_py/t_fast:.1f}x",
+        f"# otel ingest: native {t_fast:.3f}s ({total/t_fast:,.0f} r/s, "
+        f"{gb_per_sec:.3f} GB/s) | python {t_py:.3f}s ({total/t_py:,.0f} r/s) | "
+        f"{t_py/t_fast:.1f}x",
+        file=sys.stderr,
+    )
+    print(
+        f"# otel ingest sharding: shards=1 {total/t_1:,.0f} r/s vs "
+        f"shards={shards_n} {total/t_fast:,.0f} r/s ({t_1/t_fast:.2f}x on a "
+        f"{cores}-core box; {total/t_fast/shards_n:,.0f} r/s/core)",
         file=sys.stderr,
     )
     emit(
         "otel_logs_ingest_rows_per_sec",
         total / t_fast,
         t_py / t_fast,
-        {"note": "native C++ columnar OTel lane (single-pass -> Arrow buffers) vs Python flattener pipeline, end-to-end incl. staging"},
+        {
+            "note": "native C++ columnar OTel lane (sharded single-pass -> Arrow buffers -> ordered stitch) vs Python flattener pipeline, end-to-end incl. staging",
+            "latency_p50_s": round(t_fast, 4),
+            "latency_p95_s": round(t_fast_p95, 4),
+            "gb_per_sec": round(gb_per_sec, 4),
+            "rows_per_sec_per_core": round(total / t_fast / shards_n, 1),
+            "cores": cores,
+            "parse_shards": shards_n,
+            "shards1_rows_per_sec": round(total / t_1, 1),
+            "shard_scaling_x": round(t_1 / t_fast, 4),
+        },
     )
 
 

@@ -144,6 +144,7 @@ def main(argv: list[str] | None = None) -> int:
             "executed": stats["executed"],
             "findings": len(findings),
             "banked": stats["banked"],
+            "infra_flakes": stats.get("infra_flakes", 0),
         }
         ledger = _append_fuzz_log(root, record)
         for f in findings:

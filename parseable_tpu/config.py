@@ -153,6 +153,21 @@ def psan_options() -> dict:
     }
 
 
+def ingest_shard_options() -> tuple[int, int]:
+    """(shards, min_bytes) for the multi-core native parse (native/__init__).
+
+    P_INGEST_PARSE_SHARDS: worker count for the sharded columnar parse —
+    default min(cpu, 4), 1 restores the single-core path exactly.
+    P_INGEST_SHARD_MIN_BYTES: payloads below this threshold parse on one
+    core regardless (split/stitch bookkeeping costs more than it saves on
+    small bodies). Read per call — cheap, and tests/benches can flip the
+    env without rebuilding Options."""
+    return (
+        _env_int("P_INGEST_PARSE_SHARDS", min(os.cpu_count() or 1, 4)),
+        _env_int("P_INGEST_SHARD_MIN_BYTES", 256 * 1024),
+    )
+
+
 def nsan_options() -> dict:
     """Knobs for the native-code safety gate (analysis/nsan).
 

@@ -262,6 +262,43 @@ def _bind_columnar(lib: ctypes.CDLL) -> None:
     lib.ptpu_cols_free.argtypes = [ctypes.c_void_p]
     lib.ptpu_cols_live.restype = ctypes.c_longlong
     lib.ptpu_cols_live.argtypes = []
+    lib.ptpu_flatten_columnar_sharded.restype = ctypes.c_int
+    lib.ptpu_flatten_columnar_sharded.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ptpu_otel_logs_columnar_sharded.restype = ctypes.c_int
+    lib.ptpu_otel_logs_columnar_sharded.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ptpu_otel_metrics_columnar.restype = ctypes.c_int
+    lib.ptpu_otel_metrics_columnar.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ptpu_otel_traces_columnar.restype = ctypes.c_int
+    lib.ptpu_otel_traces_columnar.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ptpu_parse_pool_shutdown.restype = None
+    lib.ptpu_parse_pool_shutdown.argtypes = []
+    lib.ptpu_parse_pool_size.restype = ctypes.c_int
+    lib.ptpu_parse_pool_size.argtypes = []
 
 
 def native_available() -> bool:
@@ -414,42 +451,139 @@ def _import_columnar(lib, handle: int):
     return names, arrays, nrows
 
 
-def flatten_columnar(payload: bytes, max_depth: int, separator: str = "_"):
+def _effective_shards(payload_len: int, shards: int | None) -> int:
+    """Shard count for one parse call: an explicit `shards` wins (tests and
+    the fuzzer force specific counts); otherwise P_INGEST_PARSE_SHARDS,
+    gated by the P_INGEST_SHARD_MIN_BYTES threshold so small payloads skip
+    the split/stitch overhead entirely. Always clamped to [1, 16] (the C
+    side clamps too — belt and braces across the ABI)."""
+    if shards is None:
+        from parseable_tpu.config import ingest_shard_options
+
+        shards, min_bytes = ingest_shard_options()
+        if payload_len < min_bytes:
+            return 1
+    return max(1, min(int(shards), 16))
+
+
+def flatten_columnar(
+    payload: bytes, max_depth: int, separator: str = "_", shards: int | None = None
+):
     """Tier-1 native ingest: parse+flatten a plain-JSON payload straight
     into Arrow-layout column buffers in ONE pass (fastpath.cpp
     ptpu_flatten_columnar) and import them zero-copy. Returns
     (names, arrays, nrows) or None when the payload needs a lower tier
     (the NDJSON lane, then Python) — arrays/mixed types/sparse keys/depth
     exactly like the NDJSON lane, plus escaped keys, lone surrogates and
-    other columnar-only declines."""
+    other columnar-only declines.
+
+    shards > 1 splits the payload at record boundaries and parses the
+    slices on the native worker pool; the stitched result (and the rc on
+    decline) is identical to shards=1 at any count — a split landing
+    anywhere awkward makes the C side rerun single-shard internally."""
     lib = _load()
     if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
-    rc = lib.ptpu_flatten_columnar(
-        payload, len(payload), max_depth, separator.encode(), ctypes.byref(out)
+    rc = lib.ptpu_flatten_columnar_sharded(
+        payload,
+        len(payload),
+        max_depth,
+        separator.encode(),
+        _effective_shards(len(payload), shards),
+        ctypes.byref(out),
     )
     if rc != 0:
         return None
     return _import_columnar(lib, out.value)
 
 
-def otel_logs_columnar(payload: bytes, ts_as_ms: bool = True):
+def otel_logs_columnar(payload: bytes, ts_as_ms: bool = True, shards: int | None = None):
     """Tier-1 native OTel-logs ingest: walk the OTLP-JSON structure and
     build the flattened rows as Arrow-layout columns in one pass
     (fastpath.cpp ptpu_otel_logs_columnar), imported zero-copy. ts_as_ms
     emits the time fields as timestamp(ms) columns directly. Returns
-    (names, arrays, nrows) or None when the payload needs a lower tier."""
+    (names, arrays, nrows) or None when the payload needs a lower tier.
+    shards > 1 splits at resourceLogs element boundaries (same result at
+    any count)."""
     lib = _load()
     if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
-    rc = lib.ptpu_otel_logs_columnar(
-        payload, len(payload), 1 if ts_as_ms else 0, ctypes.byref(out)
+    rc = lib.ptpu_otel_logs_columnar_sharded(
+        payload,
+        len(payload),
+        1 if ts_as_ms else 0,
+        _effective_shards(len(payload), shards),
+        ctypes.byref(out),
     )
     if rc != 0:
         return None
     return _import_columnar(lib, out.value)
+
+
+def otel_metrics_columnar(
+    payload: bytes, ts_as_ms: bool = True, shards: int | None = None
+):
+    """Tier-1 native OTel-metrics ingest: one row per data point, built as
+    Arrow-layout columns in one pass (fastpath.cpp
+    ptpu_otel_metrics_columnar). Returns (names, arrays, nrows) or None
+    when the payload needs the Python flattener (there is no NDJSON middle
+    tier for metrics). shards > 1 splits at resourceMetrics element
+    boundaries."""
+    lib = _load()
+    if lib is None or not _columnar_ok:
+        return None
+    out = ctypes.c_void_p()
+    rc = lib.ptpu_otel_metrics_columnar(
+        payload,
+        len(payload),
+        1 if ts_as_ms else 0,
+        _effective_shards(len(payload), shards),
+        ctypes.byref(out),
+    )
+    if rc != 0:
+        return None
+    return _import_columnar(lib, out.value)
+
+
+def otel_traces_columnar(
+    payload: bytes, ts_as_ms: bool = True, shards: int | None = None
+):
+    """Tier-1 native OTel-traces ingest: one row per span, built as
+    Arrow-layout columns in one pass (fastpath.cpp
+    ptpu_otel_traces_columnar). Returns (names, arrays, nrows) or None
+    when the payload needs the Python flattener. shards > 1 splits at
+    resourceSpans element boundaries."""
+    lib = _load()
+    if lib is None or not _columnar_ok:
+        return None
+    out = ctypes.c_void_p()
+    rc = lib.ptpu_otel_traces_columnar(
+        payload,
+        len(payload),
+        1 if ts_as_ms else 0,
+        _effective_shards(len(payload), shards),
+        ctypes.byref(out),
+    )
+    if rc != 0:
+        return None
+    return _import_columnar(lib, out.value)
+
+
+def shutdown_parse_pool() -> None:
+    """Drain and join the native parse worker pool (wired into
+    ServerState.stop). Queued shard jobs complete first; the pool restarts
+    lazily on the next sharded parse, so calling this is always safe."""
+    if _lib is not None and _columnar_ok:
+        _lib.ptpu_parse_pool_shutdown()
+
+
+def parse_pool_size() -> int:
+    """Live native parse-pool worker count (observability + tests)."""
+    if _lib is None or not _columnar_ok:
+        return 0
+    return int(_lib.ptpu_parse_pool_size())
 
 
 def _borrowed_ptr(buf: bytes | bytearray) -> ctypes.c_void_p:

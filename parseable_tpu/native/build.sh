@@ -36,7 +36,7 @@ case "$SAN" in
     ;;
 esac
 
-g++ $FLAGS -fPIC -shared -std=c++17 fastpath.cpp -o "$OUT"
+g++ $FLAGS -fPIC -shared -pthread -std=c++17 fastpath.cpp -o "$OUT"
 
 # sanity: the columnar ingest ABI must be present — a truncated/stale build
 # would otherwise dlopen fine and silently push every request down a tier
@@ -55,7 +55,10 @@ if [ -z "$syms" ]; then
   echo "build.sh: cannot read the dynamic symbol table of $OUT (nm -D and objdump -T both unavailable or empty) — refusing to pass vacuously" >&2
   exit 1
 fi
-for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free; do
+for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free \
+           ptpu_flatten_columnar_sharded ptpu_otel_logs_columnar_sharded \
+           ptpu_otel_metrics_columnar ptpu_otel_traces_columnar \
+           ptpu_parse_pool_shutdown ptpu_parse_pool_size; do
   printf '%s\n' "$syms" | grep -q "[[:space:]]$sym\$" || {
     echo "build.sh: missing export $sym" >&2
     exit 1

@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
+#include <cfloat>
 
 extern "C" {
 
@@ -781,6 +782,26 @@ static inline int truthy(const Span& v) {
     }
 }
 
+// Python truthiness including nested shapes (bool() never raises):
+// 0 = falsy (null/false/0/""/[]/{}),  1 = truthy scalar,  2 = truthy
+// object/array. Callers that can take bool() semantics natively treat
+// 1 and 2 alike; gates whose Python body would then iterate/raise
+// decline on the nested (2) and scalar (1) cases separately.
+static inline int truthy_deep(const Span& v) {
+    switch (kind_of(v)) {
+        case K_STR: return str_content(v).len() > 0 ? 1 : 0;
+        case K_NUM: return num_is_zero(v.view()) ? 0 : 1;
+        case K_TRUE: return 1;
+        case K_FALSE: case K_NULL: return 0;
+        case K_OBJ: case K_ARR: {
+            const char* p = v.b + 1;
+            while (p < v.e && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+            return (p < v.e && (*p == '}' || *p == ']')) ? 0 : 2;
+        }
+        default: return 2;
+    }
+}
+
 // hand-rolled integer append (snprintf cost ~300ns/call dominated the walk)
 static inline void append_i64(std::string& out, long long v) {
     char buf[24];
@@ -1328,8 +1349,26 @@ enum : int32_t {
 };
 
 // locale-independent double parse over a strict-JSON number token (the
-// scanners above enforce the grammar, so strtod_l cannot under-consume)
-static double parse_double(const char* b, const char* e) {
+// scanners above enforce the grammar, so the fallback strtod_l cannot
+// under-consume). strtod_l dominated the whole-payload parse on numeric
+// columns (~20% of flatten_columnar on a float-heavy body), so common
+// shapes convert directly, with bit-exact results:
+//
+//   tier 1  <=15 significant digits, |10-exponent| <= 22: mantissa and
+//           10^k are both exactly representable doubles, so the single
+//           multiply/divide is correctly rounded (Gay's exact fast path).
+//   tier 2  (x86-64 only) <=19 digits, |10-exponent| <= 27: one x87
+//           80-bit op. m < 10^19 < 2^64 and 10^27 = 2^27*5^27 with
+//           5^27 < 2^63 are both exact long doubles, so the result is
+//           within 0.5 ulp(64) of the true value; converting down to
+//           53 bits can then only disagree with correct rounding when
+//           the 11 below-double bits sit on the halfway pattern 0x400 —
+//           those (and a +/-2 comfort margin) fall through to strtod_l.
+//           Exponent range keeps every tier-2 value in [1e-27, 1e46]:
+//           no subnormal or overflow cases to special-case.
+//   tier 3  strtod_l — authoritative for everything else (>19 digits,
+//           big exponents, halfway-adjacent values).
+static double parse_double_slow(const char* b, const char* e) {
     static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
     char buf[64];
     size_t n = (size_t)(e - b);
@@ -1340,6 +1379,73 @@ static double parse_double(const char* b, const char* e) {
     }
     std::string tmp(b, e);
     return strtod_l(tmp.c_str(), nullptr, c_loc);
+}
+
+static double parse_double(const char* b, const char* e) {
+    const char* p = b;
+    bool neg = false;
+    if (p < e && *p == '-') { neg = true; p++; }
+    uint64_t m = 0;
+    int nd = 0;        // significant digits accumulated into m
+    int64_t e10 = 0;   // value = m * 10^e10 (exact unless truncated)
+    bool truncated = false;
+    while (p < e && *p >= '0' && *p <= '9') {
+        if (m == 0 && *p == '0') { p++; continue; }  // leading zeros
+        if (nd < 19) { m = m * 10 + (uint64_t)(*p - '0'); nd++; }
+        else { e10++; truncated = true; }
+        p++;
+    }
+    if (p < e && *p == '.') {
+        p++;
+        while (p < e && *p >= '0' && *p <= '9') {
+            if (m == 0 && *p == '0') { e10--; p++; continue; }  // 0.000x
+            if (nd < 19) { m = m * 10 + (uint64_t)(*p - '0'); nd++; e10--; }
+            else truncated = true;
+            p++;
+        }
+    }
+    if (p < e && (*p == 'e' || *p == 'E')) {
+        p++;
+        bool en = false;
+        if (p < e && (*p == '+' || *p == '-')) { en = (*p == '-'); p++; }
+        int64_t ex = 0;
+        while (p < e && *p >= '0' && *p <= '9') {
+            if (ex < 1000000) ex = ex * 10 + (*p - '0');
+            p++;
+        }
+        e10 += en ? -ex : ex;
+    }
+    if (m == 0) return neg ? -0.0 : 0.0;  // covers "0", "-0.0", "0e9"
+    if (!truncated) {
+        if (nd <= 15 && e10 >= -22 && e10 <= 22) {
+            static const double p10[23] = {
+                1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+            double d = (double)m;  // exact: m < 10^15 < 2^53
+            d = e10 >= 0 ? d * p10[e10] : d / p10[-e10];
+            return neg ? -d : d;
+        }
+#if defined(__x86_64__) && defined(__SIZEOF_LONG_DOUBLE__) && (LDBL_MANT_DIG == 64)
+        if (e10 >= -27 && e10 <= 27) {
+            static const long double lp10[28] = {
+                1e0L,  1e1L,  1e2L,  1e3L,  1e4L,  1e5L,  1e6L,
+                1e7L,  1e8L,  1e9L,  1e10L, 1e11L, 1e12L, 1e13L,
+                1e14L, 1e15L, 1e16L, 1e17L, 1e18L, 1e19L, 1e20L,
+                1e21L, 1e22L, 1e23L, 1e24L, 1e25L, 1e26L, 1e27L};
+            long double ld = (long double)m;  // exact: m < 10^19 < 2^64
+            ld = e10 >= 0 ? ld * lp10[e10] : ld / lp10[-e10];
+            uint64_t m64;
+            std::memcpy(&m64, &ld, 8);  // x87 layout: low 8 bytes = mantissa
+            uint32_t r = (uint32_t)(m64 & 0x7FF);
+            if (r < 0x3FE || r > 0x402) {
+                double d = (double)ld;
+                return neg ? -d : d;
+            }
+        }
+#endif
+    }
+    return parse_double_slow(b, e);
 }
 
 // strict UTF-8 validation (surrogate and overlong rejecting): column chars
@@ -1730,6 +1836,33 @@ struct JsonColCtx {
         if (c.p != c.end) return fail(INV);
         return true;
     }
+
+    // One shard's slice of a JSON-array payload: `record (, record)*`,
+    // ending exactly at the slice end. Shard 0 additionally consumes the
+    // leading `[`; the last shard consumes the closing `]`. The boundary
+    // scan is optimistic — a split landing inside a string or nested value
+    // makes some shard fail (str_span finds no unescaped close quote before
+    // the slice end, or the record parse trips on the orphaned bytes), and
+    // the caller reruns single-shard, which is authoritative.
+    bool run_records(bool open_bracket, bool close_bracket) {
+        c.ws();
+        if (open_bracket) {
+            if (c.p >= c.end || *c.p != '[') return fail(FB);
+            c.p++;
+        }
+        while (true) {
+            if (!record()) return false;
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (close_bracket) {
+                if (c.p >= c.end || *c.p != ']') return fail(INV);
+                c.p++;
+                c.ws();
+            }
+            if (c.p != c.end) return fail(INV);
+            return true;
+        }
+    }
 };
 
 // ---- OTel logs lane: flatten straight to columns --------------------------
@@ -1743,6 +1876,8 @@ struct OtelColBuilder {
     std::vector<Member> ms_b, ms_c, ms_d;
     int rc = OK;
     bool ts_as_ms = false;
+
+    virtual ~OtelColBuilder() = default;
 
     // one scope group's shared fields, fully materialized for per-record
     // replay (spans into the payload stay valid for the whole call, but
@@ -2005,11 +2140,33 @@ struct OtelColBuilder {
                    : fail(FB);
     }
 
-    bool log_record(const std::vector<Member>& rec) {
+    // replay the scope group's shared fields into the current row; column
+    // indices resolve lazily on first replay so a group with zero records
+    // creates no columns (the Python flattener emits none)
+    bool replay_base() {
         for (auto& bv : base) {
             if (bv.col < 0) bv.col = (int64_t)col_of(bv.name);
             if (!add_val((uint32_t)bv.col, bv.v)) return fail(FB);
         }
+        return true;
+    }
+
+    // by-name single-value adds (dup key in row / mixed-type -> decline)
+    bool row_f64(std::string_view name, double d) {
+        return b.add_f64(b.cols[col_of(name)], d) ? true : fail(FB);
+    }
+    bool row_bool(std::string_view name, bool v) {
+        return b.add_bool(b.cols[col_of(name)], v) ? true : fail(FB);
+    }
+    bool row_str(std::string_view name, std::string_view s) {
+        return b.add_str_raw(b.cols[col_of(name)], s.data(), s.size()) ? true : fail(FB);
+    }
+    bool row_null(std::string_view name) {
+        return b.add_null(b.cols[col_of(name)]) ? true : fail(FB);
+    }
+
+    bool log_record(const std::vector<Member>& rec) {
+        if (!replay_base()) return false;
         if (!col_time(find(rec, "timeUnixNano"), "time_unix_nano")) return false;
         if (!col_time(find(rec, "observedTimeUnixNano"), "observed_time_unix_nano"))
             return false;
@@ -2099,28 +2256,62 @@ struct OtelColBuilder {
         }
     }
 
+    // like each_object, but a PRESENT null array declines: the metrics and
+    // traces flatteners read `.get(key, [])`, so an explicit null raises on
+    // iteration in Python — that error belongs to the Python lane. (The
+    // logs flattener predates this helper and keeps each_object's skip.)
+    template <typename Fn>
+    bool each_object_strict(const Span& arr, std::vector<Member>& buf, Fn fn) {
+        if (arr.present() && kind_of(arr) == K_NULL) return fail(FB);
+        return each_object(arr, buf, fn);
+    }
+
+    // lane identity: the top-level resource array key and the per-element
+    // walk, overridden by the metrics/traces builders
+    virtual const char* key_top() const { return "resourceLogs"; }
+    virtual bool top_null_declines() const { return false; }
+
+    virtual bool resource_element(const std::vector<Member>& rl) {
+        Span resource = find(rl, "resource");
+        Span scope_logs = find(rl, "scopeLogs");
+        std::vector<Member> sl_buf;
+        return each_object(scope_logs, sl_buf, [&](const std::vector<Member>& sl) {
+            if (!scope_group(resource, sl)) return false;
+            Span records = find(sl, "logRecords");
+            std::vector<Member> rec_buf;
+            return each_object(records, rec_buf,
+                               [&](const std::vector<Member>& rec) {
+                                   return log_record(rec);
+                               });
+        });
+    }
+
     bool run(const char* in, uint64_t len) {
         Cur c{in, in + len};
         std::vector<Member> top;
         if (!collect(c, top, 0)) return fail(c.rc);
         c.ws();
         if (c.p != c.end) return fail(INV);
-        Span rls = find(top, "resourceLogs");
+        Span rls = find(top, key_top());
+        if (top_null_declines() && rls.present() && kind_of(rls) == K_NULL)
+            return fail(FB);
         std::vector<Member> rl_ms;
         return each_object(rls, rl_ms, [&](const std::vector<Member>& rl) {
-            Span resource = find(rl, "resource");
-            Span scope_logs = find(rl, "scopeLogs");
-            std::vector<Member> sl_buf;
-            return each_object(scope_logs, sl_buf, [&](const std::vector<Member>& sl) {
-                if (!scope_group(resource, sl)) return false;
-                Span records = find(sl, "logRecords");
-                std::vector<Member> rec_buf;
-                return each_object(records, rec_buf,
-                                   [&](const std::vector<Member>& rec) {
-                                       return log_record(rec);
-                                   });
-            });
+            return resource_element(rl);
         });
+    }
+
+    // sharded worker entry: one contiguous run of top-level resource
+    // elements (spans enumerated serially by the caller, so trailing
+    // payload validation already happened)
+    bool run_spans(const Span* elems, size_t n) {
+        std::vector<Member> rl_ms;
+        for (size_t i = 0; i < n; i++) {
+            Cur c{elems[i].b, elems[i].e};
+            if (!collect(c, rl_ms, 0)) return fail(c.rc);
+            if (!resource_element(rl_ms)) return false;
+        }
+        return true;
     }
 };
 
@@ -2247,5 +2438,949 @@ void ptpu_cols_free(void* h) {
 long long ptpu_cols_live(void) {
     return g_cols_live.load(std::memory_order_relaxed);
 }
+
+}  // extern "C"
+
+// --------------------------- OTel metrics + traces columnar lanes ----------
+//
+// Same chassis as the logs lane (OtelColBuilder), same contract: mirror the
+// Python flatteners field-for-field, and for any shape whose Python
+// semantics go beyond what the native builder replicates exactly —
+// int()/float() coercion quirks, json.dumps of floats, truthy containers
+// the Python body would iterate or raise on — return FALLBACK so the
+// Python lane owns the behavior (including its errors).
+
+namespace {
+namespace colb {
+
+using otelj::truthy_deep;
+using otelj::append_i64;
+
+static const char* const AGG_TEMPORALITY_TEXT[3] = {
+    "AGGREGATION_TEMPORALITY_UNSPECIFIED",
+    "AGGREGATION_TEMPORALITY_DELTA",
+    "AGGREGATION_TEMPORALITY_CUMULATIVE",
+};
+
+static const char* const SPAN_KIND_TEXT[6] = {
+    "SPAN_KIND_UNSPECIFIED", "SPAN_KIND_INTERNAL", "SPAN_KIND_SERVER",
+    "SPAN_KIND_CLIENT",      "SPAN_KIND_PRODUCER", "SPAN_KIND_CONSUMER",
+};
+
+static const char* const STATUS_CODE_TEXT[3] = {
+    "STATUS_CODE_UNSET", "STATUS_CODE_OK", "STATUS_CODE_ERROR",
+};
+
+// mirrors otel/metrics.py::flatten_otel_metrics (one row per data point)
+struct OtelMetricsBuilder : OtelColBuilder {
+    const char* key_top() const override { return "resourceMetrics"; }
+    bool top_null_declines() const override { return true; }
+
+    // int(x) for the tokens taken natively: integer number tokens and
+    // plain integer strings. Bools (int(True)=1), floats (truncation),
+    // padded/underscored strings and bigints decline to Python.
+    bool int_arg(const Span& v, long long& out_ll) {
+        Kind k = kind_of(v);
+        if (k == K_NUM)
+            return (num_is_integer(v.view()) && parse_i64(v.view(), out_ll))
+                       ? true
+                       : fail(FB);
+        if (k == K_STR)
+            return parse_i64(str_content(v).view(), out_ll) ? true : fail(FB);
+        return fail(FB);
+    }
+
+    // float(x): number tokens and strict-JSON-number strings only
+    bool float_arg(const Span& v, double& out_d) {
+        Kind k = kind_of(v);
+        if (k == K_NUM) { out_d = parse_double(v.b, v.e); return true; }
+        if (k == K_STR) {
+            Span sc = str_content(v);
+            if (!is_json_number(sc.view())) return fail(FB);
+            out_d = parse_double(sc.b, sc.e);
+            return true;
+        }
+        return fail(FB);
+    }
+
+    // json.dumps([int(c) for c in arr]) for an array of integer tokens
+    bool int_array_json(const Span& arr, std::string& out) {
+        if (kind_of(arr) != K_ARR) return fail(FB);
+        out = "[";
+        Cur c{arr.b + 1, arr.e};
+        c.ws();
+        if (c.p < c.end && *c.p == ']') { out += ']'; return true; }
+        bool first = true;
+        while (true) {
+            Span v;
+            if (!c.value_span(v, 1)) return fail(c.rc);
+            long long iv;
+            if (!int_arg(v, iv)) return false;
+            if (!first) out += ", ";
+            first = false;
+            append_i64(out, iv);
+            c.ws();
+            if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+            if (c.p < c.end && *c.p == ']') { out += ']'; return true; }
+            return fail(INV);
+        }
+    }
+
+    // int(kind_obj.get("aggregationTemporality", 0)) — parsed BEFORE the
+    // data-point loop, like Python (a bad value errors with zero points)
+    bool temporality(const std::vector<Member>& km, long long& temp) {
+        Span t = find(km, "aggregationTemporality");
+        if (!t.present()) { temp = 0; return true; }
+        return int_arg(t, temp);
+    }
+
+    bool emit_temporality(std::string_view prefix, long long temp) {
+        std::string name(prefix);
+        name += "_aggregation_temporality";
+        if (!row_f64(name, (double)temp)) return false;
+        name.assign(prefix);
+        name += "_aggregation_temporality_description";
+        if (temp >= 0 && temp <= 2)
+            return row_str(name, AGG_TEMPORALITY_TEXT[temp]);
+        return row_null(name);  // AGG_TEMPORALITY.get(unknown) -> None
+    }
+
+    // _point_common: dp attributes (no prefix), gated start time, time,
+    // flags + flags description, exemplars (truthy -> Python json.dumps)
+    bool point_common(const std::vector<Member>& dp) {
+        std::string scratch;
+        if (!attributes(find(dp, "attributes"), "", false, scratch)) return false;
+        Span st = find(dp, "startTimeUnixNano");
+        if (st.present()) {
+            int t = otelj::truthy(st);
+            if (t < 0) return fail(FB);
+            if (t == 1 && !col_time(st, "start_time_unix_nano")) return false;
+        }
+        if (!col_time(find(dp, "timeUnixNano"), "time_unix_nano")) return false;
+        Span flags = find(dp, "flags");
+        if (flags.present() && kind_of(flags) != K_NULL) {
+            long long fv;
+            if (!int_arg(flags, fv)) return false;
+            if (!row_f64("flags", (double)fv)) return false;
+            const char* d = (fv & 1) ? "DATA_POINT_FLAGS_NO_RECORDED_VALUE_MASK"
+                                     : "DATA_POINT_FLAGS_DO_NOT_USE";
+            if (!row_str("data_point_flags_description", d)) return false;
+        }
+        Span ex = find(dp, "exemplars");
+        if (ex.present() && truthy_deep(ex) != 0) return fail(FB);
+        return true;
+    }
+
+    // _number_value: asDouble by key presence first, then asInt
+    bool number_value(const std::vector<Member>& dp, std::string_view prefix) {
+        std::string name(prefix);
+        name += "_value";
+        Span d = find(dp, "asDouble");
+        if (d.present()) {
+            double dv;
+            if (!float_arg(d, dv)) return false;
+            return row_f64(name, dv);
+        }
+        Span i = find(dp, "asInt");
+        if (i.present()) {
+            long long iv;
+            if (!int_arg(i, iv)) return false;
+            return row_f64(name, (double)iv);
+        }
+        return true;
+    }
+
+    // int(dp.get(key, 0)) row field
+    bool int_field(const std::vector<Member>& dp, std::string_view key,
+                   std::string_view col) {
+        Span v = find(dp, key);
+        long long iv = 0;
+        if (v.present() && !int_arg(v, iv)) return false;
+        return row_f64(col, (double)iv);
+    }
+
+    // `if key in dp:` presence-gated float row field
+    bool float_field_if_present(const std::vector<Member>& dp,
+                                std::string_view key, std::string_view col) {
+        Span v = find(dp, key);
+        if (!v.present()) return true;
+        double dv;
+        if (!float_arg(v, dv)) return false;
+        return row_f64(col, dv);
+    }
+
+    bool gauge_points(const std::vector<Member>& km) {
+        Span dps = find(km, "dataPoints");
+        std::vector<Member> dp_buf;
+        return each_object_strict(dps, dp_buf, [&](const std::vector<Member>& dp) {
+            if (!replay_base()) return false;
+            if (!row_str("metric_type", "gauge")) return false;
+            if (!point_common(dp)) return false;
+            if (!number_value(dp, "gauge")) return false;
+            return b.end_row() ? true : fail(FB);
+        });
+    }
+
+    bool sum_points(const std::vector<Member>& km) {
+        long long temp;
+        if (!temporality(km, temp)) return false;
+        Span mono = find(km, "isMonotonic");
+        bool mono_v = mono.present() && truthy_deep(mono) != 0;  // bool(): never raises
+        Span dps = find(km, "dataPoints");
+        std::vector<Member> dp_buf;
+        return each_object_strict(dps, dp_buf, [&](const std::vector<Member>& dp) {
+            if (!replay_base()) return false;
+            if (!row_str("metric_type", "sum")) return false;
+            if (!point_common(dp)) return false;
+            if (!number_value(dp, "sum")) return false;
+            if (!row_bool("sum_is_monotonic", mono_v)) return false;
+            return emit_temporality("sum", temp) &&
+                   (b.end_row() ? true : fail(FB));
+        });
+    }
+
+    bool histogram_points(const std::vector<Member>& km) {
+        long long temp;
+        if (!temporality(km, temp)) return false;
+        Span dps = find(km, "dataPoints");
+        std::vector<Member> dp_buf;
+        return each_object_strict(dps, dp_buf, [&](const std::vector<Member>& dp) {
+            if (!replay_base()) return false;
+            if (!row_str("metric_type", "histogram")) return false;
+            if (!point_common(dp)) return false;
+            if (!int_field(dp, "count", "histogram_count")) return false;
+            if (!float_field_if_present(dp, "sum", "histogram_sum")) return false;
+            if (!float_field_if_present(dp, "min", "histogram_min")) return false;
+            if (!float_field_if_present(dp, "max", "histogram_max")) return false;
+            Span bc = find(dp, "bucketCounts");
+            if (bc.present()) {
+                int t = truthy_deep(bc);
+                if (t == 1) return fail(FB);  // truthy scalar: Python iterates it
+                if (t != 0) {
+                    std::string js;
+                    if (!int_array_json(bc, js)) return false;
+                    if (!row_str("histogram_bucket_counts", js)) return false;
+                }
+            }
+            // explicitBounds: json.dumps of floats — repr format stays Python's
+            Span eb = find(dp, "explicitBounds");
+            if (eb.present() && truthy_deep(eb) != 0) return fail(FB);
+            return emit_temporality("histogram", temp) &&
+                   (b.end_row() ? true : fail(FB));
+        });
+    }
+
+    bool exp_histogram_points(const std::vector<Member>& km) {
+        long long temp;
+        if (!temporality(km, temp)) return false;
+        Span dps = find(km, "dataPoints");
+        std::vector<Member> dp_buf;
+        return each_object_strict(dps, dp_buf, [&](const std::vector<Member>& dp) {
+            if (!replay_base()) return false;
+            if (!row_str("metric_type", "exponential_histogram")) return false;
+            if (!point_common(dp)) return false;
+            if (!int_field(dp, "count", "exp_histogram_count")) return false;
+            if (!float_field_if_present(dp, "sum", "exp_histogram_sum")) return false;
+            if (!int_field(dp, "scale", "exp_histogram_scale")) return false;
+            if (!int_field(dp, "zeroCount", "exp_histogram_zero_count")) return false;
+            static const char* const SIDES[2] = {"positive", "negative"};
+            for (const char* side : SIDES) {
+                Span sv = find(dp, side);
+                if (!sv.present() || truthy_deep(sv) == 0) continue;
+                if (kind_of(sv) != K_OBJ) return fail(FB);
+                Cur c{sv.b, sv.e};
+                std::vector<Member> sm;
+                if (!collect(c, sm, 0)) return fail(c.rc);
+                std::string name("exp_histogram_");
+                name += side;
+                name += "_offset";
+                Span off = find(sm, "offset");
+                long long ov = 0;
+                if (off.present() && !int_arg(off, ov)) return false;
+                if (!row_f64(name, (double)ov)) return false;
+                name.assign("exp_histogram_");
+                name += side;
+                name += "_bucket_counts";
+                Span sbc = find(sm, "bucketCounts");
+                std::string js;
+                if (!sbc.present()) {
+                    js = "[]";  // b.get("bucketCounts", []) default
+                } else if (!int_array_json(sbc, js)) {
+                    return false;
+                }
+                if (!row_str(name, js)) return false;
+            }
+            return emit_temporality("exp_histogram", temp) &&
+                   (b.end_row() ? true : fail(FB));
+        });
+    }
+
+    bool summary_points(const std::vector<Member>& km) {
+        Span dps = find(km, "dataPoints");
+        std::vector<Member> dp_buf;
+        return each_object_strict(dps, dp_buf, [&](const std::vector<Member>& dp) {
+            if (!replay_base()) return false;
+            if (!row_str("metric_type", "summary")) return false;
+            if (!point_common(dp)) return false;
+            if (!int_field(dp, "count", "summary_count")) return false;
+            if (!float_field_if_present(dp, "sum", "summary_sum")) return false;
+            // quantileValues: json.dumps of floats — Python's repr territory
+            Span qv = find(dp, "quantileValues");
+            if (qv.present() && truthy_deep(qv) != 0) return fail(FB);
+            return b.end_row() ? true : fail(FB);
+        });
+    }
+
+    bool metric_element(const std::vector<Member>& m) {
+        // metric-level fields ride on `base` for per-point replay; truncate
+        // back to the scope group's fields when this metric is done
+        size_t base_len = base.size();
+        bool ok = metric_body(m);
+        base.resize(base_len);
+        return ok;
+    }
+
+    bool metric_body(const std::vector<Member>& m) {
+        Val v;
+        Span name = find(m, "name");
+        if (name.present() && !scalar_to_val(name, v)) return false;
+        if (!push_base(std::string("metric_name"), std::move(v))) return false;
+        if (!emit_if_truthy(find(m, "description"), "metric_description", true))
+            return false;
+        if (!emit_if_truthy(find(m, "unit"), "metric_unit", true)) return false;
+        Span md = find(m, "metadata");
+        if (md.present()) {
+            int t = truthy_deep(md);
+            if (t == 1) return fail(FB);  // truthy scalar: Python iterates it
+            if (t != 0) {
+                std::string scratch;
+                if (!attributes(md, "metric_metadata_", true, scratch))
+                    return false;
+            }
+        }
+        // kind dispatch by KEY PRESENCE, in Python's elif order; a present
+        // key with a non-object value raises in Python -> decline
+        static const char* const KIND_KEYS[5] = {
+            "gauge", "sum", "histogram", "exponentialHistogram", "summary"};
+        for (int ki = 0; ki < 5; ki++) {
+            Span kv = find(m, KIND_KEYS[ki]);
+            if (!kv.present()) continue;
+            if (kind_of(kv) != K_OBJ) return fail(FB);
+            Cur c{kv.b, kv.e};
+            std::vector<Member> km;
+            if (!collect(c, km, 0)) return fail(c.rc);
+            switch (ki) {
+                case 0: return gauge_points(km);
+                case 1: return sum_points(km);
+                case 2: return histogram_points(km);
+                case 3: return exp_histogram_points(km);
+                default: return summary_points(km);
+            }
+        }
+        return true;  // kindless metric: base evaluated, no rows
+    }
+
+    bool resource_element(const std::vector<Member>& rm) override {
+        Span resource = find(rm, "resource");
+        Span sms = find(rm, "scopeMetrics");
+        std::vector<Member> sm_buf;
+        return each_object_strict(sms, sm_buf, [&](const std::vector<Member>& sm) {
+            if (!scope_group(resource, sm)) return false;
+            Span metrics = find(sm, "metrics");
+            std::vector<Member> m_buf;
+            return each_object_strict(metrics, m_buf,
+                                      [&](const std::vector<Member>& m) {
+                                          return metric_element(m);
+                                      });
+        });
+    }
+};
+
+// mirrors otel/traces.py::flatten_otel_traces (one row per span)
+struct OtelTracesBuilder : OtelColBuilder {
+    const char* key_top() const override { return "resourceSpans"; }
+    bool top_null_declines() const override { return true; }
+
+    // always-present row field carrying the raw scalar (absent -> null)
+    bool row_scalar(const std::vector<Member>& ms, std::string_view key,
+                    std::string_view col) {
+        Val v;
+        Span sp = find(ms, key);
+        if (sp.present() && !scalar_to_val(sp, v)) return false;
+        return add_val(col_of(col), v) ? true : fail(FB);
+    }
+
+    bool span_element(const std::vector<Member>& span) {
+        if (!replay_base()) return false;
+        if (!row_scalar(span, "traceId", "span_trace_id")) return false;
+        if (!row_scalar(span, "spanId", "span_span_id")) return false;
+        if (!emit_if_truthy(find(span, "parentSpanId"), "span_parent_span_id", false))
+            return false;
+        if (!emit_if_truthy(find(span, "traceState"), "span_trace_state", false))
+            return false;
+        if (!row_scalar(span, "name", "span_name")) return false;
+        Span kd = find(span, "kind");
+        if (kd.present() && kind_of(kd) != K_NULL) {
+            long long kv;
+            Kind kk = kind_of(kd);
+            if (kk == K_NUM) {
+                if (!num_is_integer(kd.view()) || !parse_i64(kd.view(), kv))
+                    return fail(FB);
+            } else if (kk == K_STR) {
+                if (!parse_i64(str_content(kd).view(), kv)) return fail(FB);
+            } else {
+                return fail(FB);  // bool: int(True)=1 quirk — Python path
+            }
+            if (!row_f64("span_kind", (double)kv)) return false;
+            if (kv >= 0 && kv <= 5) {
+                if (!row_str("span_kind_description", SPAN_KIND_TEXT[kv]))
+                    return false;
+            } else if (kk == K_NUM) {
+                // SPAN_KIND.get(int(kind), str(kind)): str of the ORIGINAL
+                // value — canonical integer tokens print identically
+                if (!row_str("span_kind_description", kd.view())) return false;
+            } else {
+                std::string s;
+                Span sc = str_content(kd);
+                if (!unescape_append(sc.b, sc.e, s)) return fail(FB);
+                if (!row_str("span_kind_description", s)) return false;
+            }
+        }
+        if (!col_time(find(span, "startTimeUnixNano"), "span_start_time_unix_nano"))
+            return false;
+        if (!col_time(find(span, "endTimeUnixNano"), "span_end_time_unix_nano"))
+            return false;
+        std::string scratch;
+        if (!attributes(find(span, "attributes"), "span_", false, scratch))
+            return false;
+        // events/links: any truthy value means Python json.dumps output
+        // (or a Python-side error) — both belong to the Python lane
+        Span ev = find(span, "events");
+        if (ev.present() && truthy_deep(ev) != 0) return fail(FB);
+        Span ln = find(span, "links");
+        if (ln.present() && truthy_deep(ln) != 0) return fail(FB);
+        if (!emit_if_truthy(find(span, "droppedAttributesCount"),
+                            "span_dropped_attributes_count", false))
+            return false;
+        if (!emit_if_truthy(find(span, "droppedEventsCount"),
+                            "span_dropped_events_count", false))
+            return false;
+        if (!emit_if_truthy(find(span, "droppedLinksCount"),
+                            "span_dropped_links_count", false))
+            return false;
+        Span st = find(span, "status");
+        if (st.present()) {
+            int t = truthy_deep(st);
+            if (t == 1) return fail(FB);  // truthy scalar: .get raises
+            if (t == 2) {
+                if (kind_of(st) != K_OBJ) return fail(FB);  // truthy array
+                Cur c{st.b, st.e};
+                std::vector<Member> sm;
+                if (!collect(c, sm, 0)) return fail(c.rc);
+                Span code = find(sm, "code");
+                long long cv = 0;
+                if (code.present()) {
+                    Kind ck = kind_of(code);
+                    if (ck == K_NUM) {
+                        if (!num_is_integer(code.view()) ||
+                            !parse_i64(code.view(), cv))
+                            return fail(FB);
+                    } else if (ck == K_STR) {
+                        if (!parse_i64(str_content(code).view(), cv))
+                            return fail(FB);
+                    } else {
+                        return fail(FB);  // null/bool: int() quirks
+                    }
+                }
+                if (!row_f64("span_status_code", (double)cv)) return false;
+                if (cv >= 0 && cv <= 2) {
+                    if (!row_str("span_status_description", STATUS_CODE_TEXT[cv]))
+                        return false;
+                } else {
+                    // STATUS_CODE.get(code, str(code)): str of the PARSED int
+                    std::string s;
+                    append_i64(s, cv);
+                    if (!row_str("span_status_description", s)) return false;
+                }
+                if (!emit_if_truthy(find(sm, "message"), "span_status_message",
+                                    false))
+                    return false;
+            }
+        }
+        return b.end_row() ? true : fail(FB);
+    }
+
+    bool resource_element(const std::vector<Member>& rs) override {
+        Span resource = find(rs, "resource");
+        Span sss = find(rs, "scopeSpans");
+        std::vector<Member> ss_buf;
+        return each_object_strict(sss, ss_buf, [&](const std::vector<Member>& ss) {
+            if (!scope_group(resource, ss)) return false;
+            Span spans = find(ss, "spans");
+            std::vector<Member> sp_buf;
+            return each_object_strict(spans, sp_buf,
+                                      [&](const std::vector<Member>& sp) {
+                                          return span_element(sp);
+                                      });
+        });
+    }
+};
+
+}  // namespace colb
+}  // anonymous namespace
+
+// ------------------------------- sharded parse -----------------------------
+//
+// Multi-core ingest: split the payload at record boundaries, parse each
+// slice on a native worker pool into its own ColumnarBatch, then stitch the
+// parts back in payload order into ONE contiguous batch behind the same
+// ptpu_cols_* handle. The split is OPTIMISTIC — a boundary landing inside a
+// string or nested value makes some shard's parse fail, and the caller
+// reruns single-shard, which is authoritative for rc AND result. Sharded
+// success is byte-identical to unsharded success: per-shard builders apply
+// the same per-record rules, and the stitch completes the cross-shard
+// checks (positional name equality for the plain lane, first-seen union +
+// kind agreement for the OTel lanes).
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+
+namespace {
+namespace ppool {
+
+// Native parse worker pool (lock-id: ppool::g_mu). Lazily started by the
+// first sharded parse and restartable after shutdown: ServerState.stop
+// drains it, a later call just re-spawns workers under the same lock.
+// All four objects are intentionally leaked (never destroyed): a process
+// exiting without ptpu_parse_pool_shutdown would otherwise run the static
+// destructor of a vector of JOINABLE std::threads, which is
+// std::terminate. Idle workers parked on g_cv die with the process.
+std::mutex& g_mu = *new std::mutex;
+std::condition_variable& g_cv = *new std::condition_variable;        // guarded-by: g_mu
+std::deque<std::function<void()>>& g_jobs =
+    *new std::deque<std::function<void()>>;                          // guarded-by: g_mu
+std::vector<std::thread>& g_workers = *new std::vector<std::thread>; // guarded-by: g_mu
+bool g_stopping = false;                                             // guarded-by: g_mu
+
+void worker_main() {
+    for (;;) {
+        std::function<void()> job;
+        {
+            std::unique_lock<std::mutex> lk(g_mu);
+            g_cv.wait(lk, [] { return g_stopping || !g_jobs.empty(); });
+            if (g_jobs.empty()) return;  // stopping, queue drained
+            job = std::move(g_jobs.front());
+            g_jobs.pop_front();
+        }
+        job();
+    }
+}
+
+// per-call completion latch: the submitting thread parses shard 0 itself
+// (ctypes released the GIL for the whole call) and then blocks here
+struct Latch {
+    std::mutex mu;
+    std::condition_variable cv;
+    int remaining;  // guarded-by: mu
+    explicit Latch(int n) : remaining(n) {}
+    void count_down() {
+        std::lock_guard<std::mutex> lk(mu);
+        if (--remaining == 0) cv.notify_all();
+    }
+    void wait() {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return remaining == 0; });
+    }
+};
+
+// run fn(1..n-1) on the pool and fn(0) inline; returns when all complete.
+// Tops up (and un-stops) the pool under g_mu, so a shutdown racing a new
+// request cannot strand queued jobs: either the old workers drain them
+// (they exit only on stopping AND empty), or fresh workers are spawned.
+template <typename Fn>
+void run_sharded(int n, Fn&& fn) {
+    if (n <= 1) {
+        fn(0);
+        return;
+    }
+    Latch latch(n - 1);
+    {
+        std::lock_guard<std::mutex> lk(g_mu);
+        g_stopping = false;
+        while ((int)g_workers.size() < n - 1) g_workers.emplace_back(worker_main);
+        for (int i = 1; i < n; i++)
+            g_jobs.emplace_back([i, &fn, &latch] {
+                fn(i);
+                latch.count_down();
+            });
+    }
+    g_cv.notify_all();
+    fn(0);
+    latch.wait();
+}
+
+void shutdown() {
+    std::vector<std::thread> workers;
+    {
+        std::lock_guard<std::mutex> lk(g_mu);
+        g_stopping = true;
+        workers.swap(g_workers);
+    }
+    g_cv.notify_all();
+    for (auto& w : workers) w.join();  // join outside the lock
+}
+
+int size() {
+    std::lock_guard<std::mutex> lk(g_mu);
+    return (int)g_workers.size();
+}
+
+}  // namespace ppool
+}  // anonymous namespace
+
+namespace {
+namespace colb {
+
+enum { PTPU_MAX_SHARDS = 16 };
+
+// Find up to nshards-1 record-boundary split points in a JSON-array
+// payload: a ',' whose previous non-ws byte is '}' and next non-ws byte is
+// '{', scanned forward from evenly spaced byte targets. Purely optimistic —
+// false positives (the pattern inside a string) just fail a shard later.
+static bool shard_boundaries(const char* in, uint64_t len, int nshards,
+                             std::vector<uint64_t>& cuts) {
+    const char* end = in + len;
+    const char* p = in;
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+    if (p >= end || *p != '[') return false;  // single-object / other shapes
+    uint64_t prev = (uint64_t)(p - in) + 1;
+    for (int k = 1; k < nshards; k++) {
+        uint64_t target = len * (uint64_t)k / (uint64_t)nshards;
+        if (target <= prev) target = prev + 1;
+        if (target >= len) break;
+        const char* q = in + target;
+        const char* hit = nullptr;
+        while (q < end) {
+            q = (const char*)std::memchr(q, ',', (size_t)(end - q));
+            if (q == nullptr) break;
+            const char* r = q - 1;
+            while (r > in && (*r == ' ' || *r == '\t' || *r == '\n' || *r == '\r'))
+                r--;
+            if (*r == '}') {
+                const char* f = q + 1;
+                while (f < end &&
+                       (*f == ' ' || *f == '\t' || *f == '\n' || *f == '\r'))
+                    f++;
+                if (f < end && *f == '{') {
+                    hit = q;
+                    break;
+                }
+            }
+            q++;
+        }
+        if (hit == nullptr) break;  // tail has no more boundaries
+        cuts.push_back((uint64_t)(hit - in));
+        prev = (uint64_t)(hit - in) + 1;
+    }
+    return !cuts.empty();
+}
+
+// enumerate the top-level elements of a resource array (OTel sharding);
+// every element must be an object — anything else goes unsharded
+static bool array_element_spans(const Span& arr, std::vector<Span>& out) {
+    if (kind_of(arr) != K_ARR) return false;
+    Cur c{arr.b + 1, arr.e};
+    c.ws();
+    if (c.p < c.end && *c.p == ']') return true;
+    while (true) {
+        Span v;
+        if (!c.value_span(v, 1)) return false;
+        if (kind_of(v) != K_OBJ) return false;
+        out.push_back(v);
+        c.ws();
+        if (c.p < c.end && *c.p == ',') { c.p++; continue; }
+        if (c.p < c.end && *c.p == ']') return true;
+        return false;
+    }
+}
+
+// contiguous byte-balanced element runs: shard k gets [starts[k], starts[k+1])
+static void partition_spans(const std::vector<Span>& elems, int n,
+                            std::vector<size_t>& starts) {
+    uint64_t total = 0;
+    for (const auto& e : elems) total += e.len();
+    starts.assign((size_t)n + 1, elems.size());
+    starts[0] = 0;
+    uint64_t cum = 0;
+    int k = 1;
+    for (size_t i = 0; i < elems.size() && k < n; i++) {
+        cum += elems[i].len();
+        while (k < n && cum * (uint64_t)n >= total * (uint64_t)k) {
+            size_t cut = i;  // boundary before the crossing element...
+            if (cut <= starts[(size_t)k - 1]) cut = starts[(size_t)k - 1] + 1;
+            if (cut > elems.size()) cut = elems.size();  // ...never an empty middle run
+            starts[(size_t)k++] = cut;
+        }
+    }
+}
+
+// ---- ordered stitch -------------------------------------------------------
+
+// append n bits of src's LSB-first bitmap onto dst (current length
+// dst_rows bits); byte-aligned fast path memcpys whole bytes — trailing
+// bits of the last source byte are zero by bm_push construction
+static void bm_append(std::vector<uint8_t>& dst, uint64_t dst_rows,
+                      const std::vector<uint8_t>& src, uint64_t n) {
+    if (n == 0) return;
+    if ((dst_rows & 7) == 0) {
+        dst.insert(dst.end(), src.begin(), src.begin() + (size_t)((n + 7) / 8));
+        return;
+    }
+    for (uint64_t i = 0; i < n; i++)
+        bm_push(dst, dst_rows + i, (src[(size_t)(i >> 3)] >> (i & 7)) & 1);
+}
+
+static void bm_append_zeros(std::vector<uint8_t>& bm, uint64_t start, uint64_t n) {
+    for (uint64_t i = 0; i < n; i++) bm_push(bm, start + i, false);
+}
+
+// one all-null run of n rows (missing or NULL-kind source part)
+static bool stitch_nulls(ColBuilder& c, uint64_t n) {
+    bm_append_zeros(c.validity, c.rows, n);
+    c.null_count += n;
+    switch (c.kind) {
+        case PT_COL_FLOAT64: c.f64.insert(c.f64.end(), (size_t)n, 0.0); break;
+        case PT_COL_TS_MS: c.ts.insert(c.ts.end(), (size_t)n, 0); break;
+        case PT_COL_BOOL: bm_append_zeros(c.bits, c.rows, n); break;
+        case PT_COL_STRING:
+            c.offsets.insert(c.offsets.end(), (size_t)n, c.offsets.back());
+            break;
+        default: break;
+    }
+    c.rows += n;
+    return true;
+}
+
+static bool stitch_part_col(ColBuilder& dst, const ColBuilder& src) {
+    if (src.kind == PT_COL_NULL) return stitch_nulls(dst, src.rows);
+    bm_append(dst.validity, dst.rows, src.validity, src.rows);
+    dst.null_count += src.null_count;
+    switch (dst.kind) {  // kinds verified equal in pass 1
+        case PT_COL_FLOAT64:
+            dst.f64.insert(dst.f64.end(), src.f64.begin(), src.f64.end());
+            break;
+        case PT_COL_TS_MS:
+            dst.ts.insert(dst.ts.end(), src.ts.begin(), src.ts.end());
+            break;
+        case PT_COL_BOOL:
+            bm_append(dst.bits, dst.rows, src.bits, src.rows);
+            break;
+        case PT_COL_STRING: {
+            if (dst.chars.size() + src.chars.size() > (size_t)INT32_MAX)
+                return false;  // rerun unsharded -> same FB the add would hit
+            int32_t rebase = (int32_t)dst.chars.size();
+            dst.chars.append(src.chars);
+            for (size_t j = 1; j < src.offsets.size(); j++)
+                dst.offsets.push_back(rebase + src.offsets[j]);
+            break;
+        }
+        default: break;
+    }
+    dst.rows += src.rows;
+    return true;
+}
+
+// Stitch per-shard batches into one contiguous batch, in payload order.
+// positional (plain-JSON lane): every part must carry the identical column
+// name sequence — the cross-shard completion of the record-0 uniformity
+// rule. union (OTel lanes): first-seen order across parts, which equals the
+// unsharded first-occurrence order because shard runs are contiguous. Kind
+// disagreements fail -> the caller reruns unsharded, which reproduces the
+// exact decline the ladder expects.
+static bool stitch_parts(std::vector<ColumnarBatch>& parts, bool positional,
+                         ColumnarBatch& out) {
+    if (positional) {
+        for (size_t p = 1; p < parts.size(); p++) {
+            if (parts[p].cols.size() != parts[0].cols.size()) return false;
+            for (size_t i = 0; i < parts[p].cols.size(); i++)
+                if (parts[p].cols[i].name != parts[0].cols[i].name) return false;
+        }
+        for (const auto& c : parts[0].cols) out.create(c.name);
+    } else {
+        for (const auto& part : parts)
+            for (const auto& c : part.cols)
+                if (out.find_col(c.name) < 0) out.create(c.name);
+    }
+    for (auto& oc : out.cols) {
+        int32_t k = PT_COL_NULL;
+        for (const auto& part : parts) {
+            int64_t si = part.find_col(oc.name);
+            if (si < 0) continue;
+            int32_t pk = part.cols[(size_t)si].kind;
+            if (pk == PT_COL_NULL) continue;
+            if (k == PT_COL_NULL) k = pk;
+            else if (k != pk) return false;  // mixed-type across shards
+        }
+        if (!out.set_kind(oc, k)) return false;
+    }
+    for (auto& oc : out.cols) {
+        for (const auto& part : parts) {
+            int64_t si = part.find_col(oc.name);
+            if (si < 0) {
+                if (!stitch_nulls(oc, part.nrows)) return false;
+            } else if (!stitch_part_col(oc, part.cols[(size_t)si])) {
+                return false;
+            }
+        }
+    }
+    uint64_t total = 0;
+    for (const auto& part : parts) total += part.nrows;
+    out.nrows = total;
+    return true;
+}
+
+}  // namespace colb
+}  // anonymous namespace
+
+// publish a finished batch behind an owning handle
+static int ptpu_publish_cols(colb::ColumnarBatch&& b, void** out) {
+    auto* h = new colb::ColumnarBatch(std::move(b));
+    g_cols_live.fetch_add(1, std::memory_order_relaxed);
+    *out = h;
+    return PTPU_FJ_OK;
+}
+
+// shared sharded driver for the three OTel lanes: serial top-level element
+// enumeration, byte-balanced contiguous runs, per-shard builders on the
+// pool, union stitch; any wrinkle falls back to the unsharded run, which
+// is authoritative for rc and result
+template <typename B>
+static int otel_columnar_run(const char* in, uint64_t len, int ts_as_ms,
+                             int nshards, void** out) {
+    if (nshards > colb::PTPU_MAX_SHARDS) nshards = colb::PTPU_MAX_SHARDS;
+    if (nshards > 1) {
+        B probe;
+        otelj::Cur c{in, in + len};
+        std::vector<otelj::Member> top;
+        if (otelj::collect(c, top, 0)) {
+            c.ws();
+            if (c.p == c.end) {
+                otelj::Span arr = otelj::find(top, probe.key_top());
+                std::vector<otelj::Span> elems;
+                if (arr.present() && colb::array_element_spans(arr, elems) &&
+                    elems.size() >= 2) {
+                    int n = nshards < (int)elems.size() ? nshards
+                                                        : (int)elems.size();
+                    std::vector<size_t> starts;
+                    colb::partition_spans(elems, n, starts);
+                    std::vector<B> builders((size_t)n);
+                    std::vector<char> ok((size_t)n, 0);
+                    for (auto& bd : builders) bd.ts_as_ms = ts_as_ms != 0;
+                    ppool::run_sharded(n, [&](int i) {
+                        ok[(size_t)i] =
+                            builders[(size_t)i].run_spans(
+                                elems.data() + starts[(size_t)i],
+                                starts[(size_t)i + 1] - starts[(size_t)i])
+                                ? 1
+                                : 0;
+                    });
+                    bool all_ok = true;
+                    for (int i = 0; i < n; i++) all_ok = all_ok && ok[(size_t)i];
+                    if (all_ok) {
+                        std::vector<colb::ColumnarBatch> parts;
+                        parts.reserve((size_t)n);
+                        for (auto& bd : builders) parts.push_back(std::move(bd.b));
+                        colb::ColumnarBatch stitched;
+                        if (colb::stitch_parts(parts, /*positional=*/false,
+                                               stitched))
+                            return ptpu_publish_cols(std::move(stitched), out);
+                    }
+                }
+            }
+        }
+    }
+    B builder;
+    builder.ts_as_ms = ts_as_ms != 0;
+    if (!builder.run(in, len))
+        return builder.rc == colb::OK ? PTPU_FJ_FALLBACK : builder.rc;
+    return ptpu_publish_cols(std::move(builder.b), out);
+}
+
+extern "C" {
+
+// Sharded variant of ptpu_flatten_columnar: nshards worker slices split at
+// record boundaries, stitched in payload order. Identical observable
+// behavior to the unsharded export at any shard count — any shard or
+// stitch failure reruns single-shard, which is authoritative.
+int ptpu_flatten_columnar_sharded(const char* in, uint64_t len, int max_depth,
+                                  const char* sep, int nshards, void** out) {
+    if (nshards > colb::PTPU_MAX_SHARDS) nshards = colb::PTPU_MAX_SHARDS;
+    if (nshards > 1) {
+        std::vector<uint64_t> cuts;
+        if (colb::shard_boundaries(in, len, nshards, cuts)) {
+            int n = (int)cuts.size() + 1;
+            std::vector<colb::JsonColCtx> ctxs((size_t)n);
+            std::vector<char> ok((size_t)n, 0);
+            for (int i = 0; i < n; i++) {
+                uint64_t sb = i == 0 ? 0 : cuts[(size_t)i - 1] + 1;
+                uint64_t se = i == n - 1 ? len : cuts[(size_t)i];
+                ctxs[(size_t)i].c = colb::Cur{in + sb, in + se};
+                ctxs[(size_t)i].max_depth = max_depth;
+                ctxs[(size_t)i].sep = sep;
+                ctxs[(size_t)i].seplen = std::strlen(sep);
+            }
+            ppool::run_sharded(n, [&](int i) {
+                ok[(size_t)i] =
+                    ctxs[(size_t)i].run_records(i == 0, i == n - 1) ? 1 : 0;
+            });
+            bool all_ok = true;
+            for (int i = 0; i < n; i++) all_ok = all_ok && ok[(size_t)i];
+            if (all_ok) {
+                std::vector<colb::ColumnarBatch> parts;
+                parts.reserve((size_t)n);
+                for (auto& ctx : ctxs) parts.push_back(std::move(ctx.b));
+                colb::ColumnarBatch stitched;
+                if (colb::stitch_parts(parts, /*positional=*/true, stitched))
+                    return ptpu_publish_cols(std::move(stitched), out);
+            }
+        }
+    }
+    return ptpu_flatten_columnar(in, len, max_depth, sep, out);
+}
+
+// Sharded variant of ptpu_otel_logs_columnar (split at resourceLogs
+// element boundaries; same observable behavior at any shard count).
+int ptpu_otel_logs_columnar_sharded(const char* in, uint64_t len, int ts_as_ms,
+                                    int nshards, void** out) {
+    return otel_columnar_run<colb::OtelColBuilder>(in, len, ts_as_ms, nshards,
+                                                   out);
+}
+
+// OTLP-JSON metrics payload -> columnar batch (one row per data point),
+// sharded at resourceMetrics element boundaries when nshards > 1.
+int ptpu_otel_metrics_columnar(const char* in, uint64_t len, int ts_as_ms,
+                               int nshards, void** out) {
+    return otel_columnar_run<colb::OtelMetricsBuilder>(in, len, ts_as_ms,
+                                                       nshards, out);
+}
+
+// OTLP-JSON traces payload -> columnar batch (one row per span), sharded
+// at resourceSpans element boundaries when nshards > 1.
+int ptpu_otel_traces_columnar(const char* in, uint64_t len, int ts_as_ms,
+                              int nshards, void** out) {
+    return otel_columnar_run<colb::OtelTracesBuilder>(in, len, ts_as_ms,
+                                                      nshards, out);
+}
+
+// Drain and join the parse worker pool (ServerState.stop / teardown).
+// Queued jobs complete first; the pool restarts lazily on the next
+// sharded parse.
+void ptpu_parse_pool_shutdown(void) { ppool::shutdown(); }
+
+// live worker count (observability + tests)
+int ptpu_parse_pool_size(void) { return ppool::size(); }
 
 }  // extern "C"

@@ -287,6 +287,12 @@ class ServerState:
         from parseable_tpu.ops.link import shutdown_warmer
 
         shutdown_warmer()
+        # native sharded-parse worker pool (pool-lifecycle: the C++ side's
+        # lock-id ppool::g_mu state drains queued shard jobs before joining;
+        # the pool restarts lazily if anything parses after stop)
+        from parseable_tpu.native import shutdown_parse_pool
+
+        shutdown_parse_pool()
         self.query_workers.shutdown(wait=False)
         self.workers.shutdown(wait=False)
         # sync loop threads exit on the next _sync_stop.wait() wake; join so

@@ -245,7 +245,11 @@ def _columns_to_event(
     import pyarrow as pa
 
     tbl = pa.Table.from_arrays(arrays, names=names)
-    return _table_to_event(p, stream, tbl, origin_size, log_source, custom_fields)
+    # direct: the arrays are single-chunk contiguous native buffers, so the
+    # staged batch can stream straight into the bucket's IPC file
+    return _table_to_event(
+        p, stream, tbl, origin_size, log_source, custom_fields, direct=True
+    )
 
 
 def _ndjson_to_event(
@@ -290,6 +294,7 @@ def _table_to_event(
     origin_size: int,
     log_source: LogSource,
     custom_fields: dict[str, str] | None,
+    direct: bool = False,
 ) -> int | None:
     """Shared tail of both native tiers: the fast-path normalization types
     the columns, then the event processes through the unchanged schema
@@ -320,6 +325,7 @@ def _table_to_event(
         is_first_event=not meta.schema,
         log_source=log_source,
         stream_type=meta.stream_type,
+        direct_staging=direct,
     )
     ev.process(stream, livetail=LIVETAIL.process, commit_schema=p.commit_schema)
     return batch.num_rows
@@ -389,6 +395,46 @@ def ingest_otel_native_fast(
     return count
 
 
+def ingest_otel_columnar_fast(
+    p: Parseable,
+    stream_name: str,
+    raw_body: bytes,
+    custom_fields: dict[str, str] | None,
+    columnar_fn,
+    log_source: LogSource,
+    lane_out: dict | None = None,
+) -> int | None:
+    """Native columnar lane for the OTel metrics and traces sources.
+
+    Unlike logs there is no NDJSON middle tier: these flatteners are pure
+    structure walks (one row per data point / span), so the C++ builder
+    either lands the exact rows in typed Arrow buffers or declines to the
+    Python flattener — `columnar_fn` is native.otel_metrics_columnar or
+    native.otel_traces_columnar. Returns the row count or None (decline),
+    with identical behavior either way."""
+    stream = p.get_stream(stream_name)
+    meta = stream.metadata
+    if not _native_lane_eligible(meta):
+        return None
+    ts_as_ms = bool(meta.infer_timestamp)
+    r = columnar_fn(raw_body, ts_as_ms=ts_as_ms)
+    if r is None:
+        return None
+    names, arrays, nrows = r
+    if lane_out is not None:
+        lane_out["lane"] = "columnar"
+    if nrows == 0:
+        return 0
+    count = _columns_to_event(
+        p, stream, names, arrays, len(raw_body), log_source, custom_fields
+    )
+    if count is not None:
+        return count
+    if lane_out is not None:
+        del lane_out["lane"]
+    return None  # normalization declined: Python flattener decides
+
+
 def _flatten_and_push(
     p: Parseable,
     stream_name: str,
@@ -425,6 +471,26 @@ def _flatten_and_push(
         info = {}
         count = ingest_otel_native_fast(
             p, stream_name, raw_body, custom_fields, lane_out=info
+        )
+        if count is not None:
+            _lane_result(sp, info.get("lane", "columnar"), "hit")
+            return count
+    if raw_body is not None and log_source in (
+        LogSource.OTEL_METRICS,
+        LogSource.OTEL_TRACES,
+    ):
+        from parseable_tpu import native
+
+        native_attempted = True
+        info = {}
+        columnar_fn = (
+            native.otel_metrics_columnar
+            if log_source == LogSource.OTEL_METRICS
+            else native.otel_traces_columnar
+        )
+        count = ingest_otel_columnar_fast(
+            p, stream_name, raw_body, custom_fields, columnar_fn, log_source,
+            lane_out=info,
         )
         if count is not None:
             _lane_result(sp, info.get("lane", "columnar"), "hit")

@@ -26,6 +26,13 @@ ARROW_FILE_EXTENSION = "arrows"
 PART_FILE_EXTENSION = "part.arrows"
 
 
+# Explicit IPC write options for the staging files: no compression and the
+# current metadata version, stated rather than inherited, so the direct
+# (native-columnar) path and the buffered path provably produce the same
+# framing — recover_orphans and the converter read both identically.
+IPC_WRITE_OPTIONS = ipc.IpcWriteOptions()
+
+
 class DiskWriter:
     """One IPC file for one staging bucket. Not thread-safe; callers lock."""
 
@@ -37,16 +44,38 @@ class DiskWriter:
         self.rows_written = 0
         self._pending: list[pa.RecordBatch] = []
         self._pending_rows = 0
+        # write-path accounting (tests assert the columnar lane stays on
+        # the direct path): direct = straight write_batch from the native
+        # buffers, buffered = through the _pending regrouping, adapted =
+        # schema-mismatch copies through adapt_batch
+        self.direct_writes = 0
+        self.buffered_writes = 0
+        self.adapted_writes = 0
         path.parent.mkdir(parents=True, exist_ok=True)
         self._sink = pa.OSFile(str(path), "wb")
-        self._writer = ipc.new_file(self._sink, schema)
+        self._writer = ipc.new_file(self._sink, schema, options=IPC_WRITE_OPTIONS)
         self.finished = False
 
-    def write(self, batch: pa.RecordBatch) -> None:
+    def write(self, batch: pa.RecordBatch, direct: bool = False) -> None:
         if batch.schema != self.schema:
             from parseable_tpu.utils.arrowutil import adapt_batch
 
             batch = adapt_batch(self.schema, batch)
+            self.adapted_writes += 1
+            direct = False  # adapt copied; regroup like any Python-lane batch
+        if direct:
+            # native-columnar batches arrive payload-sized and already
+            # backed by contiguous Arrow buffers: stream them straight into
+            # the IPC file with zero re-serialization. Pending batches (if
+            # an earlier Python-lane write buffered some) flush first so
+            # row order in the file stays ingestion order.
+            if self._pending:
+                self._flush_pending()
+            self._writer.write_batch(batch)
+            self.rows_written += batch.num_rows
+            self.direct_writes += 1
+            return
+        self.buffered_writes += 1
         self._pending.append(batch)
         self._pending_rows += batch.num_rows
         if self._pending_rows >= self.batch_rows:
@@ -111,12 +140,14 @@ class Writer:
         self.mem: MemWriter | None = MemWriter() if enable_memory else None
         self.batch_rows = batch_rows
 
-    def push(self, bucket_key: str, path: Path, batch: pa.RecordBatch) -> None:
+    def push(
+        self, bucket_key: str, path: Path, batch: pa.RecordBatch, direct: bool = False
+    ) -> None:
         w = self.disk.get(bucket_key)
         if w is None or w.finished:
             w = DiskWriter(path, batch.schema, self.batch_rows)
             self.disk[bucket_key] = w
-        w.write(batch)
+        w.write(batch, direct=direct)
         if self.mem is not None:
             self.mem.push(batch)
 

@@ -221,6 +221,35 @@ def test_broker_error_records_skipped(parseable):
     assert committed_next(fake.commits, "applogs", 0) == 8
 
 
+def test_kafka_flush_rides_columnar_lane(parseable):
+    """The sink flush routes through the three-tier native ladder: a
+    uniform chunk must land via the columnar lane (proved by the
+    parseable_ingest_native_total counter), not the per-record Python
+    wrap — that path is reserved for malformed batches."""
+    from parseable_tpu import native
+    from parseable_tpu.utils.metrics import REGISTRY
+
+    if not native.native_available():
+        pytest.skip("native fastpath unavailable")
+
+    def lane(ln, r):
+        return (
+            REGISTRY.get_sample_value(
+                "parseable_ingest_native_total", {"lane": ln, "result": r}
+            )
+            or 0.0
+        )
+
+    before = lane("columnar", "hit")
+    source, fake = make_source(parseable, [])
+    script = recs("applogs", 0, 0, 3)  # exactly one full chunk -> flush
+    script.append(("stop", source))
+    fake.script = script
+    source.run()
+    assert staged_rows(parseable, "applogs") == 3
+    assert lane("columnar", "hit") > before, "kafka flush missed the columnar lane"
+
+
 def test_malformed_payloads_survive(parseable):
     source, fake = make_source(parseable, [])
     script = [

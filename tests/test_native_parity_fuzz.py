@@ -17,6 +17,7 @@ from __future__ import annotations
 import gc
 import json
 import random
+from pathlib import Path
 
 import pyarrow as pa
 import pytest
@@ -57,9 +58,13 @@ def staged(p: Parseable, stream: str):
     return pa.Table.from_batches(batches).drop_columns(["p_timestamp"])
 
 
-def run_three_lanes(trio, stream: str, body: bytes, monkeypatch, source=LogSource.JSON):
+def run_three_lanes(
+    trio, stream: str, body: bytes, monkeypatch, source=LogSource.JSON, shards=None
+):
     """Ingest `body` through native-default, NDJSON-forced, and pure-Python
-    and return (counts, tables, lane) — every lane must agree on errors."""
+    and return (counts, tables, lane) — every lane must agree on errors.
+    `shards` forces P_INGEST_PARSE_SHARDS (threshold zeroed) on the native
+    lane, so every payload exercises the sharded split/stitch path too."""
     p_nat, p_ndj, p_py = trio
     for p in trio:
         p.create_stream_if_not_exists(stream)
@@ -71,9 +76,14 @@ def run_three_lanes(trio, stream: str, body: bytes, monkeypatch, source=LogSourc
     }
     for kind, p in (("nat", p_nat), ("ndj", p_ndj), ("py", p_py)):
         with monkeypatch.context() as m:
+            if kind == "nat" and shards is not None:
+                m.setenv("P_INGEST_PARSE_SHARDS", str(shards))
+                m.setenv("P_INGEST_SHARD_MIN_BYTES", "0")
             if kind == "ndj":
                 m.setattr(native, "flatten_columnar", lambda *a, **k: None)
                 m.setattr(native, "otel_logs_columnar", lambda *a, **k: None)
+                m.setattr(native, "otel_metrics_columnar", lambda *a, **k: None)
+                m.setattr(native, "otel_traces_columnar", lambda *a, **k: None)
             try:
                 if kind == "py":
                     count = flatten_and_push_logs(
@@ -116,6 +126,48 @@ def trio(tmp_path):
     yield ps
     for p in ps:
         p.shutdown()
+
+
+# ------------------------------------------------------- shard invariance
+
+FLATTEN_DEPTH = Options().event_flatten_level - 1
+
+
+def native_table(body: bytes, shards: int, source=LogSource.JSON):
+    """Parse `body` at an explicit shard count through the requested lane's
+    columnar entry point; returns a pa.Table or None on decline/invalid."""
+    if source == LogSource.JSON:
+        r = native.flatten_columnar(body, FLATTEN_DEPTH, shards=shards)
+    elif source == LogSource.OTEL_LOGS:
+        r = native.otel_logs_columnar(body, shards=shards)
+    elif source == LogSource.OTEL_METRICS:
+        r = native.otel_metrics_columnar(body, shards=shards)
+    else:
+        r = native.otel_traces_columnar(body, shards=shards)
+    if r is None:
+        return None
+    names, arrays, nrows = r
+    if not names:
+        return pa.table({"_rows": pa.array([nrows])})
+    return pa.Table.from_arrays(list(arrays), names=list(names))
+
+
+def assert_shard_invariant(body: bytes, source=LogSource.JSON, counts=(1, 2, 4)):
+    """The sharded parse must be observably identical to shards=1 at EVERY
+    count: same decline decision, same schema, same values, byte-for-byte
+    (the IPC serialization of equal tables is identical)."""
+    base = native_table(body, counts[0], source)
+    for s in counts[1:]:
+        t = native_table(body, s, source)
+        if base is None:
+            assert t is None, f"shards={s} parsed; shards={counts[0]} declined"
+            continue
+        assert t is not None, f"shards={s} declined; shards={counts[0]} parsed"
+        assert t.schema.equals(base.schema), (
+            f"shards={s} schema drift:\n{t.schema}\nvs\n{base.schema}"
+        )
+        assert t.equals(base), f"shards={s} values drift from shards={counts[0]}"
+    return base
 
 
 # ---------------------------------------------------------------- generators
@@ -273,6 +325,155 @@ def gen_otel_payload(rng: random.Random):
     return {"resourceLogs": groups}
 
 
+def gen_otel_metrics_payload(rng: random.Random):
+    def attrs():
+        return [
+            {"key": f"a{j}", "value": {"stringValue": rng.choice(STRINGS)}}
+            for j in range(rng.randrange(0, 3))
+        ]
+
+    def point(i):
+        d = {}
+        if rng.random() < 0.9:
+            d["timeUnixNano"] = rng.choice(
+                [str(1714521600000000000 + i), 1714521600000000000 + i, "", "x"]
+            )
+        if rng.random() < 0.5:
+            d["startTimeUnixNano"] = str(1714521500000000000 + i)
+        if rng.random() < 0.7:
+            d["asDouble"] = rng.uniform(-1e9, 1e9)
+        elif rng.random() < 0.8:
+            d["asInt"] = rng.choice([str(rng.randrange(-(10**12), 10**12)), 7])
+        if rng.random() < 0.5:
+            d["attributes"] = attrs()
+        if rng.random() < 0.1:
+            d["exemplars"] = [{"asDouble": 1.0}]  # Python tier
+        if rng.random() < 0.08:
+            d["flags"] = rng.choice([0, 1])
+        return d
+
+    def metric(i):
+        m = {"name": f"m{i}"}
+        if rng.random() < 0.6:
+            m["unit"] = rng.choice(["ms", "1", "", "By"])
+        if rng.random() < 0.5:
+            m["description"] = rng.choice(["latency", "", "é desc"])
+        points = [point(j) for j in range(rng.randrange(0, 4))]
+        roll = rng.random()
+        if roll < 0.3:
+            m["gauge"] = {"dataPoints": points}
+        elif roll < 0.6:
+            m["sum"] = {
+                "dataPoints": points,
+                "aggregationTemporality": rng.choice([1, 2, 0, "2"]),
+                "isMonotonic": rng.choice([True, False]),
+            }
+        elif roll < 0.8:
+            for d in points:
+                d["count"] = rng.choice([str(rng.randrange(0, 100)), 5])
+                if rng.random() < 0.7:
+                    d["sum"] = rng.uniform(0, 1e6)
+                if rng.random() < 0.6:
+                    d["bucketCounts"] = [str(rng.randrange(0, 9)) for _ in range(3)]
+                    d["explicitBounds"] = [0.1, 1.0]
+                if rng.random() < 0.4:
+                    d["min"] = 0.0
+                    d["max"] = rng.uniform(1, 100)
+            m["histogram"] = {
+                "dataPoints": points,
+                "aggregationTemporality": rng.choice([1, 2]),
+            }
+        elif roll < 0.9:
+            for d in points:
+                d.pop("asDouble", None)
+                d.pop("asInt", None)
+                d["count"] = str(rng.randrange(0, 50))
+                d["sum"] = rng.uniform(0, 100)
+                if rng.random() < 0.3:
+                    d["quantileValues"] = [{"quantile": 0.5, "value": 1.0}]  # Python
+            m["summary"] = {"dataPoints": points}
+        else:
+            m["exponentialHistogram"] = {
+                "dataPoints": points,
+                "aggregationTemporality": 2,
+            }
+        return m
+
+    groups = []
+    for g in range(rng.randrange(1, 3)):
+        sm = []
+        for _s in range(rng.randrange(1, 3)):
+            entry = {"metrics": [metric(i) for i in range(rng.randrange(0, 3))]}
+            if rng.random() < 0.5:
+                entry["scope"] = {"name": f"scope{g}", "version": "2"}
+            sm.append(entry)
+        rm = {"scopeMetrics": sm}
+        if rng.random() < 0.7:
+            rm["resource"] = {
+                "attributes": [
+                    {"key": "service.name", "value": {"stringValue": f"svc{g}"}}
+                ]
+            }
+        groups.append(rm)
+    return {"resourceMetrics": groups}
+
+
+def gen_otel_traces_payload(rng: random.Random):
+    def span(i):
+        s = {}
+        if rng.random() < 0.9:
+            s["traceId"] = f"{i:032x}"
+        if rng.random() < 0.9:
+            s["spanId"] = f"{i:016x}"
+        if rng.random() < 0.4:
+            s["parentSpanId"] = f"{i + 1:016x}"
+        if rng.random() < 0.95:
+            s["name"] = rng.choice(["op", "", "sp é 漢"])
+        if rng.random() < 0.8:
+            s["startTimeUnixNano"] = rng.choice(
+                [str(1714521600000000000 + i), 1714521600000000000 + i, ""]
+            )
+        if rng.random() < 0.8:
+            s["endTimeUnixNano"] = str(1714521600500000000 + i)
+        if rng.random() < 0.6:
+            s["kind"] = rng.choice([1, 2, 3, 4, 5, "2", 0, 99, None])
+        if rng.random() < 0.5:
+            st = {"code": rng.choice([0, 1, 2, "1", 77])}
+            if rng.random() < 0.5:
+                st["message"] = rng.choice(["ok", "", "bad é"])
+            s["status"] = st
+        if rng.random() < 0.4:
+            s["attributes"] = [
+                {"key": f"k{j}", "value": {"stringValue": rng.choice(STRINGS)}}
+                for j in range(rng.randrange(0, 3))
+            ]
+        if rng.random() < 0.1:
+            s["events"] = [{"name": "e", "timeUnixNano": "1"}]  # Python tier
+        if rng.random() < 0.08:
+            s["links"] = [{"traceId": f"{i:032x}"}]  # Python tier
+        if rng.random() < 0.15:
+            s["droppedAttributesCount"] = rng.choice([0, 3])
+        return s
+
+    groups = []
+    for g in range(rng.randrange(1, 3)):
+        ss = []
+        for _s in range(rng.randrange(1, 3)):
+            entry = {"spans": [span(i) for i in range(rng.randrange(0, 4))]}
+            if rng.random() < 0.5:
+                entry["scope"] = {"name": f"scope{g}"}
+            ss.append(entry)
+        rs = {"scopeSpans": ss}
+        if rng.random() < 0.7:
+            rs["resource"] = {
+                "attributes": [
+                    {"key": "service.name", "value": {"stringValue": f"svc{g}"}}
+                ]
+            }
+        groups.append(rs)
+    return {"resourceSpans": groups}
+
+
 # ---------------------------------------------------------------------- fuzz
 
 
@@ -281,7 +482,10 @@ def test_fuzz_json_three_lane_parity(tmp_path, trio, monkeypatch):
     for i in range(60):
         payload = gen_payload(rng)
         body = json.dumps(payload).encode()
-        run_three_lanes(trio, f"s{i}", body, monkeypatch)
+        # each payload runs the full pipeline at a forced shard count AND
+        # the direct shards={1,2,4} invariance check at the native layer
+        run_three_lanes(trio, f"s{i}", body, monkeypatch, shards=(1, 2, 4)[i % 3])
+        assert_shard_invariant(body)
     gc.collect()
     assert native.columnar_live() == 0, "leaked native columnar buffers"
 
@@ -307,8 +511,14 @@ def test_fuzz_otel_three_lane_parity(tmp_path, trio, monkeypatch):
         payload = gen_otel_payload(rng)
         body = json.dumps(payload).encode()
         run_three_lanes(
-            trio, f"o{i}", body, monkeypatch, source=LogSource.OTEL_LOGS
+            trio,
+            f"o{i}",
+            body,
+            monkeypatch,
+            source=LogSource.OTEL_LOGS,
+            shards=(1, 2, 4)[i % 3],
         )
+        assert_shard_invariant(body, source=LogSource.OTEL_LOGS)
     gc.collect()
     assert native.columnar_live() == 0
 
@@ -390,5 +600,333 @@ def test_otel_declines(tmp_path, trio, monkeypatch):
         {"key": 'we"ird\nkey', "value": {"stringValue": "v"}}
     ]
     expect_lane(trio, "oe", esc, monkeypatch, "ndjson", LogSource.OTEL_LOGS)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+# ------------------------------------------- OTel metrics / traces lanes
+
+
+def test_fuzz_otel_metrics_three_lane_parity(tmp_path, trio, monkeypatch):
+    """Metrics has no NDJSON middle tier: the 'ndj' lane (all columnar
+    entry points stubbed) degenerates to pure Python — the parity contract
+    (identical staged tables, identical errors) still holds across lanes
+    and across shard counts."""
+    rng = random.Random(0xFEED)
+    for i in range(30):
+        payload = gen_otel_metrics_payload(rng)
+        body = json.dumps(payload).encode()
+        run_three_lanes(
+            trio,
+            f"m{i}",
+            body,
+            monkeypatch,
+            source=LogSource.OTEL_METRICS,
+            shards=(1, 2, 4)[i % 3],
+        )
+        assert_shard_invariant(body, source=LogSource.OTEL_METRICS)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_fuzz_otel_traces_three_lane_parity(tmp_path, trio, monkeypatch):
+    rng = random.Random(0xACE)
+    for i in range(30):
+        payload = gen_otel_traces_payload(rng)
+        body = json.dumps(payload).encode()
+        run_three_lanes(
+            trio,
+            f"t{i}",
+            body,
+            monkeypatch,
+            source=LogSource.OTEL_TRACES,
+            shards=(1, 2, 4)[i % 3],
+        )
+        assert_shard_invariant(body, source=LogSource.OTEL_TRACES)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_otel_metrics_clean_payload_hits_columnar(tmp_path, trio, monkeypatch):
+    payload = {
+        "resourceMetrics": [
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name", "value": {"stringValue": "svc"}}
+                    ]
+                },
+                "scopeMetrics": [
+                    {
+                        "metrics": [
+                            {
+                                "name": "lat",
+                                "unit": "ms",
+                                "gauge": {
+                                    "dataPoints": [
+                                        {
+                                            "timeUnixNano": "1714521600000000000",
+                                            "asDouble": 1.5,
+                                        }
+                                    ]
+                                },
+                            }
+                        ]
+                    }
+                ],
+            }
+        ]
+    }
+    expect_lane(trio, "mc", payload, monkeypatch, "columnar", LogSource.OTEL_METRICS)
+    # exemplars need the Python flattener's exact serialization
+    declined = json.loads(json.dumps(payload))
+    declined["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]["gauge"][
+        "dataPoints"
+    ][0]["exemplars"] = [{"asDouble": 2.0}]
+    expect_lane(trio, "mp", declined, monkeypatch, "python", LogSource.OTEL_METRICS)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_otel_traces_clean_payload_hits_columnar(tmp_path, trio, monkeypatch):
+    payload = {
+        "resourceSpans": [
+            {
+                "scopeSpans": [
+                    {
+                        "spans": [
+                            {
+                                "traceId": "0" * 32,
+                                "spanId": "1" * 16,
+                                "name": "op",
+                                "kind": 2,
+                                "startTimeUnixNano": "1714521600000000000",
+                                "endTimeUnixNano": "1714521600500000000",
+                            }
+                        ]
+                    }
+                ]
+            }
+        ]
+    }
+    expect_lane(trio, "tc", payload, monkeypatch, "columnar", LogSource.OTEL_TRACES)
+    # `status` adds span_status_description, whose name trips the time-ish
+    # heuristic ('at' in 'status'): with no stored schema the normalizer
+    # conservatively declines to Python (exactly like the NDJSON lane for
+    # any status-named string column) — then the committed string schema
+    # disables the inference and the SECOND batch rides columnar
+    with_status = json.loads(json.dumps(payload))
+    with_status["resourceSpans"][0]["scopeSpans"][0]["spans"][0]["status"] = {
+        "code": 1
+    }
+    expect_lane(trio, "ts", with_status, monkeypatch, "python", LogSource.OTEL_TRACES)
+    expect_lane(
+        trio, "ts", with_status, monkeypatch, "columnar", LogSource.OTEL_TRACES
+    )
+    declined = json.loads(json.dumps(payload))
+    declined["resourceSpans"][0]["scopeSpans"][0]["spans"][0]["events"] = [
+        {"name": "e"}
+    ]
+    expect_lane(trio, "tp", declined, monkeypatch, "python", LogSource.OTEL_TRACES)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+# --------------------------------------------------- shard boundary attacks
+
+
+def test_shard_boundary_record_straddle(tmp_path):
+    """One record dwarfing the rest: every interior byte target lands
+    INSIDE it, so the boundary scan must walk forward past it (or the
+    shard fails and the C side reruns unsharded) — either way identical."""
+    recs = [{"m": "x" * 5000, "v": 1.0}] + [
+        {"m": f"r{i}", "v": float(i)} for i in range(50)
+    ]
+    body = json.dumps(recs).encode()
+    t = assert_shard_invariant(body, counts=(1, 2, 3, 4, 7, 16))
+    assert t is not None and t.num_rows == 51
+    del t
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_shard_boundary_multibyte_utf8(tmp_path):
+    """Multi-byte UTF-8 sequences packed around every plausible split
+    point: a cut landing mid-codepoint inside a record must never corrupt
+    values. Pad sweeps shift the record boundary through all phases of the
+    2/3/4-byte sequences."""
+    for ch in ("é", "漢", "🚀"):
+        for pad in range(1, 8):
+            recs = [
+                {"m": ch * (17 + pad), "k": "a" * pad, "v": float(j)}
+                for j in range(40)
+            ]
+            body = json.dumps(recs, ensure_ascii=False).encode()
+            t = assert_shard_invariant(body, counts=(1, 2, 3, 4))
+            assert t is not None and t.num_rows == 40
+            assert t.column("m")[0].as_py() == ch * (17 + pad)
+            del t
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_shard_boundary_brace_comma_inside_string(tmp_path):
+    """String values containing the literal record-separator pattern
+    '},{"' — the optimistic boundary scan will bite on these; the shard
+    parse then fails mid-record and the C side must rerun unsharded with
+    an identical result (values intact, no partial rows)."""
+    evil = 'x},{"fake": 1, "y": 2}'
+    recs = [{"m": evil, "v": float(i)} for i in range(64)]
+    body = json.dumps(recs).encode()
+    t = assert_shard_invariant(body, counts=(1, 2, 4, 8))
+    assert t is not None and t.num_rows == 64
+    assert t.column("m")[63].as_py() == evil
+    # compact separators too (no whitespace between records)
+    body2 = json.dumps(recs, separators=(",", ":")).encode()
+    t2 = assert_shard_invariant(body2, counts=(1, 2, 4, 8))
+    assert t2 is not None and t2.equals(t)
+    del t, t2
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_shard_boundary_otel_element_spans(tmp_path):
+    """OTel sharding splits at top-level array element boundaries with
+    byte-balanced runs: wildly unbalanced element sizes must still stitch
+    to the shards=1 table."""
+    big = {
+        "scopeLogs": [
+            {
+                "logRecords": [
+                    {
+                        "timeUnixNano": str(1714521600000000000 + i),
+                        "body": {"stringValue": "y" * 300},
+                        "severityText": "INFO",
+                    }
+                    for i in range(40)
+                ]
+            }
+        ]
+    }
+    small = {
+        "scopeLogs": [
+            {
+                "logRecords": [
+                    {
+                        "timeUnixNano": "1714521600000000000",
+                        "body": {"stringValue": "s"},
+                    }
+                ]
+            }
+        ]
+    }
+    for groups in ([big, small, small, small], [small, small, small, big]):
+        body = json.dumps({"resourceLogs": groups}).encode()
+        t = assert_shard_invariant(
+            body, source=LogSource.OTEL_LOGS, counts=(1, 2, 3, 4, 7)
+        )
+        assert t is not None and t.num_rows == 43
+        del t
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_number_parse_bit_exact_vs_python(tmp_path):
+    """The native decimal->double conversion must be bit-identical to
+    Python's (correctly-rounded) parse on every tier of its fast path:
+    the exact-double tier (<=15 digits, |e10|<=22), the extended-precision
+    tier (<=19 digits, |e10|<=27, including near-halfway mantissas that
+    force the strtod bail), and the strtod fallback (>19 digits, huge
+    exponents, subnormals). repr() strings are what json.dumps emits, so
+    shortest-roundtrip shapes are the production distribution."""
+    adversarial = [
+        "0", "-0.0", "0e9", "-0e-999", "0.000000000000000000000000001",
+        "437.2579414323392", "0.1", "0.2", "0.3", "2.5e-1",
+        "9007199254740992", "9007199254740993",            # 2^53 boundary
+        "999999999999999999", "9999999999999999999",       # 18/19 digits
+        "18446744073709551615", "18446744073709551616",    # 2^64 boundary
+        "123456789012345678901234567890",                  # truncated tier
+        "1e22", "1e23", "-1e23", "1e27", "1e-27", "1e28", "1e-28",
+        "1.7976931348623157e308", "2.2250738585072014e-308",
+        "5e-324", "1e-400", "1e400",                       # sub/overflow
+        "6.62607015e-34", "6.02214076e23", "3.141592653589793",
+    ]
+    rng = random.Random(0xD0B1E)
+    for _ in range(400):
+        adversarial.append(repr(rng.uniform(-1e9, 1e9)))
+        adversarial.append(repr(rng.uniform(0, 1)))
+        # random digit strings spanning all three tiers (integer part must
+        # not carry a leading zero — that's invalid JSON grammar)
+        nd = rng.randrange(1, 22)
+        ip = str(rng.randrange(0, 10**nd))
+        fp = "".join(rng.choice("0123456789") for _ in range(nd))
+        adversarial.append(f"{ip}.{fp}e{rng.randrange(-30, 31)}")
+    # hand-built body so the parser sees each adversarial numeral VERBATIM
+    # (json.dumps would re-serialize through Python repr and launder them)
+    body = (
+        "[" + ",".join('{"v": %s}' % s for s in adversarial) + "]"
+    ).encode()
+    t = native_table(body, 1)
+    assert t is not None, "numeric payload must stay on the columnar tier"
+    got = t.column("v").to_pylist()
+    for s, g in zip(adversarial, got):
+        want = float(s)
+        assert (g == want and repr(g) == repr(want)) or (
+            g != g and want != want
+        ), f"parse drift on {s!r}: native {g!r} vs python {want!r}"
+    del t
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+def test_corpus_cases_shard_invariant(tmp_path):
+    """Replay every banked nsan corpus case (adversarial payloads from
+    past fuzz campaigns) through all lanes at shard counts {1,2,4} — any
+    NEW divergence found by the fuzz tests above gets banked here too."""
+    corpus = Path(__file__).parent / "corpus" / "nsan"
+    cases = sorted(corpus.glob("case-*.bin"))
+    assert cases, "nsan corpus missing"
+    for f in cases:
+        body = f.read_bytes()
+        for source in (
+            LogSource.JSON,
+            LogSource.OTEL_LOGS,
+            LogSource.OTEL_METRICS,
+            LogSource.OTEL_TRACES,
+        ):
+            assert_shard_invariant(body, source=source)
+    gc.collect()
+    assert native.columnar_live() == 0
+
+
+# --------------------------------------------------- direct-to-IPC staging
+
+
+def test_columnar_lane_stages_direct_to_ipc(tmp_path, trio):
+    """The columnar lane must hit DiskWriter's direct path (straight
+    write_batch from the native buffers, zero re-serialization); the
+    Python lane must keep the pending-regroup path. Counters are the
+    proof, the readable staged table is the safety check."""
+    p = trio[0]
+    p.create_stream_if_not_exists("direct")
+    body = json.dumps(
+        [{"a": float(i), "b": f"s{i}"} for i in range(100)]
+    ).encode()
+    n = flatten_and_push_logs(p, "direct", None, LogSource.JSON, {}, raw_body=body)
+    assert n == 100
+    writers = list(p.streams.get("direct").writer.disk.values())
+    assert writers, "no disk writer created"
+    assert sum(w.direct_writes for w in writers) == 1
+    assert sum(w.buffered_writes for w in writers) == 0
+    assert sum(w.adapted_writes for w in writers) == 0
+    # a Python-lane batch into the same stream takes the buffered path
+    flatten_and_push_logs(
+        p, "direct", [{"a": 1.0, "b": "x"}, {"a": "mixed", "b": "y"}],
+        LogSource.JSON, {},
+    )
+    writers = list(p.streams.get("direct").writer.disk.values())
+    assert sum(w.buffered_writes + w.adapted_writes for w in writers) >= 1
+    tbl = staged(p, "direct")
+    assert tbl is not None and tbl.num_rows == 102
     gc.collect()
     assert native.columnar_live() == 0

@@ -114,6 +114,55 @@ def test_classify_failure():
     assert rule == "nsan-fuzz-crash" and "signal 11" in msg
 
 
+def test_sanitizer_infra_failure_detection():
+    """A tracer death is the sanitizer runtime failing, not a detected bug
+    in the target — it must never be credited to the payload. But a real
+    ASan/UBSan report wins even with tracer noise in the same stderr."""
+    tracer = (
+        "Tracer caught signal 11: addr=0x0 pc=0x7f75b76d30f0 sp=0x7f7560da0d10\n"
+        "==19417==LeakSanitizer has encountered a fatal error.\n"
+    )
+    assert fuzz.sanitizer_infra_failure(tracer)
+    assert fuzz.sanitizer_infra_failure("failed to fork the tracer thread\n")
+    assert not fuzz.sanitizer_infra_failure("")
+    assert not fuzz.sanitizer_infra_failure(
+        "==1==ERROR: AddressSanitizer: heap-buffer-overflow on x\n" + tracer
+    )
+    assert not fuzz.sanitizer_infra_failure(
+        tracer + "f.cpp:3:2: runtime error: shift exponent"
+    )
+    # an infra death still classifies (the child did die) — campaign and
+    # replay callers consult sanitizer_infra_failure to retry first
+    rule, _ = fuzz.classify_failure(fuzz.EXIT_ASAN_ERROR, tracer)
+    assert rule == "nsan-fuzz-crash"
+
+
+def test_payload_fails_ignores_infra_flakes(tmp_path, monkeypatch):
+    """_payload_fails must not let a tracer flake validate a minimizer
+    removal (a flaky 'failure' mid-shrink banks a bogus reproducer)."""
+    (tmp_path / "tests" / "corpus").mkdir(parents=True)
+
+    class P:
+        def __init__(self, rc, stderr=""):
+            self.returncode = rc
+            self.stderr = stderr
+
+    outcomes = {}
+
+    def fake_run_child(root, lib, **kw):
+        return outcomes["next"]
+
+    monkeypatch.setattr(fuzz, "run_child", fake_run_child)
+    outcomes["next"] = P(0)
+    assert not fuzz._payload_fails(tmp_path, Path("lib.so"), b"x", {})
+    outcomes["next"] = P(fuzz.EXIT_ASAN_ERROR, "==1==ERROR: AddressSanitizer: bad")
+    assert fuzz._payload_fails(tmp_path, Path("lib.so"), b"x", {})
+    outcomes["next"] = P(
+        fuzz.EXIT_ASAN_ERROR, "Tracer caught signal 11: addr=0x0 pc=0x1 sp=0x2"
+    )
+    assert not fuzz._payload_fails(tmp_path, Path("lib.so"), b"x", {})
+
+
 def test_bank_case_is_content_addressed(tmp_path):
     (tmp_path / "tests").mkdir()
     a = fuzz.bank_case(tmp_path, b"payload-a")
