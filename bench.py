@@ -556,11 +556,14 @@ def bench_json_ingest(p) -> None:
     shards_n = min(cores, 4)
     payload_gb = sum(len(b) for b in bodies) / 1e9
 
-    def run_ours(shards: int) -> list[float]:
+    def run_ours(shards: int, telem: bool = True) -> list[float]:
         # pin the shard count (and drop the byte threshold so every chunk
-        # actually shards) for the duration of the measured loop
+        # actually shards) for the duration of the measured loop; telem=False
+        # A/Bs the native telemetry plane off (read per-call via telem_sync)
         os.environ["P_INGEST_PARSE_SHARDS"] = str(shards)
         os.environ["P_INGEST_SHARD_MIN_BYTES"] = "0"
+        if not telem:
+            os.environ["P_NATIVE_TELEM"] = "0"
         try:
             times: list[float] = []
             for _ in range(reps):
@@ -574,11 +577,41 @@ def bench_json_ingest(p) -> None:
         finally:
             os.environ.pop("P_INGEST_PARSE_SHARDS", None)
             os.environ.pop("P_INGEST_SHARD_MIN_BYTES", None)
+            os.environ.pop("P_NATIVE_TELEM", None)
 
+    def stage_sums() -> dict[str, float]:
+        # cumulative ingest_stage_seconds sums per stage (lanes folded in),
+        # read through the public collect() API — deltas around a measured
+        # run give the per-stage waterfall attribution for that run
+        from parseable_tpu.utils.metrics import INGEST_STAGE_TIME
+
+        out: dict[str, float] = {}
+        for metric in INGEST_STAGE_TIME.collect():
+            for s in metric.samples:
+                if s.name.endswith("_sum"):
+                    stage = s.labels["stage"]
+                    out[stage] = out.get(stage, 0.0) + s.value
+        return out
+
+    pre = stage_sums()
     shard1_times = run_ours(1)
+    mid = stage_sums()
     ours_times = run_ours(shards_n) if shards_n > 1 else shard1_times
+    post = stage_sums()
+    # attribute stages to the headline run (which is the shard1 run itself
+    # on a 1-core box, where no second measured loop happens)
+    lo, hi = (mid, post) if shards_n > 1 else (pre, mid)
+    stage_ms = {
+        k: (hi.get(k, 0.0) - lo.get(k, 0.0)) * 1e3 / reps
+        for k in sorted(set(lo) | set(hi))
+    }
+    teloff_times = run_ours(shards_n, telem=False)
     ours = n / percentile(ours_times, 0.50)
     shard1 = n / percentile(shard1_times, 0.50)
+    teloff = n / percentile(teloff_times, 0.50)
+    # telemetry cost = slowdown of the telemetry-ON run vs OFF (<1 means
+    # noise put the ON run ahead; the gate only cares about the upper side)
+    telem_overhead_pct = (teloff / ours - 1.0) * 100.0
 
     floor_times: list[float] = []
     for _ in range(reps):
@@ -598,6 +631,13 @@ def bench_json_ingest(p) -> None:
         f"# json ingest sharding: shards=1 {shard1:,.0f} rows/s vs "
         f"shards={shards_n} {ours:,.0f} rows/s ({ours / shard1:.2f}x on a "
         f"{cores}-core box; {ours / shards_n:,.0f} rows/s/core)",
+        file=sys.stderr,
+    )
+    breakdown = " | ".join(f"{k} {v:.1f}ms" for k, v in stage_ms.items() if v > 0)
+    print(
+        f"# json ingest stages (per rep, {n:,} rows): {breakdown or 'n/a'} | "
+        f"telemetry off {teloff:,.0f} rows/s (on-cost "
+        f"{telem_overhead_pct:+.1f}%)",
         file=sys.stderr,
     )
     emit(
@@ -624,6 +664,9 @@ def bench_json_ingest(p) -> None:
             "parse_shards": shards_n,
             "shards1_rows_per_sec": round(shard1, 1),
             "shard_scaling_x": round(ours / shard1, 4),
+            "stage_ms_per_rep": {k: round(v, 2) for k, v in stage_ms.items()},
+            "telem_off_rows_per_sec": round(teloff, 1),
+            "telem_overhead_pct": round(telem_overhead_pct, 2),
         },
     )
 

@@ -20,6 +20,11 @@ fed by the ingest path, and audits three invariant families:
   across every node's stream json never decreases between observations.
 - ``gauges_zero``      — at quiesce: inflight/queued work gauges
   (query admission, scan pool, enccache, enrichment) reconcile to zero.
+- ``native_rows_conserved`` — per stream: rows parsed by the native fast
+  path == rows staged through it + rows declined to a lower tier (each
+  tier's parse and outcome both counted, so a cascade balances). The fast
+  path can never silently drop or double-count a row between the C++
+  parse and the staging push.
 
 A querier additionally closes the loop with ``queryable_count``: at
 quiesce, ``SELECT count(*)`` over a wide window must equal the sum of all
@@ -91,6 +96,10 @@ class Ledger:
         self._baseline: dict[str, int] = {}  # guarded-by: self._lock
         self._watermark: dict[str, int] = {}  # guarded-by: self._lock
         self._last_sample: dict[str, tuple] = {}  # guarded-by: self._lock
+        # per-stream [parsed, staged, declined] native fast-path rows; no
+        # baseline needed — all three counters start at zero with this
+        # process, so the absolute identity must hold
+        self._native: dict[str, list[int]] = {}  # guarded-by: self._lock
         self.last_report: dict | None = None
 
     def ensure_stream(self, p, name: str) -> None:
@@ -121,6 +130,28 @@ class Ledger:
             return {
                 name: {"acked": self._acked.get(name, 0), "baseline": base}
                 for name, base in self._baseline.items()
+            }
+
+    def record_native(
+        self, name: str, parsed: int = 0, staged: int = 0, declined: int = 0
+    ) -> None:
+        """Count native fast-path rows for one stream: `parsed` when a
+        native tier produced rows, then exactly one of `staged` (those rows
+        entered staging through that tier) or `declined` (a post-parse
+        decline pushed them down a tier — where the next tier's parse
+        counts them again, so a cascade balances)."""
+        if name in _INTERNAL or (parsed <= 0 and staged <= 0 and declined <= 0):
+            return
+        with self._lock:
+            tri = self._native.setdefault(name, [0, 0, 0])
+            tri[0] += max(0, parsed)
+            tri[1] += max(0, staged)
+            tri[2] += max(0, declined)
+
+    def native_counters(self) -> dict[str, tuple[int, int, int]]:
+        with self._lock:
+            return {
+                name: (tri[0], tri[1], tri[2]) for name, tri in self._native.items()
             }
 
     def observe_sample(self, name: str, sample: tuple) -> bool:
@@ -207,9 +238,10 @@ def local_report(p, quiesce: bool = False) -> dict:
     conservation for streams at rest since the previous tick."""
     led = p.audit
     counters = led.counters()
+    native = led.native_counters()
     violations: list[dict] = []
     streams_out: dict[str, dict] = {}
-    for name in sorted(set(p.streams.list_names()) | set(counters)):
+    for name in sorted(set(p.streams.list_names()) | set(counters) | set(native)):
         stream = p.streams.get(name)
         if stream is None or name in _INTERNAL or stream.metadata.stream_type == "Internal":
             continue
@@ -232,6 +264,29 @@ def local_report(p, quiesce: bool = False) -> dict:
                         f"{manifest} - baseline {c['baseline']}",
                         expected,
                         actual,
+                    )
+                )
+        nat = native.get(name)
+        if nat is not None:
+            parsed, staged_n, declined = nat
+            entry.update(
+                native_parsed=parsed, native_staged=staged_n, native_declined=declined
+            )
+            # pure in-process counters, but a request can sit between parse
+            # and stage — same at-rest gate as rows_conserved, keyed apart
+            # (\x00 cannot appear in a stream name) so the two samples
+            # don't perturb each other
+            nat_rest = led.observe_sample(name + "\x00native", nat)
+            if (quiesce or nat_rest) and parsed != staged_n + declined:
+                violations.append(
+                    _violation(
+                        "native_rows_conserved",
+                        name,
+                        p.node_id,
+                        f"native parsed {parsed} != staged {staged_n} + "
+                        f"declined {declined}",
+                        parsed,
+                        staged_n + declined,
                     )
                 )
         lifetime = _lifetime_events(p, name)

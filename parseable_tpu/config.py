@@ -168,6 +168,20 @@ def ingest_shard_options() -> tuple[int, int]:
     )
 
 
+def native_telem_options() -> dict:
+    """Knobs for the native-path telemetry plane (fastpath.cpp telem ring +
+    the ingest stage waterfall in server/ingest_utils).
+
+    P_NATIVE_TELEM: record per-shard parse/stitch events in the native ring
+    and emit them as child spans + stage histograms per ingest request. On
+    by default (<3%% of bench_json_ingest); 0 is the A/B escape hatch. Read
+    per parse call (native.telem_sync pushes changes across the ABI), so
+    the bench and tests can flip it without a process restart."""
+    return {
+        "enabled": _env_bool("P_NATIVE_TELEM", True),
+    }
+
+
 def nsan_options() -> dict:
     """Knobs for the native-code safety gate (analysis/nsan).
 

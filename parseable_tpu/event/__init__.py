@@ -7,6 +7,7 @@ fans out to livetail subscribers.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from datetime import UTC, datetime
 
@@ -22,6 +23,7 @@ from parseable_tpu.utils.metrics import (
     LIFETIME_EVENTS_INGESTED,
     LIFETIME_EVENTS_INGESTED_SIZE,
 )
+from parseable_tpu.utils.telemetry import TRACER
 
 
 @dataclass
@@ -42,6 +44,9 @@ class Event:
     # contiguous native buffers — staging streams it straight into the
     # bucket's IPC file (no pending-regroup re-serialization)
     direct_staging: bool = False
+    # stage waterfall timings stashed by process() (ns per stage name);
+    # the ingest path reads them back to observe the per-lane histograms
+    stage_ns: dict[str, int] = field(default_factory=dict)
 
     def get_schema_key(self) -> str:
         """Key of this batch's schema shape + partition suffix
@@ -65,14 +70,25 @@ class Event:
                 )
             )
         ):
-            commit_schema(self.stream_name, self.rb.schema)
+            t0 = time.time_ns()
+            with TRACER.span("schema-commit", stream=self.stream_name):
+                commit_schema(self.stream_name, self.rb.schema)
+            self.stage_ns["schema-commit"] = time.time_ns() - t0
         ts = self.parsed_timestamp
         if ts.tzinfo is not None:
             ts = ts.astimezone(UTC).replace(tzinfo=None)
-        stream.push(
-            schema_key, self.rb, ts, self.custom_partition_values,
-            direct=self.direct_staging,
-        )
+        t0 = time.time_ns()
+        with TRACER.span(
+            "stage-ipc",
+            stream=self.stream_name,
+            rows=self.rb.num_rows,
+            bytes=self.origin_size,
+        ):
+            stream.push(
+                schema_key, self.rb, ts, self.custom_partition_values,
+                direct=self.direct_staging,
+            )
+        self.stage_ns["stage-ipc"] = time.time_ns() - t0
         n = self.rb.num_rows
         labels = (self.stream_name, self.origin_format)
         EVENTS_INGESTED.labels(*labels).inc(n)

@@ -29,6 +29,14 @@ _load_failed = False  # negative cache: never retry build/dlopen per call
 # tier (counted, so a fleet quietly running one lane down is visible in
 # metrics), not the whole library.
 _columnar_ok = False
+# telemetry plane bound? Same staleness story as the columnar lane: a .so
+# predating the ptpu_telem_* ABI disables ONLY the telemetry plane (parses
+# still run, just unobserved) — and hard-fails under P_NATIVE_REQUIRED.
+_telem_ok = False
+# last enable state pushed to the C side (None = never pushed); the knob is
+# re-read per drain/sync so tests and the bench can flip P_NATIVE_TELEM
+# without a reload
+_telem_pushed: bool | None = None
 
 
 def _build() -> bool:
@@ -63,7 +71,7 @@ def _lib_path() -> Path:
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed, _columnar_ok
+    global _lib, _load_failed, _columnar_ok, _telem_ok
     if _lib is not None:
         return _lib
     if _load_failed:
@@ -136,6 +144,23 @@ def _load() -> ctypes.CDLL | None:
         from parseable_tpu.utils.metrics import INGEST_NATIVE
 
         INGEST_NATIVE.labels("columnar", "bind-failed").inc()
+    try:
+        _bind_telem(lib)
+        _telem_ok = True
+    except AttributeError as e:
+        # the .so predates the telemetry ABI: parses still run, just
+        # unobserved. With a toolchain present a partial library is a build
+        # bug — hard failure under P_NATIVE_REQUIRED, same as columnar.
+        _telem_ok = False
+        logger.warning(
+            "native fastpath lacks the telemetry ABI (%s); native telemetry disabled",
+            e,
+        )
+        if _required():
+            raise RuntimeError(
+                f"P_NATIVE_REQUIRED=1 but the native fastpath lacks the "
+                f"telemetry ABI: {e}"
+            ) from e
     _lib = lib
     return lib
 
@@ -299,6 +324,31 @@ def _bind_columnar(lib: ctypes.CDLL) -> None:
     lib.ptpu_parse_pool_shutdown.argtypes = []
     lib.ptpu_parse_pool_size.restype = ctypes.c_int
     lib.ptpu_parse_pool_size.argtypes = []
+
+
+def _bind_telem(lib: ctypes.CDLL) -> None:
+    """Declare the native telemetry-plane exports (per-thread event ring
+    drain + counters + pool accessors); raises AttributeError when the
+    library predates the plane — _load() then disables only telemetry."""
+    lib.ptpu_telem_enable.restype = None
+    lib.ptpu_telem_enable.argtypes = [ctypes.c_int]
+    lib.ptpu_telem_enabled.restype = ctypes.c_int
+    lib.ptpu_telem_enabled.argtypes = []
+    lib.ptpu_telem_drain.restype = ctypes.c_int
+    lib.ptpu_telem_drain.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ptpu_telem_free.restype = None
+    lib.ptpu_telem_free.argtypes = [ctypes.c_void_p]
+    lib.ptpu_telem_live.restype = ctypes.c_longlong
+    lib.ptpu_telem_live.argtypes = []
+    lib.ptpu_telem_drops.restype = ctypes.c_uint64
+    lib.ptpu_telem_drops.argtypes = []
+    lib.ptpu_telem_pool_queue_depth.restype = ctypes.c_int
+    lib.ptpu_telem_pool_queue_depth.argtypes = []
+    lib.ptpu_telem_pool_busy_ns.restype = ctypes.c_uint64
+    lib.ptpu_telem_pool_busy_ns.argtypes = [ctypes.c_int]
 
 
 def native_available() -> bool:
@@ -584,6 +634,129 @@ def parse_pool_size() -> int:
     if _lib is None or not _columnar_ok:
         return 0
     return int(_lib.ptpu_parse_pool_size())
+
+
+# ------------------------------ telemetry plane ------------------------------
+
+# Event kinds and lane names crossing the ABI (fastpath.cpp telem::EV_* /
+# telem::LANE_*). Lane index -> the label the metrics/spans use.
+TELEM_EV_PARSE, TELEM_EV_STITCH = 0, 1
+TELEM_LANES = ("json", "otel-logs", "otel-metrics", "otel-traces")
+# decline cause codes (PTPU_FJ_*) -> span/metric label
+TELEM_CAUSES = {0: "ok", 1: "fallback", 2: "invalid"}
+
+
+class _TelemEvent(ctypes.Structure):
+    """Field-for-field mirror of fastpath.cpp's telem::Event (9x uint64)."""
+
+    _fields_ = [
+        ("kind", ctypes.c_uint64),
+        ("shard", ctypes.c_uint64),
+        ("lane", ctypes.c_uint64),
+        ("rc", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("rows", ctypes.c_uint64),
+        ("start_ns", ctypes.c_uint64),
+        ("dur_ns", ctypes.c_uint64),
+        ("qwait_ns", ctypes.c_uint64),
+    ]
+
+
+def telem_sync() -> bool:
+    """Push the P_NATIVE_TELEM knob to the C side (only when it changed
+    since the last push) and report whether recording is on. Called once
+    per native parse attempt, mirroring the per-call ingest_shard_options
+    read, so the bench and tests can A/B without a process restart."""
+    global _telem_pushed
+    # _load(), not _lib: telem_sync runs BEFORE the parse attempt that
+    # would otherwise lazily load the library — without the load here the
+    # first request per process would record but discard its events
+    if _load() is None or not _telem_ok:
+        return False
+    from parseable_tpu.config import native_telem_options
+
+    enabled = native_telem_options()["enabled"]
+    if enabled != _telem_pushed:
+        _lib.ptpu_telem_enable(1 if enabled else 0)
+        _telem_pushed = enabled
+    return enabled
+
+
+def telem_drain() -> list[tuple[int, int, int, int, int, int, int, int, int]]:
+    """Drain the CALLING thread's native event ring. Returns a list of
+    (kind, shard, lane, rc, bytes, rows, start_ns, dur_ns, qwait_ns)
+    tuples — events from parses this thread submitted, in publish order.
+    The native array is copied out and freed before returning (single-owner
+    contract; ptpu_telem_live counts any misses)."""
+    if _lib is None or not _telem_ok:
+        return []
+    out = ctypes.c_void_p()
+    n = ctypes.c_uint64()
+    _lib.ptpu_telem_drain(ctypes.byref(out), ctypes.byref(n))
+    if not out.value or not n.value:
+        return []
+    try:
+        evs = ctypes.cast(out, ctypes.POINTER(_TelemEvent * n.value)).contents
+        return [
+            (
+                int(e.kind),
+                int(e.shard),
+                int(e.lane),
+                int(e.rc),
+                int(e.bytes),
+                int(e.rows),
+                int(e.start_ns),
+                int(e.dur_ns),
+                int(e.qwait_ns),
+            )
+            for e in evs
+        ]
+    finally:
+        _lib.ptpu_telem_free(out)
+
+
+def telem_drops() -> int:
+    """Cumulative events dropped on ring overflow (recording never blocks
+    a parse)."""
+    if _lib is None or not _telem_ok:
+        return 0
+    return int(_lib.ptpu_telem_drops())
+
+
+def telem_live() -> int:
+    """Outstanding drain handles (leak-detector hook, mirrors columnar_live)."""
+    if _lib is None or not _telem_ok:
+        return 0
+    return int(_lib.ptpu_telem_live())
+
+
+def pool_queue_depth() -> int:
+    """Native parse-pool jobs queued but not yet picked up by a worker."""
+    if _lib is None or not _telem_ok:
+        return 0
+    return int(_lib.ptpu_telem_pool_queue_depth())
+
+
+def pool_busy_ns(worker: int) -> int:
+    """Cumulative busy ns for one pool worker slot (monotonic across pool
+    restarts; the /metrics refresh computes ratios from deltas)."""
+    if _lib is None or not _telem_ok:
+        return 0
+    return int(_lib.ptpu_telem_pool_busy_ns(worker))
+
+
+def reset_telem_state() -> None:
+    """Forget the pushed-enable cache and discard any undrained events on
+    the calling thread (ServerState.stop: no stale telemetry state leaks
+    across a re-root; a later sync re-pushes the knob)."""
+    global _telem_pushed
+    _telem_pushed = None
+    if _lib is not None and _telem_ok:
+        out = ctypes.c_void_p()
+        n = ctypes.c_uint64()
+        _lib.ptpu_telem_drain(ctypes.byref(out), ctypes.byref(n))
+        if out.value:
+            _lib.ptpu_telem_free(out)
 
 
 def _borrowed_ptr(buf: bytes | bytearray) -> ctypes.c_void_p:

@@ -58,7 +58,10 @@ fi
 for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free \
            ptpu_flatten_columnar_sharded ptpu_otel_logs_columnar_sharded \
            ptpu_otel_metrics_columnar ptpu_otel_traces_columnar \
-           ptpu_parse_pool_shutdown ptpu_parse_pool_size; do
+           ptpu_parse_pool_shutdown ptpu_parse_pool_size \
+           ptpu_telem_enable ptpu_telem_enabled ptpu_telem_drain \
+           ptpu_telem_free ptpu_telem_live ptpu_telem_drops \
+           ptpu_telem_pool_queue_depth ptpu_telem_pool_busy_ns; do
   printf '%s\n' "$syms" | grep -q "[[:space:]]$sym\$" || {
     echo "build.sh: missing export $sym" >&2
     exit 1

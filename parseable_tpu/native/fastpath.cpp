@@ -2322,6 +2322,111 @@ struct OtelColBuilder {
 // pair with exactly one ptpu_cols_free once the Python arrays release
 static std::atomic<long long> g_cols_live{0};
 
+// ------------------------------ native telemetry plane ---------------------
+//
+// Per-thread event rings that make the C++ fast path visible to the Python
+// observability stack: every columnar parse call records per-shard spans
+// (slice bytes, rows, wall ns, decline cause), the stitch, and the pool
+// queue-wait — without ever taking a lock on the parse path.
+//
+// Attribution model: ctypes releases the GIL, so concurrent ingest requests
+// sit inside parse calls on DIFFERENT executor threads at once. Events are
+// therefore published into a thread_local ring owned by the SUBMITTING
+// thread: shard jobs on pool threads append into a per-call buffer through
+// an atomic cursor, and after the completion latch (whose mutex provides
+// the happens-before edge for the non-atomic event payloads) the submitter
+// publishes the whole group into its own ring. The Python thread that made
+// the parse call then drains its own ring — events can never interleave
+// across requests, and a full ring drops (counted) instead of blocking.
+//
+// Drain follows the ptpu_cols_* ownership contract: ptpu_telem_drain hands
+// back one malloc'd Event array per call, the caller releases it with
+// ptpu_telem_free exactly once, and ptpu_telem_live counts outstanding
+// handles for the leak gate.
+
+#include <chrono>
+
+namespace {
+namespace telem {
+
+enum { EV_PARSE = 0, EV_STITCH = 1 };
+enum {
+    LANE_JSON = 0,
+    LANE_OTEL_LOGS = 1,
+    LANE_OTEL_METRICS = 2,
+    LANE_OTEL_TRACES = 3,
+};
+
+// Fixed 9x uint64 layout, mirrored field-for-field by the _TelemEvent
+// ctypes Structure in native/__init__.py.
+struct Event {
+    uint64_t kind;      // EV_PARSE | EV_STITCH
+    uint64_t shard;     // shard index (0 for unsharded and stitch)
+    uint64_t lane;      // LANE_*
+    uint64_t rc;        // PTPU_FJ_* outcome of this span (0 = success)
+    uint64_t bytes;     // payload slice bytes covered by this span
+    uint64_t rows;      // rows produced by this span
+    uint64_t start_ns;  // wall-clock ns (system_clock): Python emits real spans
+    uint64_t dur_ns;
+    uint64_t qwait_ns;  // pool queue wait (0 for inline shard 0 and stitch)
+};
+
+std::atomic<int> g_enabled{1};
+std::atomic<uint64_t> g_drops{0};
+std::atomic<long long> g_live{0};  // outstanding drain handles (leak gate)
+
+// per-worker busy accumulators indexed by ppool worker slot; 64 slots
+// comfortably covers the PTPU_MAX_SHARDS-bounded pool
+enum { MAX_WORKERS = 64 };
+std::atomic<uint64_t> g_worker_busy[MAX_WORKERS];
+
+inline bool enabled() { return g_enabled.load(std::memory_order_relaxed) != 0; }
+
+inline uint64_t now_ns() {
+    return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::system_clock::now().time_since_epoch())
+        .count();
+}
+
+// Single-thread ring: produce (publish) and consume (drain) are always the
+// same OS thread, so plain non-atomic fields suffice. Overflow increments
+// g_drops and never blocks the producer.
+enum { RING_CAP = 256 };
+struct Ring {
+    Event ev[RING_CAP];
+    uint32_t n = 0;
+    void push(const Event& e) {
+        if (n >= (uint32_t)RING_CAP) {
+            g_drops.fetch_add(1, std::memory_order_relaxed);
+            return;
+        }
+        ev[n++] = e;
+    }
+};
+thread_local Ring t_ring;
+
+// Per-call staging for sharded parses: pool threads append through the
+// atomic cursor (wait-free); the submitting thread publishes the group
+// after the completion latch. Capacity = max shards + the stitch span.
+struct CallBuf {
+    enum { CAP = 17 };  // PTPU_MAX_SHARDS parse spans + 1 stitch span
+    Event ev[CAP];
+    std::atomic<uint32_t> n{0};
+    void add(const Event& e) {
+        uint32_t i = n.fetch_add(1, std::memory_order_relaxed);
+        if (i < (uint32_t)CAP) ev[i] = e;
+        else g_drops.fetch_add(1, std::memory_order_relaxed);
+    }
+    void publish() {  // submitting thread only, after the latch
+        uint32_t cnt = n.load(std::memory_order_relaxed);
+        if (cnt > (uint32_t)CAP) cnt = CAP;
+        for (uint32_t i = 0; i < cnt; i++) t_ring.push(ev[i]);
+    }
+};
+
+}  // namespace telem
+}  // anonymous namespace
+
 extern "C" {
 
 // Parse+flatten a plain-JSON ingest payload straight into Arrow-layout
@@ -2336,7 +2441,16 @@ int ptpu_flatten_columnar(const char* in, uint64_t len, int max_depth,
     ctx.max_depth = max_depth;
     ctx.sep = sep;
     ctx.seplen = std::strlen(sep);
-    if (!ctx.run()) return ctx.rc == colb::OK ? PTPU_FJ_FALLBACK : ctx.rc;
+    const bool tel = telem::enabled();
+    const uint64_t t0 = tel ? telem::now_ns() : 0;
+    const bool parsed = ctx.run();
+    const int rc =
+        parsed ? PTPU_FJ_OK : (ctx.rc == colb::OK ? PTPU_FJ_FALLBACK : ctx.rc);
+    if (tel)
+        telem::t_ring.push({telem::EV_PARSE, 0, telem::LANE_JSON, (uint64_t)rc,
+                            len, parsed ? ctx.b.nrows : 0, t0,
+                            telem::now_ns() - t0, 0});
+    if (!parsed) return rc;
     auto* h = new colb::ColumnarBatch(std::move(ctx.b));
     g_cols_live.fetch_add(1, std::memory_order_relaxed);
     *out = h;
@@ -2349,8 +2463,17 @@ int ptpu_otel_logs_columnar(const char* in, uint64_t len, int ts_as_ms,
                             void** out) {
     colb::OtelColBuilder builder;
     builder.ts_as_ms = ts_as_ms != 0;
-    if (!builder.run(in, len))
-        return builder.rc == colb::OK ? PTPU_FJ_FALLBACK : builder.rc;
+    const bool tel = telem::enabled();
+    const uint64_t t0 = tel ? telem::now_ns() : 0;
+    const bool parsed = builder.run(in, len);
+    const int rc = parsed ? PTPU_FJ_OK
+                          : (builder.rc == colb::OK ? PTPU_FJ_FALLBACK
+                                                    : builder.rc);
+    if (tel)
+        telem::t_ring.push({telem::EV_PARSE, 0, telem::LANE_OTEL_LOGS,
+                            (uint64_t)rc, len, parsed ? builder.b.nrows : 0,
+                            t0, telem::now_ns() - t0, 0});
+    if (!parsed) return rc;
     auto* h = new colb::ColumnarBatch(std::move(builder.b));
     g_cols_live.fetch_add(1, std::memory_order_relaxed);
     *out = h;
@@ -2965,7 +3088,7 @@ std::deque<std::function<void()>>& g_jobs =
 std::vector<std::thread>& g_workers = *new std::vector<std::thread>; // guarded-by: g_mu
 bool g_stopping = false;                                             // guarded-by: g_mu
 
-void worker_main() {
+void worker_main(int idx) {
     for (;;) {
         std::function<void()> job;
         {
@@ -2975,7 +3098,16 @@ void worker_main() {
             job = std::move(g_jobs.front());
             g_jobs.pop_front();
         }
-        job();
+        // busy accounting for the per-worker utilization gauges; cumulative
+        // and monotonic across pool restarts (Python takes deltas)
+        if (telem::enabled() && idx >= 0 && idx < telem::MAX_WORKERS) {
+            const uint64_t t0 = telem::now_ns();
+            job();
+            telem::g_worker_busy[idx].fetch_add(telem::now_ns() - t0,
+                                                std::memory_order_relaxed);
+        } else {
+            job();
+        }
     }
 }
 
@@ -3010,7 +3142,8 @@ void run_sharded(int n, Fn&& fn) {
     {
         std::lock_guard<std::mutex> lk(g_mu);
         g_stopping = false;
-        while ((int)g_workers.size() < n - 1) g_workers.emplace_back(worker_main);
+        while ((int)g_workers.size() < n - 1)
+            g_workers.emplace_back(worker_main, (int)g_workers.size());
         for (int i = 1; i < n; i++)
             g_jobs.emplace_back([i, &fn, &latch] {
                 fn(i);
@@ -3259,7 +3392,8 @@ static int ptpu_publish_cols(colb::ColumnarBatch&& b, void** out) {
 // is authoritative for rc and result
 template <typename B>
 static int otel_columnar_run(const char* in, uint64_t len, int ts_as_ms,
-                             int nshards, void** out) {
+                             int nshards, int lane, void** out) {
+    const bool tel = telem::enabled();
     if (nshards > colb::PTPU_MAX_SHARDS) nshards = colb::PTPU_MAX_SHARDS;
     if (nshards > 1) {
         B probe;
@@ -3279,33 +3413,71 @@ static int otel_columnar_run(const char* in, uint64_t len, int ts_as_ms,
                     std::vector<B> builders((size_t)n);
                     std::vector<char> ok((size_t)n, 0);
                     for (auto& bd : builders) bd.ts_as_ms = ts_as_ms != 0;
+                    telem::CallBuf tbuf;
+                    const uint64_t submit_ns = tel ? telem::now_ns() : 0;
                     ppool::run_sharded(n, [&](int i) {
-                        ok[(size_t)i] =
-                            builders[(size_t)i].run_spans(
-                                elems.data() + starts[(size_t)i],
-                                starts[(size_t)i + 1] - starts[(size_t)i])
-                                ? 1
-                                : 0;
+                        const uint64_t t0 = tel ? telem::now_ns() : 0;
+                        const bool sok = builders[(size_t)i].run_spans(
+                            elems.data() + starts[(size_t)i],
+                            starts[(size_t)i + 1] - starts[(size_t)i]);
+                        ok[(size_t)i] = sok ? 1 : 0;
+                        if (tel) {
+                            uint64_t bytes = 0;
+                            for (size_t j = starts[(size_t)i];
+                                 j < starts[(size_t)i + 1]; j++)
+                                bytes += elems[j].len();
+                            const int src =
+                                sok ? PTPU_FJ_OK
+                                    : (builders[(size_t)i].rc == colb::OK
+                                           ? PTPU_FJ_FALLBACK
+                                           : builders[(size_t)i].rc);
+                            tbuf.add({telem::EV_PARSE, (uint64_t)i,
+                                      (uint64_t)lane, (uint64_t)src, bytes,
+                                      sok ? builders[(size_t)i].b.nrows : 0,
+                                      t0, telem::now_ns() - t0,
+                                      i == 0 ? 0 : t0 - submit_ns});
+                        }
                     });
                     bool all_ok = true;
                     for (int i = 0; i < n; i++) all_ok = all_ok && ok[(size_t)i];
                     if (all_ok) {
+                        const uint64_t st0 = tel ? telem::now_ns() : 0;
                         std::vector<colb::ColumnarBatch> parts;
                         parts.reserve((size_t)n);
                         for (auto& bd : builders) parts.push_back(std::move(bd.b));
                         colb::ColumnarBatch stitched;
-                        if (colb::stitch_parts(parts, /*positional=*/false,
-                                               stitched))
+                        const bool st_ok = colb::stitch_parts(
+                            parts, /*positional=*/false, stitched);
+                        if (tel)
+                            tbuf.add({telem::EV_STITCH, 0, (uint64_t)lane,
+                                      st_ok ? (uint64_t)PTPU_FJ_OK
+                                            : (uint64_t)PTPU_FJ_FALLBACK,
+                                      len, st_ok ? stitched.nrows : 0, st0,
+                                      telem::now_ns() - st0, 0});
+                        if (st_ok) {
+                            if (tel) tbuf.publish();
                             return ptpu_publish_cols(std::move(stitched), out);
+                        }
                     }
+                    // failed shards/stitch stay visible (rc != 0 events)
+                    // ahead of the authoritative unsharded rerun below
+                    if (tel) tbuf.publish();
                 }
             }
         }
     }
     B builder;
     builder.ts_as_ms = ts_as_ms != 0;
-    if (!builder.run(in, len))
-        return builder.rc == colb::OK ? PTPU_FJ_FALLBACK : builder.rc;
+    const uint64_t t0 = tel ? telem::now_ns() : 0;
+    const bool parsed = builder.run(in, len);
+    const int rc = parsed ? PTPU_FJ_OK
+                          : (builder.rc == colb::OK ? PTPU_FJ_FALLBACK
+                                                    : builder.rc);
+    if (tel)
+        telem::t_ring.push({telem::EV_PARSE, 0, (uint64_t)lane, (uint64_t)rc,
+                            len, parsed ? builder.b.nrows : 0, t0,
+                            telem::now_ns() - t0, 0});
+    if (!parsed) return rc;
     return ptpu_publish_cols(std::move(builder.b), out);
 }
 
@@ -3321,6 +3493,7 @@ int ptpu_flatten_columnar_sharded(const char* in, uint64_t len, int max_depth,
     if (nshards > 1) {
         std::vector<uint64_t> cuts;
         if (colb::shard_boundaries(in, len, nshards, cuts)) {
+            const bool tel = telem::enabled();
             int n = (int)cuts.size() + 1;
             std::vector<colb::JsonColCtx> ctxs((size_t)n);
             std::vector<char> ok((size_t)n, 0);
@@ -3332,20 +3505,52 @@ int ptpu_flatten_columnar_sharded(const char* in, uint64_t len, int max_depth,
                 ctxs[(size_t)i].sep = sep;
                 ctxs[(size_t)i].seplen = std::strlen(sep);
             }
+            telem::CallBuf tbuf;
+            const uint64_t submit_ns = tel ? telem::now_ns() : 0;
             ppool::run_sharded(n, [&](int i) {
-                ok[(size_t)i] =
-                    ctxs[(size_t)i].run_records(i == 0, i == n - 1) ? 1 : 0;
+                const uint64_t t0 = tel ? telem::now_ns() : 0;
+                const bool sok = ctxs[(size_t)i].run_records(i == 0, i == n - 1);
+                ok[(size_t)i] = sok ? 1 : 0;
+                if (tel) {
+                    // covered-slice accounting: the cut comma belongs to the
+                    // preceding shard, so shard bytes sum exactly to len
+                    const uint64_t sb = i == 0 ? 0 : cuts[(size_t)i - 1] + 1;
+                    const uint64_t ce = i == n - 1 ? len : cuts[(size_t)i] + 1;
+                    const int src = sok ? PTPU_FJ_OK
+                                        : (ctxs[(size_t)i].rc == colb::OK
+                                               ? PTPU_FJ_FALLBACK
+                                               : ctxs[(size_t)i].rc);
+                    tbuf.add({telem::EV_PARSE, (uint64_t)i, telem::LANE_JSON,
+                              (uint64_t)src, ce - sb,
+                              sok ? ctxs[(size_t)i].b.nrows : 0, t0,
+                              telem::now_ns() - t0,
+                              i == 0 ? 0 : t0 - submit_ns});
+                }
             });
             bool all_ok = true;
             for (int i = 0; i < n; i++) all_ok = all_ok && ok[(size_t)i];
             if (all_ok) {
+                const uint64_t st0 = tel ? telem::now_ns() : 0;
                 std::vector<colb::ColumnarBatch> parts;
                 parts.reserve((size_t)n);
                 for (auto& ctx : ctxs) parts.push_back(std::move(ctx.b));
                 colb::ColumnarBatch stitched;
-                if (colb::stitch_parts(parts, /*positional=*/true, stitched))
+                const bool st_ok =
+                    colb::stitch_parts(parts, /*positional=*/true, stitched);
+                if (tel)
+                    tbuf.add({telem::EV_STITCH, 0, telem::LANE_JSON,
+                              st_ok ? (uint64_t)PTPU_FJ_OK
+                                    : (uint64_t)PTPU_FJ_FALLBACK,
+                              len, st_ok ? stitched.nrows : 0, st0,
+                              telem::now_ns() - st0, 0});
+                if (st_ok) {
+                    if (tel) tbuf.publish();
                     return ptpu_publish_cols(std::move(stitched), out);
+                }
             }
+            // failed shards/stitch stay visible (rc != 0 events) ahead of
+            // the authoritative unsharded rerun below
+            if (tel) tbuf.publish();
         }
     }
     return ptpu_flatten_columnar(in, len, max_depth, sep, out);
@@ -3356,23 +3561,23 @@ int ptpu_flatten_columnar_sharded(const char* in, uint64_t len, int max_depth,
 int ptpu_otel_logs_columnar_sharded(const char* in, uint64_t len, int ts_as_ms,
                                     int nshards, void** out) {
     return otel_columnar_run<colb::OtelColBuilder>(in, len, ts_as_ms, nshards,
-                                                   out);
+                                                   telem::LANE_OTEL_LOGS, out);
 }
 
 // OTLP-JSON metrics payload -> columnar batch (one row per data point),
 // sharded at resourceMetrics element boundaries when nshards > 1.
 int ptpu_otel_metrics_columnar(const char* in, uint64_t len, int ts_as_ms,
                                int nshards, void** out) {
-    return otel_columnar_run<colb::OtelMetricsBuilder>(in, len, ts_as_ms,
-                                                       nshards, out);
+    return otel_columnar_run<colb::OtelMetricsBuilder>(
+        in, len, ts_as_ms, nshards, telem::LANE_OTEL_METRICS, out);
 }
 
 // OTLP-JSON traces payload -> columnar batch (one row per span), sharded
 // at resourceSpans element boundaries when nshards > 1.
 int ptpu_otel_traces_columnar(const char* in, uint64_t len, int ts_as_ms,
                               int nshards, void** out) {
-    return otel_columnar_run<colb::OtelTracesBuilder>(in, len, ts_as_ms,
-                                                      nshards, out);
+    return otel_columnar_run<colb::OtelTracesBuilder>(
+        in, len, ts_as_ms, nshards, telem::LANE_OTEL_TRACES, out);
 }
 
 // Drain and join the parse worker pool (ServerState.stop / teardown).
@@ -3382,5 +3587,77 @@ void ptpu_parse_pool_shutdown(void) { ppool::shutdown(); }
 
 // live worker count (observability + tests)
 int ptpu_parse_pool_size(void) { return ppool::size(); }
+
+// --------------------------- telemetry plane ABI (ptpu_telem_*) ------------
+
+// Process-wide recording switch (P_NATIVE_TELEM; the Python side syncs the
+// env knob per call). Disabled = one relaxed atomic load per parse call.
+void ptpu_telem_enable(int on) {
+    telem::g_enabled.store(on != 0 ? 1 : 0, std::memory_order_relaxed);
+}
+
+int ptpu_telem_enabled(void) {
+    return telem::g_enabled.load(std::memory_order_relaxed);
+}
+
+// Drain the CALLING thread's event ring (events are attributed to the
+// thread that submitted the parse, so the request handler that made the
+// call drains exactly its own events). On success *out is one malloc'd
+// array of *n fixed-layout events the caller must release with
+// ptpu_telem_free exactly once; an empty ring yields *out = NULL, *n = 0
+// with no handle minted. Same single-owner contract as ptpu_cols_*.
+int ptpu_telem_drain(void** out, uint64_t* n) {
+    telem::Ring& r = telem::t_ring;
+    if (r.n == 0) {
+        *out = nullptr;
+        *n = 0;
+        return 0;
+    }
+    void* buf = std::malloc((size_t)r.n * sizeof(telem::Event));
+    if (buf == nullptr) {  // degrade: drop the batch, count it, never fail
+        telem::g_drops.fetch_add(r.n, std::memory_order_relaxed);
+        r.n = 0;
+        *out = nullptr;
+        *n = 0;
+        return 0;
+    }
+    std::memcpy(buf, r.ev, (size_t)r.n * sizeof(telem::Event));
+    *out = buf;
+    *n = r.n;
+    r.n = 0;
+    telem::g_live.fetch_add(1, std::memory_order_relaxed);
+    return 0;
+}
+
+void ptpu_telem_free(void* buf) {
+    if (buf == nullptr) return;
+    std::free(buf);
+    telem::g_live.fetch_sub(1, std::memory_order_relaxed);
+}
+
+// outstanding drain handles — the tier-1 session leak gate, mirroring
+// ptpu_cols_live
+long long ptpu_telem_live(void) {
+    return telem::g_live.load(std::memory_order_relaxed);
+}
+
+// cumulative events dropped on ring/buffer overflow (recording never
+// blocks a parse)
+uint64_t ptpu_telem_drops(void) {
+    return telem::g_drops.load(std::memory_order_relaxed);
+}
+
+// pool observability: jobs queued but not yet picked up by a worker
+int ptpu_telem_pool_queue_depth(void) {
+    std::lock_guard<std::mutex> lk(ppool::g_mu);
+    return (int)ppool::g_jobs.size();
+}
+
+// cumulative busy ns for worker slot `worker`, monotonic across pool
+// restarts (Python computes busy ratios from deltas between scrapes)
+uint64_t ptpu_telem_pool_busy_ns(int worker) {
+    if (worker < 0 || worker >= telem::MAX_WORKERS) return 0;
+    return telem::g_worker_busy[worker].load(std::memory_order_relaxed);
+}
 
 }  // extern "C"

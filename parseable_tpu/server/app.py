@@ -290,9 +290,12 @@ class ServerState:
         # native sharded-parse worker pool (pool-lifecycle: the C++ side's
         # lock-id ppool::g_mu state drains queued shard jobs before joining;
         # the pool restarts lazily if anything parses after stop)
-        from parseable_tpu.native import shutdown_parse_pool
+        from parseable_tpu.native import reset_telem_state, shutdown_parse_pool
 
         shutdown_parse_pool()
+        # telemetry drain state: discard anything this thread never drained
+        # and forget the pushed-enable cache so a restarted instance re-syncs
+        reset_telem_state()
         self.query_workers.shutdown(wait=False)
         self.workers.shutdown(wait=False)
         # sync loop threads exit on the next _sync_stop.wait() wake; join so
@@ -593,11 +596,53 @@ async def metrics_handler(request: web.Request) -> web.Response:
     OpenMetrics-aware scrapers negotiate on it."""
     from parseable_tpu.ops.device import collect_device_gauges
 
-    # refresh accelerator gauges at scrape time (live HBM usage)
-    collect_device_gauges()
+    def _collect_and_render() -> bytes:
+        # refresh accelerator gauges at scrape time (live HBM usage) and
+        # the native pool gauges, then serialize the registry — all of it
+        # off the event loop: device introspection and generate_latest over
+        # a grown registry each take tens of ms, which would stall every
+        # in-flight request for the duration of a scrape
+        collect_device_gauges()
+        _refresh_native_pool_gauges()
+        return prom.render()
+
+    body = await asyncio.get_running_loop().run_in_executor(None, _collect_and_render)
     return web.Response(
-        body=prom.render(), headers={"Content-Type": prom.CONTENT_TYPE_LATEST}
+        body=body, headers={"Content-Type": prom.CONTENT_TYPE_LATEST}
     )
+
+
+# previous (busy_ns, sample_ns) per pool worker slot: the busy counters are
+# cumulative and monotonic across pool restarts, so the scrape-interval
+# ratio is a pure delta — no reset coordination with the C side needed.
+# The refresh runs on executor threads (metrics_handler keeps the render
+# off the event loop), so concurrent scrapes must not interleave the
+# read-prev/store-new sequence.
+_POOL_BUSY_LAST: dict[int, tuple[int, int]] = {}  # guarded-by: _POOL_BUSY_MU
+_POOL_BUSY_MU = threading.Lock()
+
+
+def _refresh_native_pool_gauges() -> None:
+    """Scrape-time refresh of the native parse-pool gauges (same pattern
+    as the device gauges): live worker count, queued-not-running depth,
+    cumulative telemetry ring drops, and per-worker busy fraction over the
+    interval since the previous scrape."""
+    from parseable_tpu import native
+
+    size = native.parse_pool_size()
+    prom.NATIVE_POOL_SIZE.set(size)
+    prom.NATIVE_POOL_QUEUE_DEPTH.set(native.pool_queue_depth())
+    prom.NATIVE_TELEM_DROPS.set(native.telem_drops())
+    now = time.monotonic_ns()
+    with _POOL_BUSY_MU:
+        for w in range(size):
+            busy = native.pool_busy_ns(w)
+            prev = _POOL_BUSY_LAST.get(w)
+            _POOL_BUSY_LAST[w] = (busy, now)
+            if prev is None or now <= prev[1]:
+                continue  # first scrape: no interval to compute a ratio over
+            ratio = (busy - prev[0]) / (now - prev[1])
+            prom.NATIVE_POOL_BUSY_RATIO.labels(str(w)).set(min(1.0, max(0.0, ratio)))
 
 
 @require(Action.METRICS)
@@ -702,7 +747,12 @@ async def _do_ingest(
     request: web.Request, stream_name: str, log_source: LogSource, telemetry_type: str = "logs"
 ) -> web.Response:
     state: ServerState = request.app["state"]
+    t_recv = time.time_ns()
     body = await request.read()
+    # recv: the waterfall's first stage — wire-to-memory time for the body
+    prom.INGEST_STAGE_TIME.labels("recv", log_source.value).observe(
+        (time.time_ns() - t_recv) / 1e9
+    )
     if len(body) > state.p.options.max_event_payload_bytes:
         return web.json_response({"error": "payload too large"}, status=413)
     # json.loads is deferred: the native ingest lane parses the raw bytes
@@ -753,7 +803,12 @@ async def _do_ingest(
         count = await _run_traced(state, work)
     except (IngestError, StreamError, EventError) as e:
         return web.json_response({"error": str(e)}, status=400)
-    return web.json_response({"message": f"ingested {count} records"}, status=200)
+    t_ack = time.time_ns()
+    resp = web.json_response({"message": f"ingested {count} records"}, status=200)
+    prom.INGEST_STAGE_TIME.labels("ack", log_source.value).observe(
+        (time.time_ns() - t_ack) / 1e9
+    )
+    return resp
 
 
 @require(Action.QUERY)

@@ -141,6 +141,66 @@ def _lane_result(sp, lane: str, result: str | None) -> None:
         INGEST_NATIVE.labels(lane, result).inc()
 
 
+def _emit_native_telem(sp, enabled: bool) -> None:
+    """Drain the calling thread's native telemetry ring and replay the
+    events into the request's trace + metrics.
+
+    The drain is unconditional — ctypes releases the GIL, so this thread
+    IS the thread whose thread-local ring the C++ parse just filled, and
+    draining here (hit or decline, enabled or not) guarantees no event
+    leaks across requests when executor threads are reused. With
+    telemetry disabled the drain returns empty for one cheap call.
+
+    Each parse/stitch event becomes a real child span under the current
+    request context (`TRACER.record_span` — the C++ side stamped wall ns,
+    so timings are real, not re-measured) and an `ingest_stage_seconds`
+    observation; >1 parse event also refreshes the shard-imbalance gauge
+    (max/mean shard ns — the signal that one shard got a pathological
+    slice)."""
+    from parseable_tpu import native
+
+    events = native.telem_drain()
+    if not events or not enabled:
+        return
+    from parseable_tpu.utils.metrics import (
+        INGEST_SHARD_IMBALANCE,
+        INGEST_STAGE_TIME,
+    )
+    from parseable_tpu.utils.telemetry import TRACER
+
+    parse_durs: list[int] = []
+    for kind, shard, lane, rc, nbytes, rows, start_ns, dur_ns, qwait_ns in events:
+        lane_name = (
+            native.TELEM_LANES[lane]
+            if lane < len(native.TELEM_LANES)
+            else str(lane)
+        )
+        if kind == native.TELEM_EV_PARSE:
+            name, stage = "native.parse", "parse"
+            parse_durs.append(dur_ns)
+        else:
+            name, stage = "native.stitch", "stitch"
+        attrs = {
+            "shard": shard,
+            "lane": lane_name,
+            "cause": native.TELEM_CAUSES.get(rc, str(rc)),
+            "bytes": nbytes,
+            "rows": rows,
+        }
+        if qwait_ns:
+            # pool queue wait: job-start minus submit (0 for the inline
+            # shard) — the waterfall's "waiting, not working" component
+            attrs["qwait_us"] = qwait_ns // 1000
+        TRACER.record_span(name, start_ns, start_ns + dur_ns, **attrs)
+        INGEST_STAGE_TIME.labels(stage, lane_name).observe(dur_ns / 1e9)
+    if len(parse_durs) > 1:
+        mean = sum(parse_durs) / len(parse_durs)
+        if mean > 0:
+            INGEST_SHARD_IMBALANCE.set(max(parse_durs) / mean)
+    if sp is not None and parse_durs:
+        sp["native_spans"] = len(parse_durs)
+
+
 def _parse_payload(payload: Any, raw_body: bytes | None) -> Any:
     if payload is not None or raw_body is None:
         return payload
@@ -196,10 +256,12 @@ def ingest_native_fast(
             p, stream, names, arrays, len(raw_body), log_source, custom_fields
         )
         if count is not None:
+            p.audit.record_native(stream_name, parsed=nrows, staged=count)
             return count
         # normalization declined (mixed semantics the reader-level facts
         # can't prove clean): the Python path is authoritative — the NDJSON
         # tier would assemble the same columns and decline identically
+        p.audit.record_native(stream_name, parsed=nrows, declined=nrows)
         if lane_out is not None:
             del lane_out["lane"]
         return None
@@ -214,8 +276,12 @@ def ingest_native_fast(
     count = _ndjson_to_event(
         p, stream, ndjson, len(raw_body), log_source, custom_fields
     )
-    if count is not None and lane_out is not None:
-        lane_out["lane"] = "ndjson"
+    if count is not None:
+        p.audit.record_native(stream_name, parsed=nrows, staged=count)
+        if lane_out is not None:
+            lane_out["lane"] = "ndjson"
+    else:
+        p.audit.record_native(stream_name, parsed=nrows, declined=nrows)
     return count
 
 
@@ -264,14 +330,29 @@ def _ndjson_to_event(
     """NDJSON-tier tail: pyarrow's C++ JSON reader builds the columns from
     natively-flattened NDJSON. Returns None when the reader prefers the
     exact Python path."""
+    import time
+
     import pyarrow as pa
     import pyarrow.json as pj
 
+    from parseable_tpu.utils.metrics import INGEST_STAGE_TIME
+    from parseable_tpu.utils.telemetry import TRACER
+
+    # the NDJSON tier's real parse happens here (pyarrow's C++ reader),
+    # above the telemetry ring — timed Python-side under the same
+    # stage/lane taxonomy so the waterfall stays complete on this tier
+    t0 = time.time_ns()
     try:
         # BufferReader wraps the bytes zero-copy (BytesIO copies them)
         tbl = pj.read_json(pa.BufferReader(ndjson))
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
         return None  # reader-level type conflict: Python path decides
+    t1 = time.time_ns()
+    INGEST_STAGE_TIME.labels("parse", "ndjson").observe((t1 - t0) / 1e9)
+    TRACER.record_span(
+        "native.parse", t0, t1, lane="ndjson", shard=0,
+        rows=tbl.num_rows, bytes=len(ndjson),
+    )
     for name in cast_ts_ms:
         # the NDJSON OTel lane emits these as integer epoch-ms; the int64
         # -> timestamp(ms) cast is value-preserving and parse-free (the
@@ -328,6 +409,11 @@ def _table_to_event(
         direct_staging=direct,
     )
     ev.process(stream, livetail=LIVETAIL.process, commit_schema=p.commit_schema)
+    if ev.stage_ns:
+        from parseable_tpu.utils.metrics import INGEST_STAGE_TIME
+
+        for stage, ns in ev.stage_ns.items():
+            INGEST_STAGE_TIME.labels(stage, log_source.value).observe(ns / 1e9)
     return batch.num_rows
 
 
@@ -373,7 +459,9 @@ def ingest_otel_native_fast(
             custom_fields,
         )
         if count is not None:
+            p.audit.record_native(stream_name, parsed=nrows, staged=count)
             return count
+        p.audit.record_native(stream_name, parsed=nrows, declined=nrows)
         if lane_out is not None:
             del lane_out["lane"]
         return None  # normalization declined: Python flattener decides
@@ -390,8 +478,12 @@ def ingest_otel_native_fast(
         p, stream, ndjson, len(raw_body), LogSource.OTEL_LOGS, custom_fields,
         cast_ts_ms=cast_ts,
     )
-    if count is not None and lane_out is not None:
-        lane_out["lane"] = "ndjson"
+    if count is not None:
+        p.audit.record_native(stream_name, parsed=nrows, staged=count)
+        if lane_out is not None:
+            lane_out["lane"] = "ndjson"
+    else:
+        p.audit.record_native(stream_name, parsed=nrows, declined=nrows)
     return count
 
 
@@ -429,7 +521,9 @@ def ingest_otel_columnar_fast(
         p, stream, names, arrays, len(raw_body), log_source, custom_fields
     )
     if count is not None:
+        p.audit.record_native(stream_name, parsed=nrows, staged=count)
         return count
+    p.audit.record_native(stream_name, parsed=nrows, declined=nrows)
     if lane_out is not None:
         del lane_out["lane"]
     return None  # normalization declined: Python flattener decides
@@ -458,20 +552,33 @@ def _flatten_and_push(
         plain_json = log_source_name not in KNOWN_FORMATS
     native_attempted = False
     if raw_body is not None and plain_json:
+        from parseable_tpu import native
+
         native_attempted = True
+        telem = native.telem_sync()
         info: dict = {}
-        count = ingest_native_fast(
-            p, stream_name, raw_body, log_source, custom_fields, lane_out=info
-        )
+        try:
+            count = ingest_native_fast(
+                p, stream_name, raw_body, log_source, custom_fields,
+                lane_out=info,
+            )
+        finally:
+            _emit_native_telem(sp, telem)
         if count is not None:
             _lane_result(sp, info.get("lane", "columnar"), "hit")
             return count
     if raw_body is not None and log_source == LogSource.OTEL_LOGS:
+        from parseable_tpu import native
+
         native_attempted = True
+        telem = native.telem_sync()
         info = {}
-        count = ingest_otel_native_fast(
-            p, stream_name, raw_body, custom_fields, lane_out=info
-        )
+        try:
+            count = ingest_otel_native_fast(
+                p, stream_name, raw_body, custom_fields, lane_out=info
+            )
+        finally:
+            _emit_native_telem(sp, telem)
         if count is not None:
             _lane_result(sp, info.get("lane", "columnar"), "hit")
             return count
@@ -482,16 +589,20 @@ def _flatten_and_push(
         from parseable_tpu import native
 
         native_attempted = True
+        telem = native.telem_sync()
         info = {}
         columnar_fn = (
             native.otel_metrics_columnar
             if log_source == LogSource.OTEL_METRICS
             else native.otel_traces_columnar
         )
-        count = ingest_otel_columnar_fast(
-            p, stream_name, raw_body, custom_fields, columnar_fn, log_source,
-            lane_out=info,
-        )
+        try:
+            count = ingest_otel_columnar_fast(
+                p, stream_name, raw_body, custom_fields, columnar_fn,
+                log_source, lane_out=info,
+            )
+        finally:
+            _emit_native_telem(sp, telem)
         if count is not None:
             _lane_result(sp, info.get("lane", "columnar"), "hit")
             return count
@@ -543,6 +654,8 @@ def push_logs(
 ) -> int:
     """Chunk rows by custom-partition value and process each chunk
     (reference: ingest_utils.rs:291)."""
+    from parseable_tpu.utils.metrics import INGEST_STAGE_TIME
+
     stream = p.get_stream(stream_name)
     meta = stream.metadata
     chunks: list[list[dict]]
@@ -576,5 +689,7 @@ def push_logs(
             custom_fields=custom_fields or {},
         ).into_event(meta, stream.metadata.stream_type)
         ev.process(stream, livetail=LIVETAIL.process, commit_schema=p.commit_schema)
+        for stage, ns in ev.stage_ns.items():
+            INGEST_STAGE_TIME.labels(stage, "python").observe(ns / 1e9)
         total += ev.rb.num_rows
     return total

@@ -22,6 +22,8 @@ from pathlib import Path
 import pyarrow as pa
 import pyarrow.ipc as ipc
 
+from parseable_tpu.utils.metrics import STAGING_WRITES
+
 ARROW_FILE_EXTENSION = "arrows"
 PART_FILE_EXTENSION = "part.arrows"
 
@@ -62,6 +64,7 @@ class DiskWriter:
 
             batch = adapt_batch(self.schema, batch)
             self.adapted_writes += 1
+            STAGING_WRITES.labels("adapted").inc()
             direct = False  # adapt copied; regroup like any Python-lane batch
         if direct:
             # native-columnar batches arrive payload-sized and already
@@ -74,8 +77,10 @@ class DiskWriter:
             self._writer.write_batch(batch)
             self.rows_written += batch.num_rows
             self.direct_writes += 1
+            STAGING_WRITES.labels("direct").inc()
             return
         self.buffered_writes += 1
+        STAGING_WRITES.labels("buffered").inc()
         self._pending.append(batch)
         self._pending_rows += batch.num_rows
         if self._pending_rows >= self.batch_rows:

@@ -52,6 +52,56 @@ INGEST_NATIVE = _counter(
     "result: hit/declined)",
     ["lane", "result"],
 )
+# ingest stage waterfall (server/ingest_utils.py + event/__init__.py):
+# per-request stage timings recv -> parse[shard] -> stitch -> schema-commit
+# -> stage-ipc, fed by the native telemetry ring for the C++ stages and by
+# Python timers for the rest. Lane matches INGEST_NATIVE's label values.
+INGEST_STAGE_TIME = Histogram(
+    "ingest_stage_seconds",
+    "Ingest stage waterfall timings (recv/parse/stitch/schema-commit/"
+    "stage-ipc) per lane",
+    ["stage", "lane"],
+    namespace=METRICS_NAMESPACE,
+    registry=REGISTRY,
+)
+# shard balance of the most recent sharded native parse: max/mean shard ns
+# (1.0 = perfectly balanced; a high ratio means one shard serializes the
+# whole parse and the pool buys nothing)
+INGEST_SHARD_IMBALANCE = _gauge(
+    "ingest_shard_imbalance",
+    "max/mean shard parse ns of the last sharded native parse",
+    [],
+)
+# staging IPC write modes (staging/writer.py DiskWriter): direct = native
+# columnar buffers streamed straight into the bucket file, buffered =
+# through the pending regroup, adapted = schema-mismatch copy. A falling
+# direct share means the zero-copy lane quietly stopped engaging.
+STAGING_WRITES = _counter(
+    "staging_writes",
+    "Staging IPC batch writes by path (mode: direct/buffered/adapted)",
+    ["mode"],
+)
+# native parse pool health (scrape-time refresh in server/app.py
+# metrics_handler, same pattern as the device gauges): live workers,
+# queued-not-running jobs, and per-worker busy ratio over the scrape
+# interval (busy-ns delta / wall delta)
+NATIVE_POOL_SIZE = _gauge("native_pool_size", "Native parse pool live workers", [])
+NATIVE_POOL_QUEUE_DEPTH = _gauge(
+    "native_pool_queue_depth", "Native parse pool jobs queued, not yet running", []
+)
+NATIVE_POOL_BUSY_RATIO = _gauge(
+    "native_pool_busy_ratio",
+    "Per-worker busy fraction since the previous /metrics scrape",
+    ["worker"],
+)
+# telemetry ring overflow (cumulative, read from the native side at scrape
+# time): nonzero means some requests' native spans were dropped rather
+# than blocking their parse
+NATIVE_TELEM_DROPS = _gauge(
+    "native_telem_dropped_events",
+    "Native telemetry events dropped on ring overflow (cumulative)",
+    [],
+)
 
 # --- storage -------------------------------------------------------------
 STORAGE_SIZE = _gauge("storage_size", "Storage size bytes", ["type", "stream", "format"])
@@ -211,8 +261,9 @@ CLUSTER_FANOUT_LATENCY = Histogram(
 
 # conservation-law auditor (parseable_tpu/audit.py): each detected
 # invariant breach ticks once, labeled by invariant name (rows_conserved /
-# snapshot_monotonic / gauges_zero / queryable_count) — the soak battery's
-# "did we lose or double-count rows" alarm
+# snapshot_monotonic / gauges_zero / queryable_count /
+# native_rows_conserved) — the soak battery's "did we lose or
+# double-count rows" alarm
 AUDIT_VIOLATIONS = _counter(
     "audit_violations",
     "Conservation-law audit violations by invariant",

@@ -312,6 +312,40 @@ class Tracer:
                 name, trace_id, span_id, parent_id, start_ns, end_ns, err, attrs
             )
 
+    def record_span(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        parent_span_id: str | None = None,
+        **attrs,
+    ) -> str | None:
+        """Record an already-timed span: the native telemetry ring replays
+        work that happened below the ctypes boundary with its own
+        wall-clock start/duration, so these spans carry REAL timings, not
+        re-measured ones. Parents under the current context span unless an
+        explicit parent_span_id is given. Returns the new span id, or None
+        when nothing consumes spans."""
+        if not self._recording():
+            return None
+        ctx = _TRACE_CTX.get()
+        if ctx is not None:
+            trace_id, ctx_span = ctx
+        else:
+            trace_id, ctx_span = new_trace_id(), None
+        span_id = new_span_id()
+        self._finish(
+            name,
+            trace_id,
+            span_id,
+            parent_span_id or ctx_span,
+            start_ns,
+            end_ns,
+            None,
+            attrs,
+        )
+        return span_id
+
     def _finish(self, name, trace_id, span_id, parent_id, start_ns, end_ns, err, attrs):
         row = {
             "event_type": "span",
@@ -322,12 +356,19 @@ class Tracer:
             "stream": str(attrs.get("stream", "")),
             "duration_ms": round((end_ns - start_ns) / 1e6, 3),
             "bytes": int(attrs.get("bytes", 0) or 0),
+            "rows": int(attrs.get("rows", 0) or 0),
             "status": "error" if err else str(attrs.get("status", "ok")),
             "status_code": int(attrs.get("status_code", 0) or 0),
             "ts": _rfc3339_ns(start_ns),
             "node": _NODE_IDENTITY["node"],
             "role": _NODE_IDENTITY["role"],
         }
+        # native-telemetry detail attrs ride along when present so the
+        # stitched cluster trace shows WHICH shard/lane produced a span and
+        # why it declined — the fixed fields above stay the stable schema
+        for k in ("shard", "lane", "cause", "qwait_us"):
+            if k in attrs:
+                row[k] = attrs[k]
         _SPAN_RING.append(row)
         SPAN_SINK.record(row)
         if not self.enabled:

@@ -90,6 +90,7 @@ if [ "${NSAN:-1}" != "0" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu P_NSAN=1 python -m pytest -q -m 'not slow' \
       tests/test_native_ingest.py tests/test_native_otel.py \
       tests/test_native_parity_fuzz.py tests/test_native_and_formats.py \
+      tests/test_native_telem.py \
       tests/test_hll_distinct.py tests/test_nsan_fuzz.py \
       --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
       2>&1 | tee /tmp/_t1_nsan.log

@@ -164,6 +164,10 @@ def run_cluster_smoke(workdir: Path) -> dict:
     frozen = {
         "P_LOCAL_SYNC_INTERVAL": "3600",
         "P_STORAGE_UPLOAD_INTERVAL": "3600",
+        # force the sharded native parse on every ingest so the stitched
+        # trace must contain per-shard C++ spans (native-path telemetry)
+        "P_INGEST_PARSE_SHARDS": "2",
+        "P_INGEST_SHARD_MIN_BYTES": "0",
     }
     with bb.ClusterHarness(workdir) as cluster:
         ing0 = cluster.spawn("ingest", "ing0", env_extra=frozen)
@@ -222,6 +226,44 @@ def run_cluster_smoke(workdir: Path) -> dict:
         )
         plan_types = {r.get("plan_type") for r in plan}
         assert "fanout" in plan_types, f"no fanout plan row: {plan}"
+
+        # native-path telemetry: a traced ingest must stitch the C++
+        # per-shard parse spans (recorded below the ctypes boundary by the
+        # fastpath event ring) into the cluster trace, and their row/byte
+        # accounting must be exact
+        import json as _json
+
+        ing_tid = "f0" * 16
+        payload = [{"host": f"h{i % 2}", "v": float(i)} for i in range(40)]
+        status, _, _ = bb.http_json_headers(
+            "POST",
+            f"{ing0.url}/api/v1/ingest",
+            payload,
+            headers={
+                "X-P-Stream": "csmoke",
+                "traceparent": f"00-{ing_tid}-{'d1' * 8}-01",
+            },
+        )
+        assert status == 200, f"traced ingest failed: {status}"
+        itree = cluster.cluster_trace(q, ing_tid)
+
+        def walk(nodes):
+            for nd in nodes:
+                yield nd
+                yield from walk(nd["children"])
+
+        ispans = list(walk(itree["tree"]))
+        native_parse = [s for s in ispans if s["name"] == "native.parse"]
+        assert len(native_parse) == 2, (
+            f"expected 2 native shard spans, got {[s['name'] for s in ispans]}"
+        )
+        assert sum(s["rows"] for s in native_parse) == 40, native_parse
+        assert sum(s["bytes"] for s in native_parse) == len(
+            _json.dumps(payload).encode()
+        ), native_parse
+        assert any(s["name"] == "native.stitch" for s in ispans), (
+            f"no stitch span in {[s['name'] for s in ispans]}"
+        )
 
         # conservation audit: zero violations once the cluster is at rest
         deadline = time.monotonic() + 60

@@ -108,6 +108,17 @@ def pytest_sessionfinish(session, exitstatus):
             )
             if session.exitstatus == 0:
                 session.exitstatus = 1
+        # same single-owner contract for telemetry drain handles: each
+        # ptpu_telem_drain array must meet exactly one ptpu_telem_free
+        tlive = native.telem_live()
+        if tlive != 0:
+            print(
+                f"\nconftest: ptpu_telem_live() == {tlive} at session end "
+                "(expected 0) — a telemetry drain handle leaked",
+                file=_sys.stderr,
+            )
+            if session.exitstatus == 0:
+                session.exitstatus = 1
     except Exception:
         pass  # the gate must never turn an unrelated failure into a crash
 

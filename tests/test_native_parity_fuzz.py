@@ -490,6 +490,52 @@ def test_fuzz_json_three_lane_parity(tmp_path, trio, monkeypatch):
     assert native.columnar_live() == 0, "leaked native columnar buffers"
 
 
+def test_fuzz_telemetry_onoff_staged_identical(tmp_path, monkeypatch):
+    """P_NATIVE_TELEM must be a pure observer: for every fuzzed payload
+    (at a rotating forced shard count) the staged table with telemetry on
+    is identical to telemetry off — same decline/error decision, same
+    schema, same values — and each request's drain leaves nothing behind
+    on the thread."""
+    rng = random.Random(0x7E1E)
+    p_on, p_off = mk(tmp_path, "ton"), mk(tmp_path, "toff")
+    try:
+        for i in range(30):
+            payload = gen_payload(rng)
+            body = json.dumps(payload).encode()
+            stream = f"t{i}"
+            outcomes = []
+            for p, tel in ((p_on, "1"), (p_off, "0")):
+                p.create_stream_if_not_exists(stream)
+                with monkeypatch.context() as m:
+                    m.setenv("P_NATIVE_TELEM", tel)
+                    m.setenv("P_INGEST_PARSE_SHARDS", str((1, 2, 4)[i % 3]))
+                    m.setenv("P_INGEST_SHARD_MIN_BYTES", "0")
+                    try:
+                        outcomes.append(
+                            ("ok", flatten_and_push_logs(
+                                p, stream, None, LogSource.JSON, {}, raw_body=body
+                            ))
+                        )
+                    except IngestError:
+                        outcomes.append(("err", None))
+            assert outcomes[0] == outcomes[1], f"telemetry changed behavior: {outcomes}"
+            t_on, t_off = staged(p_on, stream), staged(p_off, stream)
+            if t_off is None:
+                assert t_on is None
+                continue
+            assert t_on.schema.equals(t_off.schema), (
+                f"telemetry schema drift:\n{t_on.schema}\nvs\n{t_off.schema}"
+            )
+            assert t_on.equals(t_off), "telemetry changed staged values"
+        # the per-request drain owned every event: ring empty, no handles
+        assert native.telem_drain() == []
+        gc.collect()
+        assert native.telem_live() == 0 and native.columnar_live() == 0
+    finally:
+        p_on.shutdown()
+        p_off.shutdown()
+
+
 def test_fuzz_json_schema_evolution_across_lanes(tmp_path, trio, monkeypatch):
     """Consecutive batches into ONE stream, each batch through all lanes:
     schema widening and stored-schema overrides must agree regardless of

@@ -1,0 +1,365 @@
+"""Native-path telemetry plane (observability tentpole).
+
+The C++ fast path records per-shard parse spans, stitch time, and pool
+queue-wait into a lock-free per-thread event ring (fastpath.cpp telem::)
+drained over the ptpu_telem_* ABI by the SAME Python thread that
+submitted the parse. The contracts under test:
+
+- recording NEVER blocks or corrupts a parse: ring overflow drops events
+  (counted in ptpu_telem_drops) and results stay exact;
+- thread-local attribution: concurrent parse+drain on many threads never
+  cross-contaminate (each thread drains exactly its own events);
+- a traced sharded ingest stitches EXACTLY `shards` native child spans
+  whose rows/bytes sum to the request totals, parented under the ingest
+  span;
+- pool introspection (size / queue depth / per-worker busy ns) and the
+  scrape-time gauge refresh;
+- the native_rows_conserved audit invariant balances on real ingest and
+  trips on a fabricated imbalance;
+- single-owner drain handles never leak (telem_live == 0 at rest —
+  also enforced globally by conftest's session-finish gate).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import threading
+
+import pytest
+
+from parseable_tpu import native
+from parseable_tpu.config import Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.event.format import LogSource
+from parseable_tpu.server.ingest_utils import flatten_and_push_logs
+from parseable_tpu.utils import telemetry
+from parseable_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native fastpath unavailable"
+)
+
+DEPTH = Options().event_flatten_level - 1
+
+
+def mk(tmp_path) -> Parseable:
+    opts = Options()
+    opts.local_staging_path = tmp_path / "staging"
+    return Parseable(
+        opts, StorageOptions(backend="local-store", root=tmp_path / "data")
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    """Every test starts and ends with an empty ring on this thread."""
+    native.telem_sync()
+    native.telem_drain()
+    yield
+    native.telem_drain()
+
+
+# ------------------------------------------------------------ ring mechanics
+
+
+def test_ring_overflow_drops_counted_never_blocks():
+    """More undrained parses than the ring holds: the surplus is dropped
+    and counted, every parse still returns exact results, and the drained
+    remainder + drop delta accounts for every event."""
+    body = json.dumps([{"a": i, "b": "x" * 8} for i in range(25)]).encode()
+    calls = 300  # ring capacity is 256; anything >256 must overflow
+    drops_before = native.telem_drops()
+    for _ in range(calls):
+        r = native.flatten_columnar(body, DEPTH)
+        assert r is not None and r[2] == 25, "overflow corrupted a parse"
+    drained = native.telem_drain()
+    dropped = native.telem_drops() - drops_before
+    assert dropped > 0, "300 undrained events never overflowed the ring"
+    assert dropped + len(drained) == calls
+    assert all(e[5] == 25 for e in drained), drained
+    gc.collect()
+    assert native.telem_live() == 0
+
+
+def test_event_fields_unsharded():
+    body = json.dumps([{"a": i} for i in range(10)]).encode()
+    r = native.flatten_columnar(body, DEPTH)
+    assert r is not None
+    evs = native.telem_drain()
+    assert len(evs) == 1
+    kind, shard, lane, rc, nbytes, rows, start_ns, dur_ns, qwait_ns = evs[0]
+    assert kind == native.TELEM_EV_PARSE
+    assert shard == 0 and qwait_ns == 0  # inline parse: no pool wait
+    assert native.TELEM_LANES[lane] == "json"
+    assert native.TELEM_CAUSES[rc] == "ok"
+    assert nbytes == len(body) and rows == 10
+    assert start_ns > 0 and dur_ns > 0
+
+
+def test_decline_events_carry_cause():
+    """A payload the columnar builders decline still records its parse
+    attempt, with a non-ok cause code — the waterfall sees declines."""
+    body = json.dumps([{"a": [1, 2, 3]}]).encode()  # arrays: columnar declines
+    assert native.flatten_columnar(body, DEPTH) is None
+    evs = native.telem_drain()
+    assert evs, "declined parse recorded no event"
+    assert any(native.TELEM_CAUSES.get(e[3]) != "ok" for e in evs), evs
+
+
+def test_sharded_events_sum_exactly():
+    """Per-shard byte/row accounting: shard slices cover the payload with
+    no gap or overlap, rows sum to the total, and the stitch event rides
+    along; shard>0 jobs carry a real pool queue-wait."""
+    body = json.dumps([{"a": i, "s": "y" * 30} for i in range(2000)]).encode()
+    r = native.flatten_columnar(body, DEPTH, shards=4)
+    assert r is not None and r[2] == 2000
+    evs = native.telem_drain()
+    parse = [e for e in evs if e[0] == native.TELEM_EV_PARSE]
+    stitch = [e for e in evs if e[0] == native.TELEM_EV_STITCH]
+    assert len(parse) == 4 and len(stitch) == 1
+    assert sorted(e[1] for e in parse) == [0, 1, 2, 3]
+    assert sum(e[5] for e in parse) == 2000
+    assert sum(e[4] for e in parse) == len(body)
+    assert stitch[0][5] == 2000
+    # only the non-inline shards wait on the pool queue
+    assert parse[0][8] == 0 or any(e[8] > 0 for e in parse[1:])
+
+
+def test_telem_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("P_NATIVE_TELEM", "0")
+    assert native.telem_sync() is False
+    body = json.dumps([{"a": 1}]).encode()
+    r = native.flatten_columnar(body, DEPTH, shards=2)
+    assert r is not None
+    assert native.telem_drain() == []
+    monkeypatch.delenv("P_NATIVE_TELEM")
+    assert native.telem_sync() is True  # knob re-syncs without a reload
+
+
+# ------------------------------------------------------- drain-vs-parse race
+
+
+def test_drain_vs_parse_thread_isolation():
+    """Concurrent threads parse (sharded and not) and drain in a tight
+    loop: every thread must drain exactly its own events — row totals per
+    drain match that thread's payload, with zero cross-thread bleed —
+    while pool workers race CallBuf publication underneath."""
+    errors: list[BaseException] = []
+
+    def worker(idx: int) -> None:
+        nrows = 40 + idx  # per-thread row count: contamination breaks sums
+        body = json.dumps(
+            [{"a": i, "w": idx, "pad": "z" * 20} for i in range(nrows)]
+        ).encode()
+        try:
+            for it in range(40):
+                shards = 1 + (idx + it) % 3
+                r = native.flatten_columnar(body, DEPTH, shards=shards)
+                assert r is not None and r[2] == nrows
+                evs = native.telem_drain()
+                parse = [e for e in evs if e[0] == native.TELEM_EV_PARSE]
+                assert sum(e[5] for e in parse) == nrows, (
+                    f"thread {idx} drained foreign events: {evs}"
+                )
+                assert sum(e[4] for e in parse) == len(body)
+        except BaseException as e:  # noqa: BLE001 — surfaced to the test
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # the main thread submitted nothing: its ring must be empty
+    assert native.telem_drain() == []
+    gc.collect()
+    assert native.telem_live() == 0 and native.columnar_live() == 0
+
+
+# --------------------------------------------------------- stitched waterfall
+
+
+def test_stitched_trace_exact_shard_spans(tmp_path, monkeypatch):
+    """A traced sharded ingest must contain exactly `shards` native.parse
+    child spans whose rows/bytes sum to the request totals, plus one
+    native.stitch — all parented under the request's ingest span."""
+    monkeypatch.setenv("P_INGEST_PARSE_SHARDS", "4")
+    monkeypatch.setenv("P_INGEST_SHARD_MIN_BYTES", "0")
+    p = mk(tmp_path)
+    try:
+        p.create_stream_if_not_exists("s")
+        body = json.dumps(
+            [{"host": f"h{i % 5}", "v": float(i)} for i in range(1000)]
+        ).encode()
+        telemetry.clear_recent_spans()
+        with telemetry.trace_context() as trace_id:
+            count = flatten_and_push_logs(
+                p, "s", None, LogSource.JSON, {}, raw_body=body
+            )
+        assert count == 1000
+        spans = telemetry.recent_spans(trace_id)
+        parse = [s for s in spans if s["name"] == "native.parse"]
+        stitch = [s for s in spans if s["name"] == "native.stitch"]
+        ingest = [s for s in spans if s["name"] == "ingest"]
+        assert len(parse) == 4, [s["name"] for s in spans]
+        assert sum(s["rows"] for s in parse) == 1000
+        assert sum(s["bytes"] for s in parse) == len(body)
+        assert len(stitch) == 1 and stitch[0]["rows"] == 1000
+        assert len(ingest) == 1
+        for s in parse + stitch:
+            assert s["parent_span_id"] == ingest[0]["span_id"]
+            assert s["duration_ms"] > 0
+    finally:
+        p.shutdown()
+        telemetry.clear_recent_spans()
+
+
+def test_stage_histograms_and_imbalance_gauge(tmp_path, monkeypatch):
+    """One native ingest populates the per-lane stage waterfall histograms
+    (parse + schema-commit + stage-ipc) and a sharded one refreshes the
+    shard-imbalance gauge."""
+
+    def stage_count(stage: str, lane: str) -> float:
+        return (
+            REGISTRY.get_sample_value(
+                "parseable_ingest_stage_seconds_count",
+                {"stage": stage, "lane": lane},
+            )
+            or 0.0
+        )
+
+    before = {
+        ("parse", "json"): stage_count("parse", "json"),
+        ("stitch", "json"): stage_count("stitch", "json"),
+        ("schema-commit", "json"): stage_count("schema-commit", "json"),
+        ("stage-ipc", "json"): stage_count("stage-ipc", "json"),
+    }
+    monkeypatch.setenv("P_INGEST_PARSE_SHARDS", "2")
+    monkeypatch.setenv("P_INGEST_SHARD_MIN_BYTES", "0")
+    p = mk(tmp_path)
+    try:
+        p.create_stream_if_not_exists("s")
+        body = json.dumps([{"a": i} for i in range(500)]).encode()
+        count = flatten_and_push_logs(
+            p, "s", None, LogSource.JSON, {}, raw_body=body
+        )
+        assert count == 500
+        assert stage_count("parse", "json") == before[("parse", "json")] + 2
+        assert stage_count("stitch", "json") == before[("stitch", "json")] + 1
+        assert (
+            stage_count("schema-commit", "json")
+            == before[("schema-commit", "json")] + 1
+        )
+        assert stage_count("stage-ipc", "json") == before[("stage-ipc", "json")] + 1
+        imb = REGISTRY.get_sample_value("parseable_ingest_shard_imbalance")
+        assert imb is not None and imb >= 1.0
+    finally:
+        p.shutdown()
+
+
+# ------------------------------------------------------------- pool gauges
+
+
+def test_pool_introspection_and_busy_monotonic():
+    body = json.dumps([{"a": i, "pad": "q" * 20} for i in range(3000)]).encode()
+    r = native.flatten_columnar(body, DEPTH, shards=4)
+    assert r is not None
+    native.telem_drain()
+    size = native.parse_pool_size()
+    assert size >= 1, "sharded parse left no live pool workers"
+    assert native.pool_queue_depth() >= 0
+    busy1 = sum(native.pool_busy_ns(w) for w in range(size))
+    r = native.flatten_columnar(body, DEPTH, shards=4)
+    assert r is not None
+    native.telem_drain()
+    busy2 = sum(native.pool_busy_ns(w) for w in range(size))
+    assert busy2 >= busy1, "busy counters must be monotonic"
+    # out-of-range worker slots answer 0, never fault
+    assert native.pool_busy_ns(10_000) == 0 and native.pool_busy_ns(-1) == 0
+
+
+def test_metrics_refresh_sets_pool_gauges(tmp_path):
+    from parseable_tpu.server import app as server_app
+
+    body = json.dumps([{"a": i} for i in range(2000)]).encode()
+    assert native.flatten_columnar(body, DEPTH, shards=2) is not None
+    native.telem_drain()
+    server_app._refresh_native_pool_gauges()
+    size = REGISTRY.get_sample_value("parseable_native_pool_size")
+    depth = REGISTRY.get_sample_value("parseable_native_pool_queue_depth")
+    drops = REGISTRY.get_sample_value("parseable_native_telem_dropped_events")
+    assert size is not None and size >= 1
+    assert depth is not None and depth >= 0
+    assert drops is not None and drops >= 0
+    # second refresh computes per-worker busy ratios from the deltas
+    server_app._refresh_native_pool_gauges()
+    ratio = REGISTRY.get_sample_value(
+        "parseable_native_pool_busy_ratio", {"worker": "0"}
+    )
+    assert ratio is not None and 0.0 <= ratio <= 1.0
+
+
+# ---------------------------------------------------------------- audit tie-in
+
+
+def test_native_rows_conserved_balances_and_trips(tmp_path):
+    from parseable_tpu import audit
+
+    p = mk(tmp_path)
+    try:
+        p.create_stream_if_not_exists("s")
+        p.audit.ensure_stream(p, "s")
+        body = json.dumps([{"a": i} for i in range(20)]).encode()
+        count = flatten_and_push_logs(
+            p, "s", None, LogSource.JSON, {}, raw_body=body
+        )
+        assert count == 20
+        p.audit.record_acked("s", count)
+        rep = audit.local_report(p, quiesce=True)
+        assert rep["violations"] == [], rep["violations"]
+        entry = rep["streams"]["s"]
+        assert entry["native_parsed"] == 20
+        assert entry["native_staged"] == 20
+        assert entry["native_declined"] == 0
+        # fabricate rows that parsed natively but neither staged nor
+        # declined — the invariant must trip at quiesce
+        p.audit.record_native("s", parsed=5)
+        rep = audit.local_report(p, quiesce=True)
+        broken = [
+            v for v in rep["violations"] if v["invariant"] == "native_rows_conserved"
+        ]
+        assert broken, rep["violations"]
+    finally:
+        p.shutdown()
+
+
+def test_native_decline_cascade_balances(tmp_path):
+    """A columnar parse whose normalization declines pushes the rows down
+    a tier; the books must balance (parsed == staged + declined) even
+    though two tiers each counted their own parse."""
+    from parseable_tpu import audit
+
+    p = mk(tmp_path)
+    try:
+        p.create_stream_if_not_exists("s")
+        p.audit.ensure_stream(p, "s")
+        # int-typed column then string-typed same column: the second batch
+        # parses columnar but the stored-schema normalization declines it
+        for payload in ([{"a": 1}], [{"a": "not an int"}]):
+            body = json.dumps(payload).encode()
+            try:
+                flatten_and_push_logs(p, "s", None, LogSource.JSON, {}, raw_body=body)
+            except Exception:  # noqa: BLE001 — only the books matter here
+                pass  # the authoritative Python path may reject the batch
+        counters = p.audit.native_counters().get("s")
+        assert counters is not None
+        parsed, staged, declined = counters
+        assert parsed == staged + declined, counters
+        rep = audit.local_report(p, quiesce=False)
+        assert [
+            v for v in rep["violations"] if v["invariant"] == "native_rows_conserved"
+        ] == []
+    finally:
+        p.shutdown()
