@@ -257,6 +257,8 @@ _PTR_POINTEE_OK = {
     "i64": "POINTER(c_longlong)",
     "u32": "POINTER(c_uint32)",
     "i32": "POINTER(c_int32)",
+    "int": "POINTER(c_int)",
+    "uint": "POINTER(c_uint)",
     "void": "POINTER(None)",
     "ptr": "POINTER(c_void_p)",
 }

@@ -247,6 +247,90 @@ def gen_byte_mutation(rng: random.Random) -> bytes:
     return bytes(base)
 
 
+def gen_http_framing(rng: random.Random) -> bytes:
+    """Adversarial HTTP/1.1 wire images for the edge acceptor's parser
+    (driven through ptpu_edge_parse_probe at several recv-slice sizes, so
+    request lines and chunk frames split across feed boundaries). Families:
+    request smuggling shapes (duplicate Content-Length, CL+TE together),
+    chunked-extension garbage, bare-LF and obs-fold headers, truncation at
+    every phase, and pipelined keep-alive trains."""
+    body = rng.choice([b"", b"{}", b'{"a":1}', b"x" * rng.randrange(1, 300)])
+    target = rng.choice(
+        [
+            b"/api/v1/ingest",
+            b"/api/v1/logstream/s1",
+            b"/v1/logs",
+            b"/v1/metrics",
+            b"/other",
+            b"/" + bytes(rng.randrange(1, 256) for _ in range(rng.randrange(1, 30))),
+        ]
+    )
+    method = rng.choice([b"POST", b"GET", b"PUT", b"P\x00ST", b""])
+    version = rng.choice([b"HTTP/1.1", b"HTTP/1.0", b"HTTP/9.9", b"HTTP", b""])
+    pick = rng.randrange(6)
+    if pick == 0:
+        # smuggled framing: duplicate/conflicting Content-Length, CL+TE
+        h = rng.choice(
+            [
+                b"Content-Length: %d\r\nContent-Length: %d\r\n"
+                % (len(body), len(body) + rng.randrange(1, 9)),
+                b"Content-Length: %d\r\nTransfer-Encoding: chunked\r\n" % len(body),
+                b"Content-Length: -1\r\n",
+                b"Content-Length: 99999999999999999999\r\n",
+                b"Content-Length: %d \r\n" % len(body),
+            ]
+        )
+        return b"%s %s %s\r\n%s\r\n%s" % (method, target, version, h, body)
+    if pick == 1:
+        # chunked with extension garbage / bad sizes / missing CRLFs
+        size = b"%x" % len(body)
+        ext = rng.choice([b"", b";ext=1", b";" + b";" * 200, b"\x80\xff", b" ; a=b"])
+        tail = rng.choice([b"\r\n0\r\n\r\n", b"\r\n0\r\n", b"\r\n", b""])
+        crlf = rng.choice([b"\r\n", b"\n", b""])
+        return (
+            b"POST %s HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n" % target
+            + size + ext + crlf + body + tail
+        )
+    if pick == 2:
+        # header pathology: obs-fold, bare LF, NULs, missing colon, huge
+        sep = rng.choice([b"\r\n", b"\n"])
+        h = rng.choice(
+            [
+                b"X-P-Stream: s1\r\n continued\r\n",
+                b"NoColonHere\r\n",
+                b"X-P-Stream\x00: s1\r\n",
+                b"A: " + b"b" * rng.randrange(1, 9000) + b"\r\n",
+                b": empty-name\r\n",
+            ]
+        )
+        return (
+            b"POST %s HTTP/1.1" % target + sep
+            + b"Content-Length: %d" % len(body) + sep + h + sep + body
+        )
+    if pick == 3:
+        # truncation at a random phase of an otherwise-valid request
+        full = (
+            b"POST %s HTTP/1.1\r\nAuthorization: Basic dTpw\r\n"
+            b"X-P-Stream: s1\r\nContent-Length: %d\r\n\r\n%s"
+            % (target, len(body), body)
+        )
+        return full[: rng.randrange(0, len(full) + 1)]
+    if pick == 4:
+        # pipelined keep-alive trains, valid and mixed with garbage
+        reqs = []
+        for _ in range(rng.randrange(2, 6)):
+            b2 = rng.choice([b"{}", b'{"k":2}', b""])
+            reqs.append(
+                b"POST /api/v1/ingest HTTP/1.1\r\nX-P-Stream: s%d\r\n"
+                b"Content-Length: %d\r\n\r\n%s" % (rng.randrange(9), len(b2), b2)
+            )
+        if rng.random() < 0.3:
+            reqs.insert(rng.randrange(len(reqs)), gen_byte_mutation(rng)[:200])
+        return b"".join(reqs)
+    # pure noise through the HTTP state machine
+    return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 400)))
+
+
 FAMILIES = [
     ("valid_ndjson", gen_valid_ndjson),
     ("truncated_utf8", gen_truncated_utf8),
@@ -259,6 +343,7 @@ FAMILIES = [
     ("shard_boundary", gen_shard_boundary),
     ("otel_shaped", gen_otel_shaped),
     ("byte_mutation", gen_byte_mutation),
+    ("http_framing", gen_http_framing),
 ]
 
 
@@ -298,6 +383,13 @@ def _drive_payload(native, np, payload: bytes) -> int:
     r3 = native.otel_metrics_columnar(payload, ts_as_ms=False)
     r4 = native.otel_traces_columnar(payload, ts_as_ms=False)
     del r3, r4
+
+    # edge HTTP parser: every payload (not just http_framing) walks the
+    # state machine whole, in 1-byte slices (every boundary split), and at
+    # a prime step that shifts chunk frames across feed calls
+    if getattr(native, "edge_available", lambda: False)():
+        for chunk in (0, 1, 7):
+            native.edge_parse_probe(payload, chunk)
 
     lines = payload.split(b"\n")[:256] or [b""]
     buf = bytearray()

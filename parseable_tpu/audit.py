@@ -314,6 +314,20 @@ def local_report(p, quiesce: bool = False) -> dict:
                         "gauges_zero", "", p.node_id, f"{gname} = {v} at quiesce", 0, v
                     )
                 )
+    edge = _edge_report()
+    if edge is not None and quiesce and edge["live"]:
+        # a claimed-but-unresponded edge request at quiesce is stranded
+        # work — same invariant class as the worker gauges above
+        violations.append(
+            _violation(
+                "edge_drained",
+                "",
+                p.node_id,
+                f"edge live requests = {edge['live']} at quiesce",
+                0,
+                edge["live"],
+            )
+        )
     for v in violations:
         AUDIT_VIOLATIONS.labels(v["invariant"]).inc()
         logger.warning("audit violation: %s", v)
@@ -326,8 +340,25 @@ def local_report(p, quiesce: bool = False) -> dict:
         "streams": streams_out,
         "violations": violations,
     }
+    if edge is not None:
+        report["edge"] = edge
     led.last_report = report
     return report
+
+
+def _edge_report() -> dict | None:
+    """Snapshot of the native HTTP edge acceptor's C-side counters (None
+    when the edge ABI isn't loaded). `happy + declined == requests` always;
+    `direct` counts canned C responses (413/400 framing errors) that never
+    reached Python, so they are outside the request conservation sum."""
+    from parseable_tpu import native
+
+    if not getattr(native, "edge_available", lambda: False)():
+        return None
+    names = ("conns", "requests", "happy", "declined", "direct", "auth_miss")
+    out = {n: native.edge_counter(i) for i, n in enumerate(names)}
+    out["live"] = native.edge_live()
+    return out
 
 
 def _peer_audit(p, node: dict, quiesce: bool) -> dict:

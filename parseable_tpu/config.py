@@ -182,6 +182,26 @@ def native_telem_options() -> dict:
     }
 
 
+def edge_options() -> dict:
+    """Knobs for the native HTTP ingest edge (native/edge.py + the
+    fastpath.cpp `ptpu_edge_*` acceptor).
+
+    P_EDGE_PORT: listener port for the C++ epoll acceptor; 0 (default)
+    disables the edge entirely — the aiohttp tier alone serves ingest.
+    P_EDGE_DISPATCHERS: Python dispatcher threads draining the acceptor's
+    ready queue (parse + stage + ack per claimed request) — default
+    min(cpu, 4), matching the sharded-parse worker default.
+    P_INGEST_MAX_BODY_BYTES: hard request-body cap shared by BOTH tiers —
+    aiohttp's client_max_size and the C acceptor's framing limit — so a
+    decline never changes which bodies are accepted (413 past it either
+    way). Default 64 MiB (the previous hardwired aiohttp value)."""
+    return {
+        "port": _env_int("P_EDGE_PORT", 0),
+        "dispatchers": _env_int("P_EDGE_DISPATCHERS", min(os.cpu_count() or 1, 4)),
+        "max_body": _env_int("P_INGEST_MAX_BODY_BYTES", 64 * 1024 * 1024),
+    }
+
+
 def nsan_options() -> dict:
     """Knobs for the native-code safety gate (analysis/nsan).
 

@@ -33,6 +33,10 @@ _columnar_ok = False
 # predating the ptpu_telem_* ABI disables ONLY the telemetry plane (parses
 # still run, just unobserved) — and hard-fails under P_NATIVE_REQUIRED.
 _telem_ok = False
+# ingest-edge plane bound? A .so predating the ptpu_edge_* ABI disables
+# ONLY the native acceptor (ingest falls back to the aiohttp tier) — and
+# hard-fails under P_NATIVE_REQUIRED like the other planes.
+_edge_ok = False
 # last enable state pushed to the C side (None = never pushed); the knob is
 # re-read per drain/sync so tests and the bench can flip P_NATIVE_TELEM
 # without a reload
@@ -71,7 +75,7 @@ def _lib_path() -> Path:
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed, _columnar_ok, _telem_ok
+    global _lib, _load_failed, _columnar_ok, _telem_ok, _edge_ok
     if _lib is not None:
         return _lib
     if _load_failed:
@@ -144,6 +148,23 @@ def _load() -> ctypes.CDLL | None:
         from parseable_tpu.utils.metrics import INGEST_NATIVE
 
         INGEST_NATIVE.labels("columnar", "bind-failed").inc()
+    try:
+        _bind_edge(lib)
+        _edge_ok = True
+    except AttributeError as e:
+        # the .so predates the ingest-edge ABI: the native acceptor stays
+        # off and every ingest byte takes the aiohttp path — correct, just
+        # slower. Hard failure under P_NATIVE_REQUIRED like the other planes.
+        _edge_ok = False
+        logger.warning(
+            "native fastpath lacks the edge ABI (%s); native ingest edge disabled",
+            e,
+        )
+        if _required():
+            raise RuntimeError(
+                f"P_NATIVE_REQUIRED=1 but the native fastpath lacks the "
+                f"edge ABI: {e}"
+            ) from e
     try:
         _bind_telem(lib)
         _telem_ok = True
@@ -351,8 +372,121 @@ def _bind_telem(lib: ctypes.CDLL) -> None:
     lib.ptpu_telem_pool_busy_ns.argtypes = [ctypes.c_int]
 
 
+def _bind_edge(lib: ctypes.CDLL) -> None:
+    """Declare the native ingest-edge exports (epoll acceptor lifecycle,
+    request claim/respond, auth snapshot, parser probe); raises
+    AttributeError when the library predates the plane — _load() then
+    disables only the edge."""
+    lib.ptpu_edge_start.restype = ctypes.c_int
+    lib.ptpu_edge_start.argtypes = [ctypes.c_int, ctypes.c_uint64]
+    lib.ptpu_edge_stop.restype = None
+    lib.ptpu_edge_stop.argtypes = []
+    lib.ptpu_edge_auth_set.restype = None
+    lib.ptpu_edge_auth_set.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.ptpu_edge_next.restype = ctypes.c_int
+    lib.ptpu_edge_next.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+    ]
+    lib.ptpu_edge_req_stream.restype = ctypes.c_int
+    lib.ptpu_edge_req_stream.argtypes = [
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ptpu_edge_req_body.restype = ctypes.c_int
+    lib.ptpu_edge_req_body.argtypes = [
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ptpu_edge_req_raw.restype = ctypes.c_int
+    lib.ptpu_edge_req_raw.argtypes = [
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ptpu_edge_req_trace.restype = ctypes.c_int
+    lib.ptpu_edge_req_trace.argtypes = [
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ptpu_edge_req_reason.restype = ctypes.c_int
+    lib.ptpu_edge_req_reason.argtypes = [ctypes.c_uint64]
+    lib.ptpu_edge_respond_ack.restype = ctypes.c_int
+    lib.ptpu_edge_respond_ack.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_longlong,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.ptpu_edge_respond.restype = ctypes.c_int
+    lib.ptpu_edge_respond.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.ptpu_edge_respond_raw.restype = ctypes.c_int
+    lib.ptpu_edge_respond_raw.argtypes = [
+        ctypes.c_uint64,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.ptpu_edge_live.restype = ctypes.c_longlong
+    lib.ptpu_edge_live.argtypes = []
+    lib.ptpu_edge_counter.restype = ctypes.c_uint64
+    lib.ptpu_edge_counter.argtypes = [ctypes.c_int]
+    lib.ptpu_edge_parse_probe.restype = ctypes.c_int
+    lib.ptpu_edge_parse_probe.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+
+
 def native_available() -> bool:
     return _load() is not None
+
+
+class CBuf:
+    """Borrowed view of C-owned bytes — an edge request body living in the
+    acceptor's arena. Passed zero-copy into the native parse entry points
+    (via _payload_arg), so the happy path never materializes a Python
+    `bytes` of the payload. Valid ONLY until the owning edge request is
+    responded; tobytes() copies out for the Python fallback tiers."""
+
+    __slots__ = ("addr", "length")
+
+    def __init__(self, addr: int, length: int):
+        self.addr = addr
+        self.length = length
+
+    def __len__(self) -> int:
+        return self.length
+
+    def tobytes(self) -> bytes:
+        if not self.length or not self.addr:
+            return b""
+        return ctypes.string_at(self.addr, self.length)
+
+
+def _payload_arg(payload) -> tuple:
+    """(c_char_p-compatible arg, length) for a parse payload: plain bytes
+    pass through; a CBuf passes its borrowed C pointer without copying."""
+    if isinstance(payload, CBuf):
+        if not payload.addr or not payload.length:
+            return b"", 0
+        return (
+            ctypes.cast(ctypes.c_void_p(payload.addr), ctypes.c_char_p),
+            payload.length,
+        )
+    return payload, len(payload)
 
 
 def flatten_ndjson(payload: bytes, max_depth: int, separator: str = "_") -> tuple[bytes, int] | None:
@@ -369,9 +503,10 @@ def flatten_ndjson(payload: bytes, max_depth: int, separator: str = "_") -> tupl
     out = ctypes.c_void_p()
     out_len = ctypes.c_uint64()
     nrows = ctypes.c_uint64()
+    arg, n = _payload_arg(payload)
     rc = lib.ptpu_flatten_ndjson(
-        payload,
-        len(payload),
+        arg,
+        n,
         max_depth,
         separator.encode(),
         ctypes.byref(out),
@@ -406,9 +541,10 @@ def otel_logs_ndjson(payload: bytes, ts_as_ms: bool = True) -> tuple[bytes, int]
     out = ctypes.c_void_p()
     out_len = ctypes.c_uint64()
     nrows = ctypes.c_uint64()
+    arg, n = _payload_arg(payload)
     rc = lib.ptpu_otel_logs_ndjson(
-        payload,
-        len(payload),
+        arg,
+        n,
         1 if ts_as_ms else 0,
         ctypes.byref(out),
         ctypes.byref(out_len),
@@ -535,12 +671,13 @@ def flatten_columnar(
     if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
+    arg, n = _payload_arg(payload)
     rc = lib.ptpu_flatten_columnar_sharded(
-        payload,
-        len(payload),
+        arg,
+        n,
         max_depth,
         separator.encode(),
-        _effective_shards(len(payload), shards),
+        _effective_shards(n, shards),
         ctypes.byref(out),
     )
     if rc != 0:
@@ -560,11 +697,12 @@ def otel_logs_columnar(payload: bytes, ts_as_ms: bool = True, shards: int | None
     if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
+    arg, n = _payload_arg(payload)
     rc = lib.ptpu_otel_logs_columnar_sharded(
-        payload,
-        len(payload),
+        arg,
+        n,
         1 if ts_as_ms else 0,
-        _effective_shards(len(payload), shards),
+        _effective_shards(n, shards),
         ctypes.byref(out),
     )
     if rc != 0:
@@ -585,11 +723,12 @@ def otel_metrics_columnar(
     if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
+    arg, n = _payload_arg(payload)
     rc = lib.ptpu_otel_metrics_columnar(
-        payload,
-        len(payload),
+        arg,
+        n,
         1 if ts_as_ms else 0,
-        _effective_shards(len(payload), shards),
+        _effective_shards(n, shards),
         ctypes.byref(out),
     )
     if rc != 0:
@@ -609,11 +748,12 @@ def otel_traces_columnar(
     if lib is None or not _columnar_ok:
         return None
     out = ctypes.c_void_p()
+    arg, n = _payload_arg(payload)
     rc = lib.ptpu_otel_traces_columnar(
-        payload,
-        len(payload),
+        arg,
+        n,
         1 if ts_as_ms else 0,
-        _effective_shards(len(payload), shards),
+        _effective_shards(n, shards),
         ctypes.byref(out),
     )
     if rc != 0:
@@ -640,7 +780,7 @@ def parse_pool_size() -> int:
 
 # Event kinds and lane names crossing the ABI (fastpath.cpp telem::EV_* /
 # telem::LANE_*). Lane index -> the label the metrics/spans use.
-TELEM_EV_PARSE, TELEM_EV_STITCH = 0, 1
+TELEM_EV_PARSE, TELEM_EV_STITCH, TELEM_EV_RECV = 0, 1, 2
 TELEM_LANES = ("json", "otel-logs", "otel-metrics", "otel-traces")
 # decline cause codes (PTPU_FJ_*) -> span/metric label
 TELEM_CAUSES = {0: "ok", 1: "fallback", 2: "invalid"}
@@ -757,6 +897,157 @@ def reset_telem_state() -> None:
         _lib.ptpu_telem_drain(ctypes.byref(out), ctypes.byref(n))
         if out.value:
             _lib.ptpu_telem_free(out)
+
+
+# ------------------------------ ingest edge ---------------------------------
+
+# Request kinds crossing the edge ABI (fastpath.cpp edge::REQ_*).
+EDGE_JSON, EDGE_LOGSTREAM = 0, 1
+EDGE_OTEL_LOGS, EDGE_OTEL_METRICS, EDGE_OTEL_TRACES = 2, 3, 4
+EDGE_DECLINE = 100
+# decline reasons (edge::DECL_*) -> metric/span label
+EDGE_REASONS = {
+    0: "none",
+    1: "method",
+    2: "route",
+    3: "auth",
+    4: "header",
+    5: "framing",
+    6: "version",
+}
+# ptpu_edge_next outcomes
+EDGE_GOT, EDGE_TIMEOUT, EDGE_STOPPED = 0, 1, 2
+
+
+def edge_available() -> bool:
+    """True when the loaded library carries the ingest-edge ABI."""
+    return _load() is not None and _edge_ok
+
+
+def edge_start(port: int, max_body: int = 0) -> int:
+    """Start the native HTTP acceptor on `port` (0 = ephemeral; `max_body`
+    bounds any buffered request, 0 keeps the C default). Returns the bound
+    port, or -1 when the edge plane is unavailable or setup failed."""
+    lib = _load()
+    if lib is None or not _edge_ok:
+        return -1
+    return int(lib.ptpu_edge_start(port, max_body))
+
+
+def edge_stop() -> None:
+    """Stop accepting and join the acceptor thread (restartable; unclaimed
+    queued requests are freed, claimed ones drain through their responds)."""
+    if _lib is not None and _edge_ok:
+        _lib.ptpu_edge_stop()
+
+
+def edge_auth_set(tokens) -> None:
+    """Replace the C-side auth snapshot: an iterable of exact Authorization
+    header values ("Basic <b64>", "Bearer <token>"). Pushed on every RBAC
+    change; an empty snapshot declines every request to the aiohttp tier."""
+    if _lib is None or not _edge_ok:
+        return
+    blob = "\n".join(tokens).encode()
+    _lib.ptpu_edge_auth_set(blob, len(blob))
+
+
+def edge_next(timeout_ms: int = 200) -> tuple[int, int, int]:
+    """Claim the next parsed edge request. Returns (rc, id, kind) where rc
+    is EDGE_GOT / EDGE_TIMEOUT / EDGE_STOPPED. Claiming also stamps the
+    request's EV_RECV span into THIS thread's telemetry ring (the claiming
+    dispatcher is the thread that runs the native parse, so recv and parse
+    spans drain together)."""
+    if _lib is None or not _edge_ok:
+        return EDGE_STOPPED, 0, 0
+    rid = ctypes.c_uint64()
+    kind = ctypes.c_int()
+    rc = _lib.ptpu_edge_next(ctypes.byref(rid), ctypes.byref(kind), timeout_ms)
+    return int(rc), int(rid.value), int(kind.value)
+
+
+def _edge_view(fn, rid: int) -> CBuf | None:
+    ptr = ctypes.c_void_p()
+    length = ctypes.c_uint64()
+    if fn(rid, ctypes.byref(ptr), ctypes.byref(length)) != 0:
+        return None
+    return CBuf(ptr.value or 0, int(length.value))
+
+
+def edge_req_stream(rid: int) -> str | None:
+    """Decoded stream name of a claimed request (empty for declines)."""
+    view = _edge_view(_lib.ptpu_edge_req_stream, rid)
+    if view is None:
+        return None
+    return view.tobytes().decode("utf-8", "replace")
+
+
+def edge_req_body(rid: int) -> CBuf | None:
+    """Borrowed zero-copy view of a claimed request's decoded body — THE
+    shard-arena buffer the native parse consumes. Valid until respond."""
+    return _edge_view(_lib.ptpu_edge_req_body, rid)
+
+
+def edge_req_raw(rid: int) -> CBuf | None:
+    """Borrowed view of the request verbatim as received (decline replay)."""
+    return _edge_view(_lib.ptpu_edge_req_raw, rid)
+
+
+def edge_req_trace(rid: int) -> str:
+    """The request's traceparent header value ("" when absent)."""
+    view = _edge_view(_lib.ptpu_edge_req_trace, rid)
+    return "" if view is None else view.tobytes().decode("ascii", "replace")
+
+
+def edge_req_reason(rid: int) -> str:
+    """Decline reason label for a claimed request."""
+    rc = int(_lib.ptpu_edge_req_reason(rid))
+    return EDGE_REASONS.get(rc, str(rc))
+
+
+def edge_respond_ack(rid: int, rows: int, trace_id: str = "") -> None:
+    """Write the happy-path 200 ack (row count + X-P-Trace-Id echo) from C
+    and release the request."""
+    t = trace_id.encode()
+    _lib.ptpu_edge_respond_ack(rid, rows, t, len(t))
+
+
+def edge_respond(rid: int, status: int, body: bytes, trace_id: str = "") -> None:
+    """Write an error/detour JSON response (Python mirrors the aiohttp
+    handlers' bodies) and release the request."""
+    t = trace_id.encode()
+    _lib.ptpu_edge_respond(rid, status, body, len(body), t, len(t))
+
+
+def edge_respond_raw(rid: int, data: bytes, close_after: bool = False) -> None:
+    """Relay an upstream (aiohttp) response verbatim and release the
+    request — the decline tier's byte-identity contract."""
+    _lib.ptpu_edge_respond_raw(rid, data, len(data), 1 if close_after else 0)
+
+
+def edge_live() -> int:
+    """Claimed-but-unresponded edge requests (leak-detector hook, mirrors
+    columnar_live/telem_live)."""
+    if _lib is None or not _edge_ok:
+        return 0
+    return int(_lib.ptpu_edge_live())
+
+
+def edge_counter(which: int) -> int:
+    """Edge counters: 0 conns, 1 requests, 2 happy, 3 declined, 4 direct
+    C-side error responses, 5 auth misses."""
+    if _lib is None or not _edge_ok:
+        return 0
+    return int(_lib.ptpu_edge_counter(which))
+
+
+def edge_parse_probe(payload: bytes, chunk: int = 0) -> int:
+    """Fuzz/test hook: drive raw HTTP bytes through the edge request parser
+    in `chunk`-sized feeds (0 = one shot), no sockets or threads. Returns
+    the completed-request count, or -1 on a parser hard error."""
+    lib = _load()
+    if lib is None or not _edge_ok:
+        return 0
+    return int(lib.ptpu_edge_parse_probe(payload, len(payload), chunk))
 
 
 def _borrowed_ptr(buf: bytes | bytearray) -> ctypes.c_void_p:

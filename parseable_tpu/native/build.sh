@@ -61,7 +61,12 @@ for sym in ptpu_flatten_columnar ptpu_otel_logs_columnar ptpu_cols_free \
            ptpu_parse_pool_shutdown ptpu_parse_pool_size \
            ptpu_telem_enable ptpu_telem_enabled ptpu_telem_drain \
            ptpu_telem_free ptpu_telem_live ptpu_telem_drops \
-           ptpu_telem_pool_queue_depth ptpu_telem_pool_busy_ns; do
+           ptpu_telem_pool_queue_depth ptpu_telem_pool_busy_ns \
+           ptpu_edge_start ptpu_edge_stop ptpu_edge_auth_set \
+           ptpu_edge_next ptpu_edge_req_stream ptpu_edge_req_body \
+           ptpu_edge_req_raw ptpu_edge_req_trace ptpu_edge_req_reason \
+           ptpu_edge_respond_ack ptpu_edge_respond ptpu_edge_respond_raw \
+           ptpu_edge_live ptpu_edge_counter ptpu_edge_parse_probe; do
   printf '%s\n' "$syms" | grep -q "[[:space:]]$sym\$" || {
     echo "build.sh: missing export $sym" >&2
     exit 1

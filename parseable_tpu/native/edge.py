@@ -1,0 +1,380 @@
+"""Native HTTP ingest edge: the Python half of the ptpu_edge_* acceptor.
+
+The C++ side (fastpath.cpp `edge` namespace) owns the listener socket, the
+epoll loop, HTTP/1.1 framing, the constant-time auth-snapshot check, and
+response writes. This module runs the dispatcher threads that claim parsed
+requests (`native.edge_next`), run the native parse ladder straight off the
+C-owned body buffer (zero-copy via native.CBuf — no Python `bytes` of the
+payload on the happy path), book acked rows through the conservation
+ledger, and ack from C. Anything the C side classified as a decline — or
+anything that fails Python-side checks here — replays VERBATIM against the
+local aiohttp tier over a persistent loopback connection, and the upstream
+response relays back byte-identical (the columnar -> ndjson -> python
+ladder idiom, applied to the whole HTTP request).
+
+Lifecycle: ServerState owns one EdgeServer (run_server starts it when
+P_EDGE_PORT > 0, ServerState.stop() stops it); RBAC mutations call
+refresh_auth() so the C-side token snapshot never lags a revocation.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import socket
+import threading
+import time
+
+from parseable_tpu import native
+from parseable_tpu.config import edge_options
+
+logger = logging.getLogger(__name__)
+
+# edge request kind -> (log source name, telemetry type) for the hot routes
+_KIND_SOURCE = {
+    native.EDGE_JSON: ("json", "logs"),
+    native.EDGE_LOGSTREAM: ("json", "logs"),
+    native.EDGE_OTEL_LOGS: ("otel-logs", "logs"),
+    native.EDGE_OTEL_METRICS: ("otel-metrics", "metrics"),
+    native.EDGE_OTEL_TRACES: ("otel-traces", "traces"),
+}
+
+
+def _json_body(obj) -> bytes:
+    # match aiohttp's web.json_response body bytes (default json.dumps
+    # separators) so both tiers answer errors identically
+    return json.dumps(obj).encode()
+
+
+class _Upstream:
+    """One persistent loopback connection to the aiohttp tier, owned by one
+    dispatcher thread: declined requests replay through it verbatim and the
+    response bytes come back exactly as aiohttp framed them."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.sock: socket.socket | None = None
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _connect(self) -> socket.socket:
+        if self.sock is None:
+            self.sock = socket.create_connection((self.host, self.port), timeout=30)
+        return self.sock
+
+    def roundtrip(self, raw: bytes) -> tuple[bytes, bool] | None:
+        """Send one buffered request verbatim; return (response_bytes,
+        upstream_closed) or None when the upstream is unreachable. One
+        reconnect retry covers a keep-alive connection the server aged out
+        between declines."""
+        for attempt in (0, 1):
+            try:
+                s = self._connect()
+                s.sendall(raw)
+                return self._read_response(s)
+            except (OSError, ValueError):
+                self.close()
+                if attempt == 1:
+                    return None
+        return None
+
+    def _read_response(self, s: socket.socket) -> tuple[bytes, bool]:
+        """Read exactly one final HTTP response (interim 1xx responses are
+        consumed and dropped, standard client behavior), returning its raw
+        bytes and whether the upstream signalled connection close."""
+        while True:
+            head, rest = self._read_head(s)
+            status = int(head.split(b" ", 2)[1])
+            if 100 <= status < 200:
+                # interim response: headerless body by definition; drop it
+                self._unread = rest
+                continue
+            break
+        headers = self._parse_headers(head)
+        close = b"close" in headers.get(b"connection", b"").lower()
+        chunked = b"chunked" in headers.get(b"transfer-encoding", b"").lower()
+        resp = bytearray(head)
+        if chunked:
+            rest = self._read_chunked(s, rest, resp)
+        elif b"content-length" in headers:
+            need = int(headers[b"content-length"])
+            while len(rest) < need:
+                more = s.recv(65536)
+                if not more:
+                    raise ValueError("truncated upstream response")
+                rest += more
+            resp += rest[:need]
+            rest = rest[need:]
+        else:
+            # no framing: body runs to EOF (aiohttp only does this with
+            # Connection: close)
+            resp += rest
+            while True:
+                more = s.recv(65536)
+                if not more:
+                    break
+                resp += more
+            close = True
+        self._unread = rest
+        if close:
+            self.close()
+        return bytes(resp), close
+
+    _unread = b""
+
+    def _read_head(self, s: socket.socket) -> tuple[bytes, bytes]:
+        buf = bytearray(self._unread)
+        self._unread = b""
+        while b"\r\n\r\n" not in buf:
+            more = s.recv(65536)
+            if not more:
+                raise ValueError("upstream closed mid-headers")
+            buf += more
+        i = buf.index(b"\r\n\r\n") + 4
+        return bytes(buf[:i]), bytes(buf[i:])
+
+    @staticmethod
+    def _parse_headers(head: bytes) -> dict[bytes, bytes]:
+        headers: dict[bytes, bytes] = {}
+        for line in head.split(b"\r\n")[1:]:
+            if b":" in line:
+                k, v = line.split(b":", 1)
+                headers[k.strip().lower()] = v.strip()
+        return headers
+
+    def _read_chunked(self, s: socket.socket, rest: bytes, resp: bytearray) -> bytes:
+        buf = bytearray(rest)
+
+        def fill() -> None:
+            more = s.recv(65536)
+            if not more:
+                raise ValueError("truncated chunked upstream response")
+            buf.extend(more)
+
+        while True:
+            while b"\r\n" not in buf:
+                fill()
+            line, _, tail = bytes(buf).partition(b"\r\n")
+            size = int(line.split(b";")[0], 16)
+            del buf[: len(line) + 2]
+            resp += line + b"\r\n"
+            need = size + 2  # chunk data + CRLF
+            while len(buf) < need:
+                fill()
+            resp += bytes(buf[:need])
+            del buf[:need]
+            if size == 0:
+                # the 0-chunk's trailing CRLF was just consumed (empty
+                # trailer section); aiohttp emits no trailers
+                return bytes(buf)
+
+
+class EdgeServer:
+    """Owns the native acceptor's lifetime plus N dispatcher threads."""
+
+    def __init__(self, state, port: int, dispatchers: int | None = None,
+                 max_body: int | None = None):
+        opts = edge_options()
+        self.state = state
+        self.max_body = opts["max_body"] if max_body is None else max_body
+        self.dispatchers = (
+            opts["dispatchers"] if dispatchers is None else dispatchers
+        )
+        self._threads: list[threading.Thread] = []
+        self.port = native.edge_start(port, self.max_body)
+        if self.port < 0:
+            raise RuntimeError("native ingest edge failed to start")
+        self.refresh_auth()
+        host, _, upstream_port = state.p.options.address.rpartition(":")
+        self._upstream_host = (
+            "127.0.0.1" if host in ("", "0.0.0.0", "::") else host
+        )
+        self._upstream_port = int(upstream_port or 8000)
+        for i in range(max(1, self.dispatchers)):
+            t = threading.Thread(
+                target=self._dispatch_loop, name=f"edge-dispatch-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        logger.info(
+            "native ingest edge listening on :%d (%d dispatchers, max body %d)",
+            self.port, len(self._threads), self.max_body,
+        )
+
+    # ----- auth snapshot ----------------------------------------------------
+    def refresh_auth(self) -> None:
+        """Push the full set of Authorization header values the C side may
+        accept: the root user's Basic credentials (the only plaintext the
+        server holds) and Bearer session tokens for users holding GLOBAL
+        ingest rights. Scoped users (per-stream grants) and scrypt-hashed
+        Basic credentials decline to the aiohttp tier, which answers with
+        full RBAC semantics — a snapshot miss is never a denial."""
+        from parseable_tpu.rbac import Action
+
+        state = self.state
+        tokens: list[str] = []
+        opts = state.p.options
+        now = time.time()
+        if state.rbac.authorize(opts.username, Action.INGEST, None):
+            cred = base64.b64encode(
+                f"{opts.username}:{opts.password}".encode()
+            ).decode()
+            tokens.append(f"Basic {cred}")
+        for key, sess in list(state.rbac.sessions.items()):
+            if sess.expires_at < now:
+                continue
+            u = sess.username
+            if (
+                state.rbac.user_allowed_streams(u) is None
+                and state.rbac.authorize(u, Action.INGEST, None)
+            ):
+                tokens.append(f"Bearer {key}")
+        native.edge_auth_set(tokens)
+
+    # ----- lifecycle --------------------------------------------------------
+    def stop(self) -> None:
+        """Stop the acceptor, then join the dispatchers: edge_next returns
+        EDGE_STOPPED once the ready queue drains, and every claimed request
+        is responded before its dispatcher exits — edge_live() lands at 0."""
+        native.edge_stop()
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads.clear()
+
+    # ----- dispatch ---------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        upstream = _Upstream(self._upstream_host, self._upstream_port)
+        try:
+            while True:
+                rc, rid, kind = native.edge_next(200)
+                if rc == native.EDGE_STOPPED:
+                    return
+                if rc != native.EDGE_GOT:
+                    continue
+                try:
+                    self._handle(rid, kind, upstream)
+                except Exception:
+                    # the dispatcher must survive anything; the request
+                    # still gets an answer so edge_live() drains
+                    logger.exception("edge request %d failed", rid)
+                    try:
+                        native.edge_respond(
+                            rid, 500, _json_body({"error": "internal error"})
+                        )
+                    except Exception:
+                        pass
+        finally:
+            upstream.close()
+
+    def _handle(self, rid: int, kind: int, upstream: _Upstream) -> None:
+        from parseable_tpu.utils.metrics import INGEST_NATIVE
+
+        if kind == native.EDGE_DECLINE:
+            INGEST_NATIVE.labels("edge", "declined").inc()
+            self._relay(rid, upstream)
+            return
+        body = native.edge_req_body(rid)
+        if body is None:
+            return  # request vanished (stop raced); nothing to answer
+        if len(body) > self.state.p.options.max_event_payload_bytes:
+            # over the soft per-event cap: the aiohttp handler owns the 413
+            # so the limit lives in exactly one place — replay verbatim
+            INGEST_NATIVE.labels("edge", "declined").inc()
+            self._relay(rid, upstream)
+            return
+        INGEST_NATIVE.labels("edge", "hit").inc()
+        self._ingest(rid, kind, body)
+
+    def _ingest(self, rid: int, kind: int, body) -> None:
+        from parseable_tpu.core import StreamError
+        from parseable_tpu.event.format import LogSource
+        from parseable_tpu.event.json_format import EventError
+        from parseable_tpu.server.ingest_utils import (
+            IngestError,
+            _emit_native_telem,
+            flatten_and_push_logs,
+        )
+        from parseable_tpu.utils import telemetry
+
+        state = self.state
+        stream_name = native.edge_req_stream(rid) or ""
+        source_name, telemetry_type = _KIND_SOURCE[kind]
+        log_source = LogSource.from_str(source_name)
+        traceparent = native.edge_req_trace(rid) or None
+        telem_on = native.telem_sync()
+        with telemetry.trace_context(traceparent) as trace_id:
+            try:
+                try:
+                    state.p.create_stream_if_not_exists(
+                        stream_name,
+                        log_source=log_source,
+                        telemetry_type=telemetry_type,
+                    )
+                    # baseline BEFORE the push (audit.py Ledger contract)
+                    state.p.audit.ensure_stream(state.p, stream_name)
+                    count = flatten_and_push_logs(
+                        state.p,
+                        stream_name,
+                        None,
+                        log_source,
+                        {},
+                        origin_size=len(body),
+                        log_source_name=source_name,
+                        raw_body=body,
+                    )
+                    state.p.audit.record_acked(stream_name, count)
+                except (IngestError, StreamError, EventError) as e:
+                    native.edge_respond(
+                        rid, 400, _json_body({"error": str(e)}), trace_id
+                    )
+                    return
+                native.edge_respond_ack(rid, count, trace_id)
+            finally:
+                # backstop drain inside the trace context: when no native
+                # parse tier ran (and so no drain happened), the EV_RECV
+                # span stamped at claim time must not leak into the next
+                # request's trace on this thread
+                _emit_native_telem(None, telem_on)
+
+    def _relay(self, rid: int, upstream: _Upstream) -> None:
+        raw = native.edge_req_raw(rid)
+        if raw is None:
+            return
+        result = upstream.roundtrip(raw.tobytes())
+        if result is None:
+            native.edge_respond(
+                rid, 503, _json_body({"error": "ingest tier unavailable"})
+            )
+            return
+        resp, upstream_closed = result
+        native.edge_respond_raw(rid, resp, close_after=upstream_closed)
+
+
+def maybe_start_edge(state) -> EdgeServer | None:
+    """Start the edge for a serving process when configured: P_EDGE_PORT > 0,
+    an ingesting mode, and the edge ABI present. Returns None (logged) on
+    any miss — the aiohttp tier alone is always a correct server."""
+    from parseable_tpu.config import Mode
+
+    opts = edge_options()
+    port = opts["port"]
+    if port <= 0:
+        return None
+    if state.p.options.mode not in (Mode.ALL, Mode.INGEST):
+        return None
+    if not native.edge_available():
+        logger.warning("P_EDGE_PORT=%d set but the native edge ABI is unavailable", port)
+        return None
+    try:
+        return EdgeServer(state, port)
+    except RuntimeError:
+        logger.exception("native ingest edge failed to start on port %d", port)
+        return None

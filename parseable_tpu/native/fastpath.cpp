@@ -3661,3 +3661,996 @@ uint64_t ptpu_telem_pool_busy_ns(int worker) {
 }
 
 }  // extern "C"
+
+// ======================= native ingest edge (ptpu_edge_*) ===================
+//
+// A minimal epoll-driven HTTP/1.1 acceptor on its own listener port
+// (P_EDGE_PORT): request line + headers + Content-Length/chunked bodies are
+// parsed here, POST bodies land in C++-owned buffers the sharded parser
+// consumes zero-copy, and the ack is written back without a Python object
+// per request. Anything off the hot path (bad auth, unknown route, odd
+// headers, malformed framing) is handed to the aiohttp tier VERBATIM — the
+// buffered request bytes replay upstream so every decline is byte-identical
+// to the pure-Python server (the same ladder idiom as columnar -> ndjson ->
+// python). The epoll thread owns all sockets and parser state; Python
+// dispatcher threads claim parsed requests via ptpu_edge_next and deliver
+// responses via ptpu_edge_respond_* (outbox append + eventfd wake).
+
+#include <cerrno>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+namespace edge {
+
+// request kinds handed to Python (mirrored in native/__init__.py)
+enum {
+    REQ_JSON = 0,          // POST /api/v1/ingest (stream from X-P-Stream)
+    REQ_LOGSTREAM = 1,     // POST /api/v1/logstream/{name}
+    REQ_OTEL_LOGS = 2,     // POST /v1/logs
+    REQ_OTEL_METRICS = 3,  // POST /v1/metrics
+    REQ_OTEL_TRACES = 4,   // POST /v1/traces
+    REQ_DECLINE = 100,     // replay the raw request through aiohttp
+};
+
+// decline reasons (observability; the decline behavior never branches on it)
+enum {
+    DECL_NONE = 0,
+    DECL_METHOD = 1,   // not POST
+    DECL_ROUTE = 2,    // target not a hot ingest route
+    DECL_AUTH = 3,     // Authorization missed the pushed token snapshot
+    DECL_HEADER = 4,   // tenant/custom-field/log-source header needs Python
+    DECL_FRAMING = 5,  // malformed HTTP framing (relay + close)
+    DECL_VERSION = 6,  // not HTTP/1.1
+};
+
+// telemetry event kind for the wire->memory span (rides the telem ring;
+// TELEM_EV_RECV in native/__init__.py next to EV_PARSE/EV_STITCH)
+enum { EV_RECV = 2 };
+
+// edge counters (ptpu_edge_counter): accepted conns, parsed requests,
+// happy-path requests, declined requests, direct C-side error responses,
+// auth-snapshot misses
+enum { C_CONNS = 0, C_REQS = 1, C_HAPPY = 2, C_DECLINED = 3, C_DIRECT = 4,
+       C_AUTH_MISS = 5, C_NCOUNTERS = 6 };
+std::atomic<uint64_t> g_counters[C_NCOUNTERS];
+
+struct Req {
+    uint64_t id = 0;
+    int kind = REQ_DECLINE;
+    int reason = DECL_NONE;
+    int close_after = 0;        // connection must close after the response
+    std::string stream;         // decoded stream name (happy kinds)
+    std::string trace;          // traceparent header value (may be empty)
+    std::string body;           // decoded body (the shard-arena buffer)
+    std::string raw;            // the request verbatim as received (declines)
+    uint64_t conn_id = 0;
+    uint64_t start_ns = 0;      // first byte of this request seen
+    uint64_t dur_ns = 0;        // until the body completed (the recv span)
+};
+
+inline uint64_t lane_of(int kind) {
+    switch (kind) {
+        case REQ_OTEL_LOGS: return telem::LANE_OTEL_LOGS;
+        case REQ_OTEL_METRICS: return telem::LANE_OTEL_METRICS;
+        case REQ_OTEL_TRACES: return telem::LANE_OTEL_TRACES;
+        default: return telem::LANE_JSON;
+    }
+}
+
+inline std::string lower(std::string s) {
+    for (char& c : s)
+        if (c >= 'A' && c <= 'Z') c = (char)(c - 'A' + 'a');
+    return s;
+}
+
+inline std::string trim(const std::string& s) {
+    size_t b = 0, e = s.size();
+    while (b < e && (s[b] == ' ' || s[b] == '\t')) b++;
+    while (e > b && (s[e - 1] == ' ' || s[e - 1] == '\t')) e--;
+    return s.substr(b, e - b);
+}
+
+// constant-time header-value compare (the auth snapshot check must not
+// leak a prefix-length oracle through early exit)
+inline bool ct_equal(const std::string& a, const std::string& b) {
+    if (a.size() != b.size()) return false;
+    unsigned char d = 0;
+    for (size_t i = 0; i < a.size(); i++)
+        d |= (unsigned char)(a[i] ^ b[i]);
+    return d == 0;
+}
+
+// ---- incremental HTTP/1.1 request parser (socket-independent: the epoll
+// loop feeds it recv() slices; ptpu_edge_parse_probe feeds it raw bytes for
+// the fuzzer). One Parser per connection; emits Req* into `out`.
+struct Parser {
+    enum State { S_HEAD, S_BODY_CL, S_CHUNK_SIZE, S_CHUNK_DATA, S_CHUNK_CRLF,
+                 S_TRAILER };
+    std::string buf;           // unconsumed wire bytes
+    State state = S_HEAD;
+    uint64_t max_buf = 64ull << 20;  // hard cap (P_INGEST_MAX_BODY_BYTES)
+    Req* cur = nullptr;        // request being assembled (body phase)
+    uint64_t need = 0;         // CL remaining / current chunk remaining
+    bool send_continue = false;
+
+    ~Parser() { delete cur; }
+
+    // returns 0 = ok, -1 = fatal framing/limit error: `direct` holds the
+    // canned response to write before closing. Completed requests are
+    // appended to `out` (at most `max_reqs` per call when > 0 — the conn
+    // pauses between pipelined requests so responses stay ordered).
+    int feed(const char* p, size_t n, std::vector<Req*>& out,
+             std::string& direct, int max_reqs) {
+        if (p != nullptr && n > 0) {
+            if (buf.size() + n > max_buf + (64ull << 10)) {
+                direct = canned(413, "{\"error\": \"payload too large\"}");
+                return -1;
+            }
+            buf.append(p, n);
+        }
+        for (;;) {
+            if (max_reqs > 0 && (int)out.size() >= max_reqs) return 0;
+            switch (state) {
+                case S_HEAD: {
+                    if (buf.empty()) return 0;
+                    if (cur == nullptr) {
+                        cur = new Req();
+                        cur->start_ns = telem::now_ns();
+                    }
+                    size_t he = buf.find("\r\n\r\n");
+                    if (he == std::string::npos) {
+                        if (buf.size() > (64ull << 10)) {
+                            direct = canned(400, "{\"error\": \"header block too large\"}");
+                            return -1;
+                        }
+                        return 0;
+                    }
+                    size_t head_len = he + 4;
+                    if (parse_head(head_len, direct) != 0) return -1;
+                    break;
+                }
+                case S_BODY_CL: {
+                    size_t take = (size_t)std::min<uint64_t>(need, buf.size());
+                    if (take > 0) {
+                        cur->body.append(buf, 0, take);
+                        cur->raw.append(buf, 0, take);
+                        buf.erase(0, take);
+                        need -= take;
+                    }
+                    if (need > 0) return 0;
+                    finish(out);
+                    break;
+                }
+                case S_CHUNK_SIZE: {
+                    size_t le = buf.find("\r\n");
+                    if (le == std::string::npos) {
+                        if (buf.size() > 1024) {
+                            direct = canned(400, "{\"error\": \"bad chunk size\"}");
+                            return -1;
+                        }
+                        return 0;
+                    }
+                    // hex size, optional ;chunk-extension garbage tolerated
+                    uint64_t sz = 0;
+                    size_t i = 0;
+                    bool any = false;
+                    for (; i < le; i++) {
+                        char c = buf[i];
+                        int v;
+                        if (c >= '0' && c <= '9') v = c - '0';
+                        else if (c >= 'a' && c <= 'f') v = c - 'a' + 10;
+                        else if (c >= 'A' && c <= 'F') v = c - 'A' + 10;
+                        else break;
+                        if (sz > (max_buf >> 4) + 1) {  // overflow guard
+                            direct = canned(413, "{\"error\": \"payload too large\"}");
+                            return -1;
+                        }
+                        sz = sz * 16 + (uint64_t)v;
+                        any = true;
+                    }
+                    if (!any || (i < le && buf[i] != ';')) {
+                        direct = canned(400, "{\"error\": \"bad chunk size\"}");
+                        return -1;
+                    }
+                    cur->raw.append(buf, 0, le + 2);
+                    buf.erase(0, le + 2);
+                    need = sz;
+                    state = sz == 0 ? S_TRAILER : S_CHUNK_DATA;
+                    break;
+                }
+                case S_CHUNK_DATA: {
+                    if (cur->body.size() + need > max_buf) {
+                        direct = canned(413, "{\"error\": \"payload too large\"}");
+                        return -1;
+                    }
+                    size_t take = (size_t)std::min<uint64_t>(need, buf.size());
+                    if (take > 0) {
+                        cur->body.append(buf, 0, take);
+                        cur->raw.append(buf, 0, take);
+                        buf.erase(0, take);
+                        need -= take;
+                    }
+                    if (need > 0) return 0;
+                    state = S_CHUNK_CRLF;
+                    break;
+                }
+                case S_CHUNK_CRLF: {
+                    if (buf.size() < 2) return 0;
+                    if (buf[0] != '\r' || buf[1] != '\n') {
+                        direct = canned(400, "{\"error\": \"bad chunk framing\"}");
+                        return -1;
+                    }
+                    cur->raw.append(buf, 0, 2);
+                    buf.erase(0, 2);
+                    state = S_CHUNK_SIZE;
+                    break;
+                }
+                case S_TRAILER: {
+                    // consume trailer lines until the terminating CRLF
+                    size_t le = buf.find("\r\n");
+                    if (le == std::string::npos) {
+                        if (buf.size() > (16ull << 10)) {
+                            direct = canned(400, "{\"error\": \"trailer too large\"}");
+                            return -1;
+                        }
+                        return 0;
+                    }
+                    cur->raw.append(buf, 0, le + 2);
+                    buf.erase(0, le + 2);
+                    if (le == 0) finish(out);  // blank line ends the trailers
+                    break;
+                }
+            }
+        }
+    }
+
+    static std::string canned(int status, const std::string& body) {
+        const char* reason = status == 413 ? "Payload Too Large" : "Bad Request";
+        std::string r = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                        "\r\nContent-Type: application/json\r\nContent-Length: " +
+                        std::to_string(body.size()) + "\r\nConnection: close\r\n\r\n";
+        r += body;
+        return r;
+    }
+
+    void decline(int reason, bool close_conn) {
+        cur->kind = REQ_DECLINE;
+        if (cur->reason == DECL_NONE) cur->reason = reason;
+        if (close_conn) cur->close_after = 1;
+    }
+
+    void finish(std::vector<Req*>& out) {
+        cur->dur_ns = telem::now_ns() - cur->start_ns;
+        out.push_back(cur);
+        cur = nullptr;
+        need = 0;
+        state = S_HEAD;
+    }
+
+    // Parse + classify one complete header block ([0, head_len) of buf).
+    // Sets body framing state; on any hard error fills `direct` and
+    // returns -1. Soft problems classify the request as a decline but the
+    // body is still read so the replay has the complete request.
+    int parse_head(size_t head_len, std::string& direct) {
+        cur->raw.assign(buf, 0, head_len);
+        std::string head = buf.substr(0, head_len - 2);  // keep final CRLF off
+        buf.erase(0, head_len);
+
+        size_t rl_end = head.find("\r\n");
+        std::string rl = head.substr(0, rl_end);
+        size_t sp1 = rl.find(' ');
+        size_t sp2 = rl.rfind(' ');
+        if (sp1 == std::string::npos || sp2 == sp1) {
+            direct = canned(400, "{\"error\": \"bad request line\"}");
+            return -1;
+        }
+        std::string method = rl.substr(0, sp1);
+        std::string target = rl.substr(sp1 + 1, sp2 - sp1 - 1);
+        std::string version = rl.substr(sp2 + 1);
+        if (version != "HTTP/1.1") {
+            // HTTP/1.0 (and anything else) replays through aiohttp; its
+            // keep-alive semantics differ, so the conn closes afterwards
+            decline(DECL_VERSION, true);
+        }
+
+        // headers: strict CRLF framing, no obs-fold; duplicate
+        // Content-Length / CL+TE conflicts are smuggling vectors -> the
+        // request declines AND the connection closes after the replay
+        uint64_t content_length = 0;
+        int cl_seen = 0;
+        bool chunked = false, te_seen = false, conn_close = false;
+        std::string auth_header, lsrc = "json";
+        size_t pos = rl_end == std::string::npos ? head.size() : rl_end + 2;
+        while (pos < head.size()) {
+            size_t le = head.find("\r\n", pos);
+            if (le == std::string::npos) le = head.size();
+            std::string line = head.substr(pos, le - pos);
+            pos = le + 2;
+            if (line.empty()) continue;
+            if (line[0] == ' ' || line[0] == '\t') {  // obs-fold
+                decline(DECL_FRAMING, true);
+                continue;
+            }
+            size_t c = line.find(':');
+            if (c == std::string::npos) {
+                decline(DECL_FRAMING, true);
+                continue;
+            }
+            std::string name = lower(trim(line.substr(0, c)));
+            std::string value = trim(line.substr(c + 1));
+            if (name == "content-length") {
+                cl_seen++;
+                uint64_t v = 0;
+                bool ok = !value.empty();
+                for (char ch : value) {
+                    if (ch < '0' || ch > '9') { ok = false; break; }
+                    if (v > max_buf) break;  // saturate past the cap
+                    v = v * 10 + (uint64_t)(ch - '0');
+                }
+                if (!ok || (cl_seen > 1 && v != content_length))
+                    decline(DECL_FRAMING, true);
+                content_length = v;
+            } else if (name == "transfer-encoding") {
+                te_seen = true;
+                if (lower(value) == "chunked") chunked = true;
+                else decline(DECL_FRAMING, true);
+            } else if (name == "authorization") {
+                auth_header = value;
+            } else if (name == "connection") {
+                if (lower(value).find("close") != std::string::npos)
+                    conn_close = true;
+            } else if (name == "expect") {
+                if (lower(value) == "100-continue") send_continue = true;
+                else decline(DECL_HEADER, false);
+            } else if (name == "x-p-stream") {
+                cur->stream = value;
+            } else if (name == "traceparent") {
+                cur->trace = value;
+            } else if (name == "x-p-log-source") {
+                lsrc = lower(value);
+            } else if (name.compare(0, 4, "x-p-") == 0 &&
+                       name != "x-p-trace-id") {
+                // tenant checks, custom fields (X-P-Meta-*), cache toggles:
+                // Python-side semantics -> decline
+                decline(DECL_HEADER, false);
+            }
+        }
+        if (cl_seen > 0 && te_seen) decline(DECL_FRAMING, true);
+        if (conn_close) cur->close_after = 1;
+
+        // route + method classification (only exact hot ingest routes stay)
+        if (cur->kind != REQ_DECLINE || cur->reason == DECL_NONE) {
+            int kind = -1;
+            if (target == "/api/v1/ingest") kind = REQ_JSON;
+            else if (target == "/v1/logs") kind = REQ_OTEL_LOGS;
+            else if (target == "/v1/metrics") kind = REQ_OTEL_METRICS;
+            else if (target == "/v1/traces") kind = REQ_OTEL_TRACES;
+            else if (target.compare(0, 18, "/api/v1/logstream/") == 0 &&
+                     target.size() > 18) {
+                std::string name = target.substr(18);
+                if (name.find('/') == std::string::npos &&
+                    name.find('%') == std::string::npos &&
+                    name.find('?') == std::string::npos) {
+                    kind = REQ_LOGSTREAM;
+                    cur->stream = name;
+                }
+            }
+            if (kind < 0) decline(DECL_ROUTE, false);
+            else if (method != "POST") decline(DECL_METHOD, false);
+            else {
+                cur->kind = kind;
+                if (kind == REQ_JSON && cur->stream.empty())
+                    decline(DECL_HEADER, false);  // aiohttp's 400, verbatim
+                if (lsrc != "json" && (kind == REQ_JSON || kind == REQ_LOGSTREAM))
+                    decline(DECL_HEADER, false);  // non-json source ladder
+                if ((kind == REQ_OTEL_LOGS || kind == REQ_OTEL_METRICS ||
+                     kind == REQ_OTEL_TRACES) && cur->stream.empty())
+                    cur->stream = kind == REQ_OTEL_LOGS ? "otel-logs"
+                                  : kind == REQ_OTEL_METRICS ? "otel-metrics"
+                                                             : "otel-traces";
+                if (cur->kind != REQ_DECLINE && !check_auth(auth_header)) {
+                    g_counters[C_AUTH_MISS].fetch_add(1, std::memory_order_relaxed);
+                    decline(DECL_AUTH, false);
+                }
+            }
+        }
+
+        if (chunked) {
+            state = S_CHUNK_SIZE;
+        } else {
+            if (content_length > max_buf) {
+                direct = canned(413, "{\"error\": \"payload too large\"}");
+                return -1;
+            }
+            need = content_length;
+            state = S_BODY_CL;
+        }
+        return 0;
+    }
+
+    static bool check_auth(const std::string& header);
+};
+
+struct Conn {
+    int fd = -1;
+    uint64_t id = 0;
+    Parser parser;             // epoll thread only
+    std::string out;           // guarded-by: g_edge_mu (respond appends)
+    bool close_after_write = false;  // guarded-by: g_edge_mu
+    bool inflight = false;     // guarded-by: g_edge_mu (a claimed request)
+    bool want_resume = false;  // guarded-by: g_edge_mu (respond -> loop)
+    bool want_write = false;   // epoll thread only: EPOLLOUT armed
+};
+
+// lock-id: edge_mu — leaf lock: never held while acquiring another lock,
+// and respond/next callers run with the GIL released (ctypes)
+std::mutex g_edge_mu;
+std::condition_variable g_edge_cv;
+std::deque<Req*> g_ready;                       // guarded-by: g_edge_mu
+std::unordered_map<uint64_t, Req*> g_claimed;   // guarded-by: g_edge_mu
+std::unordered_map<uint64_t, Conn*> g_conns;    // guarded-by: g_edge_mu
+std::vector<std::string> g_auth;                // guarded-by: g_edge_mu
+bool g_running = false;                         // guarded-by: g_edge_mu
+bool g_stopping = false;                        // guarded-by: g_edge_mu
+std::atomic<long long> g_live{0};  // claimed, unresponded requests
+int g_listen_fd = -1, g_epoll_fd = -1, g_event_fd = -1;
+uint64_t g_max_buf = 64ull << 20;
+uint64_t g_next_conn = 2;  // 0 = listener, 1 = eventfd in epoll data
+uint64_t g_next_req = 1;   // guarded-by: g_edge_mu
+// intentionally leaked on exit, same rationale as ppool::g_workers: a
+// static std::thread destructor would terminate() on interpreter exit
+std::thread* g_thread = nullptr;
+
+bool Parser::check_auth(const std::string& header) {
+    if (header.empty()) return false;
+    std::lock_guard<std::mutex> lk(g_edge_mu);
+    bool ok = false;
+    for (const std::string& tok : g_auth)
+        ok |= ct_equal(header, tok);  // no early exit: constant-time scan
+    return ok;
+}
+
+void wake_loop() {
+    uint64_t one = 1;
+    ssize_t r = write(g_event_fd, &one, sizeof(one));
+    (void)r;
+}
+
+void close_conn(Conn* c) {
+    epoll_ctl(g_epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    std::lock_guard<std::mutex> lk(g_edge_mu);
+    g_conns.erase(c->id);
+    delete c;  // Conn objects die only under g_edge_mu (respond looks up)
+}
+
+void arm(Conn* c, bool want_in, bool want_out);
+
+// flush the outbox; returns false when the conn was closed
+bool flush_out(Conn* c) {
+    std::string pending;
+    bool close_after;
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        pending.swap(c->out);
+        close_after = c->close_after_write;
+    }
+    size_t off = 0;
+    while (off < pending.size()) {
+        ssize_t n = send(c->fd, pending.data() + off, pending.size() - off,
+                         MSG_NOSIGNAL);
+        if (n > 0) { off += (size_t)n; continue; }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+        close_conn(c);
+        return false;
+    }
+    bool drained = off >= pending.size();
+    if (!drained) {
+        {
+            std::lock_guard<std::mutex> lk(g_edge_mu);
+            c->out.insert(0, pending, off, pending.size() - off);
+        }
+        arm(c, false, true);  // finish the write before reading again
+        return true;
+    }
+    if (close_after) {
+        close_conn(c);
+        return false;
+    }
+    return true;
+}
+
+void arm(Conn* c, bool want_in, bool want_out) {
+    epoll_event ev{};
+    ev.events = (want_in ? EPOLLIN : 0) | (want_out ? EPOLLOUT : 0) | EPOLLRDHUP;
+    ev.data.u64 = c->id;
+    epoll_ctl(g_epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// dispatch completed requests from one conn's parser; pauses reads while a
+// request is claimed so keep-alive responses stay ordered
+void pump_conn(Conn* c, const char* data, size_t n) {
+    std::vector<Req*> out;
+    std::string direct;
+    int rc = c->parser.feed(data, n, out, direct, 1);
+    if (c->parser.send_continue && rc == 0 && out.empty()) {
+        // Expect: 100-continue — tell the client to send the body now
+        c->parser.send_continue = false;
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        c->out += "HTTP/1.1 100 Continue\r\n\r\n";
+    }
+    c->parser.send_continue = false;
+    if (!out.empty()) {
+        Req* r = out[0];
+        g_counters[C_REQS].fetch_add(1, std::memory_order_relaxed);
+        g_counters[r->kind == REQ_DECLINE ? C_DECLINED : C_HAPPY].fetch_add(
+            1, std::memory_order_relaxed);
+        r->conn_id = c->id;
+        {
+            std::lock_guard<std::mutex> lk(g_edge_mu);
+            r->id = g_next_req++;
+            g_ready.push_back(r);
+            c->inflight = true;
+        }
+        g_edge_cv.notify_one();
+        arm(c, false, false);  // pause reads until the response lands
+    }
+    bool have_out;
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        have_out = !c->out.empty();
+    }
+    if (rc != 0) {
+        g_counters[C_DIRECT].fetch_add(1, std::memory_order_relaxed);
+        {
+            std::lock_guard<std::mutex> lk(g_edge_mu);
+            c->out += direct;
+            c->close_after_write = true;
+        }
+        flush_out(c);
+        return;
+    }
+    if (have_out) flush_out(c);
+}
+
+void loop_main() {
+    epoll_event evs[64];
+    for (;;) {
+        int n = epoll_wait(g_epoll_fd, evs, 64, 500);
+        {
+            std::lock_guard<std::mutex> lk(g_edge_mu);
+            if (g_stopping) break;
+        }
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int i = 0; i < n; i++) {
+            uint64_t tag = evs[i].data.u64;
+            if (tag == 0) {  // listener
+                for (;;) {
+                    int fd = accept4(g_listen_fd, nullptr, nullptr,
+                                     SOCK_NONBLOCK | SOCK_CLOEXEC);
+                    if (fd < 0) break;
+                    int one = 1;
+                    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+                    Conn* c = new Conn();
+                    c->fd = fd;
+                    c->parser.max_buf = g_max_buf;
+                    {
+                        std::lock_guard<std::mutex> lk(g_edge_mu);
+                        c->id = g_next_conn++;
+                        g_conns[c->id] = c;
+                    }
+                    epoll_event ev{};
+                    ev.events = EPOLLIN | EPOLLRDHUP;
+                    ev.data.u64 = c->id;
+                    epoll_ctl(g_epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+                    g_counters[C_CONNS].fetch_add(1, std::memory_order_relaxed);
+                }
+                continue;
+            }
+            if (tag == 1) {  // eventfd: responses ready / resume requests
+                uint64_t v;
+                ssize_t r = read(g_event_fd, &v, sizeof(v));
+                (void)r;
+                std::vector<Conn*> todo;
+                {
+                    std::lock_guard<std::mutex> lk(g_edge_mu);
+                    for (auto& kv : g_conns) {
+                        Conn* c = kv.second;
+                        if (!c->out.empty() || c->want_resume) {
+                            c->want_resume = false;
+                            todo.push_back(c);
+                        }
+                    }
+                }
+                for (Conn* c : todo) {
+                    if (!flush_out(c)) continue;
+                    bool inflight;
+                    {
+                        std::lock_guard<std::mutex> lk(g_edge_mu);
+                        inflight = c->inflight;
+                    }
+                    if (!inflight) {
+                        arm(c, true, false);
+                        // leftover pipelined bytes may already hold the
+                        // next request
+                        pump_conn(c, nullptr, 0);
+                    }
+                }
+                continue;
+            }
+            Conn* c;
+            {
+                std::lock_guard<std::mutex> lk(g_edge_mu);
+                auto it = g_conns.find(tag);
+                c = it == g_conns.end() ? nullptr : it->second;
+            }
+            if (c == nullptr) continue;
+            if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+                close_conn(c);
+                continue;
+            }
+            if (evs[i].events & EPOLLOUT) {
+                if (!flush_out(c)) continue;
+                bool inflight, drained;
+                {
+                    std::lock_guard<std::mutex> lk(g_edge_mu);
+                    inflight = c->inflight;
+                    drained = c->out.empty();
+                }
+                if (drained && !inflight) {
+                    arm(c, true, false);
+                    pump_conn(c, nullptr, 0);
+                }
+            }
+            if (evs[i].events & (EPOLLIN | EPOLLRDHUP)) {
+                char rb[65536];
+                bool closed = false;
+                for (;;) {
+                    ssize_t r = recv(c->fd, rb, sizeof(rb), 0);
+                    if (r > 0) {
+                        pump_conn(c, rb, (size_t)r);
+                        bool paused;
+                        {
+                            std::lock_guard<std::mutex> lk(g_edge_mu);
+                            paused = c->inflight;
+                        }
+                        if (paused) break;  // stop reading mid keep-alive
+                        continue;
+                    }
+                    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+                    close_conn(c);
+                    closed = true;
+                    break;
+                }
+                if (closed) continue;
+            }
+        }
+    }
+    // teardown: close every conn; unclaimed queued requests are freed here,
+    // claimed ones are freed by their (conn-less) respond calls
+    std::vector<Conn*> conns;
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        for (auto& kv : g_conns) conns.push_back(kv.second);
+        g_conns.clear();
+        for (Req* r : g_ready) delete r;
+        g_ready.clear();
+    }
+    for (Conn* c : conns) {
+        close(c->fd);
+        delete c;
+    }
+    close(g_epoll_fd);
+    close(g_event_fd);
+    close(g_listen_fd);
+    g_epoll_fd = g_event_fd = g_listen_fd = -1;
+}
+
+}  // namespace edge
+}  // anonymous namespace
+
+extern "C" {
+
+// Start the edge acceptor on `port` (0 = ephemeral). `max_body` bounds any
+// single buffered request (P_INGEST_MAX_BODY_BYTES; 0 keeps the default).
+// Returns the actually-bound port, or -1 on any setup failure. Restartable
+// after ptpu_edge_stop, same as the parse pool.
+int ptpu_edge_start(int port, uint64_t max_body) {
+    using namespace edge;
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        if (g_running) return -1;
+    }
+    if (max_body > 0) g_max_buf = max_body;
+    int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (lfd < 0) return -1;
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(lfd, 128) != 0) {
+        close(lfd);
+        return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(lfd, (sockaddr*)&addr, &alen);
+    int bound = (int)ntohs(addr.sin_port);
+    int efd = epoll_create1(EPOLL_CLOEXEC);
+    int wfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    if (efd < 0 || wfd < 0) {
+        close(lfd);
+        if (efd >= 0) close(efd);
+        if (wfd >= 0) close(wfd);
+        return -1;
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = 0;
+    epoll_ctl(efd, EPOLL_CTL_ADD, lfd, &ev);
+    ev.events = EPOLLIN;
+    ev.data.u64 = 1;
+    epoll_ctl(efd, EPOLL_CTL_ADD, wfd, &ev);
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        g_listen_fd = lfd;
+        g_epoll_fd = efd;
+        g_event_fd = wfd;
+        g_stopping = false;
+        g_running = true;
+    }
+    delete g_thread;
+    g_thread = new std::thread([] { loop_main(); });
+    return bound;
+}
+
+// Stop accepting and join the epoll thread. Unclaimed queued requests are
+// freed; requests already claimed by a dispatcher stay live until that
+// dispatcher responds (the respond call frees them conn-less).
+void ptpu_edge_stop(void) {
+    using namespace edge;
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        if (!g_running) return;
+        g_stopping = true;
+    }
+    g_edge_cv.notify_all();
+    wake_loop();
+    g_thread->join();
+    std::lock_guard<std::mutex> lk(g_edge_mu);
+    g_running = false;
+}
+
+// Replace the auth snapshot: `blob` is newline-separated exact
+// Authorization header values ("Basic <b64>", "Bearer <token>"). Pushed by
+// Python on every RBAC change; an empty blob declines everything.
+void ptpu_edge_auth_set(const char* blob, uint64_t len) {
+    using namespace edge;
+    std::vector<std::string> toks;
+    size_t start = 0;
+    std::string s(blob == nullptr ? "" : std::string(blob, (size_t)len));
+    while (start <= s.size() && !s.empty()) {
+        size_t nl = s.find('\n', start);
+        if (nl == std::string::npos) nl = s.size();
+        if (nl > start) toks.emplace_back(s, start, nl - start);
+        if (nl >= s.size()) break;
+        start = nl + 1;
+    }
+    std::lock_guard<std::mutex> lk(g_edge_mu);
+    g_auth.swap(toks);
+}
+
+// Claim the next parsed request (dispatcher threads; blocks up to
+// timeout_ms). Returns 0 with *id/*kind set, 1 on timeout, 2 when the edge
+// stopped and the queue is drained. The claiming thread's telemetry ring
+// receives the request's EV_RECV span here — this IS the thread that will
+// run the native parse, so the recv span drains with the parse spans.
+int ptpu_edge_next(uint64_t* id, int* kind, int timeout_ms) {
+    using namespace edge;
+    Req* r = nullptr;
+    {
+        std::unique_lock<std::mutex> lk(g_edge_mu);
+        if (g_ready.empty() && !g_stopping) {
+            g_edge_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+        }
+        if (g_ready.empty()) return g_stopping ? 2 : 1;
+        r = g_ready.front();
+        g_ready.pop_front();
+        g_claimed[r->id] = r;
+    }
+    g_live.fetch_add(1, std::memory_order_relaxed);
+    if (telem::enabled() && r->kind != REQ_DECLINE) {
+        telem::Event e{};
+        e.kind = EV_RECV;
+        e.lane = lane_of(r->kind);
+        e.bytes = r->raw.size();
+        e.start_ns = r->start_ns;
+        e.dur_ns = r->dur_ns;
+        telem::t_ring.push(e);
+    }
+    *id = r->id;
+    *kind = r->kind;
+    return 0;
+}
+
+namespace {
+edge::Req* edge_claimed(uint64_t id) {
+    std::lock_guard<std::mutex> lk(edge::g_edge_mu);
+    auto it = edge::g_claimed.find(id);
+    return it == edge::g_claimed.end() ? nullptr : it->second;
+}
+}  // namespace
+
+// Accessors for a claimed request. Pointers stay valid until the matching
+// ptpu_edge_respond_* call (single-owner: the claiming dispatcher).
+int ptpu_edge_req_stream(uint64_t id, const void** ptr, uint64_t* len) {
+    edge::Req* r = edge_claimed(id);
+    if (r == nullptr) return -1;
+    *ptr = r->stream.data();
+    *len = r->stream.size();
+    return 0;
+}
+
+int ptpu_edge_req_body(uint64_t id, const void** ptr, uint64_t* len) {
+    edge::Req* r = edge_claimed(id);
+    if (r == nullptr) return -1;
+    *ptr = r->body.data();
+    *len = r->body.size();
+    return 0;
+}
+
+int ptpu_edge_req_raw(uint64_t id, const void** ptr, uint64_t* len) {
+    edge::Req* r = edge_claimed(id);
+    if (r == nullptr) return -1;
+    *ptr = r->raw.data();
+    *len = r->raw.size();
+    return 0;
+}
+
+int ptpu_edge_req_trace(uint64_t id, const void** ptr, uint64_t* len) {
+    edge::Req* r = edge_claimed(id);
+    if (r == nullptr) return -1;
+    *ptr = r->trace.data();
+    *len = r->trace.size();
+    return 0;
+}
+
+int ptpu_edge_req_reason(uint64_t id) {
+    edge::Req* r = edge_claimed(id);
+    return r == nullptr ? -1 : r->reason;
+}
+
+namespace {
+// deliver `resp` for claimed request `id`; frees the Req either way
+int edge_deliver(uint64_t id, const std::string& resp, int close_after) {
+    using namespace edge;
+    Req* r;
+    bool conn_alive = false;
+    {
+        std::lock_guard<std::mutex> lk(g_edge_mu);
+        auto it = g_claimed.find(id);
+        if (it == g_claimed.end()) return -1;
+        r = it->second;
+        g_claimed.erase(it);
+        auto cit = g_conns.find(r->conn_id);
+        if (cit != g_conns.end()) {
+            Conn* c = cit->second;
+            c->out += resp;
+            if (close_after || r->close_after) c->close_after_write = true;
+            c->inflight = false;
+            c->want_resume = true;
+            conn_alive = true;
+        }
+    }
+    g_live.fetch_sub(1, std::memory_order_relaxed);
+    delete r;
+    if (conn_alive) wake_loop();
+    return conn_alive ? 0 : 1;
+}
+
+std::string edge_status_line(int status) {
+    const char* reason = "OK";
+    switch (status) {
+        case 200: reason = "OK"; break;
+        case 400: reason = "Bad Request"; break;
+        case 403: reason = "Forbidden"; break;
+        case 404: reason = "Not Found"; break;
+        case 413: reason = "Payload Too Large"; break;
+        case 429: reason = "Too Many Requests"; break;
+        case 503: reason = "Service Unavailable"; break;
+        default: reason = "Error"; break;
+    }
+    return "HTTP/1.1 " + std::to_string(status) + " " + reason + "\r\n";
+}
+
+std::string edge_json_response(int status, const std::string& body,
+                               const char* trace, uint64_t trace_len) {
+    std::string resp = edge_status_line(status);
+    resp += "Content-Type: application/json; charset=utf-8\r\n";
+    if (trace != nullptr && trace_len > 0) {
+        resp += "X-P-Trace-Id: ";
+        resp.append(trace, (size_t)trace_len);
+        resp += "\r\n";
+    }
+    resp += "Content-Length: " + std::to_string(body.size()) + "\r\n\r\n";
+    resp += body;
+    return resp;
+}
+}  // namespace
+
+// Happy-path ack, written entirely from C: 200 + row count + trace echo
+// (the same shape as the aiohttp tier's json_response + trace middleware).
+int ptpu_edge_respond_ack(uint64_t id, long long rows, const char* trace,
+                          uint64_t trace_len) {
+    std::string body =
+        "{\"message\": \"ingested " + std::to_string(rows) + " records\"}";
+    return edge_deliver(id, edge_json_response(200, body, trace, trace_len), 0);
+}
+
+// Error/detour response with a caller-built JSON body (Python mirrors the
+// aiohttp handlers' bodies so both tiers answer identically).
+int ptpu_edge_respond(uint64_t id, int status, const char* body, uint64_t blen,
+                      const char* trace, uint64_t trace_len) {
+    std::string b(body == nullptr ? "" : std::string(body, (size_t)blen));
+    return edge_deliver(id, edge_json_response(status, b, trace, trace_len), 0);
+}
+
+// Verbatim relay of an upstream (aiohttp) response for a declined request —
+// the byte-identity guarantee of the decline ladder lives here.
+int ptpu_edge_respond_raw(uint64_t id, const char* data, uint64_t len,
+                          int close_after) {
+    std::string resp(data == nullptr ? "" : std::string(data, (size_t)len));
+    return edge_deliver(id, resp, close_after);
+}
+
+// claimed-but-unresponded requests — the tier-1 session leak gate,
+// mirroring ptpu_cols_live / ptpu_telem_live
+long long ptpu_edge_live(void) {
+    return edge::g_live.load(std::memory_order_relaxed);
+}
+
+// edge counters: 0 conns, 1 requests, 2 happy, 3 declined, 4 direct C-side
+// error responses, 5 auth misses
+uint64_t ptpu_edge_counter(int which) {
+    if (which < 0 || which >= edge::C_NCOUNTERS) return 0;
+    return edge::g_counters[which].load(std::memory_order_relaxed);
+}
+
+// Fuzz/test hook: drive `len` bytes of raw HTTP through the request parser
+// in `chunk`-sized feeds (0 = all at once) with no sockets or threads.
+// Returns completed request count, or -1 when the parser hard-errored.
+int ptpu_edge_parse_probe(const char* data, uint64_t len, int chunk) {
+    using namespace edge;
+    Parser ps;
+    ps.max_buf = 1ull << 20;
+    std::vector<Req*> out;
+    std::string direct;
+    int completed = 0;
+    uint64_t off = 0;
+    uint64_t step = chunk <= 0 ? (len == 0 ? 1 : len) : (uint64_t)chunk;
+    int rc = 0;
+    while (off < len) {
+        uint64_t n = std::min(step, len - off);
+        rc = ps.feed(data + off, n, out, direct, 0);
+        off += n;
+        for (Req* r : out) {
+            completed++;
+            delete r;
+        }
+        out.clear();
+        if (rc != 0) break;
+    }
+    return rc != 0 ? -1 : completed;
+}
+
+}  // extern "C"

@@ -104,6 +104,10 @@ class ServerState:
         from parseable_tpu.tenants import TenantRegistry
 
         self.tenants = TenantRegistry(p.metastore)
+        # native HTTP ingest edge (native/edge.py) — started by run_server
+        # when P_EDGE_PORT > 0, stopped in stop(); RBAC mutations push a
+        # fresh auth snapshot through it
+        self.edge = None
 
     def hot_tier(self):
         """Lazily-built hot tier manager, restored from persisted budgets."""
@@ -136,6 +140,7 @@ class ServerState:
 
     def save_rbac(self) -> None:
         self.p.metastore.put_document("users", "rbac", self.rbac.to_json())
+        self._refresh_edge_auth()
 
     def reload_rbac(self) -> None:
         """Refresh users/roles from the metastore (cluster sync), keeping
@@ -144,6 +149,17 @@ class ServerState:
         fresh = self._load_rbac()
         fresh.sessions = self.rbac.sessions
         self.rbac = fresh
+        self._refresh_edge_auth()
+
+    def _refresh_edge_auth(self) -> None:
+        """Re-snapshot the C-side edge auth tokens after any RBAC change —
+        the acceptor must never honor a revoked session longer than the
+        mutation that revoked it takes to return."""
+        if self.edge is not None:
+            try:
+                self.edge.refresh_auth()
+            except Exception:
+                logger.exception("edge auth snapshot refresh failed")
 
     # ----- background sync (reference: src/sync.rs) -------------------------
     def start_sync_loops(self) -> None:
@@ -255,6 +271,15 @@ class ServerState:
             return  # idempotent: tests and signal paths may both stop
         self.shutting_down = True
         self._sync_stop.set()
+        # native ingest edge first: stop accepting + join dispatchers before
+        # staging flushes, so every acked row is in staging when p.shutdown()
+        # runs and edge_live() is 0 before the process exits
+        if self.edge is not None:
+            try:
+                self.edge.stop()
+            except Exception:
+                logger.exception("edge stop failed")
+            self.edge = None
         self.resources.stop()
         # drain buffered spans into pmeta before the final staging flush so
         # the last requests' telemetry survives shutdown, then detach (no
@@ -685,6 +710,7 @@ async def login(request: web.Request) -> web.Response:
     (reference: session cookie flow, http/oidc.rs for the OAuth variant)."""
     state: ServerState = request.app["state"]
     token = state.rbac.new_session(request["username"])
+    state._refresh_edge_auth()
     resp = web.json_response({"token": token})
     resp.set_cookie("session", token, httponly=True, max_age=7 * 24 * 3600)
     return resp
@@ -743,12 +769,28 @@ async def otel_ingest(request: web.Request) -> web.Response:
     return await _do_ingest(request, stream_name, source, telemetry_type=kind)
 
 
+async def _read_body(request: web.Request) -> bytes | None:
+    """Body read under the shared P_INGEST_MAX_BODY_BYTES transport cap
+    (build_app's client_max_size). Returns None past the cap — callers
+    answer with the same JSON 413 the native edge sends from C, so the
+    limit and the error shape cannot diverge across tiers."""
+    try:
+        return await request.read()
+    except web.HTTPRequestEntityTooLarge:
+        return None
+
+
+_BODY_TOO_LARGE = {"error": "payload too large"}
+
+
 async def _do_ingest(
     request: web.Request, stream_name: str, log_source: LogSource, telemetry_type: str = "logs"
 ) -> web.Response:
     state: ServerState = request.app["state"]
     t_recv = time.time_ns()
-    body = await request.read()
+    body = await _read_body(request)
+    if body is None:
+        return web.json_response(_BODY_TOO_LARGE, status=413)
     # recv: the waterfall's first stage — wire-to-memory time for the body
     prom.INGEST_STAGE_TIME.labels("recv", log_source.value).observe(
         (time.time_ns() - t_recv) / 1e9
@@ -1044,7 +1086,9 @@ async def put_stream(request: web.Request) -> web.Response:
     static_schema_flag = request.headers.get(STATIC_SCHEMA_HEADER, "").lower() == "true"
     telemetry_type = request.headers.get(TELEMETRY_TYPE_HEADER, "logs")
     static_schema = None
-    body = await request.read()
+    body = await _read_body(request)
+    if body is None:
+        return web.json_response(_BODY_TOO_LARGE, status=413)
     if static_schema_flag and body:
         from parseable_tpu.static_schema import convert_static_schema
 
@@ -1328,7 +1372,9 @@ async def put_user(request: web.Request) -> web.Response:
     if username in state.rbac.users:
         return web.json_response({"error": f"user {username} already exists"}, status=400)
     body = {}
-    raw = await request.read()
+    raw = await _read_body(request)
+    if raw is None:
+        return web.json_response(_BODY_TOO_LARGE, status=413)
     if raw:
         body = json.loads(raw)
     roles = set(body.get("roles", []))
@@ -1671,6 +1717,7 @@ async def logout(request: web.Request) -> web.Response:
         token = request.cookies["session"]
     if token:
         state.rbac.sessions.pop(token, None)
+        state._refresh_edge_auth()
     resp = web.json_response({"message": "logged out"})
     resp.del_cookie("session")
     return resp
@@ -2183,9 +2230,13 @@ async def remove_node_handler(request: web.Request) -> web.Response:
 
 
 def build_app(state: ServerState) -> web.Application:
+    from parseable_tpu.config import edge_options
+
     app = web.Application(
         middlewares=[trace_middleware, auth_middleware],
-        client_max_size=64 * 1024 * 1024,
+        # shared with the native edge acceptor's framing limit: both tiers
+        # must agree on which bodies even get read (P_INGEST_MAX_BODY_BYTES)
+        client_max_size=edge_options()["max_body"],
     )
     app["state"] = state
     mode = state.p.options.mode
@@ -2328,6 +2379,12 @@ def run_server(opts: Options | None = None, storage: StorageOptions | None = Non
 
         state.workers.submit(check_for_update, p.options)
     state.start_sync_loops()
+    # native ingest edge: its own listener port, C++ HTTP framing + auth
+    # snapshot, Python dispatchers staging straight off C-owned buffers;
+    # every miss declines verbatim to the aiohttp app built below
+    from parseable_tpu.native.edge import maybe_start_edge
+
+    state.edge = maybe_start_edge(state)
     app = build_app(state)
 
     async def on_shutdown(app):

@@ -178,6 +178,10 @@ def _emit_native_telem(sp, enabled: bool) -> None:
         if kind == native.TELEM_EV_PARSE:
             name, stage = "native.parse", "parse"
             parse_durs.append(dur_ns)
+        elif kind == native.TELEM_EV_RECV:
+            # stamped by the C++ edge acceptor at claim time: socket-read
+            # wall time for this request (the waterfall's true recv span)
+            name, stage = "edge.recv", "recv"
         else:
             name, stage = "native.stitch", "stitch"
         attrs = {
@@ -204,6 +208,11 @@ def _emit_native_telem(sp, enabled: bool) -> None:
 def _parse_payload(payload: Any, raw_body: bytes | None) -> Any:
     if payload is not None or raw_body is None:
         return payload
+    if hasattr(raw_body, "tobytes"):
+        # edge-path CBuf (borrowed C memory): the native lanes consumed it
+        # zero-copy, but json.loads needs real bytes — copy only on this
+        # decline tier
+        raw_body = raw_body.tobytes()
     try:
         return json.loads(raw_body)
     except json.JSONDecodeError as e:

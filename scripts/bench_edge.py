@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Standalone hook for the native-edge ingest benchmark.
+
+Boots one real server process with the C++ edge acceptor enabled
+(P_EDGE_PORT) and drives BOTH its ports wrk-style over loopback —
+persistent keep-alive connections, fixed offered load, identical payload
+bytes — reporting GB/s, rows/s-per-core and p50/p95/p99 ack latency for
+the native edge next to the aiohttp tier. See bench.bench_edge for the
+env knobs (BENCH_EDGE_CONNS / _REQS / _BATCH / _OFFERED_ROWS).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import bench_edge  # noqa: E402
+
+if __name__ == "__main__":
+    bench_edge()

@@ -100,6 +100,26 @@ if [ "${NSAN:-1}" != "0" ]; then
       exit "$rc"
     fi
     echo "check_green: nsan GREEN (report: /tmp/nsan.json)"
+    # edge smoke, same UBSan leg: one real server process booted with
+    # P_EDGE_PORT against the sanitized library (P_NSAN_LIB), a keep-alive
+    # happy-path ack pair, a forced decline relayed byte-identical to the
+    # aiohttp tier, and the conservation audit's edge section drained at
+    # quiesce. Opt out with EDGE=0 (boots 1 process; ~half a minute). Only
+    # meaningful when the library exports the edge ABI — skipped otherwise.
+    if [ "${EDGE:-1}" != "0" ]; then
+      if python -c 'from parseable_tpu import native; import sys; sys.exit(0 if native.edge_available() else 1)' 2>/dev/null; then
+        san_lib=$(python -c 'import parseable_tpu, pathlib; from parseable_tpu.analysis.nsan import build_san_lib; from parseable_tpu.config import nsan_options; p = build_san_lib(pathlib.Path(parseable_tpu.__file__).resolve().parent.parent, nsan_options()["san_mode"]); print(p or "")' 2>/dev/null)
+        if ! timeout -k 10 300 env JAX_PLATFORMS=cpu P_NSAN_LIB="$san_lib" python scripts/edge_smoke.py; then
+          echo "check_green: EDGE RED (native ingest edge smoke failed under UBSan)" >&2
+          exit 1
+        fi
+        echo "check_green: edge GREEN (sanitized lib: ${san_lib:-none})"
+      else
+        echo "check_green: edge SKIPPED (native edge ABI unavailable)"
+      fi
+    else
+      echo "check_green: edge SKIPPED (EDGE=0)"
+    fi
   else
     echo "check_green: nsan GREEN — ABI+corpus only (no UBSan-capable toolchain for the sanitized test pass)"
   fi

@@ -1,0 +1,150 @@
+"""Native ingest edge smoke check: boot a real server with P_EDGE_PORT,
+prove the C++ acceptor end to end, exit nonzero on any broken link.
+
+Asserts, against one real server process (scripts/blackbox.py):
+
+- a keep-alive connection to the edge port acks two POST /api/v1/ingest
+  batches (zero-Python happy path) with `X-P-Trace-Id` echoed;
+- a forced decline on the edge port (GET of a non-hot route) relays to
+  the aiohttp tier and answers byte-identical to the same request sent
+  to the aiohttp port directly (modulo the per-request Date and
+  X-P-Trace-Id headers);
+- the acked rows are queryable through the normal SQL path;
+- the conservation-law audit reports zero violations at quiesce and the
+  edge section shows every claimed request responded (live == 0).
+
+Runnable standalone (`python scripts/edge_smoke.py`); check_green.sh runs
+it as the edge gate (opt out with EDGE=0).
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from blackbox import AUTH_HEADER, ClusterHarness, free_port  # noqa: E402
+
+
+def _recv_response(sock: socket.socket, buf: bytes) -> tuple[bytes, bytes]:
+    """Read one Content-Length-framed response; returns (response, leftover)."""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise AssertionError("connection closed mid-response")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    need = 0
+    for line in head.split(b"\r\n")[1:]:
+        k, _, v = line.partition(b":")
+        if k.strip().lower() == b"content-length":
+            need = int(v.strip())
+    while len(rest) < need:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise AssertionError("connection closed mid-body")
+        rest += chunk
+    return head + b"\r\n\r\n" + rest[:need], rest[need:]
+
+
+def _roundtrip(port: int, raw: bytes) -> bytes:
+    with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+        s.sendall(raw)
+        resp, _ = _recv_response(s, b"")
+        return resp
+
+
+def _strip_volatile(resp: bytes) -> bytes:
+    """Drop the per-request headers (Date, X-P-Trace-Id) before comparing."""
+    head, _, body = resp.partition(b"\r\n\r\n")
+    lines = [
+        ln
+        for ln in head.split(b"\r\n")
+        if not ln.lower().startswith((b"date:", b"x-p-trace-id:"))
+    ]
+    return b"\r\n".join(lines) + b"\r\n\r\n" + body
+
+
+def run_smoke(workdir: Path) -> dict:
+    auth = AUTH_HEADER["Authorization"]
+    edge_port = free_port()
+    body = b'[{"host": "edge-smoke", "status": 200}, {"host": "edge-smoke", "status": 500}]'
+    post = (
+        f"POST /api/v1/ingest HTTP/1.1\r\n"
+        f"Host: 127.0.0.1:{edge_port}\r\n"
+        f"Authorization: {auth}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"X-P-Stream: edgesmoke\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    decline = (
+        f"GET /api/v1/about HTTP/1.1\r\n"
+        f"Host: 127.0.0.1:{edge_port}\r\n"
+        f"Authorization: {auth}\r\n\r\n"
+    ).encode()
+
+    with ClusterHarness(workdir) as cluster:
+        node = cluster.spawn(
+            "all",
+            "edgesmoke",
+            env_extra={"P_EDGE_PORT": str(edge_port), "P_LOCAL_SYNC_INTERVAL": "2"},
+        )
+        cluster.wait_live(node)
+
+        # happy path: two acked batches over ONE keep-alive edge connection
+        with socket.create_connection(("127.0.0.1", edge_port), timeout=30) as s:
+            buf = b""
+            for i in range(2):
+                s.sendall(post)
+                resp, buf = _recv_response(s, buf)
+                assert resp.startswith(b"HTTP/1.1 200"), f"edge ack #{i}: {resp[:200]!r}"
+                assert b"ingested 2 records" in resp, resp[:200]
+                assert b"x-p-trace-id:" in resp.lower(), "edge ack missing trace id echo"
+
+        # decline path: byte-identical relay vs the aiohttp port directly
+        via_edge = _roundtrip(edge_port, decline)
+        direct = _roundtrip(node.port, decline)
+        assert _strip_volatile(via_edge) == _strip_volatile(direct), (
+            f"decline relay diverged:\nedge:   {via_edge[:300]!r}\n"
+            f"direct: {direct[:300]!r}"
+        )
+
+        # the acked rows land queryable through the normal path
+        deadline_rows = None
+        for _ in range(60):
+            try:
+                records, _ = cluster.query(
+                    node, "SELECT count(*) c FROM edgesmoke", timeout=15
+                )
+                deadline_rows = records[0]["c"]
+                if deadline_rows == 4:
+                    break
+            except RuntimeError:
+                pass
+            import time
+
+            time.sleep(1)
+        assert deadline_rows == 4, f"expected 4 acked rows queryable, got {deadline_rows}"
+
+        # conservation audit: zero violations at quiesce, edge drained
+        report = cluster.audit(node, scope="local", quiesce=True)
+        assert report.get("violations") == [], report["violations"]
+        edge = report.get("edge") or {}
+        assert edge.get("live") == 0, f"edge live != 0 at quiesce: {edge}"
+        assert edge.get("happy", 0) >= 2, edge
+        assert edge.get("declined", 0) >= 1, edge
+        return {"rows": deadline_rows, "edge": edge}
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="ptpu-edge-smoke-") as wd:
+        out = run_smoke(Path(wd))
+    print(f"edge smoke OK: {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,6 +119,20 @@ def pytest_sessionfinish(session, exitstatus):
             )
             if session.exitstatus == 0:
                 session.exitstatus = 1
+        # edge acceptor: every claimed request must have been responded
+        # (ptpu_edge_next -> ptpu_edge_respond*) before the session ends —
+        # a nonzero count is a dispatcher that dropped a request on the
+        # floor (its connection would hang forever in production)
+        elive = getattr(native, "edge_live", lambda: 0)()
+        if elive != 0:
+            print(
+                f"\nconftest: ptpu_edge_live() == {elive} at session end "
+                "(expected 0) — an edge request was claimed but never "
+                "responded",
+                file=_sys.stderr,
+            )
+            if session.exitstatus == 0:
+                session.exitstatus = 1
     except Exception:
         pass  # the gate must never turn an unrelated failure into a crash
 

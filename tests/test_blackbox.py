@@ -184,3 +184,129 @@ def test_blackbox_kill_ingestor_recover_orphans(tmp_path):
             f"logs: {ing2.log_path.read_text()[-2000:]}"
         )
         assert ing2.alive() and q.alive()
+
+
+def test_blackbox_edge_kill_keepalive_midbody(tmp_path):
+    """ISSUE 17: SIGKILL an ingestor that has open edge keep-alive
+    connections parked MID-BODY, restart it on the same staging dir, and
+    prove the books still balance — every row acked over the edge before
+    the kill is queryable again, and the half-received bodies (never
+    acked, never parsed) added nothing. The edge's C-side buffers die with
+    the process; only acked work may survive, exactly like the aiohttp
+    tier."""
+    import base64
+    import socket
+
+    bb = _load_blackbox()
+    auth = "Basic " + base64.b64encode(b"admin:admin").decode()
+    with bb.ClusterHarness(tmp_path) as cluster:
+        edge_port = bb.free_port()
+        frozen = {
+            "P_LOCAL_SYNC_INTERVAL": "3600",
+            "P_STORAGE_UPLOAD_INTERVAL": "3600",
+            "P_EDGE_PORT": str(edge_port),
+        }
+        ing = cluster.spawn("ingest", "ing0", env_extra=frozen)
+        cluster.wait_live(ing)
+
+        def edge_post(sock: socket.socket, rows: bytes) -> None:
+            sock.sendall(
+                b"POST /api/v1/ingest HTTP/1.1\r\nHost: t\r\n"
+                b"Authorization: " + auth.encode() + b"\r\n"
+                b"X-P-Stream: ek\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(rows) + rows
+            )
+            resp = b""
+            while b"\r\n\r\n" not in resp:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("edge closed mid-response")
+                resp += chunk
+            assert resp.startswith(b"HTTP/1.1 200"), resp[:200]
+
+        # 30 rows ACKED over ONE edge keep-alive connection
+        acked = 0
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                ka = socket.create_connection(("127.0.0.1", edge_port), timeout=30)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        with ka:
+            for i in range(10):
+                batch = b'[{"host": "h%d", "v": %d.0}, {"host": "x", "v": 0.0}, {"host": "y", "v": 1.0}]' % (i % 2, i)
+                edge_post(ka, batch)
+                acked += 3
+
+            # force IPC footers onto disk (staging fan-in flushes forced)
+            import urllib.request
+
+            req = urllib.request.Request(f"{ing.url}/api/v1/internal/staging/ek")
+            for k, v in bb.AUTH_HEADER.items():
+                req.add_header(k, v)
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                assert resp.status in (200, 204)
+                resp.read()
+
+            # two MORE keep-alive connections parked mid-body: headers sent,
+            # Content-Length promises 4096 bytes, only half arrive
+            hung = []
+            for _ in range(2):
+                h = socket.create_connection(("127.0.0.1", edge_port), timeout=30)
+                h.sendall(
+                    b"POST /api/v1/ingest HTTP/1.1\r\nHost: t\r\n"
+                    b"Authorization: " + auth.encode() + b"\r\n"
+                    b"X-P-Stream: ek\r\nContent-Length: 4096\r\n\r\n"
+                    + b'[{"half": "' + b"z" * 2000
+                )
+                hung.append(h)
+
+            ing.kill()  # SIGKILL with the keep-alive + mid-body conns open
+            assert not ing.alive()
+            for h in hung:
+                h.close()
+
+        # restart on the SAME staging dir and edge port, fast sync now
+        ing2 = cluster.spawn(
+            "ingest",
+            "ing0",
+            env_extra={
+                "P_LOCAL_SYNC_INTERVAL": "1",
+                "P_STORAGE_UPLOAD_INTERVAL": "1",
+                "P_EDGE_PORT": str(edge_port),
+            },
+        )
+        q = cluster.spawn("query", "q0")
+        cluster.wait_live(ing2)
+        cluster.wait_live(q)
+        status, _ = bb.http_json("GET", f"{ing2.url}/api/v1/logstream")
+        assert status == 200
+
+        def count_rows() -> int:
+            try:
+                recs, _ = cluster.query(q, "SELECT count(*) c FROM ek", "10m", "now")
+            except RuntimeError:
+                return -1
+            return int(recs[0]["c"]) if recs else 0
+
+        deadline = time.monotonic() + 120
+        seen = count_rows()
+        while time.monotonic() < deadline and seen != acked:
+            time.sleep(0.5)
+            seen = count_rows()
+        assert seen == acked, (
+            f"post-restart count {seen} != {acked} acked via edge pre-kill; "
+            f"logs: {ing2.log_path.read_text()[-2000:]}"
+        )
+
+        # the restarted edge must be serving again on the same port
+        with socket.create_connection(("127.0.0.1", edge_port), timeout=30) as s:
+            edge_post(s, b'[{"host": "post-restart", "v": 1.0}]')
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and count_rows() != acked + 1:
+            time.sleep(0.5)
+        assert count_rows() == acked + 1
+        assert ing2.alive() and q.alive()
