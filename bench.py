@@ -1615,6 +1615,73 @@ def bench_memory_pressure(emit_line: bool = True) -> dict | None:
     return summary
 
 
+def _flight_fanin_ab(workdir, reps: int, stream: str) -> dict | None:
+    """Interleaved Flight-vs-HTTP fan-in A/B over the live ingestor
+    processes: one in-process QUERY-mode client against the harness's
+    shared store pulls `stream`'s staging window over each transport rung
+    back-to-back, alternating the order per pair. The caller loads the
+    window once into quiescent (sync-paused) ingestors, so every pull
+    sees the byte-identical, cache-hot window — the A/B measures the
+    wire, not the server-side window build. Returns per-transport GB/s +
+    per-pull wire bytes, or None if the A/B could not run at all."""
+    from parseable_tpu.config import Mode, Options, StorageOptions
+    from parseable_tpu.core import Parseable
+    from parseable_tpu.server import cluster as C
+
+    opts = Options()
+    opts.mode = Mode.QUERY
+    opts.local_staging_path = workdir / "staging-ab"
+    q = Parseable(
+        opts, StorageOptions(backend="local-store", root=workdir / "shared-store")
+    )
+    sides: dict = {
+        t: {"secs": [], "bytes": [], "fallbacks": 0} for t in ("flight", "http")
+    }
+
+    def pull(transport: str) -> None:
+        q.options.flight_client = transport == "flight"
+        st: dict = {}
+        t0 = time.perf_counter()
+        C.fetch_staging_batches(q, stream, stats=st)
+        side = sides[transport]
+        side["secs"].append(time.perf_counter() - t0)
+        side["bytes"].append(st.get("bytes", 0))
+        side["fallbacks"] += st.get("flight_fallbacks", 0)
+
+    try:
+        # warm both rungs: channel dial / keep-alive socket, and the
+        # server-side cold window build lands here instead of in a sample
+        for t in ("flight", "http", "flight", "http"):
+            pull(t)
+        for side in sides.values():
+            side["secs"].clear()
+            side["bytes"].clear()
+            side["fallbacks"] = 0
+        for i in range(reps):
+            order = ("flight", "http") if i % 2 == 0 else ("http", "flight")
+            for t in order:
+                pull(t)
+    except Exception as e:  # noqa: BLE001 - bench-only
+        print(f"# flight fan-in A/B failed: {e}", file=sys.stderr)
+        return None
+    finally:
+        q.shutdown()
+        C.shutdown_flight_pool()
+        C.shutdown_conn_pool()
+        C.shutdown_cluster_pool()
+
+    out: dict = {}
+    for t, side in sides.items():
+        total_b, total_s = sum(side["bytes"]), sum(side["secs"])
+        out[t] = {
+            "gbs": total_b / max(total_s, 1e-9) / 1e9,
+            "p50_s": percentile(side["secs"], 0.50),
+            "wire_bytes_per_pull": total_b / max(1, len(side["bytes"])),
+            "flight_fallbacks": side["fallbacks"],
+        }
+    return out
+
+
 def bench_distributed_fanout() -> None:
     """Distributed fan-out bench with a REAL multi-process baseline
     (ROADMAP: "give the distributed mesh bench a real baseline ... not
@@ -1631,9 +1698,14 @@ def bench_distributed_fanout() -> None:
     Reports p50/p95 client-side latency and BYTES OVER THE WIRE (the
     querier<->ingestor data plane: raw staging IPC vs partial tables) per
     query, p50/p95 over BENCH_DF_QUERIES reps. vs_baseline = central p95 /
-    pushdown p95. Env knobs: BENCH_DF (0 skips), BENCH_DF_INGESTORS (2),
-    BENCH_DF_QUERIES (12), BENCH_DF_PRELOAD_ROWS (24000 per ingestor),
-    BENCH_DF_INGEST_ROWS (400 per background tick)."""
+    pushdown p95. A second record, bench_flight_fanin, comes from an
+    interleaved Flight-vs-HTTP staging fan-in A/B against the same live
+    ingestors (GB/s + per-pull wire bytes per transport). Env knobs:
+    BENCH_DF (0 skips), BENCH_DF_INGESTORS (2), BENCH_DF_QUERIES (12),
+    BENCH_DF_PRELOAD_ROWS (24000 per ingestor), BENCH_DF_INGEST_ROWS
+    (400 per background tick), BENCH_DF_AB_ROWS (960000 once per A/B
+    ingestor — ~20MB windows, big enough that the wire dominates the
+    per-pull fixed costs)."""
     import pathlib
     import threading
 
@@ -1662,8 +1734,11 @@ def bench_distributed_fanout() -> None:
             # sync fast so preloaded rows reach manifests while background
             # ingest keeps a live staging window on every peer
             ing_env = {"P_LOCAL_SYNC_INTERVAL": "3", "P_STORAGE_UPLOAD_INTERVAL": "2"}
+            # flight=True: ingestors serve both data-plane tiers, so the
+            # queriers ride the Arrow Flight hot tier by default and the
+            # A/B below can pin P_FLIGHT_CLIENT per pull
             ingestors = [
-                cluster.spawn("ingest", f"ing{i}", env_extra=ing_env)
+                cluster.spawn("ingest", f"ing{i}", env_extra=ing_env, flight=True)
                 for i in range(n_ing)
             ]
             q_central = cluster.spawn(
@@ -1706,7 +1781,7 @@ def bench_distributed_fanout() -> None:
 
             def phase(node) -> dict:
                 cluster.query(node, sql, "5m", "now")  # warm plan/stream load
-                lats, wire, push_ok, fallbacks = [], [], 0, 0
+                lats, wire, push_ok, fallbacks, flight_n = [], [], 0, 0, 0
                 for _ in range(n_queries):
                     t0 = time.perf_counter()
                     records, stats = cluster.query(node, sql, "5m", "now")
@@ -1717,6 +1792,10 @@ def bench_distributed_fanout() -> None:
                     )
                     push_ok += fan.get("ok", 0)
                     fallbacks += fan.get("fallback", 0)
+                    # pushdown scatter reports {"flight": n}; the central
+                    # plane's staging fan-in reports {"flight_peers": n}
+                    t = fan.get("transport", {})
+                    flight_n += t.get("flight", 0) + t.get("flight_peers", 0)
                     assert records, "dashboard aggregate returned no groups"
                 return {
                     "p50": percentile(lats, 0.50),
@@ -1724,12 +1803,39 @@ def bench_distributed_fanout() -> None:
                     "wire_bytes_per_query": sum(wire) / max(1, len(wire)),
                     "pushdown_ok": push_ok,
                     "fallbacks": fallbacks,
+                    "flight_peers": flight_n,
                 }
 
             central = phase(q_central)
             push = phase(q_push)
             stop.set()
             bg.join(10)
+
+            # Flight-vs-HTTP fan-in A/B: one in-process QUERY-mode client
+            # alternating the transport pull-by-pull, measuring raw
+            # data-plane GB/s. Dedicated ingestors with sync paused hold a
+            # frozen window, so every pull ships the byte-identical,
+            # cache-hot payload — the A/B measures the wire, not the
+            # server-side window build (the main-phase ingestors answer
+            # this stream with an empty window on both rungs alike).
+            ab_rows = int(os.environ.get("BENCH_DF_AB_ROWS", "960000"))
+            ab_env = {
+                "P_LOCAL_SYNC_INTERVAL": "3600",
+                "P_STORAGE_UPLOAD_INTERVAL": "3600",
+            }
+            ab_ing = [
+                cluster.spawn("ingest", f"ab{i}", env_extra=ab_env, flight=True)
+                for i in range(n_ing)
+            ]
+            for node in ab_ing:
+                cluster.wait_live(node)
+            for node in ab_ing:
+                done = 0
+                while done < ab_rows:
+                    k = min(4000, ab_rows - done)
+                    cluster.ingest(node, "dfab", batch(k))
+                    done += k
+            ab = _flight_fanin_ab(pathlib.Path(workdir), n_queries, "dfab")
 
         byte_reduction = central["wire_bytes_per_query"] / max(
             1.0, push["wire_bytes_per_query"]
@@ -1763,16 +1869,59 @@ def bench_distributed_fanout() -> None:
                 "wire_byte_reduction": round(byte_reduction, 2),
                 "pushdown_ok_total": push["pushdown_ok"],
                 "pushdown_fallbacks": push["fallbacks"],
+                "pushdown_flight_peers": push["flight_peers"],
+                "central_flight_peers": central["flight_peers"],
                 "note": (
                     "1 querier per data plane + N ingestor PROCESSES over "
                     "LocalFS (scripts/blackbox.py) under sustained ingest; "
                     "dashboard GROUP BY over the last 5 minutes; central = "
                     "raw staging pull + full local scan, pushdown = per-peer "
                     "partial aggregation; wire bytes = querier<->ingestor "
-                    "data plane only"
+                    "data plane only; both queriers ride the Arrow Flight "
+                    "hot tier (flight_peers counts per-peer Flight wins)"
                 ),
             },
         )
+        if ab and ab["flight"]["wire_bytes_per_pull"] > 0 and ab["http"]["gbs"] > 0:
+            fanin_speedup = ab["flight"]["gbs"] / max(ab["http"]["gbs"], 1e-9)
+            print(
+                f"# flight fan-in A/B: flight {ab['flight']['gbs']:.3f} GB/s "
+                f"({ab['flight']['wire_bytes_per_pull'] / 1e6:.2f} MB/pull) vs "
+                f"http {ab['http']['gbs']:.3f} GB/s "
+                f"({ab['http']['wire_bytes_per_pull'] / 1e6:.2f} MB/pull) -> "
+                f"{fanin_speedup:.2f}x fan-in throughput",
+                file=sys.stderr,
+            )
+            emit(
+                "bench_flight_fanin",
+                ab["flight"]["gbs"],
+                fanin_speedup,
+                {
+                    "unit": "GB/s",
+                    "ingestors": n_ing,
+                    "ab_pairs": n_queries,
+                    "ab_rows_per_ingestor": ab_rows,
+                    "flight_gbs": round(ab["flight"]["gbs"], 4),
+                    "http_gbs": round(ab["http"]["gbs"], 4),
+                    "flight_p50_s": round(ab["flight"]["p50_s"], 4),
+                    "http_p50_s": round(ab["http"]["p50_s"], 4),
+                    "flight_wire_bytes_per_pull": round(
+                        ab["flight"]["wire_bytes_per_pull"], 1
+                    ),
+                    "http_wire_bytes_per_pull": round(
+                        ab["http"]["wire_bytes_per_pull"], 1
+                    ),
+                    "flight_fallbacks": ab["flight"]["flight_fallbacks"],
+                    "note": (
+                        "interleaved A/B, one in-process QUERY client vs the "
+                        "live ingestor processes: staging-window fan-in over "
+                        "Arrow Flight vs keep-alive HTTP+IPC, every peer's "
+                        "window refilled before each pair so payloads match "
+                        "and the pull order alternates; GB/s = wire bytes / "
+                        "wall time per transport"
+                    ),
+                },
+            )
     except Exception as e:  # noqa: BLE001
         print(f"# distributed fanout bench failed: {e}", file=sys.stderr)
     finally:

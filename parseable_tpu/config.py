@@ -234,9 +234,16 @@ class Options:
     address: str = field(default_factory=lambda: _env("P_ADDR", "0.0.0.0:8000"))
     ingestor_endpoint: str = field(default_factory=lambda: _env("P_INGESTOR_ENDPOINT", ""))
     querier_endpoint: str = field(default_factory=lambda: _env("P_QUERIER_ENDPOINT", ""))
-    # NOTE: the reference's P_FLIGHT_PORT/P_GRPC_PORT are intentionally
-    # absent — this build's inter-node data plane is HTTP + Arrow IPC on the
-    # main port (SURVEY §5 distributed-comm mapping), not Arrow Flight gRPC.
+    # Arrow Flight gRPC data plane (server/flight.py): ingest-capable nodes
+    # serve staging fan-in + partial pushdown over Flight on this port when
+    # > 0 (the reference's P_FLIGHT_PORT; 0 = disabled, HTTP + Arrow IPC on
+    # the main port remains the always-correct fallback tier).
+    flight_port: int = field(default_factory=lambda: _env_int("P_FLIGHT_PORT", 0))
+    # client-side tier switch: 0 pins intra-cluster fetches to the HTTP
+    # tier even when peers advertise Flight (mixed-version ops, A/B bench)
+    flight_client: bool = field(
+        default_factory=lambda: _env_bool("P_FLIGHT_CLIENT", True)
+    )
     mode: Mode = field(default_factory=lambda: Mode(_env("P_MODE", "all").lower()))
 
     # --- auth -----------------------------------------------------------------

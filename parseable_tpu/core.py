@@ -167,19 +167,27 @@ class Parseable:
             if address.startswith(("http://", "https://"))
             else f"{scheme}://{address}"
         )
-        self.metastore.put_node(
-            {
-                "node_id": self.node_id,
-                "node_type": node_type,
-                "domain_name": domain,
-                "mode": self.options.mode.to_str(),
-                # lets queriers split the manifest set by owner before the
-                # pushdown scatter; registry entries without it (older
-                # nodes) are served by central pull instead
-                "owner_tag": self.owner_tag,
-                "registered_at": rfc3339_now(),
-            }
-        )
+        node = {
+            "node_id": self.node_id,
+            "node_type": node_type,
+            "domain_name": domain,
+            "mode": self.options.mode.to_str(),
+            # lets queriers split the manifest set by owner before the
+            # pushdown scatter; registry entries without it (older
+            # nodes) are served by central pull instead
+            "owner_tag": self.owner_tag,
+            "registered_at": rfc3339_now(),
+        }
+        if self.options.flight_port > 0:
+            # advertise the Arrow Flight data plane (server/flight.py):
+            # same reachable host as the HTTP domain, Flight's own port.
+            # Registry entries without this key (flight disabled, older
+            # node) keep peers on the HTTP tier — discovery IS the ladder.
+            import urllib.parse as _up
+
+            host = _up.urlsplit(domain).hostname or "127.0.0.1"
+            node["flight_url"] = f"grpc://{host}:{self.options.flight_port}"
+        self.metastore.put_node(node)
 
     # --------------------------------------------------------------- streams
 

@@ -95,16 +95,34 @@ def deserialize_table(data: bytes) -> pa.Table:
 def execute_local_partial(
     p: "Parseable", stream_name: str, sql: str, start: str | None, end: str | None
 ) -> tuple[bytes, dict] | None:
+    """HTTP wire shape of `execute_local_partial_table`: the combined
+    partial serialized as Arrow IPC. Returns (ipc_payload, meta) — payload
+    b"" when the node-local slice is empty — or None when this node doesn't
+    know the stream at all."""
+    out = execute_local_partial_table(p, stream_name, sql, start, end)
+    if out is None:
+        return None
+    table, meta = out
+    if table is None:
+        return b"", meta
+    return serialize_table(table), meta
+
+
+def execute_local_partial_table(
+    p: "Parseable", stream_name: str, sql: str, start: str | None, end: str | None
+) -> tuple[pa.Table | None, dict] | None:
     """Run the node-local half of a pushed-down aggregate: scan this node's
     staging window (arrows AND flushed-but-unuploaded parquet — the querier
     delegated this node's whole slice, so nothing else covers those rows)
     plus the manifest files this node owns, reduce to per-block partials, and combine
     them into one wire-ready partial table.
 
-    Returns (ipc_payload, meta) — payload b"" when the node-local slice is
-    empty — or None when this node doesn't know the stream at all (nothing
-    node-local can exist). Raises UnsupportedPartial for plans the partial
-    protocol can't express."""
+    Transport-neutral core shared by the HTTP handler (which serializes to
+    IPC) and the Flight DoGet partial ticket (which streams the table
+    zero-copy). Returns (combined_table_or_None, meta) — table None when
+    the node-local slice is empty — or None when this node doesn't know the
+    stream at all (nothing node-local can exist). Raises UnsupportedPartial
+    for plans the partial protocol can't express."""
     from parseable_tpu.query import partials as PT
     from parseable_tpu.query import sql as S
     from parseable_tpu.query.executor import QueryExecutor
@@ -172,11 +190,10 @@ def execute_local_partial(
             meta["scan_errors"] = scan.stats.scan_errors
         sp["rows"] = meta["rows_scanned"]
         if not parts:
-            return b"", meta
+            return None, meta
         combined = PT.combine_partials(parts, agg.specs, len(lp.select.group_by))
-        payload = serialize_table(combined)
-        sp["bytes"] = len(payload)
-    return payload, meta
+        sp["bytes"] = combined.nbytes
+    return combined, meta
 
 
 # ------------------------------------------------------------ querier side
@@ -202,6 +219,7 @@ class _PeerState:
         self.elapsed_ms: float | None = None
         self.bytes = 0
         self.rows = 0  # peer-reported rows scanned (H_ROWS)
+        self.transport: str | None = None  # "flight" | "http" once done
 
 
 class DistributedRun:
@@ -218,9 +236,13 @@ class DistributedRun:
         self.scan = scan
         self.opts = p.options
         self.body = json.dumps(body).encode()
+        self.body_dict = body  # reused verbatim as the Flight partial ticket
         self.peers = [_PeerState(n) for n in peers]
         self._q: _queue.Queue = _queue.Queue()
         self._deferred: list[_PeerState] = []
+        # worker-incremented under the GIL (same pragmatic idiom as the
+        # fan-in stats dict); read only after collect() drains the queue
+        self._flight_declines = 0
         self.stats: dict = {
             "mode": "pushdown",
             "peers": len(peers),
@@ -251,24 +273,49 @@ class DistributedRun:
         get_cluster_pool().submit(telemetry.propagate(self._attempt), st, kind)
 
     def _attempt(self, st: _PeerState, kind: str) -> None:
-        """Worker-side: one HTTP round trip; every outcome posts exactly one
-        queue record (the collector owns all state)."""
-        from parseable_tpu.server.cluster import _http
+        """Worker-side: one round trip down the transport ladder — Arrow
+        Flight when the peer's registry entry advertises it, with ANY
+        flight failure declining to the HTTP tier byte-identically; every
+        outcome posts exactly one queue record (the collector owns all
+        state)."""
+        from parseable_tpu.server import cluster as C
 
         timeout = max(0.1, self.opts.fanout_timeout_ms / 1000.0)
-        url = f"{st.domain}{PARTIAL_PATH}/{self.lp.stream}"
         t0 = _time.monotonic()
+        location = C.flight_location(self.p, st.node)
+        if location is not None:
+            try:
+                with telemetry.TRACER.span(
+                    "query.fanout", peer=st.domain, kind=kind, transport="flight"
+                ) as sp:
+                    table, headers, nbytes = self._flight_attempt(location, timeout)
+                    sp["bytes"] = nbytes
+                self._q.put(
+                    (st, True, table, headers, _time.monotonic() - t0, kind)
+                )
+                return
+            except Exception as e:  # noqa: BLE001 - decline to HTTP
+                C.get_flight_pool().invalidate(location)
+                self._flight_declines += 1
+                CLUSTER_FANOUT_REQUESTS.labels(st.domain, "flight_decline").inc()
+                logger.warning(
+                    "flight pushdown to %s declined (%s), retrying over HTTP: %s",
+                    st.domain, kind, e,
+                )
+        url = f"{st.domain}{PARTIAL_PATH}/{self.lp.stream}"
         try:
             with telemetry.TRACER.span(
-                "query.fanout", peer=st.domain, kind=kind
+                "query.fanout", peer=st.domain, kind=kind, transport="http"
             ) as sp:
-                with _http(self.p, "POST", url, self.body, timeout=timeout) as resp:
+                with C._http(self.p, "POST", url, self.body, timeout=timeout) as resp:
                     data = resp.read()
                     headers = {
                         "rows_scanned": int(resp.headers.get(H_ROWS, 0) or 0),
                         "scan_errors": int(resp.headers.get(H_ERRORS, 0) or 0),
                         "owner_tag": resp.headers.get(H_TAG, ""),
                         "status": resp.status,
+                        "transport": "http",
+                        "wire_bytes": len(data),
                     }
                 sp["bytes"] = len(data)
             self._q.put((st, True, data, headers, _time.monotonic() - t0, kind))
@@ -281,6 +328,45 @@ class DistributedRun:
             )
         except (urllib.error.URLError, OSError, ValueError) as e:
             self._q.put((st, False, e, None, _time.monotonic() - t0, kind))
+
+    def _flight_attempt(self, location: str, timeout: float):
+        """One DoGet with the partial ticket: the peer's combined partial
+        streams back zero-copy, its accounting riding as ptpu.* schema
+        metadata (server/flight.py) which is stripped before the merge so
+        the table matches the HTTP tier's byte for byte. Raises on any
+        failure — the caller declines to HTTP."""
+        import pyarrow.flight as fl
+
+        from parseable_tpu.server import cluster as C
+        from parseable_tpu.server.flight import (
+            META_EMPTY,
+            META_ERRORS,
+            META_OWNER_TAG,
+            META_ROWS,
+            strip_flight_meta,
+        )
+
+        ticket = dict(self.body_dict, kind="partial", stream=self.lp.stream)
+        client = C.get_flight_pool().get(location)
+        reader = client.do_get(
+            fl.Ticket(json.dumps(ticket).encode()),
+            C._flight_call_options(self.p, timeout),
+        )
+        table = reader.read_all()
+        meta = table.schema.metadata or {}
+        headers = {
+            "rows_scanned": int(meta.get(META_ROWS, b"0") or 0),
+            "scan_errors": int(meta.get(META_ERRORS, b"0") or 0),
+            "owner_tag": (meta.get(META_OWNER_TAG) or b"").decode(),
+            "status": 200,
+            "transport": "flight",
+        }
+        if meta.get(META_EMPTY) == b"1" or table.num_columns == 0:
+            headers["wire_bytes"] = 0
+            return None, headers, 0
+        nbytes = table.nbytes
+        headers["wire_bytes"] = nbytes
+        return strip_flight_meta(table), headers, nbytes
 
     # ------------------------------------------------------------ gather
 
@@ -330,7 +416,10 @@ class DistributedRun:
         fallback = [st for st in self.peers if st.failed]
         if fallback:
             tables.extend(self._fallback_partials(fallback))
+        transport: dict = {}
         for st in self.peers:
+            if st.done and st.transport:
+                transport[st.transport] = transport.get(st.transport, 0) + 1
             self.stats["per_peer"][st.domain] = {
                 "result": "ok" if st.done else (st.fail_reason or "failed"),
                 "ms": round(st.elapsed_ms, 3) if st.elapsed_ms is not None else None,
@@ -338,7 +427,12 @@ class DistributedRun:
                 "rows": st.rows,
                 "attempts": st.issued,
                 "hedged": st.hedged,
+                "transport": st.transport,
             }
+        # queue is drained, workers are done: safe to read the decline tally
+        if self._flight_declines:
+            transport["flight_declines"] = self._flight_declines
+        self.stats["transport"] = transport
         return tables
 
     def _handle(self, item, tables: list[pa.Table]) -> None:
@@ -361,22 +455,29 @@ class DistributedRun:
                 )
                 self._fail(st, "tag_mismatch")
                 return
-            table = None
-            if payload:
+            # payload is already a Table off the Flight tier, IPC bytes off
+            # HTTP, or empty/None for a peer with nothing node-local
+            if isinstance(payload, pa.Table):
+                table = payload
+            elif payload:
                 try:
                     table = deserialize_table(payload)
                 except pa.ArrowInvalid:
                     logger.warning("bad partial payload from %s", st.domain)
                     self._fail(st, "bad_payload")
                     return
+            else:
+                table = None
+            nbytes = int(headers.get("wire_bytes", 0) or 0)
             st.done = True
+            st.transport = headers.get("transport")
             st.elapsed_ms = elapsed * 1000
-            st.bytes = len(payload)
+            st.bytes = nbytes
             st.rows = headers["rows_scanned"]
             self.stats["ok"] += 1
-            self.stats["bytes"] += len(payload)
+            self.stats["bytes"] += nbytes
             CLUSTER_FANOUT_REQUESTS.labels(st.domain, "ok").inc()
-            CLUSTER_FANOUT_BYTES.labels(st.domain).inc(len(payload))
+            CLUSTER_FANOUT_BYTES.labels(st.domain).inc(nbytes)
             CLUSTER_FANOUT_LATENCY.labels(st.domain).observe(elapsed)
             with self.scan._stats_lock:
                 self.scan.stats.rows_scanned += headers["rows_scanned"]
@@ -450,6 +551,11 @@ class DistributedRun:
         with self.scan._stats_lock:
             self.scan.stats.fanin_bytes += fanin.get("bytes", 0)
             self.scan.stats.fanin_errors += fanin.get("errors", 0)
+            for k in ("http_bytes", "flight_bytes", "flight_peers", "flight_fallbacks"):
+                if fanin.get(k):
+                    self.scan.stats.fanin_transport[k] = (
+                        self.scan.stats.fanin_transport.get(k, 0) + fanin[k]
+                    )
         if batches:
             schema = merge_schemas([b.schema for b in batches])
             table = pa.Table.from_batches([adapt_batch(schema, b) for b in batches])

@@ -40,7 +40,7 @@ import struct
 import threading
 import time as _time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from datetime import UTC, datetime, timedelta
 from pathlib import Path
 from typing import Callable, Iterator
@@ -85,6 +85,9 @@ class ScanStats:
     # Arrow IPC (central pull / pushdown fallback) + failed peer fetches
     fanin_bytes: int = 0
     fanin_errors: int = 0
+    # transport-ladder breakdown of the fan-in (http_bytes / flight_bytes /
+    # flight_peers / flight_fallbacks), merged from cluster.py's stats dict
+    fanin_transport: dict = field(default_factory=dict)
     # manifest files skipped because a live peer's pushdown scan owns them
     # (they are NOT pruned — another node is scanning them)
     files_delegated: int = 0
@@ -868,6 +871,13 @@ class StreamScan:
             with self._stats_lock:
                 self.stats.fanin_bytes += fanin.get("bytes", 0)
                 self.stats.fanin_errors += fanin.get("errors", 0)
+                for k in (
+                    "http_bytes", "flight_bytes", "flight_peers", "flight_fallbacks"
+                ):
+                    if fanin.get(k):
+                        self.stats.fanin_transport[k] = (
+                            self.stats.fanin_transport.get(k, 0) + fanin[k]
+                        )
             if remote:
                 from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
 

@@ -444,19 +444,27 @@ class QuerySession:
                 snap["fanin_bytes"] = scan.stats.fanin_bytes
                 snap["fanin_errors"] = scan.stats.fanin_errors
                 snap["files_delegated"] = scan.stats.files_delegated
+                # fallback fan-in's share of the transport ladder; the
+                # scatter's own flight/http split is already in "transport"
+                if scan.stats.fanin_transport:
+                    snap["fanin_transport"] = dict(scan.stats.fanin_transport)
             return snap
         with scan._stats_lock:
             fanin_bytes = scan.stats.fanin_bytes
             fanin_errors = scan.stats.fanin_errors
+            fanin_transport = dict(scan.stats.fanin_transport)
         from parseable_tpu.config import Mode as _Mode
 
         if self.p.options.mode != _Mode.QUERY and not fanin_bytes and not fanin_errors:
             return None
-        return {
+        out = {
             "mode": "central",
             "fanin_bytes": fanin_bytes,
             "fanin_errors": fanin_errors,
         }
+        if fanin_transport:
+            out["transport"] = fanin_transport
+        return out
 
     def _hotset_stage(self, routes: dict | None) -> dict | None:
         """stats.stages.hotset: first-class tier state (budget, residency,
@@ -609,9 +617,16 @@ class QuerySession:
             if fanout:
                 # distributed data plane: scatter totals + one line per peer
                 plan_types.append("fanout")
+
+                def _fv(v):
+                    # transport breakdowns are dicts: render flight:2,http:1
+                    if isinstance(v, dict):
+                        return ",".join(f"{k}:{v[k]}" for k in sorted(v))
+                    return v
+
                 lines = [
                     " ".join(
-                        f"{k}={fanout[k]}"
+                        f"{k}={_fv(fanout[k])}"
                         for k in (
                             "mode",
                             "peers",
@@ -620,17 +635,22 @@ class QuerySession:
                             "hedged",
                             "retries",
                             "bytes",
+                            "transport",
                             "fanin_bytes",
                             "fanin_errors",
+                            "fanin_transport",
                         )
-                        if fanout.get(k) is not None
+                        if fanout.get(k) not in (None, {})
                     )
                 ]
                 for domain, pp in sorted((fanout.get("per_peer") or {}).items()):
                     lines.append(
                         f"peer {domain}: " + " ".join(
                             f"{k}={pp.get(k)}"
-                            for k in ("result", "ms", "rows", "bytes", "attempts", "hedged")
+                            for k in (
+                                "result", "ms", "rows", "bytes",
+                                "attempts", "hedged", "transport",
+                            )
                         )
                     )
                 plans.append("\n".join(lines))

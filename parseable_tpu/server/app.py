@@ -108,6 +108,11 @@ class ServerState:
         # when P_EDGE_PORT > 0, stopped in stop(); RBAC mutations push a
         # fresh auth snapshot through it
         self.edge = None
+        # Arrow Flight data plane (server/flight.py) — started by
+        # run_server when P_FLIGHT_PORT > 0 on an ingest-capable mode,
+        # BEFORE node registration so discovery metadata is accurate;
+        # stopped in stop()
+        self.flight = None
 
     def hot_tier(self):
         """Lazily-built hot tier manager, restored from persisted budgets."""
@@ -280,6 +285,14 @@ class ServerState:
             except Exception:
                 logger.exception("edge stop failed")
             self.edge = None
+        # flight data plane: shut the gRPC server down and join its serve
+        # thread before staging flushes — in-flight DoGets drain first
+        if self.flight is not None:
+            try:
+                self.flight.stop()
+            except Exception:
+                logger.exception("flight stop failed")
+            self.flight = None
         self.resources.stop()
         # drain buffered spans into pmeta before the final staging flush so
         # the last requests' telemetry survives shutdown, then detach (no
@@ -303,11 +316,18 @@ class ServerState:
         from parseable_tpu.query.provider import shutdown_scan_scheduler
 
         shutdown_scan_scheduler()
-        # intra-cluster HTTP pool (staging fan-in, pushdown scatter,
-        # control-plane sync) — was an import-time pool with no stop path
-        from parseable_tpu.server.cluster import shutdown_cluster_pool
+        # intra-cluster client pools (staging fan-in, pushdown scatter,
+        # control-plane sync): the worker pool, the keep-alive HTTP
+        # connection pool, and the cached Flight channels
+        from parseable_tpu.server.cluster import (
+            shutdown_cluster_pool,
+            shutdown_conn_pool,
+            shutdown_flight_pool,
+        )
 
         shutdown_cluster_pool(wait=False)
+        shutdown_conn_pool()
+        shutdown_flight_pool()
         # device-warmer singleton (background hot-set warming)
         from parseable_tpu.ops.link import shutdown_warmer
 
@@ -1566,6 +1586,54 @@ def crud_routes(collection: str, put_action: Action, get_action: Action, delete_
 # ----- intra-cluster data plane --------------------------------------------
 
 
+def staging_window_table(stream, start, end, fields):
+    """This node's staging window as ONE table, bounded to [start, end) and
+    projected to `fields` (the timestamp column always rides along so the
+    querier can re-filter). Shared verbatim by the HTTP staging handler and
+    the Flight DoGet staging ticket (server/flight.py) so the two transport
+    tiers cannot drift — byte-identical fallback is a data contract, not a
+    convention. Returns None when the window is empty."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    batches = stream.staging_batches()
+    # flushed-but-not-yet-uploaded parquet is part of this node's
+    # staging window too — without it, rows are invisible to remote
+    # queriers for a whole upload interval. Unclaimed == not yet
+    # committed, so the querier's manifest scan can't double-count.
+    batches.extend(stream.unclaimed_parquet_batches())
+    if not batches:
+        return None
+    from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+    schema = merge_schemas([b.schema for b in batches])
+    table = pa.Table.from_batches([adapt_batch(schema, b) for b in batches])
+    if (
+        (start is not None or end is not None)
+        and DEFAULT_TIMESTAMP_KEY in table.column_names
+    ):
+        col = table.column(DEFAULT_TIMESTAMP_KEY)
+        mask = None
+        if start is not None:
+            mask = pc.greater_equal(
+                col, pa.scalar(start.replace(tzinfo=None), type=col.type)
+            )
+        if end is not None:
+            m2 = pc.less(col, pa.scalar(end.replace(tzinfo=None), type=col.type))
+            mask = m2 if mask is None else pc.and_(mask, m2)
+        table = table.filter(mask)
+    if fields is not None:
+        keep = [
+            c
+            for c in table.column_names
+            if c in fields or c == DEFAULT_TIMESTAMP_KEY
+        ]
+        table = table.select(keep)
+    if table.num_rows == 0:
+        return None
+    return table
+
+
 @require(Action.QUERY, "name")
 async def internal_staging(request: web.Request) -> web.Response:
     """GET /api/v1/internal/staging/{name}: this node's staging-window rows
@@ -1599,51 +1667,10 @@ async def internal_staging(request: web.Request) -> web.Response:
     def work() -> bytes:
         import io
 
-        import pyarrow as pa
-        import pyarrow.compute as pc
         import pyarrow.ipc as ipc
-        import pyarrow.parquet as pq
 
-        batches = stream.staging_batches()
-        # flushed-but-not-yet-uploaded parquet is part of this node's
-        # staging window too — without it, rows are invisible to remote
-        # queriers for a whole upload interval. Unclaimed == not yet
-        # committed, so the querier's manifest scan can't double-count.
-        for f in stream.unclaimed_parquet_files():
-            try:
-                batches.extend(pq.read_table(f).to_batches())
-            except FileNotFoundError:
-                continue
-            except Exception:
-                logger.exception("staging fan-in: unreadable staged parquet %s", f)
-        if not batches:
-            return b""
-        from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
-
-        schema = merge_schemas([b.schema for b in batches])
-        table = pa.Table.from_batches([adapt_batch(schema, b) for b in batches])
-        if (
-            (start is not None or end is not None)
-            and DEFAULT_TIMESTAMP_KEY in table.column_names
-        ):
-            col = table.column(DEFAULT_TIMESTAMP_KEY)
-            mask = None
-            if start is not None:
-                mask = pc.greater_equal(
-                    col, pa.scalar(start.replace(tzinfo=None), type=col.type)
-                )
-            if end is not None:
-                m2 = pc.less(col, pa.scalar(end.replace(tzinfo=None), type=col.type))
-                mask = m2 if mask is None else pc.and_(mask, m2)
-            table = table.filter(mask)
-        if fields is not None:
-            keep = [
-                c
-                for c in table.column_names
-                if c in fields or c == DEFAULT_TIMESTAMP_KEY
-            ]
-            table = table.select(keep)
-        if table.num_rows == 0:
+        table = staging_window_table(stream, start, end, fields)
+        if table is None:
             return b""
         sink = io.BytesIO()
         with ipc.new_stream(sink, table.schema) as w:
@@ -2373,6 +2400,21 @@ def run_server(opts: Options | None = None, storage: StorageOptions | None = Non
         logger.info("migrated %d stream metadata documents", upgraded)
     state = ServerState(p)
     host, _, port = p.options.address.rpartition(":")
+    # Arrow Flight data plane BEFORE registration: register_node advertises
+    # the flight endpoint from options, and a failed start zeroes the port
+    # so peers never discover a plane this node can't serve
+    if p.options.flight_port > 0:
+        try:
+            from parseable_tpu.server.flight import maybe_start_flight
+
+            state.flight = maybe_start_flight(state)
+        except ImportError:
+            logger.warning(
+                "P_FLIGHT_PORT=%d set but pyarrow.flight is unavailable; "
+                "staying on the HTTP data plane",
+                p.options.flight_port,
+            )
+            p.options.flight_port = 0
     p.register_node(p.options.address)
     if p.options.check_update:
         from parseable_tpu.utils.update import check_for_update

@@ -4,8 +4,18 @@ Parity target (reference: handlers/http/cluster/mod.rs + airplane.rs +
 utils/arrow/flight.rs): queriers discover ingestors through the object-store
 node registry (rendezvous metadata, SURVEY §5), probe liveness, and pull
 each live ingestor's staging-window rows as Arrow record batches before a
-query — the reference does this over Arrow Flight gRPC; this build's DCN
-data plane is HTTP + Arrow IPC (`/api/v1/internal/staging/{stream}`).
+query. Like the reference, the data plane is a two-tier transport ladder:
+
+- HOT: Arrow Flight gRPC (server/flight.py) when the peer's registry entry
+  advertises a ``flight_url`` and this client hasn't pinned HTTP
+  (P_FLIGHT_CLIENT=0) — record batches stream zero-copy over a per-peer
+  cached channel (`FlightChannelPool`);
+- FALLBACK: HTTP + Arrow IPC (`/api/v1/internal/staging/{stream}`) over
+  per-peer keep-alive connections (`PeerConnectionPool`), batches decoded
+  incrementally off the socket. ANY Flight decline — no advertisement,
+  channel failure, auth/ticket mismatch, mid-stream death — lands here
+  byte-identically; partial Flight reads are discarded first so a row can
+  never be counted twice.
 
 Dead nodes are skipped after a liveness probe and remembered briefly
 (reference: check_liveness + removal from the round-robin map,
@@ -15,6 +25,7 @@ cluster/mod.rs:1796-1850).
 from __future__ import annotations
 
 import base64
+import http.client
 import io
 import logging
 import math
@@ -24,6 +35,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor, as_completed
+from contextlib import contextmanager
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
@@ -64,6 +76,259 @@ def shutdown_cluster_pool(wait: bool = True) -> None:
         pool, _POOL = _POOL, None
     if pool is not None:
         pool.shutdown(wait=wait)
+
+
+class PeerConnectionPool:
+    """Keep-alive `http.client` connections per peer, for the HTTP tier of
+    the intra-cluster data plane.
+
+    The old path opened one TCP (+TLS) connection per call through
+    urllib.request.urlopen — a fresh handshake exactly where fan-in fetches
+    and pushdown scatters concentrate. Checkout/return keeps at most
+    `max_idle` warm sockets per (scheme, host, port); a stale keep-alive
+    connection the peer closed while idle is retried ONCE on a fresh socket
+    before any error surfaces.
+
+    The error contract is urllib's, so every existing caller keeps its
+    handlers: status >= 400 raises urllib.error.HTTPError (with .code and a
+    readable body), transport failures raise urllib.error.URLError/OSError.
+    """
+
+    def __init__(self, max_idle: int = 4):
+        self.max_idle = max_idle
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._idle: dict[tuple, list] = {}
+        # guarded-by: self._lock
+        self._closed = False
+
+    def _checkout(self, key):
+        with self._lock:
+            conns = self._idle.get(key)
+            if conns:
+                return conns.pop()
+        return None
+
+    def _checkin(self, key, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                conns = self._idle.setdefault(key, [])
+                if len(conns) < self.max_idle:
+                    conns.append(conn)
+                    return
+        conn.close()
+
+    def _connect(self, p, scheme: str, host: str, port: int, timeout: float):
+        if scheme == "https":
+            ctx = p.options.client_ssl_context() if p is not None else None
+            return http.client.HTTPSConnection(
+                host, port, timeout=timeout, context=ctx
+            )
+        return http.client.HTTPConnection(host, port, timeout=timeout)
+
+    @contextmanager
+    def request(self, p, method, url, body=None, headers=None, timeout=10.0):
+        parts = urllib.parse.urlsplit(url)
+        scheme = parts.scheme or "http"
+        host = parts.hostname or ""
+        port = parts.port or (443 if scheme == "https" else 80)
+        key = (scheme, host, port)
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        resp = None
+        for attempt in (0, 1):
+            conn = self._checkout(key)
+            reused = conn is not None
+            if conn is None:
+                conn = self._connect(p, scheme, host, port, timeout)
+            try:
+                # per-request deadline on a pooled socket (the constructor
+                # timeout only covered the first connect)
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                else:
+                    conn.timeout = timeout
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                break
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                stale = isinstance(
+                    e,
+                    (
+                        http.client.BadStatusLine,
+                        http.client.RemoteDisconnected,
+                        BrokenPipeError,
+                        ConnectionResetError,
+                    ),
+                )
+                # a reused socket the peer closed while idle is not a peer
+                # failure — retry once on a fresh connection
+                if reused and attempt == 0 and stale:
+                    continue
+                if isinstance(e, OSError):
+                    raise
+                raise urllib.error.URLError(e) from e
+        if resp.status >= 400:
+            data = resp.read()
+            self._maybe_reuse(key, conn, resp)
+            raise urllib.error.HTTPError(
+                url, resp.status, resp.reason, resp.headers, io.BytesIO(data)
+            )
+        try:
+            yield resp
+        finally:
+            self._maybe_reuse(key, conn, resp)
+
+    def _maybe_reuse(self, key, conn, resp) -> None:
+        try:
+            if not resp.isclosed():
+                resp.read()  # drain so the next request on this socket starts clean
+            if getattr(resp, "will_close", True):
+                conn.close()
+            else:
+                self._checkin(key, conn)
+        except Exception:
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = [c for lst in self._idle.values() for c in lst]
+            self._idle.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+_CONN_POOL: PeerConnectionPool | None = None
+_CONN_POOL_LOCK = threading.Lock()
+
+
+def get_conn_pool() -> PeerConnectionPool:
+    global _CONN_POOL
+    with _CONN_POOL_LOCK:
+        if _CONN_POOL is None:
+            _CONN_POOL = PeerConnectionPool()
+        return _CONN_POOL
+
+
+def shutdown_conn_pool() -> None:
+    """Close every idle keep-alive socket; wired into ServerState.stop.
+    In-flight requests hold their connection outside the pool and close it
+    themselves on checkin (the pool is marked closed)."""
+    global _CONN_POOL
+    with _CONN_POOL_LOCK:
+        pool, _CONN_POOL = _CONN_POOL, None
+    if pool is not None:
+        pool.close()
+
+
+class FlightChannelPool:
+    """Per-peer cached Arrow Flight clients — gRPC channel setup is the
+    per-call cost the hot tier exists to avoid, so channels persist across
+    fan-in fetches and scatter attempts. invalidate() drops a channel any
+    failure implicated (the next call redials)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._clients: dict[str, object] = {}
+
+    # gRPC's BDP probe starts every stream at a small flow-control window
+    # and ramps it from RTT estimates — loopback/LAN RTTs are so low the
+    # ramp itself caps DoGet at well under wire speed. A large static
+    # window (probe off) ships staging windows ~1.5-2x faster; frame size
+    # raised to the HTTP/2 max so 2MB record batches aren't sliced into
+    # 16KB frames. Flow control is receiver-driven, so the client-side
+    # channel options govern the server->client DoGet direction.
+    GRPC_OPTIONS = [
+        ("grpc.http2.bdp_probe", 0),
+        ("grpc.http2.lookahead_bytes", 16 * 1024 * 1024),
+        ("grpc.http2.max_frame_size", 16777215),
+    ]
+
+    def get(self, location: str):
+        import pyarrow.flight as fl
+
+        with self._lock:
+            client = self._clients.get(location)
+            if client is None:
+                client = fl.FlightClient(
+                    location, generic_options=list(self.GRPC_OPTIONS)
+                )
+                self._clients[location] = client
+            return client
+
+    def invalidate(self, location: str) -> None:
+        with self._lock:
+            client = self._clients.pop(location, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - best-effort channel teardown
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for client in clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - best-effort channel teardown
+                pass
+
+
+_FLIGHT_POOL: FlightChannelPool | None = None
+_FLIGHT_POOL_LOCK = threading.Lock()
+
+
+def get_flight_pool() -> FlightChannelPool:
+    global _FLIGHT_POOL
+    with _FLIGHT_POOL_LOCK:
+        if _FLIGHT_POOL is None:
+            _FLIGHT_POOL = FlightChannelPool()
+        return _FLIGHT_POOL
+
+
+def shutdown_flight_pool() -> None:
+    """Close every cached Flight channel; wired into ServerState.stop."""
+    global _FLIGHT_POOL
+    with _FLIGHT_POOL_LOCK:
+        pool, _FLIGHT_POOL = _FLIGHT_POOL, None
+    if pool is not None:
+        pool.close()
+
+
+def flight_location(p: Parseable, node: dict) -> str | None:
+    """The peer's advertised Flight endpoint, or None when the hot tier
+    does not apply: no ``flight_url`` in the registry entry (older build,
+    flight disabled), this client pinned to HTTP (P_FLIGHT_CLIENT=0), or
+    pyarrow.flight unavailable in this build."""
+    loc = node.get("flight_url")
+    if not loc or not getattr(p.options, "flight_client", True):
+        return None
+    try:
+        import pyarrow.flight  # noqa: F401
+    except ImportError:
+        return None
+    return loc
+
+
+def _flight_call_options(p: Parseable, timeout: float):
+    """Auth + trace headers for a Flight call — the same Basic cluster
+    credentials and W3C traceparent the HTTP tier sends, riding gRPC call
+    headers into server/flight.py's middleware."""
+    import pyarrow.flight as fl
+
+    headers = [(b"authorization", _auth_header(p).encode())]
+    tp = telemetry.current_traceparent()
+    if tp is not None:
+        headers.append((b"traceparent", tp.encode()))
+    return fl.FlightCallOptions(timeout=timeout, headers=headers)
 
 
 def _auth_header(p: Parseable) -> str:
@@ -136,6 +401,41 @@ def _staging_params(time_bounds=None, columns=None) -> str:
     return urllib.parse.urlencode(params)
 
 
+class _CountingReader:
+    """Read-through wrapper exposing the file-like protocol pyarrow's IPC
+    reader needs, counting wire bytes as they pass: batches decode
+    incrementally straight off the HTTP socket (peak memory = one batch,
+    not one response — the old path buffered the whole body in BytesIO
+    before the first batch decoded) while fan-in accounting still sees the
+    exact payload size."""
+
+    closed = False
+
+    def __init__(self, raw):
+        self._raw = raw
+        self.count = 0
+
+    def read(self, n=None):
+        data = self._raw.read() if n is None else self._raw.read(n)
+        self.count += len(data)
+        return data
+
+    def readable(self):
+        return True
+
+    def writable(self):
+        return False
+
+    def seekable(self):
+        return False
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
 def _fetch_one(
     p: Parseable,
     domain: str,
@@ -144,20 +444,30 @@ def _fetch_one(
     columns=None,
     stats: dict | None = None,
 ) -> list[pa.RecordBatch]:
+    """HTTP tier of the staging fan-in: one bounded pull over the keep-alive
+    peer pool, stream-decoded off the socket."""
     url = f"{domain}/api/v1/internal/staging/{stream}"
     qs = _staging_params(time_bounds, columns)
     if qs:
         url = f"{url}?{qs}"
     with telemetry.TRACER.span(
-        "cluster.fanin", peer=domain, stream=stream
+        "cluster.fanin", peer=domain, stream=stream, transport="http"
     ) as sp:
-        req = urllib.request.Request(url, headers={"Authorization": _auth_header(p)})
-        _inject_trace(req)
         try:
-            with _urlopen(req, STAGING_TIMEOUT, p) as resp:
+            with _http(p, "GET", url, timeout=STAGING_TIMEOUT) as resp:
                 if resp.status == 204:
                     return []
-                data = resp.read()
+                counting = _CountingReader(resp)
+                try:
+                    with ipc.open_stream(counting) as reader:
+                        batches = list(reader)
+                except pa.ArrowInvalid as e:
+                    logger.warning("bad staging payload from %s: %s", domain, e)
+                    CLUSTER_FANIN_ERRORS.labels(domain).inc()
+                    sp["status"] = "error"
+                    if stats is not None:
+                        stats["errors"] = stats.get("errors", 0) + 1
+                    return []
         except (urllib.error.URLError, OSError) as e:
             logger.warning("staging fan-in from %s failed: %s", domain, e)
             CLUSTER_FANIN_ERRORS.labels(domain).inc()
@@ -166,22 +476,95 @@ def _fetch_one(
                 stats["errors"] = stats.get("errors", 0) + 1
             _dead_nodes[domain] = time.monotonic()
             return []
-        if not data:
-            return []
-        CLUSTER_FANIN_BYTES.labels(domain).inc(len(data))
-        sp["bytes"] = len(data)
+        nbytes = counting.count
+        if nbytes:
+            CLUSTER_FANIN_BYTES.labels(domain).inc(nbytes)
+        sp["bytes"] = nbytes
         if stats is not None:
-            stats["bytes"] = stats.get("bytes", 0) + len(data)
-        try:
-            with ipc.open_stream(io.BytesIO(data)) as reader:
-                return list(reader)
-        except pa.ArrowInvalid as e:
-            logger.warning("bad staging payload from %s: %s", domain, e)
-            CLUSTER_FANIN_ERRORS.labels(domain).inc()
-            sp["status"] = "error"
-            if stats is not None:
-                stats["errors"] = stats.get("errors", 0) + 1
-            return []
+            stats["bytes"] = stats.get("bytes", 0) + nbytes
+            stats["http_bytes"] = stats.get("http_bytes", 0) + nbytes
+        return batches
+
+
+def _fetch_one_flight(
+    p: Parseable,
+    location: str,
+    domain: str,
+    stream: str,
+    time_bounds=None,
+    columns=None,
+    stats: dict | None = None,
+) -> list[pa.RecordBatch] | None:
+    """Flight tier of the staging fan-in: one DoGet with the bounded-window
+    ticket, batches streamed zero-copy off the gRPC channel. Returns None
+    on ANY failure — the caller declines to the HTTP tier, and partially
+    received batches are discarded so no row is ever double-counted."""
+    import json as _json
+
+    import pyarrow.flight as fl
+
+    ticket: dict = {"kind": "staging", "stream": stream}
+    if time_bounds is not None:
+        if time_bounds.low is not None:
+            ticket["start"] = time_bounds.low.isoformat()
+        if time_bounds.high is not None:
+            ticket["end"] = time_bounds.high.isoformat()
+    if columns is not None:
+        ticket["fields"] = sorted(columns)
+    pool = get_flight_pool()
+    try:
+        with telemetry.TRACER.span(
+            "cluster.fanin", peer=domain, stream=stream, transport="flight"
+        ) as sp:
+            client = pool.get(location)
+            reader = client.do_get(
+                fl.Ticket(_json.dumps(ticket).encode()),
+                _flight_call_options(p, STAGING_TIMEOUT),
+            )
+            batches: list[pa.RecordBatch] = []
+            nbytes = 0
+            for chunk in reader:
+                b = chunk.data
+                if b.num_rows:
+                    batches.append(b)
+                    nbytes += b.nbytes
+            sp["bytes"] = nbytes
+    except Exception as e:  # noqa: BLE001 - any decline falls back to HTTP
+        logger.warning("flight fan-in from %s declined: %s", domain, e)
+        pool.invalidate(location)
+        if stats is not None:
+            stats["flight_fallbacks"] = stats.get("flight_fallbacks", 0) + 1
+        return None
+    if nbytes:
+        CLUSTER_FANIN_BYTES.labels(domain).inc(nbytes)
+    if stats is not None:
+        stats["bytes"] = stats.get("bytes", 0) + nbytes
+        stats["flight_bytes"] = stats.get("flight_bytes", 0) + nbytes
+        stats["flight_peers"] = stats.get("flight_peers", 0) + 1
+    return batches
+
+
+def _fetch_node(
+    p: Parseable,
+    node: dict,
+    stream: str,
+    time_bounds=None,
+    columns=None,
+    stats: dict | None = None,
+) -> list[pa.RecordBatch]:
+    """Transport ladder for one peer's staging window: Arrow Flight when
+    the registry advertises it, else — or on any Flight decline — the HTTP
+    tier. Both tiers serve the same `staging_window_table`, so the payload
+    is byte-identical whichever rung answers."""
+    domain = node["domain_name"]
+    location = flight_location(p, node)
+    if location is not None:
+        out = _fetch_one_flight(
+            p, location, domain, stream, time_bounds, columns, stats
+        )
+        if out is not None:
+            return out
+    return _fetch_one(p, domain, stream, time_bounds, columns, stats)
 
 
 def fetch_staging_batches(
@@ -208,9 +591,9 @@ def fetch_staging_batches(
     pool = get_cluster_pool()
     futures = [
         pool.submit(
-            telemetry.propagate(_fetch_one),
+            telemetry.propagate(_fetch_node),
             p,
-            n["domain_name"],
+            n,
             stream,
             time_bounds,
             columns,
@@ -231,14 +614,21 @@ def fetch_staging_batches(
 
 
 def _http(p: Parseable, method: str, url: str, body: bytes | None = None, headers=None, timeout=10.0):
-    req = urllib.request.Request(url, data=body, method=method)
-    req.add_header("Authorization", _auth_header(p))
-    _inject_trace(req)  # every management-plane hop joins the caller's trace
-    for k, v in (headers or {}).items():
-        req.add_header(k, v)
-    if body is not None and "Content-Type" not in (headers or {}):
-        req.add_header("Content-Type", "application/json")
-    return _urlopen(req, timeout, p)
+    """One intra-cluster HTTP round trip over the keep-alive peer pool.
+    Returns a context manager yielding the response; raises urllib-shaped
+    errors (HTTPError on >= 400, URLError/OSError on transport failure) so
+    every caller written against urlopen is unchanged. The caller's
+    traceparent rides along — every hop joins the originating trace."""
+    hdrs = {"Authorization": _auth_header(p)}
+    tp = telemetry.current_traceparent()
+    if tp is not None:
+        hdrs["traceparent"] = tp
+    hdrs.update(headers or {})
+    if body is not None and "Content-Type" not in hdrs:
+        hdrs["Content-Type"] = "application/json"
+    return get_conn_pool().request(
+        p, method, url, body=body, headers=hdrs, timeout=timeout
+    )
 
 
 def live_peers(p: Parseable, kinds: tuple[str, ...]) -> list[dict]:

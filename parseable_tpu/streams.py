@@ -95,6 +95,11 @@ class Stream:
         # same arrows twice or upload the same parquet twice
         self._claimed_arrows: set[Path] = set()  # guarded-by: self.lock
         self._claimed_parquet: set[Path] = set()  # guarded-by: self.lock
+        # decoded staging-window caches (see staging_batches /
+        # unclaimed_parquet_batches): finished .arrows and staged .parquet
+        # never mutate in place, so (path, size, mtime_ns) keys are sound
+        self._staging_cache: tuple | None = None  # guarded-by: self.lock
+        self._staged_pq_cache: dict = {}  # guarded-by: self.lock
 
     # --- filenames ---------------------------------------------------------
 
@@ -165,6 +170,19 @@ class Stream:
         with self.lock:
             return [f for f in files if f not in self._claimed_parquet]
 
+    @staticmethod
+    def _fileset_key(files: list[Path]) -> tuple | None:
+        """Cache key for a set of write-once staging files; None (= never
+        hits) when any file vanished between listing and stat."""
+        try:
+            return tuple(
+                (str(f), st.st_size, st.st_mtime_ns)
+                for f in files
+                for st in (f.stat(),)
+            )
+        except OSError:
+            return None
+
     def staging_batches(self) -> list[pa.RecordBatch]:
         """Query-visible recent data: memory buffer, else on-disk arrows.
 
@@ -173,12 +191,70 @@ class Stream:
         flush current writers first so the IPC footers are valid, then read
         the finished files — same visibility (within the staging window) with
         one code path.
+
+        The decoded window is cached on the file set: finished .arrows are
+        write-once (DiskWriter.finish suffixes rather than overwrite), so as
+        long as the flush produced nothing new and compaction claimed
+        nothing, repeated fan-in pulls reuse the same batches instead of
+        re-reading the whole window from disk per request. The cache holds
+        at most one staging window per stream — data a single pull
+        materializes anyway.
         """
         with self.lock:
             self.flush(forced=True)
             files = self.arrow_files()
-        reader = MergedReverseRecordReader(files)
-        return list(reader)
+            key = self._fileset_key(files)
+            cached = self._staging_cache
+            if key is not None and cached is not None and cached[0] == key:
+                return list(cached[1])
+        batches = list(MergedReverseRecordReader(files))
+        if len(batches) > 1:
+            # one-time regroup at cold build (cached below): the window
+            # arrives as per-flush slivers, and every downstream consumer —
+            # IPC serialization, Flight's one-gRPC-message-per-batch
+            # streaming, the local scan — pays per-batch framing. Slice to
+            # ~2MB batches: big enough to amortize framing, small enough to
+            # stream under gRPC message-size limits. Order is preserved.
+            tbl = pa.Table.from_batches(batches).combine_chunks()
+            rows_per = max(
+                1, int((2 << 20) * tbl.num_rows / max(1, tbl.nbytes))
+            )
+            batches = tbl.to_batches(max_chunksize=rows_per)
+        if key is not None:
+            with self.lock:
+                self._staging_cache = (key, batches)
+        return list(batches)
+
+    def unclaimed_parquet_batches(self) -> list[pa.RecordBatch]:
+        """Decoded batches of every unclaimed staged parquet, cached per
+        file — staged parquet is written once by compaction and deleted
+        after upload commit, never rewritten, so repeated staging fan-in
+        pulls skip the per-request pq.read_table. Files claimed or deleted
+        since the last call drop out of the cache wholesale."""
+        files = self.unclaimed_parquet_files()
+        out: list[pa.RecordBatch] = []
+        fresh: dict[Path, tuple] = {}
+        for f in files:
+            key = self._fileset_key([f])
+            with self.lock:
+                hit = self._staged_pq_cache.get(f)
+            if key is not None and hit is not None and hit[0] == key:
+                fresh[f] = hit
+                out.extend(hit[1])
+                continue
+            try:
+                batches = pq.read_table(f).to_batches()
+            except FileNotFoundError:
+                continue
+            except Exception:
+                logger.exception("staging fan-in: unreadable staged parquet %s", f)
+                continue
+            if key is not None:
+                fresh[f] = (key, batches)
+            out.extend(batches)
+        with self.lock:
+            self._staged_pq_cache = fresh
+        return out
 
     # --- flush + convert ---------------------------------------------------
 

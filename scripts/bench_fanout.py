@@ -1,10 +1,12 @@
 """Battery hook: run the multi-process distributed fan-out bench standalone.
 
 `python scripts/bench_fanout.py` boots 1 querier per data plane + N ingestor
-processes (scripts/blackbox.py) and emits the bench_distributed_fanout line
-— the same emission bench.py produces inside the full battery, runnable on
-its own for the hardware-watch battery and for iterating on the cluster
-path without rebuilding datasets. Knobs: BENCH_DF_* (see bench.py).
+processes (scripts/blackbox.py) and emits the bench_distributed_fanout and
+bench_flight_fanin lines (the latter: interleaved Arrow-Flight-vs-HTTP
+fan-in A/B, GB/s + per-pull wire bytes) — the same emissions bench.py
+produces inside the full battery, runnable on their own for the
+hardware-watch battery and for iterating on the cluster path without
+rebuilding datasets. Knobs: BENCH_DF_* (see bench.py).
 """
 
 import os

@@ -42,11 +42,19 @@ def free_port() -> int:
 class Node:
     """One running server process."""
 
-    def __init__(self, proc: subprocess.Popen, mode: str, port: int, log_path: Path):
+    def __init__(
+        self,
+        proc: subprocess.Popen,
+        mode: str,
+        port: int,
+        log_path: Path,
+        flight_port: int = 0,
+    ):
         self.proc = proc
         self.mode = mode
         self.port = port
         self.log_path = log_path
+        self.flight_port = flight_port  # 0 = HTTP-only data plane
 
     @property
     def url(self) -> str:
@@ -132,8 +140,10 @@ class ClusterHarness:
         name: str,
         env_extra: dict | None = None,
         port: int | None = None,
+        flight: bool = False,
     ) -> Node:
         port = port or free_port()
+        flight_port = free_port() if flight else 0
         staging = self.workdir / f"staging-{name}"
         staging.mkdir(parents=True, exist_ok=True)
         log_dir = self.workdir / "logs"
@@ -153,6 +163,8 @@ class ClusterHarness:
                 "PYTHONUNBUFFERED": "1",
             }
         )
+        if flight_port:
+            env["P_FLIGHT_PORT"] = str(flight_port)
         env.update(env_extra or {})
         # append: a re-spawned node (rolling restart, crash-recovery
         # scenarios) keeps its pre-kill log instead of truncating it
@@ -169,7 +181,7 @@ class ClusterHarness:
             )
         finally:
             log.close()  # the child inherited the fd
-        node = Node(proc, mode, port, log_path)
+        node = Node(proc, mode, port, log_path, flight_port=flight_port)
         self.nodes.append(node)
         return node
 

@@ -129,10 +129,13 @@ fi
 
 # observability gate: the multi-process cluster smoke — distributed trace
 # stitching (one cross-node span tree per query) and the conservation-law
-# audit (zero violations at quiesce) over REAL server processes. Opt out
-# with OBS_CLUSTER=0 (boots 3 processes; ~half a minute on a warm cache).
+# audit (zero violations at quiesce) over REAL server processes, with the
+# ingestors serving the Arrow Flight data plane (the smoke asserts the
+# scatter rode it). FLIGHT=0 pins the smoke to the HTTP tier — the escape
+# hatch if gRPC misbehaves on a box. Opt out entirely with OBS_CLUSTER=0
+# (boots 3 processes; ~half a minute on a warm cache).
 if [ "${OBS_CLUSTER:-1}" != "0" ]; then
-  if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py --cluster; then
+  if ! timeout -k 10 420 env JAX_PLATFORMS=cpu FLIGHT="${FLIGHT:-1}" python scripts/obs_smoke.py --cluster; then
     echo "check_green: OBS CLUSTER RED (trace stitching / audit smoke failed)" >&2
     exit 1
   fi

@@ -151,10 +151,16 @@ def _load_blackbox():
     return mod
 
 
-def run_cluster_smoke(workdir: Path) -> dict:
+def run_cluster_smoke(workdir: Path, flight: bool = True) -> dict:
     """Multi-process observability smoke: distributed trace stitching +
     conservation audit over a REAL 1-querier / 2-ingestor cluster.
-    Raises AssertionError on any broken link."""
+    Raises AssertionError on any broken link.
+
+    `flight=True` (default) serves the ingestors' data plane over Arrow
+    Flight and additionally asserts the scatter rode it — transport in the
+    fanout stage/plan row AND `flight.do_get` spans in the stitched trace.
+    `flight=False` (check_green.sh's FLIGHT=0 hatch) keeps the whole smoke
+    on the HTTP tier."""
     import time
 
     bb = _load_blackbox()
@@ -170,8 +176,8 @@ def run_cluster_smoke(workdir: Path) -> dict:
         "P_INGEST_SHARD_MIN_BYTES": "0",
     }
     with bb.ClusterHarness(workdir) as cluster:
-        ing0 = cluster.spawn("ingest", "ing0", env_extra=frozen)
-        ing1 = cluster.spawn("ingest", "ing1", env_extra=frozen)
+        ing0 = cluster.spawn("ingest", "ing0", env_extra=frozen, flight=flight)
+        ing1 = cluster.spawn("ingest", "ing1", env_extra=frozen, flight=flight)
         q = cluster.spawn("query", "q0")
         for node in (ing0, ing1, q):
             cluster.wait_live(node)
@@ -207,6 +213,19 @@ def run_cluster_smoke(workdir: Path) -> dict:
         assert len(trace_id) == 32, f"bad X-P-Trace-Id {trace_id!r}"
         fanout = (stats.get("stages") or {}).get("fanout") or {}
         assert fanout.get("per_peer"), f"no per-peer fanout breakdown: {stats}"
+        if flight:
+            # the hot tier carried the scatter, and said so
+            assert fanout.get("transport", {}).get("flight", 0) >= 1, fanout
+            assert all(
+                pp.get("transport") == "flight"
+                for pp in fanout["per_peer"].values()
+                if pp.get("result") == "ok"
+            ), fanout
+
+        def walk(nodes):
+            for nd in nodes:
+                yield nd
+                yield from walk(nd["children"])
 
         tree = cluster.cluster_trace(q, trace_id)
         assert tree["orphans"] == 0, tree
@@ -216,6 +235,11 @@ def run_cluster_smoke(workdir: Path) -> dict:
             f"expected querier + both ingestors in the trace, got {tree['nodes']}"
         )
         assert tree["critical_path"], tree
+        if flight:
+            # the ingestors' DoGet handlers joined the querier's trace:
+            # the gRPC hop propagates traceparent exactly like HTTP
+            qnames = [s["name"] for s in walk(tree["tree"])]
+            assert qnames.count("flight.do_get") >= 2, qnames
 
         # EXPLAIN ANALYZE surfaces the same breakdown as a plan row
         plan, _ = cluster.query(
@@ -226,6 +250,11 @@ def run_cluster_smoke(workdir: Path) -> dict:
         )
         plan_types = {r.get("plan_type") for r in plan}
         assert "fanout" in plan_types, f"no fanout plan row: {plan}"
+        if flight:
+            fanrows = [r for r in plan if r.get("plan_type") == "fanout"]
+            assert any(
+                "transport=flight" in (r.get("plan") or "") for r in fanrows
+            ), f"no flight transport in fanout plan row: {fanrows}"
 
         # native-path telemetry: a traced ingest must stitch the C++
         # per-shard parse spans (recorded below the ctypes boundary by the
@@ -246,12 +275,6 @@ def run_cluster_smoke(workdir: Path) -> dict:
         )
         assert status == 200, f"traced ingest failed: {status}"
         itree = cluster.cluster_trace(q, ing_tid)
-
-        def walk(nodes):
-            for nd in nodes:
-                yield nd
-                yield from walk(nd["children"])
-
         ispans = list(walk(itree["tree"]))
         native_parse = [s for s in ispans if s["name"] == "native.parse"]
         assert len(native_parse) == 2, (
@@ -280,19 +303,24 @@ def run_cluster_smoke(workdir: Path) -> dict:
             "trace_nodes": len(contributing),
             "span_count": tree["span_count"],
             "critical_path": [s["name"] for s in tree["critical_path"]],
+            "fanout_transport": fanout.get("transport", {}),
             "audit_nodes": len(report["nodes"]),
             "violations": report["total_violations"],
         }
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     argv = sys.argv[1:] if argv is None else argv
     with tempfile.TemporaryDirectory(prefix="obs-smoke-") as d:
         result = run_smoke(Path(d))
     print("obs smoke OK:", result)
     if "--cluster" in argv:
+        # FLIGHT=0: escape-hatch the smoke onto the HTTP data plane
+        flight = os.environ.get("FLIGHT", "1") != "0"
         with tempfile.TemporaryDirectory(prefix="obs-smoke-cluster-") as d:
-            cluster_result = run_cluster_smoke(Path(d))
+            cluster_result = run_cluster_smoke(Path(d), flight=flight)
         print("obs cluster smoke OK:", cluster_result)
     return 0
 

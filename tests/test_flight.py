@@ -1,0 +1,674 @@
+"""Arrow Flight data plane: transport-ladder parity + fallback.
+
+Same in-process cluster topology as test_fanout.py (real sockets), plus a
+FlightDataServer per ingestor. Covers the acceptance invariants: Flight and
+HTTP serve byte-identical staging windows and pushdown partials; every
+Flight decline — flight-less peer, dead channel, mid-stream death, bad
+credentials — lands on the HTTP tier with exact row conservation; and the
+keep-alive HTTP pool preserves urllib's error contract while retrying a
+stale socket once. One real 3-process ClusterHarness scenario proves the
+ladder end to end with a green quiesce audit.
+"""
+
+import asyncio
+import base64
+import http.client
+import importlib.util
+import io
+import json
+import time
+from pathlib import Path
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+import pytest
+
+from parseable_tpu.config import Mode, Options, StorageOptions
+from parseable_tpu.core import Parseable
+from parseable_tpu.query.session import QuerySession
+from parseable_tpu.server import cluster as C
+from parseable_tpu.server.app import ServerState, build_app
+from parseable_tpu.server.flight import FlightDataServer, strip_flight_meta
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+AUTH = {"Authorization": "Basic " + base64.b64encode(b"admin:admin").decode()}
+
+SQL = (
+    "SELECT host, count(*) c, sum(v) s, avg(v) a, min(v) mn, max(v) mx "
+    "FROM dist GROUP BY host ORDER BY host"
+)
+
+EXPECTED = [
+    {"host": "node0", "c": 10, "s": 45.0, "a": 4.5, "mn": 0.0, "mx": 9.0},
+    {"host": "node1", "c": 10, "s": 45.0, "a": 4.5, "mn": 0.0, "mx": 9.0},
+]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cluster_state():
+    C._dead_nodes.clear()
+    yield
+    C._dead_nodes.clear()
+    # channel/socket pools are process-global: drop them so one test's
+    # cached (possibly poisoned) connections never leak into the next
+    C.shutdown_flight_pool()
+    C.shutdown_conn_pool()
+    C.shutdown_cluster_pool()
+
+
+def make_parseable(tmp_path, node: str, mode: Mode) -> Parseable:
+    opts = Options()
+    opts.mode = mode
+    opts.local_staging_path = tmp_path / f"staging-{node}"
+    storage = StorageOptions(backend="local-store", root=tmp_path / "shared-store")
+    return Parseable(opts, storage)
+
+
+def run(coro):
+    asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def boot_ingestors(
+    tmp_path, n=2, stream="dist", rows_per_node=10, prefix="ing", flight=True
+):
+    """N ingest-mode servers on real ports; `flight=True` additionally binds
+    a FlightDataServer on an ephemeral port and advertises it through the
+    node registry (the production `maybe_start_flight` + `register_node`
+    contract, minus `run_server`)."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    states, servers = [], []
+    for i in range(n):
+        p = make_parseable(tmp_path, f"{prefix}{i}", Mode.INGEST)
+        state = ServerState(p)
+        server = TestServer(build_app(state))
+        await server.start_server()
+        if flight:
+            srv = FlightDataServer(state, "127.0.0.1", 0)
+            srv.start_background()
+            state.flight = srv  # joined by state.stop() (pool-lifecycle)
+            p.options.flight_port = srv.port
+        p.register_node(f"127.0.0.1:{server.port}")
+        states.append(state)
+        servers.append(server)
+    async with aiohttp.ClientSession() as http_sess:
+        for i, server in enumerate(servers):
+            url = f"http://127.0.0.1:{server.port}/api/v1/ingest"
+            rows = [{"host": f"node{i}", "v": float(j)} for j in range(rows_per_node)]
+            async with http_sess.post(
+                url, json=rows, headers={**AUTH, "X-P-Stream": stream}
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+    return states, servers
+
+
+async def teardown(states, servers):
+    for s in servers:
+        await s.close()
+    for st in states:
+        st.stop()  # joins flight-serve + shuts every pool (psan-thread-leak)
+
+
+def query_on(tmp_path, node: str, sql: str = SQL, **opt_overrides):
+    q = make_parseable(tmp_path, node, Mode.QUERY)
+    try:
+        for k, v in opt_overrides.items():
+            setattr(q.options, k, v)
+        res = QuerySession(q, engine="cpu").query(sql)
+        return res.to_json_rows(), res.stats
+    finally:
+        q.shutdown()
+
+
+def batches_table(batches) -> pa.Table:
+    from parseable_tpu.utils.arrowutil import adapt_batch, merge_schemas
+
+    schema = merge_schemas([b.schema for b in batches])
+    return pa.Table.from_batches([adapt_batch(schema, b) for b in batches])
+
+
+# ----------------------------------------------------------- staging fan-in
+
+
+def test_flight_staging_fanin_parity_with_http(tmp_path):
+    """The same bounded staging window arrives byte-identically over either
+    tier, and the fan-in stats carry the transport breakdown."""
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+
+        def fetch(flight_client: bool):
+            q.options.flight_client = flight_client
+            stats: dict = {}
+            batches = C.fetch_staging_batches(q, "dist", stats=stats)
+            return batches, stats
+
+        loop = asyncio.get_running_loop()
+        fb, fstats = await loop.run_in_executor(None, fetch, True)
+        hb, hstats = await loop.run_in_executor(None, fetch, False)
+        # flight tier answered, and said so
+        assert fstats["flight_peers"] == 1 and fstats["flight_bytes"] > 0
+        assert "errors" not in fstats and "flight_fallbacks" not in fstats
+        assert "http_bytes" not in fstats
+        # pinned client stayed on HTTP
+        assert hstats["http_bytes"] > 0 and "flight_peers" not in hstats
+        # identical rows either way (sort: batch order is not contractual)
+        ft, ht = batches_table(fb), batches_table(hb)
+        assert ft.num_rows == ht.num_rows == 10
+        assert ft.sort_by("v").equals(ht.sort_by("v"))
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_flight_staging_respects_bounds_and_projection(tmp_path):
+    """The staging ticket carries start/end/fields exactly like the HTTP
+    query string: an excluding window yields nothing, a projection ships
+    only the asked-for columns (+ timestamp)."""
+    from datetime import datetime, timezone
+
+    from parseable_tpu.query.planner import TimeBounds
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+
+        def fetch(bounds, columns):
+            stats: dict = {}
+            return (
+                C.fetch_staging_batches(
+                    q, "dist", time_bounds=bounds, columns=columns, stats=stats
+                ),
+                stats,
+            )
+
+        loop = asyncio.get_running_loop()
+        narrow, nstats = await loop.run_in_executor(
+            None, fetch, TimeBounds(), {"host"}
+        )
+        assert nstats.get("flight_peers") == 1
+        assert sum(b.num_rows for b in narrow) == 10
+        assert set(narrow[0].schema.names) == {"host", "p_timestamp"}
+        ancient = TimeBounds(
+            low=datetime(2000, 1, 1, tzinfo=timezone.utc),
+            high=datetime(2000, 1, 2, tzinfo=timezone.utc),
+        )
+        empty, estats = await loop.run_in_executor(None, fetch, ancient, None)
+        assert empty == []
+        assert "errors" not in estats and "flight_fallbacks" not in estats
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_flight_mid_stream_death_discards_partial_batches(tmp_path):
+    """A peer that dies mid-DoGet-stream: the partially received Flight
+    batches are discarded and the peer's WHOLE window is re-fetched over
+    HTTP — exactly 10 rows land, never 10 + a partial chunk."""
+    import pyarrow.flight as fl
+
+    class DiesMidStream(fl.FlightServerBase):
+        def do_get(self, context, ticket):
+            table = pa.table({"v": list(range(100))})
+
+            def gen():
+                yield table.to_batches(max_chunksize=10)[0]
+                raise RuntimeError("peer died mid-stream")
+
+            return fl.GeneratorStream(table.schema, gen())
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1, flight=False)
+        broken = DiesMidStream(location="grpc://127.0.0.1:0")
+        # splice the broken plane into the peer's registry entry
+        p0 = states[0].p
+        node = p0.metastore.list_nodes("ingestor")[0]
+        node["flight_url"] = f"grpc://127.0.0.1:{broken.port}"
+        p0.metastore.put_node(node)
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+
+        def fetch():
+            stats: dict = {}
+            return C.fetch_staging_batches(q, "dist", stats=stats), stats
+
+        batches, stats = await asyncio.get_running_loop().run_in_executor(
+            None, fetch
+        )
+        assert stats["flight_fallbacks"] == 1
+        assert stats["http_bytes"] > 0 and "flight_bytes" not in stats
+        assert sum(b.num_rows for b in batches) == 10
+        assert all(b.schema.names != ["v"] for b in batches)
+        broken.shutdown()
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_dead_flight_channel_falls_back_to_http(tmp_path):
+    """A registry entry advertising a flight_url nothing listens on: the
+    ladder declines fast and the HTTP tier serves the full window."""
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1, flight=False)
+        p0 = states[0].p
+        node = p0.metastore.list_nodes("ingestor")[0]
+        node["flight_url"] = "grpc://127.0.0.1:1"
+        p0.metastore.put_node(node)
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+
+        def fetch():
+            stats: dict = {}
+            return C.fetch_staging_batches(q, "dist", stats=stats), stats
+
+        batches, stats = await asyncio.get_running_loop().run_in_executor(
+            None, fetch
+        )
+        assert stats["flight_fallbacks"] == 1
+        assert sum(b.num_rows for b in batches) == 10
+        assert stats["bytes"] == stats["http_bytes"] > 0
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+# ------------------------------------------------------- pushdown scatter
+
+
+def test_flight_pushdown_parity_with_http(tmp_path):
+    """Pushdown over Flight and over pinned HTTP agree exactly, and
+    stats.stages.fanout reports the transport split + per-peer transport."""
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path)
+
+        def both():
+            frows, fstats = query_on(tmp_path, "qf")
+            hrows, hstats = query_on(tmp_path, "qh", flight_client=False)
+            return frows, fstats, hrows, hstats
+
+        frows, fstats, hrows, hstats = await asyncio.get_running_loop().run_in_executor(
+            None, both
+        )
+        assert frows == EXPECTED == hrows
+        fan = fstats["stages"]["fanout"]
+        assert fan["mode"] == "pushdown" and fan["ok"] == 2
+        assert fan["transport"] == {"flight": 2}
+        assert fan["bytes"] > 0
+        assert all(
+            pp["transport"] == "flight" and pp["bytes"] > 0
+            for pp in fan["per_peer"].values()
+        )
+        hfan = hstats["stages"]["fanout"]
+        assert hfan["ok"] == 2 and hfan["transport"] == {"http": 2}
+        # peer scan accounting rode the schema metadata, same as headers
+        assert fstats["rows_scanned"] >= 20
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_flightless_peer_rides_http_rung(tmp_path):
+    """A mixed cluster — one Flight peer, one HTTP-only peer — splits the
+    scatter across the ladder with no declines and exact results."""
+
+    async def scenario():
+        states0, servers0 = await boot_ingestors(tmp_path, n=1, flight=True)
+        states1, servers1 = await boot_ingestors(
+            tmp_path, n=1, flight=False, prefix="plain"
+        )
+        # the second boot ingested host "node0" again; re-tag it as node1
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http_sess:
+            url = f"http://127.0.0.1:{servers1[0].port}/api/v1/ingest"
+            async with http_sess.post(
+                url,
+                json=[{"host": "node1", "v": float(j)} for j in range(10)],
+                headers={**AUTH, "X-P-Stream": "dist"},
+            ) as resp:
+                assert resp.status == 200
+
+        rows, stats = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: query_on(tmp_path, "q", "SELECT host, count(*) c FROM dist GROUP BY host ORDER BY host")
+        )
+        fan = stats["stages"]["fanout"]
+        assert fan["ok"] == 2
+        assert fan["transport"] == {"flight": 1, "http": 1}
+        by_host = {r["host"]: r["c"] for r in rows}
+        assert by_host["node1"] == 10 and by_host["node0"] == 20
+        await teardown(states0 + states1, servers0 + servers1)
+
+    run(scenario())
+
+
+def test_dead_flight_channel_pushdown_declines_to_http(tmp_path):
+    """A dead advertised channel during the scatter: the attempt declines
+    to HTTP (not to the central fallback) and the merge stays exact."""
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1, flight=False)
+        p0 = states[0].p
+        node = p0.metastore.list_nodes("ingestor")[0]
+        node["flight_url"] = "grpc://127.0.0.1:1"
+        p0.metastore.put_node(node)
+
+        rows, stats = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: query_on(tmp_path, "q")
+        )
+        assert rows == [EXPECTED[0]]
+        fan = stats["stages"]["fanout"]
+        assert fan["ok"] == 1 and fan["fallback"] == 0
+        assert fan["transport"] == {"http": 1, "flight_declines": 1}
+        assert fan["per_peer"].popitem()[1]["transport"] == "http"
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_flight_partial_payload_matches_http_payload(tmp_path):
+    """The partial ticket's table, stripped of its ptpu.* metadata, is
+    byte-identical to the HTTP endpoint's IPC payload, and the accounting
+    metadata mirrors the X-P-* headers."""
+    import pyarrow.flight as fl
+
+    from parseable_tpu.query import fanout as FO
+    from parseable_tpu.server.flight import (
+        META_EMPTY,
+        META_OWNER_TAG,
+        META_ROWS,
+    )
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        state = states[0]
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+
+        def compare():
+            payload, meta = FO.execute_local_partial(
+                state.p, "dist", SQL, None, None
+            )
+            client = C.get_flight_pool().get(
+                f"grpc://127.0.0.1:{state.flight.port}"
+            )
+            ticket = {"kind": "partial", "stream": "dist", "query": SQL}
+            table = client.do_get(
+                fl.Ticket(json.dumps(ticket).encode()),
+                C._flight_call_options(q, 10.0),
+            ).read_all()
+            return payload, meta, table
+
+        payload, meta, table = await asyncio.get_running_loop().run_in_executor(
+            None, compare
+        )
+        md = table.schema.metadata
+        assert md[META_OWNER_TAG].decode() == meta["owner_tag"] == state.p.owner_tag
+        assert int(md[META_ROWS]) == meta["rows_scanned"] == 10
+        assert META_EMPTY not in md
+        stripped = strip_flight_meta(table)
+        assert stripped.equals(FO.deserialize_table(payload))
+        assert FO.serialize_table(stripped) == payload
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+# ------------------------------------------------------ auth + ticket gate
+
+
+def test_flight_rejects_bad_credentials_and_tickets(tmp_path):
+    """Middleware rejects wrong Basic credentials before any handler runs;
+    malformed and unknown tickets surface as Flight errors (the client
+    ladder turns either into an HTTP fallback)."""
+    import pyarrow.flight as fl
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        location = f"grpc://127.0.0.1:{states[0].flight.port}"
+
+        def probe():
+            client = fl.FlightClient(location)
+            bad = fl.FlightCallOptions(
+                timeout=5.0,
+                headers=[
+                    (
+                        b"authorization",
+                        b"Basic " + base64.b64encode(b"admin:wrong"),
+                    )
+                ],
+            )
+            good = fl.FlightCallOptions(
+                timeout=5.0,
+                headers=[
+                    (
+                        b"authorization",
+                        b"Basic " + base64.b64encode(b"admin:admin"),
+                    )
+                ],
+            )
+            ticket = fl.Ticket(
+                json.dumps({"kind": "staging", "stream": "dist"}).encode()
+            )
+            with pytest.raises(fl.FlightUnauthenticatedError):
+                client.do_get(ticket, bad).read_all()
+            with pytest.raises(fl.FlightError):
+                client.do_get(fl.Ticket(b"not json"), good).read_all()
+            with pytest.raises(fl.FlightError):
+                client.do_get(
+                    fl.Ticket(
+                        json.dumps({"kind": "nope", "stream": "dist"}).encode()
+                    ),
+                    good,
+                ).read_all()
+            # the gate rejects, it does not wedge: a good call still lands
+            table = client.do_get(ticket, good).read_all()
+            assert table.num_rows == 10
+            client.close()
+
+        await asyncio.get_running_loop().run_in_executor(None, probe)
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+# ----------------------------------------------- HTTP tier: keep-alive pool
+
+
+def test_conn_pool_reuses_keepalive_socket(tmp_path):
+    """Back-to-back intra-cluster requests ride ONE socket: after the
+    first response is drained the connection is checked in, and the second
+    request checks the same object out."""
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        port = servers[0].port
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+        url = f"http://127.0.0.1:{port}/api/v1/internal/staging/dist"
+
+        def two_requests():
+            pool = C.get_conn_pool()
+            with C._http(q, "GET", url) as resp:
+                assert resp.status == 200
+                resp.read()
+            key = ("http", "127.0.0.1", port)
+            idle = pool._idle.get(key, [])
+            assert len(idle) == 1, "drained keep-alive socket was not pooled"
+            first = idle[0]
+            with C._http(q, "GET", url) as resp:
+                assert resp.status == 200
+                resp.read()
+            assert pool._idle.get(key, []) == [first], "socket was not reused"
+
+        await asyncio.get_running_loop().run_in_executor(None, two_requests)
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_conn_pool_retries_stale_keepalive_once(tmp_path):
+    """A pooled socket the peer closed while idle is not a peer failure:
+    the request transparently retries ONCE on a fresh connection."""
+
+    class StaleConn:
+        sock = None
+
+        def close(self):
+            pass
+
+        def request(self, *a, **k):
+            raise http.client.RemoteDisconnected("peer closed idle socket")
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        port = servers[0].port
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+        url = f"http://127.0.0.1:{port}/api/v1/liveness"
+
+        def poisoned_then_ok():
+            pool = C.get_conn_pool()
+            pool._idle[("http", "127.0.0.1", port)] = [StaleConn()]
+            with C._http(q, "GET", url) as resp:
+                assert resp.status == 200
+
+        await asyncio.get_running_loop().run_in_executor(None, poisoned_then_ok)
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_conn_pool_preserves_urllib_error_contract(tmp_path):
+    """Status >= 400 still surfaces as urllib.error.HTTPError with .code
+    and a readable body — every pre-pool caller keeps its handlers."""
+    import urllib.error
+
+    async def scenario():
+        states, servers = await boot_ingestors(tmp_path, n=1)
+        q = make_parseable(tmp_path, "q", Mode.QUERY)
+        url = (
+            f"http://127.0.0.1:{servers[0].port}"
+            "/api/v1/internal/staging/dist?start=not-a-time"
+        )
+
+        def expect_400():
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                with C._http(q, "GET", url):
+                    pass
+            assert ei.value.code == 400
+            assert b"bad time bound" in ei.value.read()
+            ei.value.close()
+
+        await asyncio.get_running_loop().run_in_executor(None, expect_400)
+        q.shutdown()
+        await teardown(states, servers)
+
+    run(scenario())
+
+
+def test_counting_reader_streams_exact_bytes():
+    """The incremental IPC decode sees every wire byte exactly once — the
+    fan-in accounting equals the serialized payload size with no BytesIO
+    full-response copy in between."""
+    table = pa.table({"a": list(range(1000)), "b": [float(i) for i in range(1000)]})
+    sink = io.BytesIO()
+    with ipc.new_stream(sink, table.schema) as w:
+        for batch in table.to_batches(max_chunksize=100):
+            w.write_batch(batch)
+    payload = sink.getvalue()
+    counting = C._CountingReader(io.BytesIO(payload))
+    with ipc.open_stream(counting) as reader:
+        batches = list(reader)
+    assert sum(b.num_rows for b in batches) == 1000
+    assert counting.count == len(payload)
+
+
+# ----------------------------------------- real processes: ladder + audit
+
+
+def _load_blackbox():
+    spec = importlib.util.spec_from_file_location(
+        "blackbox", REPO_ROOT / "scripts" / "blackbox.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_blackbox_flight_cluster_parity_and_audit(tmp_path):
+    """3 real processes (2 Flight-serving ingestors + 1 querier): the
+    scatter reports transport=flight, a P_FLIGHT_CLIENT=0 querier answers
+    identically over HTTP, and the quiesce conservation audit is green
+    across both transports."""
+    bb = _load_blackbox()
+    with bb.ClusterHarness(tmp_path) as cluster:
+        sync_env = {
+            "P_LOCAL_SYNC_INTERVAL": "1",
+            "P_STORAGE_UPLOAD_INTERVAL": "1",
+        }
+        ing0 = cluster.spawn("ingest", "ing0", env_extra=sync_env, flight=True)
+        ing1 = cluster.spawn("ingest", "ing1", env_extra=sync_env, flight=True)
+        q_flight = cluster.spawn("query", "qf")
+        q_http = cluster.spawn("query", "qh", env_extra={"P_FLIGHT_CLIENT": "0"})
+        for node in (ing0, ing1, q_flight, q_http):
+            cluster.wait_live(node)
+        assert ing0.flight_port and ing1.flight_port
+
+        for i, ing in enumerate((ing0, ing1)):
+            cluster.ingest(
+                ing,
+                "fl",
+                [{"host": f"h{i}", "v": float(j)} for j in range(20)],
+            )
+
+        sql = "SELECT host, count(*) c, sum(v) s FROM fl GROUP BY host ORDER BY host"
+
+        def grouped(node):
+            try:
+                return cluster.query(node, sql, "10m", "now")
+            except RuntimeError:
+                return None, None  # stream not discovered yet
+
+        # poll: stream discovery, cross-process visibility, AND the scatter
+        # going pushdown-over-flight are all asynchronous (a transiently
+        # failed liveness probe pins a peer dead for DEAD_NODE_TTL)
+        def settled(recs, stats) -> bool:
+            if not recs or sum(r["c"] for r in recs) != 40:
+                return False
+            fan = (stats.get("stages") or {}).get("fanout") or {}
+            return fan.get("mode") == "pushdown" and (
+                fan.get("transport", {}).get("flight", 0) >= 1
+            )
+
+        deadline = time.monotonic() + 120
+        recs, stats = grouped(q_flight)
+        while time.monotonic() < deadline and not settled(recs, stats):
+            time.sleep(0.5)
+            recs, stats = grouped(q_flight)
+        assert recs == [
+            {"host": "h0", "c": 20, "s": 190.0},
+            {"host": "h1", "c": 20, "s": 190.0},
+        ], f"flight querier rows: {recs}"
+        fan = stats["stages"]["fanout"]
+        assert fan["mode"] == "pushdown", fan
+        assert fan["transport"].get("flight", 0) >= 1, fan
+
+        hrecs, hstats = grouped(q_http)
+        assert hrecs == recs, "HTTP-pinned querier diverged from Flight"
+        hfan = hstats["stages"]["fanout"]
+        assert "flight" not in hfan.get("transport", {}), hfan
+
+        # conservation audit stays green across both transports
+        deadline = time.monotonic() + 60
+        report = cluster.audit(q_flight, scope="cluster", quiesce=True)
+        while time.monotonic() < deadline and report["total_violations"]:
+            time.sleep(1.0)
+            report = cluster.audit(q_flight, scope="cluster", quiesce=True)
+        assert report["total_violations"] == 0, report["violations"]
