@@ -39,6 +39,39 @@ from parseable_tpu.analysis.framework import Finding, normalize_snippet
 CPP_REL = "parseable_tpu/native/fastpath.cpp"
 PY_REL = "parseable_tpu/native/__init__.py"
 
+# ------------------------------------------------------------- ownership
+#
+# The ABI's custody contract, one row per exported producer that hands the
+# caller an owned resource: which release entry points discharge it, and
+# what shape the resource takes ("buffer" = an out-pointer filled via
+# byref(), "handle" = an opaque value the producer returns, "claim" = a
+# request id that must be answered). nsan's runtime `*_live()==0` gates
+# check the same contract dynamically; wlint's ffi-custody rule checks it
+# statically on the call graph, and both read this table so the pairing
+# lives in exactly one place.
+
+OWNERSHIP: dict[str, tuple[tuple[str, ...], str]] = {
+    "ptpu_flatten_ndjson": (("ptpu_free",), "buffer"),
+    "ptpu_otel_logs_ndjson": (("ptpu_free",), "buffer"),
+    "ptpu_flatten_columnar": (("ptpu_cols_free",), "handle"),
+    "ptpu_flatten_columnar_sharded": (("ptpu_cols_free",), "handle"),
+    "ptpu_otel_logs_columnar": (("ptpu_cols_free",), "handle"),
+    "ptpu_otel_logs_columnar_sharded": (("ptpu_cols_free",), "handle"),
+    "ptpu_otel_metrics_columnar": (("ptpu_cols_free",), "handle"),
+    "ptpu_otel_traces_columnar": (("ptpu_cols_free",), "handle"),
+    "ptpu_telem_drain": (("ptpu_telem_free",), "buffer"),
+    "ptpu_hll_create": (("ptpu_hll_free",), "handle"),
+    "ptpu_edge_next": (
+        ("ptpu_edge_respond", "ptpu_edge_respond_ack", "ptpu_edge_respond_raw"),
+        "claim",
+    ),
+}
+
+# Python-side constructs that take over custody of a columnar handle: once
+# the raw pointer is handed to one of these, its __del__/internal finally
+# owns the ptpu_cols_free call.
+CUSTODY_SINKS = {"_ColumnarBufs", "_import_columnar"}
+
 # ---------------------------------------------------------------- C side
 
 

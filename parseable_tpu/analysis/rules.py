@@ -694,6 +694,41 @@ class ConfigDriftRule(Rule):
                     "(add it to the configuration tables, or a P_FAMILY_* row)"
                 ),
             )
+        yield from self._gate_hatches(project, readme)
+
+    def _gate_hatches(self, project: Project, readme: str) -> Iterable[Finding]:
+        """Every `${VAR:-default}` escape hatch in scripts/check_green.sh is
+        an operator-facing knob (PSAN=0, NSAN=0, WLINT=0, ...) — an
+        undocumented one is a gate nobody knows how to bypass when a box
+        misbehaves. Require each to appear in README as a standalone word
+        (P_EDGE_PORT does not document EDGE)."""
+        gate = project.root / "scripts" / "check_green.sh"
+        try:
+            text = gate.read_text(encoding="utf-8")
+        except OSError:
+            return
+        lines = text.splitlines()
+        seen: set[str] = set()
+        for m in re.finditer(r"\$\{([A-Z][A-Z0-9_]*):-", text):
+            var = m.group(1)
+            if var in seen:
+                continue
+            seen.add(var)
+            if re.search(rf"(?<![A-Z0-9_]){var}(?![A-Z0-9_])", readme):
+                continue
+            line = text.count("\n", 0, m.start()) + 1
+            yield Finding(
+                rule=self.name,
+                path="scripts/check_green.sh",
+                line=line,
+                context="README",
+                snippet=lines[line - 1].strip(),
+                message=(
+                    f"check_green.sh escape hatch {var} is not documented in "
+                    "README.md — every gate's opt-out variable must be "
+                    "discoverable without reading the script"
+                ),
+            )
 
 
 # ---------------------------------------------------------------------------

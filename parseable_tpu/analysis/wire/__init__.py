@@ -1,0 +1,209 @@
+"""wlint — cross-boundary wire-contract static analysis.
+
+plint sees one Python file's AST, psan one process at runtime, nsan one
+library's memory. None of them sees a contract whose two halves live in
+different sources — a route the C++ edge classifies that aiohttp renamed, a
+header fan-out reads that no peer produces, a Flight ticket kind the server
+stopped dispatching, a metric family that flatlined, a stages key a test
+asserts that the query path never emits, an owned ABI pointer that misses
+its free on one path. wlint extracts both sides of each such contract from
+source and diffs them.
+
+Rules (each is one contract family):
+
+- route-drift      client path literals vs the aiohttp route table, and the
+                   C++ hot-route classifier vs registered routes
+- header-contract  X-P-* reads vs writes across Python and fastpath.cpp
+- ticket-drift     Flight ticket kinds and ptpu.* schema-metadata keys,
+                   client vs server
+- metric-discipline  constructed-but-never-ticked families, .labels()
+                   arity/order, README coverage
+- stages-contract  stats.stages.* produced vs consumed (advisory for
+                   produced-but-unwatched)
+- ffi-custody      owned ABI pointers must reach their paired release on
+                   all paths (static complement of the *_live()==0 gates)
+
+Reuses plint's Finding/fingerprint/baseline machinery verbatim; the
+suppression marker is ``# wlint: disable[=rule,...]`` (C++:
+``// wlint: disable=...``) so a plint suppression never silences a wire
+finding or vice versa. Run as ``python -m parseable_tpu.analysis.wire``.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import (
+    AnalysisReport,
+    Finding,
+    Rule,
+    SourceFile,
+    iter_python_files,
+    load_baseline,
+    write_baseline,
+)
+from parseable_tpu.analysis.wire.csource import CSourceFile
+from parseable_tpu.analysis.wire.extract import WireProject
+from parseable_tpu.analysis.wire.rules_contracts import (
+    HeaderContractRule,
+    RouteDriftRule,
+    TicketDriftRule,
+)
+from parseable_tpu.analysis.wire.rules_custody import FfiCustodyRule
+from parseable_tpu.analysis.wire.rules_telemetry import (
+    MetricDisciplineRule,
+    StagesContractRule,
+)
+
+WLINT_VERSION = "1"
+
+WIRE_RULES: list[type[Rule]] = [
+    RouteDriftRule,
+    HeaderContractRule,
+    TicketDriftRule,
+    MetricDisciplineRule,
+    StagesContractRule,
+    FfiCustodyRule,
+]
+
+DEFAULT_PATHS = ["parseable_tpu", "scripts", "tests", "bench.py"]
+
+_SUPPRESS_RE = re.compile(r"wlint:\s*disable(?:=([A-Za-z0-9_,-]+))?")
+
+
+@dataclass
+class WireReport(AnalysisReport):
+    """plint's report shape plus non-gating advisories (stages-contract's
+    produced-but-never-consumed keys): printed as notes, serialized under
+    their own key, never part of the exit code."""
+
+    advisories: list[Finding] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        doc = super().to_json()
+        doc["advisories"] = [f.to_json() for f in self.advisories]
+        return doc
+
+
+def _wlint_suppressions(sf: SourceFile) -> dict[int, set[str] | None]:
+    """SourceFile's own suppression table answers to `plint:` markers; wire
+    findings answer only to `wlint:` ones, scanned from the same comments."""
+    out: dict[int, set[str] | None] = {}
+    for line, comment in sf.comments.items():
+        m = _SUPPRESS_RE.search(comment)
+        if m:
+            names = m.group(1)
+            out[line] = (
+                {s.strip() for s in names.split(",") if s.strip()} if names else None
+            )
+    return out
+
+
+def run_wire_analysis(
+    root: Path,
+    paths: list[str] | None = None,
+    rules: list[Rule] | None = None,
+    baseline_path: Path | None = None,
+    report_only: set[str] | None = None,
+) -> WireReport:
+    """Analyze `paths` under `root` with the wire rules. Same contract as
+    framework.run_analysis; differences: the project also carries the C++
+    sources (``*.cpp`` under parseable_tpu/), analyzer sources are excluded
+    from the project outright (finalize rules never see them), and
+    suppression/baseline use wlint's own marker and file."""
+    root = Path(root)
+    rules = rules if rules is not None else [cls() for cls in WIRE_RULES]
+    paths = paths or DEFAULT_PATHS
+    project = WireProject(root=root)
+    parse_errors: list[str] = []
+    for p in iter_python_files(root, paths):
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith("parseable_tpu/analysis/"):
+            continue  # the analyzer does not lint itself
+        try:
+            project.files.append(SourceFile.from_path(root, p))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            parse_errors.append(f"{p}: {e}")
+    native_dir = root / "parseable_tpu"
+    if native_dir.is_dir():
+        for p in sorted(native_dir.rglob("*.cpp")):
+            try:
+                project.csources.append(CSourceFile.from_path(root, p))
+            except UnicodeDecodeError as e:
+                parse_errors.append(f"{p}: {e}")
+
+    by_rel = {sf.rel: sf for sf in project.files}
+    c_by_rel = {cf.rel: cf for cf in project.csources}
+    py_suppress = {sf.rel: _wlint_suppressions(sf) for sf in project.files}
+
+    def suppressed(f: Finding) -> bool:
+        cf = c_by_rel.get(f.path)
+        if cf is not None:
+            return cf.is_suppressed(f.rule, f.line)
+        table = py_suppress.get(f.path)
+        if table is None or f.line not in table:
+            return False
+        names = table[f.line]
+        return names is None or f.rule in names
+
+    def finish(f: Finding) -> Finding:
+        if f.snippet:
+            return f
+        src = by_rel.get(f.path) or c_by_rel.get(f.path)
+        return replace(f, snippet=src.snippet(f.line)) if src is not None else f
+
+    findings: list[Finding] = []
+    advisories: list[Finding] = []
+    for sf in project.files:
+        for rule in rules:
+            if not rule.applies(sf.rel):
+                continue
+            for f in rule.check(sf):
+                if not suppressed(f):
+                    findings.append(finish(f))
+    for rule in rules:
+        for f in rule.finalize(project):
+            if not suppressed(f):
+                findings.append(finish(f))
+        advise = getattr(rule, "advisories", None)
+        if advise is not None:
+            for f in advise(project):
+                if not suppressed(f):
+                    advisories.append(finish(f))
+
+    if report_only is not None:
+        findings = [f for f in findings if f.path in report_only]
+        advisories = [f for f in advisories if f.path in report_only]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    advisories.sort(key=lambda f: (f.path, f.line, f.rule))
+    baseline = load_baseline(baseline_path)
+    baselined = [
+        f
+        for f in findings
+        if f.fingerprint in baseline or f.legacy_fingerprint in baseline
+    ]
+    unbaselined = [
+        f
+        for f in findings
+        if f.fingerprint not in baseline and f.legacy_fingerprint not in baseline
+    ]
+    return WireReport(
+        findings=findings,
+        baselined=baselined,
+        unbaselined=unbaselined,
+        files_checked=len(project.files) + len(project.csources),
+        parse_errors=parse_errors,
+        advisories=advisories,
+    )
+
+
+__all__ = [
+    "WLINT_VERSION",
+    "WIRE_RULES",
+    "DEFAULT_PATHS",
+    "WireReport",
+    "run_wire_analysis",
+    "write_baseline",
+]

@@ -1,0 +1,151 @@
+"""wlint C/C++ source model: token-level string extraction, no libclang.
+
+The wire contracts' C++ half lives in string literals — route prefixes the
+edge classifier compares against, lowercased header names, response-header
+emission like ``"X-P-Trace-Id: "``. A full C++ parse buys nothing for that;
+what matters is extracting every string literal with its line number while
+ignoring comments and char literals, plus the `extern "C"` block spans so
+rules can tell exported-surface strings from internal ones. `.clang-tidy`
+remains the optional deep pass (nsan); this scanner is the cheap, always-on
+one.
+
+Suppression syntax mirrors plint's, on the same line as the finding:
+
+    classify(target);  // wlint: disable=route-drift
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+_SUPPRESS_RE = re.compile(r"wlint:\s*disable(?:=([A-Za-z0-9_,-]+))?")
+
+
+class CSourceFile:
+    """One C/C++ translation unit, reduced to what wire rules consume:
+    ``strings`` (line, value) outside comments, per-line comment text,
+    suppressions, and `extern "C"` line spans."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel.replace("\\", "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.strings: list[tuple[int, str]] = []
+        self.comments: dict[int, str] = {}
+        self.suppressions: dict[int, set[str] | None] = {}
+        self._scan()
+        self.extern_c_spans = self._extern_c_spans()
+
+    @classmethod
+    def from_path(cls, root: Path, path: Path) -> "CSourceFile":
+        rel = path.relative_to(root).as_posix()
+        return cls(rel, path.read_text(encoding="utf-8"))
+
+    # ------------------------------------------------------------- scanner
+
+    def _scan(self) -> None:
+        """One pass over the text tracking which of five states we are in:
+        code, line comment, block comment, string literal, char literal.
+        Escapes honored inside literals; raw strings are not used by
+        fastpath.cpp and are deliberately out of scope (a raw string would
+        be scanned as a plain one — wrong contents, right line)."""
+        text = self.text
+        i, n, line = 0, len(text), 1
+        while i < n:
+            ch = text[i]
+            nxt = text[i + 1] if i + 1 < n else ""
+            if ch == "\n":
+                line += 1
+                i += 1
+            elif ch == "/" and nxt == "/":
+                j = text.find("\n", i)
+                j = n if j < 0 else j
+                self._comment(line, text[i + 2 : j].strip())
+                i = j
+            elif ch == "/" and nxt == "*":
+                j = text.find("*/", i + 2)
+                j = n - 2 if j < 0 else j
+                body = text[i + 2 : j]
+                self._comment(line, body.strip().splitlines()[0] if body.strip() else "")
+                line += body.count("\n")
+                i = j + 2
+            elif ch == '"':
+                start_line = line
+                j = i + 1
+                buf: list[str] = []
+                while j < n and text[j] != '"':
+                    if text[j] == "\\" and j + 1 < n:
+                        esc = text[j + 1]
+                        buf.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0"}.get(esc, esc))
+                        j += 2
+                    else:
+                        if text[j] == "\n":
+                            line += 1  # unterminated — keep line count honest
+                        buf.append(text[j])
+                        j += 1
+                self.strings.append((start_line, "".join(buf)))
+                i = j + 1
+            elif ch == "'":
+                j = i + 1
+                while j < n and text[j] != "'":
+                    j += 2 if text[j] == "\\" else 1
+                i = j + 1
+            else:
+                i += 1
+
+    def _comment(self, line: int, comment: str) -> None:
+        self.comments[line] = comment
+        m = _SUPPRESS_RE.search(comment)
+        if m:
+            names = m.group(1)
+            self.suppressions[line] = (
+                {s.strip() for s in names.split(",") if s.strip()} if names else None
+            )
+
+    def _extern_c_spans(self) -> list[tuple[int, int]]:
+        """(start_line, end_line) of every `extern "C" { ... }` block, by
+        brace-depth matching on the comment/string-stripped text (the same
+        approach abicheck.py uses for the ABI diff)."""
+        spans: list[tuple[int, int]] = []
+        # rebuild a literal-free view so braces inside strings don't count
+        clean_lines = list(self.lines)
+        for ln, val in self.strings:
+            if 1 <= ln <= len(clean_lines) and val:
+                clean_lines[ln - 1] = clean_lines[ln - 1].replace('"%s"' % val, '""')
+        for idx, raw in enumerate(self.lines):
+            # marker detection on the ORIGINAL line (the cleaned view has
+            # the "C" literal blanked); depth counting on the cleaned one
+            if 'extern "C"' not in raw.split("//")[0]:
+                continue
+            depth, started = 0, False
+            for j in range(idx, len(clean_lines)):
+                for ch in clean_lines[j].split("//")[0]:
+                    if ch == "{":
+                        depth += 1
+                        started = True
+                    elif ch == "}":
+                        depth -= 1
+                        if started and depth == 0:
+                            spans.append((idx + 1, j + 1))
+                            break
+                if started and depth == 0:
+                    break
+        return spans
+
+    # ------------------------------------------------------------- queries
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if line not in self.suppressions:
+            return False
+        names = self.suppressions[line]
+        return names is None or rule in names
+
+    def snippet(self, line: int) -> str:
+        from parseable_tpu.analysis.framework import normalize_snippet
+
+        if 1 <= line <= len(self.lines):
+            # C line comments use //, not # — strip them before normalizing
+            src = self.lines[line - 1].split("//")[0]
+            return normalize_snippet(src)
+        return ""

@@ -1,0 +1,415 @@
+"""wlint shared extractors: both halves of each wire contract, from source.
+
+Everything here is pure extraction — no judgement. Rules diff the tables
+these functions return. The extraction idioms:
+
+- string resolution follows constants one step: module-level
+  ``NAME = "literal"`` assigns, enclosing-function locals, for-loop
+  bindings over literal tuple tables (app.py's crud_routes loop), `+`
+  concatenation, and f-strings (unresolvable interpolations become the
+  ``{_}`` placeholder segment);
+- imports are honored so a producer writing ``FO.H_TAG`` and a consumer
+  reading the literal ``"X-P-Owner-Tag"`` land on the same header;
+- aiohttp route templates (`{name}`, `{name:regex}`) match client-side
+  path templates segment-by-segment; a client ``{_}`` placeholder matches
+  any one template segment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from parseable_tpu.analysis.framework import Project, SourceFile
+from parseable_tpu.analysis.wire.csource import CSourceFile
+
+PLACEHOLDER = "{_}"
+
+_ADD_ROUTE = {
+    "add_get": "GET",
+    "add_post": "POST",
+    "add_put": "PUT",
+    "add_delete": "DELETE",
+}
+
+
+@dataclass
+class WireProject(Project):
+    """plint's Project plus the C/C++ translation units wire rules diff
+    against (today: parseable_tpu/native/fastpath.cpp)."""
+
+    csources: list[CSourceFile] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class Route:
+    method: str
+    template: str  # "/api/v1/logstream/{name}"
+    rel: str
+    line: int
+    handler: str  # display only
+
+
+@dataclass(frozen=True)
+class ClientPath:
+    template: str  # "/api/v1/internal/staging/{_}"
+    method: str | None  # None when the call site doesn't name one
+    rel: str
+    line: int
+
+
+# ------------------------------------------------------------ constant maps
+
+
+def module_constants(sf: SourceFile) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` (str or bytes, decoded) assigns."""
+    out: dict[str, str] = {}
+    for node in sf.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+        ):
+            v = node.value.value
+            if isinstance(v, bytes):
+                try:
+                    v = v.decode()
+                except UnicodeDecodeError:
+                    continue
+            if isinstance(v, str):
+                out[node.targets[0].id] = v
+    return out
+
+
+def _rel_to_module(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def import_map(sf: SourceFile) -> dict[str, str]:
+    """local alias -> dotted module it refers to (``import x.y as z`` and
+    ``from pkg import mod [as alias]`` both land here; ``from mod import
+    NAME`` maps NAME to ``mod.NAME`` so constant lookups can split it)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+class ConstIndex:
+    """Project-wide constant resolution: Name/Attribute nodes -> string,
+    following module-level constants across imports."""
+
+    def __init__(self, project: Project):
+        self.by_module: dict[str, dict[str, str]] = {}
+        self.imports: dict[str, dict[str, str]] = {}
+        for sf in project.files:
+            mod = _rel_to_module(sf.rel)
+            self.by_module[mod] = module_constants(sf)
+            self.imports[mod] = import_map(sf)
+
+    def _lookup(self, dotted: str) -> str | None:
+        mod, _, name = dotted.rpartition(".")
+        consts = self.by_module.get(mod)
+        return consts.get(name) if consts else None
+
+    def resolve(self, node: ast.AST, sf: SourceFile) -> str | None:
+        """Constant / Name / alias.NAME -> string value, or None."""
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bytes):
+                try:
+                    return v.decode()
+                except UnicodeDecodeError:
+                    return None
+            return v if isinstance(v, str) else None
+        mod = _rel_to_module(sf.rel)
+        if isinstance(node, ast.Name):
+            local = self.by_module.get(mod, {}).get(node.id)
+            if local is not None:
+                return local
+            target = self.imports.get(mod, {}).get(node.id)
+            return self._lookup(target) if target else None
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            target = self.imports.get(mod, {}).get(node.value.id)
+            if target:
+                return self._lookup(f"{target}.{node.attr}")
+        return None
+
+
+# -------------------------------------------------------- string templates
+
+
+def _loop_candidates(fn: ast.AST, name: str) -> list[str]:
+    """Values `name` takes in ``for a, name, c in ((..), (..))`` loops over
+    literal tuple tables inside `fn` — the app.py crud_routes idiom."""
+    out: list[str] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.For):
+            continue
+        tgt, it = node.target, node.iter
+        if not isinstance(it, (ast.Tuple, ast.List)):
+            continue
+        if isinstance(tgt, ast.Name) and tgt.id == name:
+            idx = None
+        elif isinstance(tgt, ast.Tuple):
+            idx = next(
+                (
+                    i
+                    for i, e in enumerate(tgt.elts)
+                    if isinstance(e, ast.Name) and e.id == name
+                ),
+                -1,
+            )
+            if idx < 0:
+                continue
+        else:
+            continue
+        for row in it.elts:
+            cell = row if idx is None else None
+            if idx is not None and isinstance(row, (ast.Tuple, ast.List)) and idx < len(row.elts):
+                cell = row.elts[idx]
+            if isinstance(cell, ast.Constant) and isinstance(cell.value, str):
+                out.append(cell.value)
+    return out
+
+
+def _local_assigns(fn: ast.AST, name: str) -> list[str]:
+    out: list[str] = []
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out.append(node.value.value)
+    return out
+
+
+def str_templates(
+    node: ast.AST,
+    sf: SourceFile,
+    consts: ConstIndex,
+    scope: ast.AST | None = None,
+) -> list[str]:
+    """Every string value/template `node` can evaluate to, with ``{_}``
+    standing in for unresolvable f-string interpolations. Empty list when
+    the expression isn't string-shaped at all."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else []
+    if isinstance(node, ast.JoinedStr):
+        parts: list[list[str]] = [[""]]
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                opts = [str(piece.value)]
+            elif isinstance(piece, ast.FormattedValue):
+                resolved = consts.resolve(piece.value, sf)
+                opts = [resolved if resolved is not None else PLACEHOLDER]
+            else:  # pragma: no cover - JoinedStr only holds those two
+                opts = [PLACEHOLDER]
+            parts = [p + [o] for p in parts for o in opts]
+        return ["".join(p) for p in parts]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lefts = str_templates(node.left, sf, consts, scope)
+        rights = str_templates(node.right, sf, consts, scope)
+        return [a + b for a in lefts for b in rights]
+    if isinstance(node, ast.Name):
+        if scope is not None:
+            vals = _local_assigns(scope, node.id) or _loop_candidates(scope, node.id)
+            if vals:
+                return vals
+        v = consts.resolve(node, sf)
+        return [v] if v is not None else []
+    v = consts.resolve(node, sf)
+    return [v] if v is not None else []
+
+
+def scope_of(tree: ast.Module, line: int) -> ast.AST:
+    """Innermost function containing `line`, else the module."""
+    best: ast.AST = tree
+    best_span = float("inf")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lo, hi = node.lineno, getattr(node, "end_lineno", node.lineno)
+            if lo <= line <= hi and hi - lo < best_span:
+                best, best_span = node, hi - lo
+    return best
+
+
+# -------------------------------------------------------------- route table
+
+
+def route_table(project: Project, consts: ConstIndex | None = None) -> list[Route]:
+    """The aiohttp route table: every ``r.add_get/add_post/add_put/
+    add_delete(path, handler)`` call under parseable_tpu/server/."""
+    consts = consts or ConstIndex(project)
+    routes: list[Route] = []
+    for sf in project.files:
+        if not sf.rel.startswith("parseable_tpu/server/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ADD_ROUTE
+                and node.args
+            ):
+                continue
+            handler = ""
+            if len(node.args) > 1:
+                h = node.args[1]
+                handler = h.id if isinstance(h, ast.Name) else getattr(h, "attr", "")
+            scope = scope_of(sf.tree, node.lineno)
+            for tpl in str_templates(node.args[0], sf, consts, scope):
+                routes.append(
+                    Route(
+                        method=_ADD_ROUTE[node.func.attr],
+                        template=tpl,
+                        rel=sf.rel,
+                        line=node.lineno,
+                        handler=handler,
+                    )
+                )
+    return routes
+
+
+_TEMPLATE_SEG_RE = re.compile(r"^\{([A-Za-z_][A-Za-z0-9_]*)(?::(.*))?\}$")
+
+
+def _segments(path: str) -> list[str]:
+    return [s for s in path.split("/")][1:] if path.startswith("/") else path.split("/")
+
+
+def path_matches(route_template: str, client_template: str) -> bool:
+    """Does a client path template resolve against an aiohttp route
+    template? Segment-wise: a route ``{name}``/``{name:re}`` segment
+    matches any client segment (regexes are checked against literal client
+    segments); a client ``{_}`` placeholder matches any route segment. A
+    client template ending in ``/`` is a prefix probe (the C++ classifier's
+    ``/api/v1/logstream/`` compare) and matches when the route extends it
+    by exactly its templated tail."""
+    if client_template.endswith("/") and len(client_template) > 1:
+        prefix = _segments(client_template[:-1])
+        rsegs = _segments(route_template)
+        if len(rsegs) <= len(prefix):
+            return False
+        return all(
+            _seg_match(r, c) for r, c in zip(rsegs[: len(prefix)], prefix)
+        )
+    rsegs, csegs = _segments(route_template), _segments(client_template)
+    if len(rsegs) != len(csegs):
+        return False
+    return all(_seg_match(r, c) for r, c in zip(rsegs, csegs))
+
+
+def _seg_match(route_seg: str, client_seg: str) -> bool:
+    m = _TEMPLATE_SEG_RE.match(route_seg)
+    if m:
+        if not client_seg:
+            return False
+        if client_seg == PLACEHOLDER or client_seg.startswith("{"):
+            return True
+        rx = m.group(2)
+        if rx:
+            try:
+                return re.fullmatch(rx, client_seg) is not None
+            except re.error:  # pragma: no cover - bad route regex
+                return True
+        return True
+    return client_seg == route_seg or client_seg == PLACEHOLDER
+
+
+# ------------------------------------------------------------ client paths
+
+_PATH_HINT_RE = re.compile(r"/api/|^/v1/")
+
+
+def client_paths(sf: SourceFile, consts: ConstIndex) -> list[ClientPath]:
+    """Server-path templates a client file constructs: constants and
+    f-strings containing ``/api/`` (anything before it — the domain
+    interpolation — is dropped) or rooted at ``/v1/``. Query strings are
+    stripped; module-level constant *definitions* are skipped (they're
+    resolved at their use sites instead)."""
+    module_def_lines = {
+        node.lineno
+        for node in sf.tree.body
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant)
+    }
+    out: list[ClientPath] = []
+    seen: set[tuple[int, str]] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Constant, ast.JoinedStr)):
+            continue
+        if isinstance(node, ast.Constant) and not isinstance(node.value, str):
+            continue
+        if node.lineno in module_def_lines and isinstance(node, ast.Constant):
+            continue
+        scope = scope_of(sf.tree, node.lineno)
+        for tpl in str_templates(node, sf, consts, scope):
+            if not _PATH_HINT_RE.search(tpl):
+                continue
+            idx = tpl.find("/api/")
+            path = tpl[idx:] if idx >= 0 else tpl
+            path = path.split("?", 1)[0]
+            # prose mentioning a path (docstrings, log messages) is not a
+            # request: a real path template has no whitespace
+            if any(c.isspace() for c in path):
+                continue
+            if not path.startswith("/") or len(_segments(path)) < 2:
+                continue
+            method = _call_method_around(sf.tree, node)
+            key = (node.lineno, path)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(ClientPath(template=path, method=method, rel=sf.rel, line=node.lineno))
+    return out
+
+
+_METHOD_NAMES = {
+    "get": "GET",
+    "post": "POST",
+    "put": "PUT",
+    "delete": "DELETE",
+    "request": None,
+}
+
+
+def _call_method_around(tree: ast.Module, target: ast.AST) -> str | None:
+    """HTTP method of the call the path literal appears in, when the call
+    spells it: ``session.get(url)`` -> GET, ``http_json("POST", url)`` ->
+    POST. None when the call shape doesn't say."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not any(sub is target for a in node.args for sub in ast.walk(a)) and not any(
+            sub is target for kw in node.keywords for sub in ast.walk(kw.value)
+        ):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _METHOD_NAMES:
+            return _METHOD_NAMES[node.func.attr]
+        if node.args and isinstance(node.args[0], ast.Constant):
+            v = node.args[0].value
+            if isinstance(v, str) and v.upper() in ("GET", "POST", "PUT", "DELETE"):
+                return v.upper()
+    return None
+
+
+def cpp_route_literals(cf: CSourceFile) -> list[tuple[int, str]]:
+    """The edge classifier's route strings: every C++ string literal that
+    looks like a server path (``/api/...`` or ``/v1/...``)."""
+    out = []
+    for line, val in cf.strings:
+        if val.startswith("/api/") or (val.startswith("/v1/") and len(val) > 4):
+            out.append((line, val))
+    return out

@@ -1,0 +1,470 @@
+"""wlint contract rules: routes, headers, Flight tickets.
+
+Each rule extracts BOTH halves of one process-boundary agreement and
+diffs them:
+
+- route-drift      client-side path templates (cluster fan-out, query
+                   scatter, blackbox harness) must resolve against the
+                   aiohttp route table; the C++ edge classifier's route
+                   strings must be a subset of registered routes.
+- header-contract  every `X-P-*` header read somewhere must be written
+                   somewhere (and vice versa), across Python AND
+                   fastpath.cpp, modulo the allowlists for headers that
+                   originate from or terminate at external clients.
+- ticket-drift     Flight ticket `kind` values constructed client-side
+                   must be dispatched in server/flight.py and vice versa;
+                   the `ptpu.*` schema-metadata keys written server-side
+                   must exactly equal the set the client-side strip
+                   removes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from parseable_tpu.analysis.framework import (
+    Finding,
+    Rule,
+    SourceFile,
+    attr_chain,
+    enclosing_context,
+)
+from parseable_tpu.analysis.wire.extract import (
+    ConstIndex,
+    WireProject,
+    client_paths,
+    cpp_route_literals,
+    path_matches,
+    route_table,
+)
+
+# client files whose path literals must resolve against the route table
+CLIENT_FILES = (
+    "parseable_tpu/server/cluster.py",
+    "parseable_tpu/query/fanout.py",
+    "parseable_tpu/native/edge.py",
+    "scripts/blackbox.py",
+)
+
+
+class RouteDriftRule(Rule):
+    """Client path templates vs the aiohttp route table.
+
+    The server half is built from every ``r.add_get/add_post/...`` call
+    under parseable_tpu/server/ (constants, `base + "/{id}"` concats, and
+    the crud_routes literal-tuple loop all resolve). The client half is
+    every path-shaped literal/f-string in the cluster fan-out, the query
+    scatter, the native edge, and the blackbox harness; f-string
+    interpolations become `{_}` placeholders that match any one template
+    segment. The C++ edge classifier's route strings are checked the same
+    way — a prefix compare (trailing `/`) must be extended by a registered
+    template."""
+
+    name = "route-drift"
+    description = "client path literal does not resolve against the aiohttp route table"
+    rationale = (
+        "a path the server never registered 404s at runtime on exactly the "
+        "distributed paths (fan-out, staging pulls) tests exercise least"
+    )
+
+    def finalize(self, project: WireProject) -> Iterable[Finding]:
+        consts = ConstIndex(project)
+        routes = route_table(project, consts)
+        if not routes:
+            return  # fixture trees without a server half stay quiet
+        templates = [r.template for r in routes]
+        by_rel = {sf.rel: sf for sf in project.files}
+        for rel in CLIENT_FILES:
+            sf = by_rel.get(rel)
+            if sf is None:
+                continue
+            for cp in client_paths(sf, consts):
+                hits = [t for t in templates if path_matches(t, cp.template)]
+                if not hits:
+                    yield Finding(
+                        rule=self.name,
+                        path=cp.rel,
+                        line=cp.line,
+                        context=enclosing_context(sf.tree, _node_at(sf, cp.line)),
+                        message=(
+                            f"client path {cp.template!r} matches no registered "
+                            "aiohttp route (server/app.py route table)"
+                        ),
+                    )
+                elif cp.method is not None and not any(
+                    r.method == cp.method for r in routes if path_matches(r.template, cp.template)
+                ):
+                    methods = sorted(
+                        {r.method for r in routes if path_matches(r.template, cp.template)}
+                    )
+                    yield Finding(
+                        rule=self.name,
+                        path=cp.rel,
+                        line=cp.line,
+                        context=enclosing_context(sf.tree, _node_at(sf, cp.line)),
+                        message=(
+                            f"client sends {cp.method} to {cp.template!r} but the "
+                            f"route is registered for {'/'.join(methods)} only"
+                        ),
+                    )
+        # C++ hot-route classifier strings must be a subset of the table
+        for cf in project.csources:
+            for line, literal in cpp_route_literals(cf):
+                if any(path_matches(t, literal) for t in templates):
+                    continue
+                yield _c_finding(
+                    self.name,
+                    cf,
+                    line,
+                    f"edge classifier route {literal!r} matches no registered "
+                    "aiohttp route — the C++ hot set drifted from app.py",
+                )
+
+
+def _node_at(sf: SourceFile, line: int) -> ast.AST:
+    for node in ast.walk(sf.tree):
+        if getattr(node, "lineno", None) == line:
+            return node
+    return sf.tree
+
+
+def _c_finding(rule: str, cf, line: int, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=cf.rel,
+        line=line,
+        message=message,
+        snippet=cf.snippet(line),
+    )
+
+
+# --------------------------------------------------------------------------
+# header-contract
+
+
+_HEADER_RE = re.compile(r"^x-p-[a-z0-9-]+$", re.IGNORECASE)
+
+# request headers external clients originate: consumed here, produced by
+# the world (SDKs, curl, the console). The C++ edge declines unknown X-P-*
+# so this list is closed on purpose — extending it is a wire change.
+EXTERNAL_REQUEST_HEADERS = {
+    "x-p-stream",
+    "x-p-log-source",
+    "x-p-api-key",
+    "x-p-tenant",
+    "x-p-update-stream",
+    "x-p-time-partition",
+    "x-p-custom-partition",
+    "x-p-static-schema-flag",
+    "x-p-telemetry-type",
+}
+# prefix families with open-ended external producers (custom field headers)
+EXTERNAL_REQUEST_PREFIXES = ("x-p-meta-",)
+# response/beacon headers whose consumer is outside this tree
+EXTERNAL_RESPONSE_HEADERS = {"x-p-version"}
+
+_CONSUME_METHODS = {"get", "getone", "getall", "pop"}
+
+
+class HeaderContractRule(Rule):
+    """Two-sided X-P-* header accounting across Python and fastpath.cpp.
+
+    A site *consumes* a header when it reads it (``headers.get(H)``,
+    ``headers[H]`` loads, ``H in headers``) and *produces* one when it
+    writes it (dict-literal key, ``headers[H] = v`` stores). The C++ side
+    classifies lowercase ``"x-p-..."`` comparison literals as consumers
+    and ``"X-P-Name: "`` response-emission literals as producers. Every
+    consumed header needs a producer (or the external-request allowlist);
+    every produced header needs a consumer (or the external-response
+    allowlist)."""
+
+    name = "header-contract"
+    description = "X-P-* header consumed but never produced, or vice versa"
+    rationale = (
+        "an orphaned header read is dead protocol surface; an orphaned "
+        "write is data silently dropped on the floor at the other end"
+    )
+
+    # scan the shipped tree, not tests: test clients play the external role
+    def _scan(self, rel: str) -> bool:
+        return (
+            rel.endswith(".py")
+            and (rel.startswith("parseable_tpu/") or rel.startswith("scripts/"))
+        )
+
+    def finalize(self, project: WireProject) -> Iterable[Finding]:
+        consts = ConstIndex(project)
+        produced: dict[str, tuple[str, int]] = {}
+        consumed: dict[str, tuple[str, int]] = {}
+
+        def record(table: dict, header: str, rel: str, line: int) -> None:
+            table.setdefault(header.lower(), (rel, line))
+
+        for sf in project.files:
+            if not self._scan(sf.rel):
+                continue
+            for node in ast.walk(sf.tree):
+                for kind, header, line in self._classify(node, sf, consts):
+                    record(produced if kind == "produce" else consumed, header, sf.rel, line)
+        for cf in project.csources:
+            for line, val in cf.strings:
+                name = val.rstrip()
+                is_emit = name.endswith(":")
+                name = name.rstrip(":").strip()
+                if not _HEADER_RE.match(name):
+                    continue
+                record(produced if is_emit else consumed, name, cf.rel, line)
+
+        for header, (rel, line) in sorted(consumed.items()):
+            if header in produced or header in EXTERNAL_REQUEST_HEADERS:
+                continue
+            if any(header.startswith(p) for p in EXTERNAL_REQUEST_PREFIXES):
+                continue
+            yield Finding(
+                rule=self.name,
+                path=rel,
+                line=line,
+                message=(
+                    f"header {header!r} is consumed here but produced nowhere "
+                    "in the tree (and is not an allowlisted external request "
+                    "header) — dead read or missing producer"
+                ),
+            )
+        for header, (rel, line) in sorted(produced.items()):
+            if header in consumed or header in EXTERNAL_RESPONSE_HEADERS:
+                continue
+            if any(header.startswith(p) for p in EXTERNAL_REQUEST_PREFIXES):
+                continue
+            if header in EXTERNAL_REQUEST_HEADERS:
+                continue  # internal harness producing a request header is fine
+            yield Finding(
+                rule=self.name,
+                path=rel,
+                line=line,
+                message=(
+                    f"header {header!r} is produced here but consumed nowhere "
+                    "in the tree — the value is dropped on the floor at the "
+                    "other end of the wire"
+                ),
+            )
+
+    def _classify(
+        self, node: ast.AST, sf: SourceFile, consts: ConstIndex
+    ) -> Iterable[tuple[str, str, int]]:
+        def hdr(expr: ast.AST) -> str | None:
+            v = consts.resolve(expr, sf)
+            return v if v is not None and _HEADER_RE.match(v) else None
+
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _CONSUME_METHODS and node.args:
+                h = hdr(node.args[0])
+                if h:
+                    yield ("consume", h, node.lineno)
+        elif isinstance(node, ast.Subscript):
+            h = hdr(node.slice)
+            if h:
+                kind = "produce" if isinstance(node.ctx, ast.Store) else "consume"
+                yield (kind, h, node.lineno)
+        elif isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+        ):
+            h = hdr(node.left)
+            if h:
+                yield ("consume", h, node.lineno)
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is None:
+                    continue
+                h = hdr(k)
+                if h:
+                    yield ("produce", h, k.lineno)
+
+
+# --------------------------------------------------------------------------
+# ticket-drift
+
+
+_FLIGHT_SERVER_REL = "parseable_tpu/server/flight.py"
+_META_PREFIX = "ptpu."
+
+
+class TicketDriftRule(Rule):
+    """Flight ticket kinds and `ptpu.*` schema-metadata keys, both sides.
+
+    Client half: every ``{"kind": "..."}`` dict literal (or
+    ``dict(..., kind="...")``) in a module that touches the Flight plane.
+    Server half: the string literals ``kind`` is compared against in
+    server/flight.py's do_get dispatch. Both directions are errors — an
+    unconstructed dispatch arm is dead server code, an undispatched client
+    kind is a guaranteed FlightServerError.
+
+    Metadata: the ``ptpu.*`` keys flight.py defines (META_* constants)
+    must exactly equal the strip set (``_META_KEYS``) — a written key the
+    client strip misses leaks internal metadata into user-facing schemas;
+    a stripped key nobody writes is dead wire surface. Stray `ptpu.*`
+    literals elsewhere must be one of the defined keys."""
+
+    name = "ticket-drift"
+    description = "Flight ticket kind or ptpu.* metadata key drifted between client and server"
+    rationale = (
+        "the ticket vocabulary IS the data-plane API: an unknown kind "
+        "fails every DoGet, a missed metadata key leaks transport innards"
+    )
+
+    def finalize(self, project: WireProject) -> Iterable[Finding]:
+        by_rel = {sf.rel: sf for sf in project.files}
+        server = by_rel.get(_FLIGHT_SERVER_REL)
+        if server is None:
+            return
+
+        dispatched: dict[str, int] = {}
+        for node in ast.walk(server.tree):
+            if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+                sides = [node.left, node.comparators[0]]
+                names = [s for s in sides if attr_chain(s)[-1:] == ["kind"]]
+                lits = [
+                    s.value
+                    for s in sides
+                    if isinstance(s, ast.Constant) and isinstance(s.value, str)
+                ]
+                if names and lits:
+                    dispatched.setdefault(lits[0], node.lineno)
+
+        constructed: dict[str, tuple[str, int, str]] = {}
+        for sf in project.files:
+            if not sf.rel.startswith("parseable_tpu/") or sf.rel == _FLIGHT_SERVER_REL:
+                continue
+            if "flight" not in sf.text.lower():
+                continue  # only modules touching the Flight plane build tickets
+            for node in ast.walk(sf.tree):
+                kind_val, line = None, None
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "kind"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                        ):
+                            kind_val, line = v.value, k.lineno
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "dict"
+                ):
+                    for kw in node.keywords:
+                        if (
+                            kw.arg == "kind"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)
+                        ):
+                            kind_val, line = kw.value.value, node.lineno
+                if kind_val is not None:
+                    ctx = enclosing_context(sf.tree, node)
+                    constructed.setdefault(kind_val, (sf.rel, line, ctx))
+
+        for kind, (rel, line, ctx) in sorted(constructed.items()):
+            if kind not in dispatched:
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    context=ctx,
+                    message=(
+                        f"Flight ticket kind {kind!r} is constructed here but "
+                        "server/flight.py's do_get never dispatches it — every "
+                        "such DoGet fails at the peer"
+                    ),
+                )
+        for kind, line in sorted(dispatched.items()):
+            if kind not in constructed and constructed:
+                yield Finding(
+                    rule=self.name,
+                    path=_FLIGHT_SERVER_REL,
+                    line=line,
+                    message=(
+                        f"do_get dispatches ticket kind {kind!r} but no client "
+                        "in the tree constructs it — dead dispatch arm"
+                    ),
+                )
+
+        yield from self._check_meta(project, server)
+
+    def _check_meta(self, project: WireProject, server: SourceFile) -> Iterable[Finding]:
+        defined: dict[str, tuple[int, str]] = {}  # key -> (line, const name)
+        strip_set: dict[str, int] = {}
+        strip_names: dict[str, int] = {}  # _META_KEYS entries given as names
+        const_by_name: dict[str, str] = {}
+        for node in server.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                tname = node.targets[0].id
+                if isinstance(node.value, ast.Constant):
+                    v = node.value.value
+                    if isinstance(v, bytes):
+                        v = v.decode(errors="replace")
+                    if isinstance(v, str) and v.startswith(_META_PREFIX):
+                        defined[v] = (node.lineno, tname)
+                        const_by_name[tname] = v
+                elif tname == "_META_KEYS" and isinstance(node.value, (ast.Tuple, ast.List)):
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant):
+                            v = e.value
+                            v = v.decode(errors="replace") if isinstance(v, bytes) else v
+                            if isinstance(v, str):
+                                strip_set[v] = e.lineno
+                        elif isinstance(e, ast.Name):
+                            strip_names[e.id] = e.lineno
+        for nm, ln in strip_names.items():
+            if nm in const_by_name:
+                strip_set[const_by_name[nm]] = ln
+        if not defined and not strip_set:
+            return
+        for key, (line, tname) in sorted(defined.items()):
+            if key not in strip_set:
+                yield Finding(
+                    rule=self.name,
+                    path=_FLIGHT_SERVER_REL,
+                    line=line,
+                    message=(
+                        f"schema-metadata key {key!r} ({tname}) is written "
+                        "server-side but missing from _META_KEYS — the client "
+                        "strip leaks it into user-facing schemas"
+                    ),
+                )
+        for key, line in sorted(strip_set.items()):
+            if key not in defined:
+                yield Finding(
+                    rule=self.name,
+                    path=_FLIGHT_SERVER_REL,
+                    line=line,
+                    message=(
+                        f"_META_KEYS strips {key!r} but no server-side write "
+                        "defines that key — dead strip entry (typo'd key?)"
+                    ),
+                )
+        # stray ptpu.* literals outside flight.py must be defined keys
+        for sf in project.files:
+            if sf.rel == _FLIGHT_SERVER_REL or not sf.rel.startswith("parseable_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Constant):
+                    continue
+                v = node.value
+                v = v.decode(errors="replace") if isinstance(v, bytes) else v
+                if isinstance(v, str) and v.startswith(_META_PREFIX) and v not in defined:
+                    yield Finding(
+                        rule=self.name,
+                        path=sf.rel,
+                        line=node.lineno,
+                        context=enclosing_context(sf.tree, node),
+                        message=(
+                            f"ptpu.* metadata literal {v!r} matches no key "
+                            "defined in server/flight.py — typo'd wire key"
+                        ),
+                    )

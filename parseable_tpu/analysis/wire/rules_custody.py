@@ -1,0 +1,435 @@
+"""wlint ffi-custody: owned ABI pointers must reach their paired release.
+
+Static complement to nsan's runtime `ptpu_cols_live()==0` /
+`ptpu_telem_live()==0` / `ptpu_edge_live()==0` session gates. The ABI's
+ownership contract lives in one table — `abicheck.OWNERSHIP` — mapping each
+producer export to its release entry points. This rule finds every ctypes
+call of a producer (`lib.ptpu_flatten_ndjson(...)`, `_lib.ptpu_telem_drain(
+...)`) and, with the resource-leak rule's path logic, demands the owned
+handle reaches a release on all paths:
+
+- a release call inside a ``finally:`` discharges every path;
+- a straight-line release is fine unless a ``return``/``raise`` sits
+  between acquisition and release — *unless* that early exit is the
+  decline-guard idiom (guarded by an ``if`` whose test reads the rc or
+  the handle, e.g. ``if rc != 0: return None`` — on that path the C side
+  never allocated);
+- custody transfer is fine: returning the handle, storing it on
+  ``self``, or handing it to `_ColumnarBufs`/`_import_columnar`
+  (abicheck.CUSTODY_SINKS) whose destructor owns the free;
+- handing the handle to another function is fine when that callee —
+  resolved through the PR 5 call graph — transitively reaches the
+  release (an unresolvable callee is assumed to take custody: this rule
+  errs quiet, the runtime live-gates err loud).
+
+``ctypes.*`` helpers (string_at/cast/byref) never take custody.
+
+A second, Python-level check covers the edge wrappers: a function claiming
+a request with ``.edge_next(...)`` must answer it — lexically reach an
+``edge_respond*`` call or hand the rid to a callee.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from parseable_tpu.analysis.callgraph import CallGraph, build_call_graph
+from parseable_tpu.analysis.framework import (
+    Finding,
+    Rule,
+    SourceFile,
+    attr_chain,
+    enclosing_context,
+)
+from parseable_tpu.analysis.nsan.abicheck import CUSTODY_SINKS, OWNERSHIP
+from parseable_tpu.analysis.wire.extract import WireProject
+
+_RESPOND_TAILS = {
+    "edge_respond",
+    "edge_respond_ack",
+    "edge_respond_raw",
+    "ptpu_edge_respond",
+    "ptpu_edge_respond_ack",
+    "ptpu_edge_respond_raw",
+}
+
+
+def _own_statements(fn) -> list[ast.stmt]:
+    """fn's own statements top-down, nested defs excluded (the resource-leak
+    rule's traversal — a nested function is its own custody scope)."""
+    own: list[ast.stmt] = []
+    stack = list(fn.body)
+    while stack:
+        s = stack.pop(0)
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        own.append(s)
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                stack.append(child)
+    return own
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _byref_handle_names(call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    for a in call.args:
+        if isinstance(a, ast.Call):
+            chain = attr_chain(a.func)
+            if chain and chain[-1] == "byref" and a.args:
+                out |= _names_in(a.args[0])
+    return out
+
+
+_POINTER_CTORS = {"c_void_p", "c_char_p"}
+
+
+def _pointer_locals(own: list[ast.stmt]) -> set[str]:
+    """Names bound to ctypes pointer objects (``out = ctypes.c_void_p()``,
+    ``p = ctypes.POINTER(T)()``) — the byref args that can carry ownership,
+    as opposed to scalar out-params (c_uint64 counts, lengths, row counts)."""
+    out: set[str] = set()
+    for s in own:
+        if not (isinstance(s, ast.Assign) and isinstance(s.value, ast.Call)):
+            continue
+        fn = s.value.func
+        chain = attr_chain(fn)
+        is_ptr = bool(chain) and chain[-1] in _POINTER_CTORS
+        if not is_ptr and isinstance(fn, ast.Call):
+            inner = attr_chain(fn.func)
+            is_ptr = bool(inner) and inner[-1] == "POINTER"
+        if is_ptr:
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _bare_names(root: ast.AST) -> set[str]:
+    """Names occurring bare (not as the base of an attribute read): in
+    ``return out, int(n.value)`` only ``out`` is bare — ``n.value`` reads a
+    scalar copy out of the ctypes object, it does not hand over ``n``."""
+    bare: set[str] = set()
+
+    def rec(n: ast.AST, parent: ast.AST | None) -> None:
+        if isinstance(n, ast.Name) and not isinstance(parent, ast.Attribute):
+            bare.add(n.id)
+        for c in ast.iter_child_nodes(n):
+            rec(c, n)
+
+    rec(root, None)
+    return bare
+
+
+def _mentions_release(tree: ast.AST, releases: tuple[str, ...]) -> bool:
+    tails = set(releases) | CUSTODY_SINKS
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in tails:
+            return True
+        if isinstance(node, ast.Name) and node.id in tails:
+            return True
+    return False
+
+
+class FfiCustodyRule(Rule):
+    """See module docstring."""
+
+    name = "ffi-custody"
+    description = "owned ABI pointer does not reach its paired release on all paths"
+    rationale = (
+        "the runtime live-gates only catch a leak the test suite happens to "
+        "execute; the static pairing catches the early-return path nobody "
+        "drives — the exact shape of the native arena leaks PRs 16-18 fixed"
+    )
+
+    def applies(self, rel: str) -> bool:
+        return False  # finalize-only (needs the call graph)
+
+    def finalize(self, project: WireProject) -> Iterable[Finding]:
+        graph = build_call_graph(project)
+        by_loc: dict[tuple[str, int], str] = {
+            (fi.rel, fi.line): key for key, fi in graph.funcs.items()
+        }
+        for sf in project.files:
+            if not sf.rel.startswith("parseable_tpu/") or not sf.rel.endswith(".py"):
+                continue
+            for fn in ast.walk(sf.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_func(sf, fn, graph, by_loc)
+
+    # ----------------------------------------------------------- ctypes side
+
+    def _check_func(
+        self,
+        sf: SourceFile,
+        fn,
+        graph: CallGraph,
+        by_loc: dict[tuple[str, int], str],
+    ) -> Iterator[Finding]:
+        own = _own_statements(fn)
+        producers: list[tuple[ast.Call, str]] = []
+        for s in own:
+            for node in ast.walk(s):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if len(chain) >= 2 and chain[-1] in OWNERSHIP:
+                        producers.append((node, chain[-1]))
+        if producers:
+            for call, export in producers:
+                yield from self._check_producer(sf, fn, own, call, export, graph, by_loc)
+        yield from self._check_edge_claims(sf, fn, own)
+
+    def _check_producer(
+        self,
+        sf: SourceFile,
+        fn,
+        own: list[ast.stmt],
+        call: ast.Call,
+        export: str,
+        graph: CallGraph,
+        by_loc: dict[tuple[str, int], str],
+    ) -> Iterator[Finding]:
+        releases, kind = OWNERSHIP[export]
+        byref_names = _byref_handle_names(call)
+        if kind == "claim":
+            # a claim token is a scalar (request id); any byref out-param
+            # can carry it
+            handles = set(byref_names)
+        else:
+            # ownership rides the pointer-typed out-params only; scalar
+            # out-params (lengths, row counts) are copies
+            ptrs = _pointer_locals(own)
+            handles = (byref_names & ptrs) or set(byref_names)
+        rc_names: set[str] = set()
+        stored = False
+        returned_raw = False
+        # the statement that binds the producer's value
+        for s in own:
+            if any(n is call for n in ast.walk(s)):
+                if isinstance(s, ast.Assign):
+                    for t in s.targets:
+                        if isinstance(t, ast.Name):
+                            if kind == "handle" and not handles:
+                                handles.add(t.id)
+                            else:
+                                rc_names.add(t.id)
+                        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                            stored = True
+                elif isinstance(s, (ast.Return, ast.Expr)) and kind == "handle":
+                    returned_raw = isinstance(s, ast.Return)
+                break
+        guard_names = handles | rc_names
+        ctx = enclosing_context(sf.tree, fn) or fn.name
+
+        if kind == "handle" and not handles:
+            if stored or returned_raw:
+                return  # custody moved to the holder / the caller
+            yield self._finding(
+                sf,
+                call.lineno,
+                ctx,
+                f"{export}() returns an owned {kind} that is neither bound, "
+                "stored, nor returned — it can never be released",
+            )
+            return
+
+        release_lines: list[int] = []
+        finally_release = False
+        escapes = False
+        for s in own:
+            if isinstance(s, ast.Try):
+                for b in s.finalbody:
+                    for sub in ast.walk(b):
+                        if self._is_release(sub, releases, handles):
+                            finally_release = True
+        for s in own:
+            for sub in ast.walk(s):
+                if self._is_release(sub, releases, handles):
+                    release_lines.append(sub.lineno)
+                elif isinstance(sub, ast.Return) and sub.value is not None:
+                    # a claim token escapes via any mention (returning
+                    # rid.value IS the transfer); a pointer escapes only
+                    # bare (returning n.value copies a scalar out)
+                    mentioned = (
+                        _names_in(sub.value)
+                        if kind == "claim"
+                        else _bare_names(sub.value)
+                    )
+                    if handles & mentioned and not self._is_guarded(
+                        own, sub, guard_names
+                    ):
+                        escapes = True  # handle handed to the caller
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, (ast.Attribute, ast.Subscript)) and (
+                            handles & _names_in(sub.value)
+                        ):
+                            escapes = True  # stored: owner is elsewhere now
+                elif isinstance(sub, ast.Call) and sub is not call:
+                    fchain = attr_chain(sub.func)
+                    if not fchain or fchain[0] == "ctypes" or fchain[-1] == "byref":
+                        continue
+                    arg_names: set[str] = set()
+                    for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                        arg_names |= _names_in(a)
+                    if not (handles & arg_names):
+                        continue
+                    if fchain[-1] in releases:
+                        continue  # already counted
+                    if fchain[-1] in CUSTODY_SINKS:
+                        escapes = True
+                    elif self._callee_discharges(sub, releases, graph, by_loc, sf):
+                        escapes = True
+
+        if finally_release or escapes:
+            return
+        if not release_lines:
+            yield self._finding(
+                sf,
+                call.lineno,
+                ctx,
+                f"{export}() hands this function an owned {kind} but no "
+                f"paired release ({'/'.join(releases)}) is reachable from it",
+            )
+            return
+        first_release = min(release_lines)
+        for s in own:
+            for sub in ast.walk(s):
+                if (
+                    isinstance(sub, (ast.Return, ast.Raise))
+                    and call.lineno < sub.lineno < first_release
+                    and not self._is_guarded(own, sub, guard_names)
+                ):
+                    yield self._finding(
+                        sf,
+                        call.lineno,
+                        ctx,
+                        f"{export}()'s owned {kind} leaks on the early exit at "
+                        f"line {sub.lineno} (release only runs on the "
+                        "fall-through path): use `finally:` or guard the exit "
+                        "on the rc/handle",
+                    )
+                    return
+
+    @staticmethod
+    def _is_release(node: ast.AST, releases: tuple[str, ...], handles: set[str]) -> bool:
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            return False
+        if node.func.attr not in releases:
+            return False
+        if not handles:
+            return True
+        args = set()
+        for a in node.args:
+            args |= _names_in(a)
+        return bool(handles & args) or not node.args
+
+    @staticmethod
+    def _is_guarded(own: list[ast.stmt], exit_stmt: ast.AST, guard_names: set[str]) -> bool:
+        """True when `exit_stmt` sits under an `if` whose test reads an rc or
+        handle name — the decline-guard idiom (`if rc != 0: return None`)."""
+        if not guard_names:
+            return False
+        for s in own:
+            if isinstance(s, ast.If) and guard_names & _names_in(s.test):
+                for sub in ast.walk(s):
+                    if sub is exit_stmt:
+                        return True
+        return False
+
+    def _callee_discharges(
+        self,
+        callsite: ast.Call,
+        releases: tuple[str, ...],
+        graph: CallGraph,
+        by_loc: dict[tuple[str, int], str],
+        sf: SourceFile,
+    ) -> bool:
+        """Does the callee (resolved via the call graph, BFS two hops down)
+        lexically reach the paired release or a custody sink? Unresolvable
+        callees are assumed to take custody — see module docstring."""
+        tail = attr_chain(callsite.func)[-1]
+        start_keys = [
+            key
+            for key, fi in graph.funcs.items()
+            if fi.name == tail and (fi.rel == sf.rel or ":" not in tail)
+        ] or [key for key, fi in graph.funcs.items() if fi.name == tail]
+        if not start_keys:
+            return True  # not in the graph: external/unknown — assume custody
+        seen: set[str] = set()
+        frontier = list(start_keys)
+        for _ in range(3):
+            nxt: list[str] = []
+            for key in frontier:
+                if key in seen:
+                    continue
+                seen.add(key)
+                fi = graph.funcs.get(key)
+                if fi is None:
+                    continue
+                if _mentions_release(fi.node, releases):
+                    return True
+                nxt.extend(e.callee for e in fi.edges)
+            frontier = nxt
+        return False
+
+    # ------------------------------------------------------------- edge side
+
+    def _check_edge_claims(self, sf: SourceFile, fn, own: list[ast.stmt]) -> Iterator[Finding]:
+        """Python-level claim/respond pairing for the edge wrappers."""
+        if sf.rel == "parseable_tpu/native/__init__.py":
+            return  # the ctypes-level check above already covers the wrappers
+        claims: list[tuple[int, str | None]] = []
+        for s in own:
+            if not isinstance(s, ast.Assign) or not isinstance(s.value, ast.Call):
+                continue
+            chain = attr_chain(s.value.func)
+            if not chain or chain[-1] != "edge_next":
+                continue
+            rid: str | None = None
+            tgt = s.targets[0]
+            if isinstance(tgt, (ast.Tuple, ast.List)) and len(tgt.elts) >= 2:
+                if isinstance(tgt.elts[1], ast.Name):
+                    rid = tgt.elts[1].id
+            elif isinstance(tgt, ast.Name):
+                rid = tgt.id
+            claims.append((s.value.lineno, rid))
+        if not claims:
+            return
+        responds = False
+        rid_escapes = False
+        rid_names = {r for _, r in claims if r}
+        for s in own:
+            for sub in ast.walk(s):
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = attr_chain(sub.func)
+                if chain and chain[-1] in _RESPOND_TAILS:
+                    responds = True
+                elif chain and rid_names:
+                    for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                        if rid_names & _names_in(a):
+                            rid_escapes = True
+        if responds or rid_escapes:
+            return
+        line, rid = claims[0]
+        ctx = enclosing_context(sf.tree, fn) or fn.name
+        yield self._finding(
+            sf,
+            line,
+            ctx,
+            "edge_next() claims a request here but this function neither "
+            "responds (edge_respond*/ack/raw) nor hands the rid to a callee "
+            "— the claimed request can never drain and edge_live() sticks",
+        )
+
+    # ---------------------------------------------------------------- misc
+
+    def _finding(self, sf: SourceFile, line: int, ctx: str, message: str) -> Finding:
+        return Finding(
+            rule=self.name, path=sf.rel, line=line, context=ctx, message=message
+        )

@@ -1,0 +1,518 @@
+"""wlint telemetry rules: Prometheus metric discipline + stats.stages keys.
+
+- metric-discipline  every family constructed in utils/metrics.py must be
+                     ticked somewhere in shipped code, every ``.labels()``
+                     call site must pass the declared label names in
+                     order, and every exported family must appear in
+                     README (verbatim or via a ``parseable_foo_*`` family
+                     row — config-drift's doc-enforcement idiom, applied
+                     to metrics).
+- stages-contract    the `stats.stages.*` keys the query path produces vs
+                     the keys tests/EXPLAIN ANALYZE/bench consume. A
+                     consumed-but-never-produced key is an error (dead
+                     assertion surface — the check can never see the value
+                     it names); a produced-but-never-consumed key is an
+                     advisory (exported but unwatched).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Iterable
+
+from parseable_tpu.analysis.framework import (
+    Finding,
+    Rule,
+    attr_chain,
+    enclosing_context,
+)
+from parseable_tpu.analysis.wire.extract import WireProject
+
+_METRICS_REL = "parseable_tpu/utils/metrics.py"
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
+# methods that tick/observe/describe a family at a use site
+_TICK_METHODS = {
+    "inc",
+    "dec",
+    "set",
+    "observe",
+    "labels",
+    "remove",
+    "clear",
+    "set_function",
+}
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    var: str
+    kind: str
+    full_name: str  # exposition base name incl. namespace prefix
+    labels: tuple[str, ...]
+    line: int
+
+
+def _module_str_consts(tree: ast.Module) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve_ns(node: ast.expr | None, consts: dict[str, str]) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id, "")
+    return ""
+
+
+def _ctor_helpers(tree: ast.Module, consts: dict[str, str]) -> dict[str, tuple[str, str]]:
+    """Local wrappers like ``def _counter(name, doc, labels): return
+    Counter(name, doc, labels, namespace=METRICS_NAMESPACE, ...)`` —
+    helper name -> (metric kind, resolved namespace)."""
+    out: dict[str, tuple[str, str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Return) and isinstance(stmt.value, ast.Call):
+                fn = stmt.value.func
+                ctor = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+                if ctor in _METRIC_CTORS:
+                    ns = ""
+                    for kw in stmt.value.keywords:
+                        if kw.arg == "namespace":
+                            ns = _resolve_ns(kw.value, consts)
+                    out[node.name] = (ctor, ns)
+    return out
+
+
+def metrics_registry(project: WireProject) -> dict[str, MetricDef]:
+    by_rel = {sf.rel: sf for sf in project.files}
+    sf = by_rel.get(_METRICS_REL)
+    if sf is None:
+        return {}
+    consts = _module_str_consts(sf.tree)
+    helpers = _ctor_helpers(sf.tree, consts)
+    out: dict[str, MetricDef] = {}
+    for node in sf.tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        fn = node.value.func
+        ctor = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+        namespace = ""
+        if ctor in helpers:
+            ctor, namespace = helpers[ctor]
+        elif ctor not in _METRIC_CTORS:
+            continue
+        args, kws = node.value.args, node.value.keywords
+        if not args or not isinstance(args[0], ast.Constant):
+            continue
+        name = args[0].value
+        labels: tuple[str, ...] = ()
+        if len(args) >= 3 and isinstance(args[2], (ast.List, ast.Tuple)):
+            labels = tuple(
+                e.value for e in args[2].elts if isinstance(e, ast.Constant)
+            )
+        for kw in kws:
+            if kw.arg == "labelnames" and isinstance(kw.value, (ast.List, ast.Tuple)):
+                labels = tuple(
+                    e.value for e in kw.value.elts if isinstance(e, ast.Constant)
+                )
+            elif kw.arg == "namespace":
+                namespace = _resolve_ns(kw.value, consts) or namespace
+        full = f"{namespace}_{name}" if namespace else name
+        var = node.targets[0].id
+        out[var] = MetricDef(var=var, kind=ctor, full_name=full, labels=labels, line=node.lineno)
+    return out
+
+
+_FAMILY_ROW_RE = re.compile(r"`?([a-z][a-z0-9_]+)\*`?")
+
+
+class MetricDisciplineRule(Rule):
+    """See module docstring. Tick sites are scanned in shipped code only
+    (parseable_tpu/, scripts/, bench.py) — a family only tests keep alive
+    is still dead surface on a running node."""
+
+    name = "metric-discipline"
+    description = (
+        "metric never ticked, .labels() args drifted from declaration, or "
+        "family missing from README"
+    )
+    rationale = (
+        "an unticked family is a flatline on every dashboard that trusts "
+        "it; a labels() mismatch raises at the first scrape-path tick; an "
+        "undocumented family is invisible to operators"
+    )
+
+    def _scan(self, rel: str) -> bool:
+        return rel.endswith(".py") and (
+            rel.startswith("parseable_tpu/")
+            or rel.startswith("scripts/")
+            or rel == "bench.py"
+        )
+
+    def finalize(self, project: WireProject) -> Iterable[Finding]:
+        registry = metrics_registry(project)
+        if not registry:
+            return
+        ticked: set[str] = set()
+        label_sites: list[tuple[str, int, str, str, list, list]] = []
+        for sf in project.files:
+            if not self._scan(sf.rel) or sf.rel == _METRICS_REL:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                    continue
+                if node.func.attr not in _TICK_METHODS:
+                    continue
+                chain = attr_chain(node.func)
+                var = next((p for p in chain if p in registry), None)
+                if var is None:
+                    continue
+                ticked.add(var)
+                if node.func.attr == "labels":
+                    ctx = enclosing_context(sf.tree, node)
+                    label_sites.append(
+                        (sf.rel, node.lineno, ctx, var, node.args, node.keywords)
+                    )
+
+        for rel, line, ctx, var, args, keywords in label_sites:
+            decl = registry[var].labels
+            if any(isinstance(a, ast.Starred) for a in args) or any(
+                kw.arg is None for kw in keywords
+            ):
+                continue  # *args/**kwargs: arity is not statically knowable
+            npos = len(args)
+            kw_names = [kw.arg for kw in keywords]
+            total = npos + len(kw_names)
+            if total != len(decl):
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    context=ctx,
+                    message=(
+                        f"{var}.labels() passes {total} label(s) but the "
+                        f"family declares {len(decl)} ({', '.join(decl) or 'none'})"
+                    ),
+                )
+            elif kw_names and kw_names != list(decl[npos:]):
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    context=ctx,
+                    message=(
+                        f"{var}.labels() keyword order {kw_names} drifted from "
+                        f"the declared label order {list(decl[npos:])}"
+                    ),
+                )
+
+        readme = project.readme_text()
+        families = [m.group(1) for m in _FAMILY_ROW_RE.finditer(readme)]
+        for var, md in sorted(registry.items()):
+            if var not in ticked:
+                yield Finding(
+                    rule=self.name,
+                    path=_METRICS_REL,
+                    line=md.line,
+                    message=(
+                        f"metric family {md.full_name} ({var}) is constructed "
+                        "but never ticked in shipped code — flatline surface"
+                    ),
+                )
+            documented = (
+                md.full_name in readme
+                or f"{md.full_name}_total" in readme
+                or any(md.full_name.startswith(fam) for fam in families)
+            )
+            if not documented:
+                yield Finding(
+                    rule=self.name,
+                    path=_METRICS_REL,
+                    line=md.line,
+                    context="README",
+                    message=(
+                        f"metric family {md.full_name} is exported but not "
+                        "documented in README.md (add it, or a family_* row)"
+                    ),
+                )
+
+
+# --------------------------------------------------------------------------
+# stages-contract
+
+
+def _const_keys(node: ast.Dict) -> Iterable[tuple[str, int]]:
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            yield k.value, k.lineno
+
+
+class StagesContractRule(Rule):
+    """stats.stages key accounting (see module docstring).
+
+    Producers: the dict literal under a ``"stages"`` key, subscript-assigns
+    onto a name called ``stages``, plus — for nested stage payloads — dict
+    keys, subscript-assign keys, and loop-tuple constants inside functions
+    named ``*_stage``/``stats_snapshot`` and keys written to the fan-out
+    run's ``self.stats``.
+
+    Consumers: constant keys read off a ``X["stages"]``/``X.get("stages")``
+    expression, off a local previously bound to one, or off a name called
+    ``stages`` — in tests/, bench.py, scripts/ and the package itself."""
+
+    name = "stages-contract"
+    description = "stats.stages key consumed but never produced (or produced and unwatched)"
+    rationale = (
+        "a consumed-but-never-produced key is dead assertion surface: the "
+        "test or EXPLAIN row reads a value the query path cannot emit"
+    )
+
+    def finalize(self, project: WireProject) -> Iterable[Finding]:
+        produced = self._produced(project)
+        if not produced:
+            return
+        consumed = self._consumed(project)
+        for key, (rel, line) in sorted(consumed.items()):
+            if key not in produced:
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    message=(
+                        f"stats.stages key {key!r} is consumed here but the "
+                        "query path never produces it — dead assertion surface"
+                    ),
+                )
+
+    def advisories(self, project: WireProject) -> Iterable[Finding]:
+        produced = self._produced(project)
+        if not produced:
+            return
+        consumed = self._consumed(project)
+        for key, (rel, line, top) in sorted(produced.items()):
+            if top and key not in consumed:
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    message=(
+                        f"stats.stages key {key!r} is produced but nothing in "
+                        "tests/bench/scripts consumes it (advisory)"
+                    ),
+                )
+
+    # ------------------------------------------------------------ producers
+
+    def _produced(self, project: WireProject) -> dict[str, tuple[str, int, bool]]:
+        out: dict[str, tuple[str, int, bool]] = {}
+
+        def rec(key: str, rel: str, line: int, top: bool) -> None:
+            out.setdefault(key, (rel, line, top))
+
+        for sf in project.files:
+            if not sf.rel.startswith("parseable_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                # {"stages": {...literal...}} — the canonical producer
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "stages"
+                            and isinstance(v, ast.Dict)
+                        ):
+                            for key, line in _const_keys(v):
+                                rec(key, sf.rel, line, True)
+                # stages["x"] = ... (incremental producer)
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if (
+                            isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "stages"
+                            and isinstance(tgt.slice, ast.Constant)
+                            and isinstance(tgt.slice.value, str)
+                        ):
+                            rec(tgt.slice.value, sf.rel, node.lineno, True)
+                # nested stage payload producers
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+                    node.name.endswith("_stage") or node.name == "stats_snapshot"
+                ):
+                    yield_nested = self._nested_keys(node)
+                    for key, line in yield_nested:
+                        rec(key, sf.rel, line, False)
+            # the fan-out run's stats dict feeds stages.fanout verbatim
+            if sf.rel == "parseable_tpu/query/fanout.py":
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, ast.AnnAssign):
+                        # self.stats: dict = {...}
+                        if (
+                            isinstance(node.target, ast.Attribute)
+                            and node.target.attr == "stats"
+                            and isinstance(node.value, ast.Dict)
+                        ):
+                            for key, line in _const_keys(node.value):
+                                rec(key, sf.rel, line, False)
+                    if isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Subscript)
+                                and attr_chain(tgt.value)[-1:] == ["stats"]
+                                and isinstance(tgt.slice, ast.Constant)
+                                and isinstance(tgt.slice.value, str)
+                            ):
+                                rec(tgt.slice.value, sf.rel, node.lineno, False)
+                            elif (
+                                isinstance(tgt, ast.Attribute)
+                                and tgt.attr == "stats"
+                                and isinstance(node.value, ast.Dict)
+                            ):
+                                for key, line in _const_keys(node.value):
+                                    rec(key, sf.rel, line, False)
+                    if isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Subscript):
+                        tgt = node.target
+                        if (
+                            attr_chain(tgt.value)[-1:] == ["stats"]
+                            and isinstance(tgt.slice, ast.Constant)
+                            and isinstance(tgt.slice.value, str)
+                        ):
+                            rec(tgt.slice.value, sf.rel, node.lineno, False)
+        return out
+
+    def _nested_keys(self, fn: ast.AST) -> list[tuple[str, int]]:
+        out: list[tuple[str, int]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                out.extend(_const_keys(node))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in tgts:
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and isinstance(tgt.slice.value, str)
+                    ):
+                        out.append((tgt.slice.value, node.lineno))
+            elif isinstance(node, ast.For) and isinstance(node.iter, (ast.Tuple, ast.List)):
+                for e in node.iter.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        out.append((e.value, e.lineno))
+        return out
+
+    # ------------------------------------------------------------ consumers
+
+    def _consumed(self, project: WireProject) -> dict[str, tuple[str, int]]:
+        out: dict[str, tuple[str, int]] = {}
+        for sf in project.files:
+            rel = sf.rel
+            if not rel.endswith(".py"):
+                continue
+            if not (
+                rel.startswith("tests/")
+                or rel.startswith("scripts/")
+                or rel.startswith("parseable_tpu/")
+                or rel == "bench.py"
+            ):
+                continue
+            for key, line in self._file_consumed(sf):
+                out.setdefault(key, (rel, line))
+        return out
+
+    def _file_consumed(self, sf) -> Iterable[tuple[str, int]]:
+        # names bound (anywhere in the file — cheap over-approximation) to
+        # a stages expression or one of its sub-dicts
+        stagesish: set[str] = {"stages"}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and self._is_stages_expr(node.value):
+                    stagesish.add(tgt.id)
+        for node in ast.walk(sf.tree):
+            key = None
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+                and isinstance(node.ctx, ast.Load)
+            ):
+                if self._reads_stages(node.value, stagesish):
+                    key = node.slice.value
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                if self._reads_stages(node.func.value, stagesish):
+                    key = node.args[0].value
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+                # "key" in stages  /  set(stages) >= {"key", ...}
+                left, op, right = node.left, node.ops[0], node.comparators[0]
+                if (
+                    isinstance(op, (ast.In, ast.NotIn))
+                    and isinstance(left, ast.Constant)
+                    and isinstance(left.value, str)
+                    and self._reads_stages(right, stagesish)
+                ):
+                    key = left.value
+                else:
+                    for side, other in ((left, right), (right, left)):
+                        if (
+                            isinstance(side, ast.Call)
+                            and isinstance(side.func, ast.Name)
+                            and side.func.id == "set"
+                            and side.args
+                            and self._reads_stages(side.args[0], stagesish)
+                            and isinstance(other, ast.Set)
+                        ):
+                            for e in other.elts:
+                                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                                    yield e.value, node.lineno
+            if key is not None and key != "stages":
+                yield key, node.lineno
+
+    def _is_stages_expr(self, node: ast.AST) -> bool:
+        """X["stages"], X.get("stages"), (expr or {}), or a subscript/get
+        hanging off one of those (a sub-dict still consumes stage keys)."""
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_stages_expr(v) for v in node.values)
+        if isinstance(node, ast.Subscript):
+            if (
+                isinstance(node.slice, ast.Constant)
+                and node.slice.value == "stages"
+            ):
+                return True
+            return self._is_stages_expr(node.value)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "get" and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and a0.value == "stages":
+                    return True
+                return self._is_stages_expr(node.func.value)
+        return False
+
+    def _reads_stages(self, base: ast.AST, stagesish: set[str]) -> bool:
+        if isinstance(base, ast.Name):
+            return base.id in stagesish
+        return self._is_stages_expr(base)

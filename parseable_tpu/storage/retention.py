@@ -14,6 +14,11 @@ from datetime import UTC, datetime, timedelta
 
 from parseable_tpu.core import Parseable
 from parseable_tpu.metastore import MetastoreError
+from parseable_tpu.utils.metrics import (
+    DELETED_EVENTS_STORAGE_SIZE,
+    EVENTS_DELETED,
+    EVENTS_DELETED_SIZE,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -91,6 +96,15 @@ def apply_retention(p: Parseable, stream_name: str, days: int, now: datetime | N
         if expired:
             fmt.snapshot.manifest_list = keep
             p.metastore.put_stream_json(stream_name, fmt, p._node_suffix)
+
+    if expired:
+        # scrape-surface mirror of the stream-json stats adjustment above
+        # (same label idiom as the sync path's STORAGE_SIZE family ticks)
+        del_events = sum(item.events_ingested for item in expired)
+        del_storage = sum(item.storage_size for item in expired)
+        EVENTS_DELETED.labels(stream_name, "json").inc(del_events)
+        EVENTS_DELETED_SIZE.labels(stream_name, "json").inc(del_storage)
+        DELETED_EVENTS_STORAGE_SIZE.labels("data", stream_name, "json").inc(del_storage)
 
     # Phase 2 — outside the lock: delete data + manifests. Snapshot no
     # longer references them, so a crash mid-sweep leaves only unreferenced

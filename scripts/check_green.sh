@@ -48,6 +48,24 @@ if ! python -m parseable_tpu.analysis "${plint_args[@]}"; then
 fi
 echo "check_green: plint GREEN (report: /tmp/plint.json)"
 
+# wire-contract gate: wlint (parseable_tpu/analysis/wire/) diffs both sides
+# of every wire contract — client path literals vs the aiohttp route table
+# (and the C++ edge classifier's route strings), X-P-* header produce/consume
+# across Python AND fastpath.cpp, Flight ticket kinds and ptpu.* schema
+# metadata, metric families vs ticks vs README, stats.stages.* produce/
+# consume, and FFI pointer custody against the nsan ownership tables.
+# Always a full-tree run (every rule is cross-file; sub-second). Opt out
+# with WLINT=0; the JSON report lands at /tmp/wlint.json either way it runs.
+if [ "${WLINT:-1}" != "0" ]; then
+  if ! python -m parseable_tpu.analysis.wire --json-out /tmp/wlint.json; then
+    echo "check_green: WLINT RED (unbaselined findings; see above and /tmp/wlint.json)" >&2
+    exit 1
+  fi
+  echo "check_green: wlint GREEN (report: /tmp/wlint.json)"
+else
+  echo "check_green: wlint SKIPPED (WLINT=0)"
+fi
+
 # dynamic-analysis gate: the same tier-1 suite again under the psan runtime
 # concurrency sanitizer (P_PSAN=1) — Eraser lockset races on guarded-by
 # attrs, runtime lock-order vs the declared hierarchy, event-loop blocking,
@@ -143,3 +161,34 @@ if [ "${OBS_CLUSTER:-1}" != "0" ]; then
 else
   echo "check_green: obs cluster SKIPPED (OBS_CLUSTER=0)"
 fi
+
+# merged artifact: one /tmp/analysis_summary.json rolling up the four
+# static/dynamic analysis reports (plint, psan, nsan, wlint) so a snapshot
+# reviewer reads one file. Skipped gates simply have no section; the merge
+# itself never turns the gate red.
+python - <<'PY' || echo "check_green: analysis summary merge failed (non-fatal)" >&2
+import json, pathlib
+out = {}
+for name in ("plint", "psan", "nsan", "wlint"):
+    p = pathlib.Path(f"/tmp/{name}.json")
+    if not p.exists():
+        continue
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, ValueError):
+        continue
+    findings = doc.get("findings", [])
+    baselined = doc.get("baselined", [])
+    out[name] = {
+        "artifact": str(p),
+        "files_checked": doc.get("files_checked"),
+        "findings": len(findings),
+        "baselined": len(baselined),
+        "unbaselined": max(0, len(findings) - len(baselined)),
+        "clean": bool(doc.get("clean", not findings)),
+    }
+pathlib.Path("/tmp/analysis_summary.json").write_text(
+    json.dumps({"version": 1, "gates": out}, indent=2) + "\n"
+)
+print(f"check_green: analysis summary -> /tmp/analysis_summary.json ({', '.join(out) or 'no artifacts'})")
+PY

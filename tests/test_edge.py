@@ -224,61 +224,227 @@ def test_edge_auth_snapshot_is_live():
 
 # ------------------------------------------------------------ parity suite
 
-# (family name, target, extra headers, body) — each ingests through BOTH
-# tiers into per-tier streams and the staged rows must come back identical
-_FAMILIES = [
-    ("flat_list", "/api/v1/ingest", {}, b'[{"h": "a", "v": 1}, {"h": "b", "v": 2}]'),
-    ("single_obj", "/api/v1/ingest", {}, b'{"msg": "one", "n": 7}'),
-    (
-        "nested",
-        "/api/v1/ingest",
-        {},
-        b'[{"a": {"b": {"c": 1}}, "tags": ["x", "y"]}]',
-    ),
-    (
-        "unicode",
-        "/api/v1/ingest",
-        {},
-        '[{"s": "héllo ☃ 漢", "e": "q\\"uote"}]'.encode(),
-    ),
-    (
-        "otel_logs",
-        "/v1/logs",
-        {"X-P-Log-Source": "otel-logs"},
-        json.dumps(
-            {
-                "resourceLogs": [
-                    {
-                        "resource": {
-                            "attributes": [
+
+def _extracted_edge_routes():
+    """The hot-route surface, extracted rather than hand-listed: wlint's
+    route extraction reads the C++ classifier's route literals and the
+    aiohttp route table from source, so a route added to either side shows
+    up here — and a literal with no payload fixture below fails loudly
+    instead of silently going untested."""
+    from parseable_tpu.analysis.framework import (
+        Project,
+        SourceFile,
+        iter_python_files,
+    )
+    from parseable_tpu.analysis.wire import extract
+    from parseable_tpu.analysis.wire.csource import CSourceFile
+
+    project = Project(root=REPO_ROOT)
+    for p in iter_python_files(REPO_ROOT, ["parseable_tpu/server"]):
+        project.files.append(SourceFile.from_path(REPO_ROOT, p))
+    routes = extract.route_table(project)
+    cf = CSourceFile.from_path(
+        REPO_ROOT, REPO_ROOT / "parseable_tpu" / "native" / "fastpath.cpp"
+    )
+    literals = sorted({v for _, v in extract.cpp_route_literals(cf)})
+    return routes, literals
+
+
+# payload fixtures per C++ hot-route literal: {literal: [(family name,
+# target, extra headers, body), ...]}. Each family ingests through BOTH
+# tiers into per-tier streams and the staged rows must come back
+# identical. A `{stream}` in a target is replaced with the per-tier
+# stream name (path-named streams).
+_OTEL_RESOURCE = {
+    "attributes": [{"key": "service.name", "value": {"stringValue": "svc"}}]
+}
+_PAYLOAD_FIXTURES = {
+    "/api/v1/ingest": [
+        ("flat_list", "/api/v1/ingest", {}, b'[{"h": "a", "v": 1}, {"h": "b", "v": 2}]'),
+        ("single_obj", "/api/v1/ingest", {}, b'{"msg": "one", "n": 7}'),
+        (
+            "nested",
+            "/api/v1/ingest",
+            {},
+            b'[{"a": {"b": {"c": 1}}, "tags": ["x", "y"]}]',
+        ),
+        (
+            "unicode",
+            "/api/v1/ingest",
+            {},
+            '[{"s": "héllo ☃ 漢", "e": "q\\"uote"}]'.encode(),
+        ),
+    ],
+    "/api/v1/logstream/": [
+        (
+            "logstream_post",
+            "/api/v1/logstream/{stream}",
+            {},
+            b'[{"via": "path", "v": 3}]',
+        ),
+    ],
+    "/v1/logs": [
+        (
+            "otel_logs",
+            "/v1/logs",
+            {"X-P-Log-Source": "otel-logs"},
+            json.dumps(
+                {
+                    "resourceLogs": [
+                        {
+                            "resource": _OTEL_RESOURCE,
+                            "scopeLogs": [
                                 {
-                                    "key": "service.name",
-                                    "value": {"stringValue": "svc"},
+                                    "logRecords": [
+                                        {
+                                            "timeUnixNano": "1700000000000000000",
+                                            "severityText": "INFO",
+                                            "body": {"stringValue": "hello"},
+                                        }
+                                    ]
                                 }
-                            ]
-                        },
-                        "scopeLogs": [
-                            {
-                                "logRecords": [
-                                    {
-                                        "timeUnixNano": "1700000000000000000",
-                                        "severityText": "INFO",
-                                        "body": {"stringValue": "hello"},
-                                    }
-                                ]
-                            }
-                        ],
-                    }
-                ]
-            }
-        ).encode(),
-    ),
-]
+                            ],
+                        }
+                    ]
+                }
+            ).encode(),
+        ),
+    ],
+    "/v1/metrics": [
+        (
+            "otel_metrics",
+            "/v1/metrics",
+            {"X-P-Log-Source": "otel-metrics"},
+            json.dumps(
+                {
+                    "resourceMetrics": [
+                        {
+                            "resource": _OTEL_RESOURCE,
+                            "scopeMetrics": [
+                                {
+                                    "metrics": [
+                                        {
+                                            "name": "cpu.util",
+                                            "unit": "%",
+                                            "gauge": {
+                                                "dataPoints": [
+                                                    {
+                                                        "asDouble": 42.5,
+                                                        "timeUnixNano": "1700000000000000000",
+                                                    }
+                                                ]
+                                            },
+                                        }
+                                    ]
+                                }
+                            ],
+                        }
+                    ]
+                }
+            ).encode(),
+        ),
+    ],
+    "/v1/traces": [
+        (
+            "otel_traces",
+            "/v1/traces",
+            {"X-P-Log-Source": "otel-traces"},
+            json.dumps(
+                {
+                    "resourceSpans": [
+                        {
+                            "resource": _OTEL_RESOURCE,
+                            "scopeSpans": [
+                                {
+                                    "spans": [
+                                        {
+                                            "traceId": "aaaa",
+                                            "spanId": "bbbb",
+                                            "name": "GET /x",
+                                            "kind": 2,
+                                            "startTimeUnixNano": "1700000000000000000",
+                                            "endTimeUnixNano": "1700000001000000000",
+                                        }
+                                    ]
+                                }
+                            ],
+                        }
+                    ]
+                }
+            ).encode(),
+        ),
+    ],
+}
+
+
+def _edge_families() -> list[tuple[str, str, dict, bytes]]:
+    """Generate the parity family list from the EXTRACTED classifier
+    literals: a hot route added to fastpath.cpp without a payload fixture
+    here fails this assertion instead of riding along untested."""
+    _, literals = _extracted_edge_routes()
+    families: list[tuple[str, str, dict, bytes]] = []
+    for lit in literals:
+        fixtures = _PAYLOAD_FIXTURES.get(lit)
+        assert fixtures is not None, (
+            f"edge classifier route {lit!r} has no parity payload fixture "
+            "in _PAYLOAD_FIXTURES — every hot route must be exercised "
+            "through both tiers"
+        )
+        families.extend(fixtures)
+    stale = set(_PAYLOAD_FIXTURES) - set(literals)
+    assert not stale, f"payload fixtures for routes the classifier no longer matches: {stale}"
+    return families
+
+
+def test_edge_route_surface_extracted():
+    """Static route parity, no server boot: every C++ classifier literal
+    resolves against a registered aiohttp POST route, and every aiohttp
+    POST route on the ingest surface is claimable by a classifier
+    literal (wlint's route-drift rule enforces the same invariant at the
+    lint gate; this pins it in the test suite with the real tree)."""
+    from parseable_tpu.analysis.wire import extract
+
+    routes, literals = _extracted_edge_routes()
+    post = [r for r in routes if r.method == "POST"]
+    assert post and literals
+
+    def probe(lit: str) -> str:
+        # a trailing-slash literal is a prefix match for one path segment
+        return lit + "x" if lit.endswith("/") else lit
+
+    for lit in literals:
+        assert any(extract.path_matches(r.template, probe(lit)) for r in post), (
+            f"edge classifier matches {lit!r} but no aiohttp POST route serves it"
+        )
+
+    surface = [
+        r
+        for r in post
+        if r.template == "/api/v1/ingest"
+        or r.template.startswith("/v1/")
+        # the classifier claims exactly one path segment after the
+        # logstream prefix: deeper POST routes (schema/detect) are
+        # control-plane, declined to aiohttp by design
+        or (
+            r.template.startswith("/api/v1/logstream/")
+            and "/" not in r.template[len("/api/v1/logstream/") :]
+        )
+    ]
+    assert surface
+    for r in surface:
+        assert any(
+            extract.path_matches(r.template, probe(lit)) for lit in literals
+        ), (
+            f"aiohttp ingest route {r.template!r} ({r.rel}:{r.line}) is not "
+            "claimable by any edge classifier literal — the edge silently "
+            "declines a hot route"
+        )
 
 
 def test_edge_parity(tmp_path):
     bb = _load_blackbox()
     _native()
+    families = _edge_families()
     with bb.ClusterHarness(tmp_path) as cluster:
         edge_port = bb.free_port()
         node = cluster.spawn(
@@ -304,15 +470,17 @@ def test_edge_parity(tmp_path):
         wait_edge()
 
         # ---- happy-path ack parity + staged-row parity per family
-        for name, target, extra, body in _FAMILIES:
+        for name, target, extra, body in families:
             for tier, port in (("e", edge_port), ("a", node.port)):
+                stream = f"{tier}_{name}"
                 headers = {
                     "Authorization": AUTH,
-                    "X-P-Stream": f"{tier}_{name}",
+                    "X-P-Stream": stream,
                     "Content-Type": "application/json",
                     **extra,
                 }
-                resp = _roundtrip(port, _request("POST", target, headers, body))
+                tgt = target.format(stream=stream) if "{stream}" in target else target
+                resp = _roundtrip(port, _request("POST", tgt, headers, body))
                 status, hdrs, rbody = _split(resp)
                 assert status == 200, (name, tier, resp)
                 if tier == "e":
@@ -373,7 +541,7 @@ def test_edge_parity(tmp_path):
                 out.append(json.dumps(r, sort_keys=True))
             return sorted(out)
 
-        for name, _, _, _ in _FAMILIES:
+        for name, _, _, _ in families:
             e = canon(rows(f"e_{name}"))
             a = canon(rows(f"a_{name}"))
             assert e == a, f"staging diverged for family {name}: {e} != {a}"
@@ -440,7 +608,7 @@ def test_edge_parity(tmp_path):
             edge_stats["happy"] + edge_stats["declined"]
             == edge_stats["requests"]
         )
-        assert edge_stats["happy"] >= len(_FAMILIES) + 4
+        assert edge_stats["happy"] >= len(families) + 4
         # the oversized-body case parses clean in C (the soft cap is a
         # Python-side check that then relays), so it books as happy there
         assert edge_stats["declined"] >= len(declines) - 1

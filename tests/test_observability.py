@@ -283,8 +283,20 @@ def test_query_response_stats_carry_stages(tmp_path):
         )
         body = await r.json()
         stages = body["stats"]["stages"]
-        assert set(stages) >= {"plan_ms", "scan_ms", "execute_ms", "total_ms"}
+        # full produced-key surface (wlint stages-contract keeps this set
+        # honest: a key asserted here that session.py stops producing is a
+        # gate failure, and every produced key needs a consumer)
+        assert set(stages) >= {
+            "parse_ms",
+            "plan_ms",
+            "scan_ms",
+            "execute_ms",
+            "total_ms",
+            "bytes_saved_by_projection",
+        }
         assert stages["total_ms"] >= 0
+        assert stages["parse_ms"] >= 0
+        assert stages["bytes_saved_by_projection"] >= 0
 
     run(with_client(state, fn))
     state.stop()
