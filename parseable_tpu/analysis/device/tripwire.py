@@ -1,0 +1,305 @@
+"""dlint's dynamic companion: the P_DLINT=1 recompilation tripwire.
+
+The static rules prove every call-time ``jax.jit`` *claims* to ride a
+program cache; this plugin proves the claim at runtime.  It wraps
+``jax.jit`` for the whole pytest session (installed in pytest_configure,
+before collection imports the package, so decorator-time jits are wrapped
+too) and returns a thin proxy that detects real XLA compiles via the
+jitted callable's ``_cache_size()`` delta per call.  Every creation site
+is attributed to its declared program-cache name by reading the
+``# jit-cache: <family>.<program>`` annotation off the creating source
+line (the same grammar the static rules enforce, so the two halves cannot
+drift).
+
+Enforcement, for declared programs only:
+
+* a single proxy compiling more than ``P_DLINT_BUDGET`` (default 1) times
+  — a cached program is fetched once per shape class, so its proxy should
+  compile exactly once;
+* the same (program, cache-key) jit-created more than budget+1 times
+  within one test — the program cache failed to serve a warm key.  The
+  ``+1`` tolerates the one benign double-build the multithreaded query
+  pool can race into on a cold key; the per-call-jit bug this tripwire
+  exists for creates one per query and blows straight through.
+
+Undeclared sites (module-level decorators in ops/kernels.py, the mesh
+builders) are tracked in the report for visibility, never enforced —
+they are import-time or per-config, not per-query.
+
+Violations tick the shipped ``tpu_recompiles_total{program}`` counter
+(wlint's metric-discipline rule then keeps the family honest), flip the
+session exit status, and land in the P_DLINT_JSON artifact.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import sys
+import tokenize
+from collections import defaultdict
+from pathlib import Path
+
+_JIT_CACHE_RE = re.compile(r"jit-cache:\s*([A-Za-z_][A-Za-z0-9_.-]*)")
+
+#: The active plugin instance, for tests and the executor stages hook.
+_ACTIVE: "DlintPytestPlugin | None" = None
+
+
+def get_tripwire() -> "DlintPytestPlugin | None":
+    return _ACTIVE
+
+
+class _JitProxy:
+    """Wraps one jitted callable; counts real XLA compiles per call via
+    the ``_cache_size()`` delta.  Everything else passes through."""
+
+    __slots__ = ("_jitted", "_plugin", "_site", "compiles")
+
+    def __init__(self, jitted, plugin: "DlintPytestPlugin", site: tuple) -> None:
+        self._jitted = jitted
+        self._plugin = plugin
+        self._site = site
+        self.compiles = 0
+
+    def _cache_size(self) -> int:
+        try:
+            return self._jitted._cache_size()
+        except Exception:
+            return -1  # API drift: compile detection degrades, never breaks
+
+    def __call__(self, *args, **kwargs):
+        before = self._cache_size()
+        out = self._jitted(*args, **kwargs)
+        after = self._cache_size()
+        if before >= 0 and after > before:
+            self.compiles += after - before
+            self._plugin._record_compile(self._site, self.compiles, after - before)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+class DlintPytestPlugin:
+    """pytest plugin enforcing the compiles-per-shape-class budget."""
+
+    def __init__(self) -> None:
+        self.root = Path(__file__).resolve().parents[3]
+        self.budget = 1
+        self.json_path = "/tmp/dlint_tripwire.json"
+        self.programs: dict[str, dict] = {}
+        self.undeclared: dict[str, dict] = {}
+        self.violations: list[dict] = []
+        self._creations: dict[tuple, int] = defaultdict(int)
+        self._ann_cache: dict[str, dict[int, str]] = {}
+        self._nodeid = "<collection>"
+        self._orig_jit = None
+        self.report: dict | None = None
+
+    # ------------------------------------------------------------ plumbing
+
+    def _declared_name(self, filename: str, lineno: int) -> str | None:
+        """The `# jit-cache:` annotation on the creating line (or the line
+        above it), from a cached tokenize scan of the source file."""
+        table = self._ann_cache.get(filename)
+        if table is None:
+            table = {}
+            try:
+                text = Path(filename).read_text(encoding="utf-8")
+                for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                    if tok.type == tokenize.COMMENT:
+                        m = _JIT_CACHE_RE.search(tok.string)
+                        if m:
+                            table[tok.start[0]] = m.group(1)
+            except (OSError, tokenize.TokenError, IndentationError, SyntaxError):
+                pass
+            self._ann_cache[filename] = table
+        return table.get(lineno) or table.get(lineno - 1)
+
+    def _site(self) -> tuple:
+        """(rel, line, func, declared_program, key_repr) of the frame that
+        called jax.jit — the first frame outside this module."""
+        here = __file__
+        f = sys._getframe(2)
+        while f is not None and f.f_code.co_filename == here:
+            f = f.f_back
+        if f is None:
+            return ("<unknown>", 0, "", None, "")
+        filename = f.f_code.co_filename
+        lineno = f.f_lineno
+        try:
+            rel = str(Path(filename).resolve().relative_to(self.root))
+        except ValueError:
+            rel = filename
+        declared = self._declared_name(filename, lineno)
+        # the executor convention names the cache key `key` — it IS the
+        # shape class, so read it straight out of the creating frame
+        key = f.f_locals.get("key")
+        key_repr = repr(key)[:512] if key is not None else ""
+        return (rel, lineno, f.f_code.co_name, declared, key_repr)
+
+    def _program(self, name: str) -> dict:
+        return self.programs.setdefault(
+            name,
+            {"creations": 0, "compiles": 0, "keys": set(), "over_budget": 0},
+        )
+
+    def _violate(self, kind: str, program: str, detail: str) -> None:
+        self.violations.append(
+            {
+                "kind": kind,
+                "program": program,
+                "test": self._nodeid,
+                "detail": detail,
+            }
+        )
+        try:
+            from parseable_tpu.utils.metrics import DEVICE_RECOMPILES
+
+            DEVICE_RECOMPILES.labels(program).inc()
+        except Exception:
+            pass
+
+    def _record_creation(self) -> tuple:
+        site = self._site()
+        rel, lineno, func, declared, key_repr = site
+        if declared:
+            prog = self._program(declared)
+            prog["creations"] += 1
+            if key_repr:
+                prog["keys"].add(key_repr)
+                self._creations[(declared, key_repr, self._nodeid)] += 1
+                n = self._creations[(declared, key_repr, self._nodeid)]
+                if n == self.budget + 2:  # +1 slack for one benign race
+                    prog["over_budget"] += 1
+                    self._violate(
+                        "duplicate-creation",
+                        declared,
+                        f"jit program built {n}x for one cache key within "
+                        f"one test (site {rel}:{lineno} in {func}; key "
+                        f"{key_repr}) — the program cache is not serving "
+                        "warm keys",
+                    )
+        else:
+            und = self.undeclared.setdefault(
+                f"{rel}:{lineno}", {"creations": 0, "compiles": 0, "func": func}
+            )
+            und["creations"] += 1
+        return site
+
+    def _record_compile(self, site: tuple, total: int, delta: int) -> None:
+        rel, lineno, func, declared, _key = site
+        if declared:
+            prog = self._program(declared)
+            prog["compiles"] += delta
+            if total == self.budget + 1:
+                prog["over_budget"] += 1
+                self._violate(
+                    "recompile",
+                    declared,
+                    f"one jit proxy compiled {total}x (budget "
+                    f"{self.budget}; site {rel}:{lineno} in {func}) — a "
+                    "cached program should compile once per shape class",
+                )
+        else:
+            und = self.undeclared.setdefault(
+                f"{rel}:{lineno}", {"creations": 0, "compiles": 0, "func": func}
+            )
+            und["compiles"] += delta
+
+    # --------------------------------------------------------- pytest hooks
+
+    def pytest_configure(self, config) -> None:
+        global _ACTIVE
+        import jax
+
+        if self._orig_jit is not None:
+            return
+        self._orig_jit = jax.jit
+        plugin = self
+        orig = jax.jit
+
+        def _dlint_jit(fun, *args, **kwargs):
+            jitted = orig(fun, *args, **kwargs)
+            site = plugin._record_creation()
+            return _JitProxy(jitted, plugin, site)
+
+        jax.jit = _dlint_jit
+        _ACTIVE = self
+        # read the knobs only after the patch is installed: this import
+        # pulls in the package, which may jit at import time
+        from parseable_tpu.config import dlint_options
+
+        opts = dlint_options()
+        self.budget = opts["budget"]
+        self.json_path = opts["json_path"]
+
+    def pytest_unconfigure(self, config) -> None:
+        global _ACTIVE
+        if self._orig_jit is not None:
+            import jax
+
+            jax.jit = self._orig_jit
+            self._orig_jit = None
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def pytest_runtest_setup(self, item) -> None:
+        self._nodeid = item.nodeid
+
+    def assemble_report(self) -> dict:
+        return {
+            "version": 1,
+            "clean": not self.violations,
+            "budget": self.budget,
+            "programs": {
+                name: {
+                    "creations": p["creations"],
+                    "compiles": p["compiles"],
+                    "distinct_keys": len(p["keys"]),
+                    "over_budget": p["over_budget"],
+                }
+                for name, p in sorted(self.programs.items())
+            },
+            "undeclared": dict(sorted(self.undeclared.items())),
+            "violations": self.violations,
+        }
+
+    def pytest_sessionfinish(self, session, exitstatus) -> None:
+        self.report = self.assemble_report()
+        try:
+            Path(self.json_path).write_text(
+                json.dumps(self.report, indent=2) + "\n", encoding="utf-8"
+            )
+        except OSError:
+            pass
+        if not self.report["clean"] and session.exitstatus == 0:
+            session.exitstatus = 1
+
+    def pytest_terminal_summary(self, terminalreporter) -> None:
+        tr = terminalreporter
+        report = self.report or self.assemble_report()
+        tr.section("dlint recompilation tripwire")
+        tr.write_line(
+            f"budget: {report['budget']} compile(s) per program per shape class"
+        )
+        for name, p in report["programs"].items():
+            tr.write_line(
+                f"tpu_recompiles_total{{program=\"{name}\"}} "
+                f"{p['over_budget']} (built {p['creations']}, compiled "
+                f"{p['compiles']}, {p['distinct_keys']} shape class(es))"
+            )
+        if report["undeclared"]:
+            tr.write_line(
+                f"undeclared jit sites (tracked, not enforced): "
+                f"{len(report['undeclared'])}"
+            )
+        for v in report["violations"]:
+            tr.write_line(
+                f"VIOLATION [{v['kind']}] {v['program']} in {v['test']}: "
+                f"{v['detail']}"
+            )
+        if report["clean"]:
+            tr.write_line("dlint tripwire: clean")

@@ -226,6 +226,24 @@ def nsan_options() -> dict:
     }
 
 
+def dlint_options() -> dict:
+    """Knobs for the device-path recompilation tripwire (analysis/device).
+
+    Same placement rationale as psan_options: declared here so every
+    P_DLINT* knob rides the config-drift rule's README guarantee. P_DLINT
+    itself is read by tests/conftest.py before this package imports; it is
+    listed here for the same documentation guarantee.
+
+    P_DLINT_BUDGET: compiles allowed per jit proxy (a cached program
+    compiles once per shape class, so 1 is the honest default).
+    P_DLINT_JSON: where the tripwire writes its per-program report."""
+    return {
+        "enabled": _env_bool("P_DLINT", False),
+        "budget": _env_int("P_DLINT_BUDGET", 1),
+        "json_path": _env("P_DLINT_JSON", "/tmp/dlint_tripwire.json"),
+    }
+
+
 @dataclass
 class Options:
     """All server options. Defaults mirror the reference (src/cli.rs:135-641)."""

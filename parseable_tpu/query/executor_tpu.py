@@ -75,6 +75,7 @@ from parseable_tpu.utils.metrics import (
     DEVICE_BYTES_TO_DEVICE,
     DEVICE_EXECUTE_TIME,
     DEVICE_JIT_PROGRAMS,
+    DEVICE_RECOMPILES,
     DEVICE_TRANSFER_BYTES,
 )
 from parseable_tpu.utils.timeutil import parse_duration, parse_rfc3339
@@ -950,19 +951,58 @@ def _chan_merge_m2(acc_n, acc_s, acc_m2, b_n, b_s, b_m2):
 
 # Jitted programs cached process-wide: two identical queries (or two
 # executors in one query lifetime) reuse the compiled XLA executable.
-_PROGRAM_CACHE: dict[tuple, Callable] = {}
+_PROGRAM_CACHE: dict[tuple, Callable] = {}  # jit-cache: executor
+
+# Every (program-family, cache-key) ever built. A rebuild of an identical
+# key is a recompile — impossible while the cache holds the entry, so the
+# recompile counter reads 0 in steady state; nonzero means eviction or
+# key churn. PROGRAM_BUILDS is the plain testable total (warm-query
+# regression tests assert it does not move on a second run).
+_PROGRAM_KEYS_BUILT: set = set()
+PROGRAM_BUILDS = [0]
 
 _TRANSFER_COUNT = [0]
 
 
-def _timed_readback(x, stats: dict | None = None) -> np.ndarray:
+def _note_program_build(program: str, key: tuple, stats: dict | None = None) -> None:
+    """Account one call-time program build for `program` under cache `key`:
+    the tpu_jit_programs gauge, the per-query route_stats counters the
+    stages.programs entry reads, and — when this exact key was already
+    built once — the tpu_recompiles_total{program} family the dlint
+    tripwire budgets."""
+    PROGRAM_BUILDS[0] += 1
+    DEVICE_JIT_PROGRAMS.inc()
+    if stats is not None:
+        stats["programs_built"] = stats.get("programs_built", 0) + 1
+    try:
+        marker = (program, hash(key))
+    except TypeError:
+        marker = (program, repr(key))
+    if marker in _PROGRAM_KEYS_BUILT:
+        DEVICE_RECOMPILES.labels(program).inc()
+        if stats is not None:
+            stats["recompiles"] = stats.get("recompiles", 0) + 1
+    else:
+        _PROGRAM_KEYS_BUILT.add(marker)
+
+
+# the ONE declared d2h readback — waits out pending compute, times pure
+# transfer, prices wire bytes into route_stats and the link-profile EWMA
+# sync-boundary: every hot-path device->host read must flow through here
+def _timed_readback(x, stats: dict | None = None, dtype=np.float64) -> np.ndarray:
     """Device->host readback with link-profile recording. Pending compute
     is waited out BEFORE the timer starts so the d2h sample measures pure
     transfer — compute/compile waits folded in would poison the adaptive
-    cost model's latency EWMA. `stats` (a route_stats dict) gets the f32
-    wire bytes added for EXPLAIN ANALYZE observability."""
+    cost model's latency EWMA. `stats` (a route_stats dict) gets the wire
+    bytes added for EXPLAIN ANALYZE observability.
+
+    `dtype` is the HOST-side representation (np.float64 for f32
+    accumulators headed into host arithmetic; None keeps the device
+    dtype — int32 indices, bool masks). Wire bytes are priced at the
+    DEVICE dtype's width capped at 4: the device layer is f32/int32/bool
+    end to end, so a float64 host target still crossed the link as f32."""
     if isinstance(x, np.ndarray):
-        return np.asarray(x, np.float64)
+        return np.asarray(x) if dtype is None else np.asarray(x, dtype)
     try:
         # wait for pending compute FIRST so the timing below is pure
         # transfer — folding compile/compute waits into the d2h latency
@@ -971,13 +1011,17 @@ def _timed_readback(x, stats: dict | None = None) -> np.ndarray:
     except Exception:
         pass
     t0 = _time.perf_counter()
-    arr = np.asarray(x, np.float64)
+    arr = np.asarray(x) if dtype is None else np.asarray(x, dtype)
+    try:
+        wire = arr.size * min(x.dtype.itemsize, 4)
+    except (AttributeError, TypeError):
+        wire = arr.size * 4
     if stats is not None:
-        stats["d2h_bytes"] += arr.size * 4
+        stats["d2h_bytes"] += wire
     try:
         from parseable_tpu.ops.link import get_link
 
-        get_link().record_d2h(arr.size * 4, _time.perf_counter() - t0)
+        get_link().record_d2h(wire, _time.perf_counter() - t0)
     except Exception:
         pass
     return arr
@@ -1107,6 +1151,8 @@ def _device_ones(block_rows: int, mesh=None):
             import jax
 
             row_s, _ = _mesh_shardings(mesh)
+            # cached once per (rows, mesh): not a data-sized ship —
+            # link-priced: amortized across every block that reuses it
             ones = jax.device_put(ones, row_s)
         else:
             ones = jnp.asarray(ones)
@@ -1131,6 +1177,12 @@ class TpuQueryExecutor(QueryExecutor):
             "cpu_fallback": 0,  # unsupported-on-device / error / budget
             "h2d_bytes": 0,
             "d2h_bytes": 0,
+            # program-cache traffic (stages.programs reads these): builds
+            # this query, cache hits this query, rebuilds of a key that
+            # was already built once (0 in steady state)
+            "programs_built": 0,
+            "programs_reused": 0,
+            "recompiles": 0,
         }
         # query-aware prefetch (ops/prefetch.py): built lazily on the first
         # source-id'd block, once the scan has published its ordered stub
@@ -1181,7 +1233,7 @@ class TpuQueryExecutor(QueryExecutor):
             link = get_link(self.options)
             hotset_obj = get_hotset()
             compiler = PredicateCompiler()
-            for table in tables:
+            for table in tables:  # device-hot: per-block filter dispatch
                 if sel.where is None:
                     yield table
                     continue
@@ -1216,8 +1268,11 @@ class TpuQueryExecutor(QueryExecutor):
 
                     luts = [jnp.asarray(l) for l in compiler.collect_luts(sel.where, enc)]
                     mask = compiler.trace(sel.where, enc, dev, luts)
-                    mask_np = np.asarray(mask)[: enc.num_rows]
-                    self.route_stats["d2h_bytes"] += enc.num_rows  # bool mask
+                    # bool-mask readback rides the declared, priced
+                    # _timed_readback boundary (host-sync discipline)
+                    mask_np = _timed_readback(mask, self.route_stats, dtype=None)[
+                        : enc.num_rows
+                    ]
                     # materialize defensively: projection needs row values,
                     # which a hot stub doesn't carry (selects don't receive
                     # stubs today — session gates use_hot_stubs on
@@ -1490,6 +1545,9 @@ class TpuQueryExecutor(QueryExecutor):
                 import jax
 
                 _, rep_s = _mesh_shardings(self.mesh)
+                # priced: the zeroed accumulator ships once per query
+                self.route_stats["h2d_bytes"] += int(host.nbytes)
+                DEVICE_BYTES_TO_DEVICE.labels("acc").inc(host.nbytes)
                 return jax.device_put(host, rep_s)
             return jnp.asarray(host)
 
@@ -1499,6 +1557,9 @@ class TpuQueryExecutor(QueryExecutor):
                 import jax
 
                 _, rep_s = _mesh_shardings(self.mesh)
+                # priced: once-per-query sparse accumulator ship
+                self.route_stats["h2d_bytes"] += int(host.nbytes)
+                DEVICE_BYTES_TO_DEVICE.labels("acc").inc(host.nbytes)
                 return jax.device_put(host, rep_s)
             return jnp.asarray(host)
 
@@ -1508,7 +1569,13 @@ class TpuQueryExecutor(QueryExecutor):
             decode alongside)."""
             arr = _timed_readback(acc_dev, self.route_stats)
             dists = [
-                (si, dk, np.asarray(d).reshape(num_groups, dk.capacity))
+                (
+                    si,
+                    dk,
+                    _timed_readback(d, self.route_stats, dtype=None).reshape(
+                        num_groups, dk.capacity
+                    ),
+                )
                 for si, dk, d in zip(distinct_idx, dkeys, dacc)
             ]
             pcts = [
@@ -1686,7 +1753,7 @@ class TpuQueryExecutor(QueryExecutor):
         # this query's group space: stop paying encode+transfer per block
         # just to rediscover it — the rest of the scan is host-side
         force_cpu_rest = False
-        for table in blocks(tables):
+        for table in blocks(tables):  # device-hot: per-block agg dispatch
             self._check_deadline()
             if force_cpu_rest:
                 self.route_stats["cpu_fallback"] += 1
@@ -1868,7 +1935,16 @@ class TpuQueryExecutor(QueryExecutor):
                     import jax
 
                     _, rep_s = _mesh_shardings(self.mesh)
-                    put_rep = lambda a: jax.device_put(a, rep_s)
+
+                    def put_rep(a, _s=rep_s, _jax=jax):
+                        # priced: LUT/remap ships ride outside _transfer's
+                        # packed payload, so the link accounting must see
+                        # them here (no latency sample — the puts are async
+                        # and a probe would serialize the batch loop)
+                        n = int(getattr(a, "nbytes", 0))
+                        self.route_stats["h2d_bytes"] += n
+                        DEVICE_BYTES_TO_DEVICE.labels("lut").inc(n)
+                        return _jax.device_put(a, _s)
                 else:
                     put_rep = jnp.asarray
                 dev_luts = tuple(put_rep(l) for l in luts)
@@ -2169,12 +2245,19 @@ class TpuQueryExecutor(QueryExecutor):
                 _, idx = jax.lax.top_k(score, k)
                 return a[:, idx], idx
 
-            program = jax.jit(run)
-            DEVICE_JIT_PROGRAMS.inc()
+            # no donate_argnums: `acc` outlives the top-k (the flush path
+            # reads it) and donation round-trips on tunneled PJRT backends
+            # (see the executor.dense note in _get_program)
+            program = jax.jit(run)  # jit-cache: executor.topk
+            _note_program_build("executor.topk", key, self.route_stats)
             _PROGRAM_CACHE[key] = program
+        else:
+            self.route_stats["programs_reused"] += 1
         gathered, idx = program(acc)
-        self.route_stats["d2h_bytes"] += gathered.size * 4 + idx.size * 4
-        return np.asarray(gathered, np.float64), np.asarray(idx)
+        return (
+            _timed_readback(gathered, self.route_stats),
+            _timed_readback(idx, self.route_stats, dtype=None),
+        )
 
     # ----------------------------------------------- high-card (block-local)
 
@@ -2231,8 +2314,20 @@ class TpuQueryExecutor(QueryExecutor):
             import jax
 
             row_s, rep_s = _mesh_shardings(self.mesh)
-            put_rep = lambda a: jax.device_put(a, rep_s)
-            put_row = lambda a: jax.device_put(a, row_s)
+
+            def put_rep(a, _s=rep_s, _jax=jax):
+                # priced: local-fold LUT ships bypass _transfer's packed
+                # payload, so the link accounting happens at the ship
+                n = int(getattr(a, "nbytes", 0))
+                self.route_stats["h2d_bytes"] += n
+                DEVICE_BYTES_TO_DEVICE.labels("lut").inc(n)
+                return _jax.device_put(a, _s)
+
+            def put_row(a, _s=row_s, _jax=jax):
+                n = int(getattr(a, "nbytes", 0))
+                self.route_stats["h2d_bytes"] += n
+                DEVICE_BYTES_TO_DEVICE.labels("lut").inc(n)
+                return _jax.device_put(a, _s)
         else:
             put_rep = jnp.asarray
             put_row = jnp.asarray
@@ -2315,6 +2410,8 @@ class TpuQueryExecutor(QueryExecutor):
             raise UnsupportedOnDevice(f"group key column {column} missing")
         if col.values is not None and len(col.values):
             return col.values
+        # rare readback — hot-set entries strip host copies, so
+        # sync-boundary: re-materializing the codes is the only source left
         return np.asarray(dev[column])
 
     def _get_local_program(
@@ -2359,6 +2456,7 @@ class TpuQueryExecutor(QueryExecutor):
         )
         prog = _PROGRAM_CACHE.get(key)
         if prog is not None:
+            self.route_stats["programs_reused"] += 1
             return prog
 
         import jax
@@ -2463,11 +2561,13 @@ class TpuQueryExecutor(QueryExecutor):
         else:
             body = fold
 
-        prog = jax.jit(body)
+        # no donate_argnums here either — same tunneled-PJRT round-trip
+        # pessimization as the executor.dense note in _get_program
+        prog = jax.jit(body)  # jit-cache: executor.local
         if mesh is not None:
             global MESH_PROGRAMS_BUILT
             MESH_PROGRAMS_BUILT += 1
-        DEVICE_JIT_PROGRAMS.inc()
+        _note_program_build("executor.local", key, self.route_stats)
         _PROGRAM_CACHE[key] = prog
         return prog
 
@@ -2586,7 +2686,9 @@ class TpuQueryExecutor(QueryExecutor):
                 _timed_readback(h, self.route_stats)
             ).reshape(num_groups, DEVICE_NB)
         mat = h.reshape(num_groups, DEVICE_NB)
-        colsum = np.asarray(jnp.sum(mat, axis=0))  # NB-sized, ~8 KB
+        # NB-sized (~8 KB) occupancy probe gating a readback 10-50x larger
+        # sync-boundary: when sparse — the probe pays for itself
+        colsum = np.asarray(jnp.sum(mat, axis=0))
         active = np.nonzero(colsum > 0)[0]
         if len(active) * 2 >= DEVICE_NB:
             return np.asarray(
@@ -2728,6 +2830,7 @@ class TpuQueryExecutor(QueryExecutor):
         )
         prog = _PROGRAM_CACHE.get(key)
         if prog is not None:
+            self.route_stats["programs_reused"] += 1
             return prog
 
         import jax
@@ -2958,13 +3061,13 @@ class TpuQueryExecutor(QueryExecutor):
         # NOTE: no donate_argnums — buffer donation forces a synchronous
         # round trip on tunneled PJRT backends (measured 424ms vs 10ms per
         # call); the G-sized accumulator copy is far cheaper
-        prog = jax.jit(prog_body)
+        prog = jax.jit(prog_body)  # jit-cache: executor.dense
         if mesh is not None:
             global MESH_PROGRAMS_BUILT, GROUP_SHARDED_PROGRAMS_BUILT
             MESH_PROGRAMS_BUILT += 1
             if shard_groups > 1:
                 GROUP_SHARDED_PROGRAMS_BUILT += 1
-        DEVICE_JIT_PROGRAMS.inc()
+        _note_program_build("executor.dense", key, self.route_stats)
         _PROGRAM_CACHE[key] = prog
         return prog
 
@@ -3209,8 +3312,8 @@ def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
 
         row_s, _ = _mesh_shardings(mesh)
 
-        def put_row(a):
-            return jax.device_put(a, row_s)
+        def put_row(a):  # link-priced: per-column nbytes summed into the
+            return jax.device_put(a, row_s)  # scan tick below the loop
 
         for name, col in enc.columns.items():
             dev[name] = put_row(col.values)
@@ -3258,6 +3361,7 @@ def _transfer(enc: EncodedBatch, mesh=None) -> tuple[dict, int]:
         # block on 1-in-8 puts to keep the link profile honest without
         # serializing the pipeline (puts are otherwise async)
         try:
+            # sync-boundary: sampled link-profile probe
             dev_payload.block_until_ready()
             from parseable_tpu.ops.link import get_link
 

@@ -426,6 +426,13 @@ class QuerySession:
                     # tiering state for this process + this query's prefetch
                     # outcome (None on the CPU engine — no device tier)
                     "hotset": self._hotset_stage(result.stats.get("device_routes")),
+                    # program-cache traffic: XLA builds vs cache hits this
+                    # query, plus rebuilds of an already-built key — the
+                    # dlint tripwire's budget holds "recompiles" at 0
+                    # (None on the CPU engine — nothing jits)
+                    "programs": self._programs_stage(
+                        result.stats.get("device_routes")
+                    ),
                 },
             }
         )
@@ -479,6 +486,19 @@ class QuerySession:
             if routes and k in routes:
                 snap[k] = routes[k]
         return snap
+
+    def _programs_stage(self, routes: dict | None) -> dict | None:
+        """stats.stages.programs: this query's program-cache traffic —
+        warm queries should read built == 0 and recompiles == 0; a nonzero
+        recompile means a cache key was rebuilt (eviction or key churn),
+        the condition the dlint tripwire turns red on."""
+        if self.engine != "tpu" or routes is None:
+            return None
+        return {
+            "built": int(routes.get("programs_built", 0)),
+            "reused": int(routes.get("programs_reused", 0)),
+            "recompiles": int(routes.get("recompiles", 0)),
+        }
 
     def _maybe_log_slow(self, select: S.Select, elapsed: float, stats: dict) -> None:
         """Slow-query log (gated by P_SLOW_QUERY_MS; 0 disables): one

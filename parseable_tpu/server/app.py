@@ -1398,7 +1398,9 @@ async def put_user(request: web.Request) -> web.Response:
     if raw:
         body = json.loads(raw)
     roles = set(body.get("roles", []))
-    password = state.rbac.put_user(username, roles=roles)
+    # off the event loop: put_user runs the scrypt KDF (~10^2 ms by design —
+    # the same head-of-line hazard as the auth slow path above)
+    password = await _run_traced(state, state.rbac.put_user, username, None, roles)
     await _run_traced(state, state.save_rbac)
     fanout_to_ingestors(state, "POST", "/api/v1/internal/rbac/reload", kinds=("ingestor", "querier", "all"))
     return web.json_response(password)

@@ -202,6 +202,12 @@ DEVICE_TRANSFER_BYTES = _gauge(
 DEVICE_JIT_PROGRAMS = _gauge(
     "tpu_jit_programs", "XLA programs compiled (jit cache misses)", []
 )
+DEVICE_RECOMPILES = _counter(
+    "tpu_recompiles",
+    "XLA program builds for a program-cache key that was already built once "
+    "(0 in steady state; the dlint tripwire budgets these per shape class)",
+    ["program"],
+)
 # --- tiering under memory pressure (ops/hotset.py, ops/enccache.py) ------
 # first-class hot-set state: what's resident, how hard eviction is working,
 # and entries rejected for exceeding the whole budget (previously a silent

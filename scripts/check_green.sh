@@ -15,8 +15,14 @@ if command -v g++ >/dev/null 2>&1; then
   export P_NATIVE_REQUIRED=1
 fi
 
+# P_DLINT=1 arms the device-path recompilation tripwire for the tier-1 run
+# itself: jax.jit is wrapped session-wide and any cached program compiling
+# past its per-shape-class budget turns the run red (report:
+# /tmp/dlint_tripwire.json). DLINT=0 disarms it along with the static gate.
+t1_dlint="${DLINT:-1}"
+if [ "$t1_dlint" != "0" ]; then t1_dlint=1; fi
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+timeout -k 10 870 env JAX_PLATFORMS=cpu P_DLINT="$t1_dlint" python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -64,6 +70,24 @@ if [ "${WLINT:-1}" != "0" ]; then
   echo "check_green: wlint GREEN (report: /tmp/wlint.json)"
 else
   echo "check_green: wlint SKIPPED (WLINT=0)"
+fi
+
+# device-path gate: dlint (parseable_tpu/analysis/device/) — jit sites on
+# query paths must ride a declared program cache, host syncs reachable from
+# `# device-hot` loops must be `# sync-boundary` annotated, device_put/get
+# must be priced into link accounting, plus traced-control-flow, dtype
+# promotion, donation hazards and bench timing discipline. Full-tree run
+# (the host-sync rule walks the cross-file call graph; sub-second). Opt out
+# with DLINT=0 — which also disarms the P_DLINT tripwire on the tier-1 run
+# above; the JSON report lands at /tmp/dlint.json either way it runs.
+if [ "${DLINT:-1}" != "0" ]; then
+  if ! python -m parseable_tpu.analysis.device --json-out /tmp/dlint.json; then
+    echo "check_green: DLINT RED (unbaselined findings; see above and /tmp/dlint.json)" >&2
+    exit 1
+  fi
+  echo "check_green: dlint GREEN (report: /tmp/dlint.json)"
+else
+  echo "check_green: dlint SKIPPED (DLINT=0)"
 fi
 
 # dynamic-analysis gate: the same tier-1 suite again under the psan runtime
@@ -162,14 +186,14 @@ else
   echo "check_green: obs cluster SKIPPED (OBS_CLUSTER=0)"
 fi
 
-# merged artifact: one /tmp/analysis_summary.json rolling up the four
-# static/dynamic analysis reports (plint, psan, nsan, wlint) so a snapshot
+# merged artifact: one /tmp/analysis_summary.json rolling up the five
+# static/dynamic analysis reports (plint, psan, nsan, wlint, dlint) so a snapshot
 # reviewer reads one file. Skipped gates simply have no section; the merge
 # itself never turns the gate red.
 python - <<'PY' || echo "check_green: analysis summary merge failed (non-fatal)" >&2
 import json, pathlib
 out = {}
-for name in ("plint", "psan", "nsan", "wlint"):
+for name in ("plint", "psan", "nsan", "wlint", "dlint"):
     p = pathlib.Path(f"/tmp/{name}.json")
     if not p.exists():
         continue

@@ -46,8 +46,19 @@ _PSAN = os.environ.get("P_PSAN", "").strip().lower() in ("1", "true", "yes", "on
 # the same os.environ read and historic-hook registration as psan.
 _NSAN = os.environ.get("P_NSAN", "").strip().lower() in ("1", "true", "yes", "on")
 
+# dlint: the device-path recompilation tripwire (parseable_tpu/analysis/
+# device/tripwire.py). P_DLINT=1 wraps jax.jit for the whole session — the
+# plugin's pytest_configure must patch BEFORE collection imports anything
+# that jits (decorator-time jits in ops/kernels.py included), hence the
+# same os.environ read and historic-hook registration as psan/nsan above.
+_DLINT = os.environ.get("P_DLINT", "").strip().lower() in ("1", "true", "yes", "on")
+
 
 def pytest_configure(config):
+    if _DLINT and not config.pluginmanager.has_plugin("dlint"):
+        from parseable_tpu.analysis.device.tripwire import DlintPytestPlugin
+
+        config.pluginmanager.register(DlintPytestPlugin(), "dlint")
     if _PSAN and not config.pluginmanager.has_plugin("psan"):
         from parseable_tpu.analysis.psan.plugin import PsanPytestPlugin
 

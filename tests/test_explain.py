@@ -128,7 +128,11 @@ def test_explain_analyze_surfaces_device_routes(loaded):
     assert set(routes) == {
         "device_warm", "device_cold", "cpu_adaptive", "cpu_fallback",
         "h2d_bytes", "d2h_bytes",
+        # program-cache accounting (dlint): XLA builds/reuses per query and
+        # rebuilt-key recompiles — 0 recompiles is the steady-state contract
+        "programs_built", "programs_reused", "recompiles",
     }
+    assert int(routes["recompiles"]) == 0
     total_blocks = sum(
         int(routes[k])
         for k in ("device_warm", "device_cold", "cpu_adaptive", "cpu_fallback")
